@@ -1,0 +1,58 @@
+"""ray_tpu: a TPU-native distributed AI runtime.
+
+Tasks, actors, and distributed objects on a shared-memory object store;
+gang/placement-group scheduling over the TPU slice/host/chip topology; mesh
+collectives as XLA programs over ICI; streaming datasets; distributed
+training, tuning, and serving layers built on JAX/XLA/Pallas.
+
+Core API mirrors the reference framework's (`ray.init/remote/get/put/wait`)
+so users can switch with minimal changes:
+
+    import ray_tpu
+
+    ray_tpu.init()
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    ray_tpu.get(f.remote(21))  # 42
+
+NOTE: this top-level module must stay importable without JAX — worker
+processes and the core runtime do not pay the JAX import cost. JAX-dependent
+layers live under ray_tpu.parallel / ops / models / train and import lazily.
+"""
+
+from ray_tpu._version import __version__  # noqa: F401
+from ray_tpu.api import (  # noqa: F401
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core.runtime_context import get_runtime_context  # noqa: F401
+from ray_tpu import exceptions  # noqa: F401
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "method",
+    "get_actor",
+    "ObjectRef",
+    "get_runtime_context",
+    "exceptions",
+]

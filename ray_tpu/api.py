@@ -1,0 +1,118 @@
+"""Top-level public API: init/shutdown/remote/get/put/wait/kill.
+
+Mirrors the reference's core API surface (python/ray/_private/worker.py —
+ray.init :1227, ray.get :2578, ray.put :2693, ray.wait :2758, ray.kill :2939)
+on the TPU-native runtime.
+"""
+
+from __future__ import annotations
+
+import atexit
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu.core import runtime_context
+from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+
+_runtime = None
+
+
+def init(num_workers: Optional[int] = None,
+         object_store_memory: Optional[int] = None,
+         ignore_reinit_error: bool = True,
+         **kwargs):
+    """Start the local runtime: worker pool + shared-memory object store.
+
+    Returns the runtime context. Safe to call twice with
+    ``ignore_reinit_error`` (the default).
+    """
+    global _runtime
+    if runtime_context.is_initialized():
+        if ignore_reinit_error:
+            return runtime_context.get_runtime_context()
+        raise RuntimeError("ray_tpu.init() called twice")
+    from ray_tpu.core.runtime import Runtime
+
+    _runtime = Runtime(num_workers=num_workers,
+                       object_store_memory=object_store_memory)
+    runtime_context.set_core(_runtime)
+    atexit.register(shutdown)
+    return runtime_context.get_runtime_context()
+
+
+def is_initialized() -> bool:
+    return runtime_context.is_initialized()
+
+
+def shutdown():
+    global _runtime
+    if _runtime is not None:
+        _runtime.shutdown()
+        _runtime = None
+    if runtime_context.get_core_or_none() is not None:
+        runtime_context.set_core(None)
+
+
+def remote(*args, **options):
+    """Decorator converting a function into a RemoteFunction or a class into
+    an ActorClass (reference: python/ray/_private/worker.py ray.remote)."""
+
+    def decorate(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, options)
+        if callable(obj):
+            return RemoteFunction(obj, options)
+        raise TypeError("@remote requires a function or class")
+
+    if len(args) == 1 and not options and (callable(args[0]) or isinstance(args[0], type)):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+    return decorate
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        timeout: Optional[float] = None) -> Any:
+    """Block until object(s) are available and return the value(s)."""
+    core = runtime_context.get_core()
+    if isinstance(refs, ObjectRef):
+        return core.get_objects([refs], timeout=timeout)[0]
+    refs = list(refs)
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRefs, got {type(r).__name__}")
+    if not refs:
+        return []
+    return core.get_objects(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    """Store a value in the object store and return a ref."""
+    core = runtime_context.get_core()
+    return core.put_object(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None
+         ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    """Wait until ``num_returns`` of ``refs`` are ready."""
+    core = runtime_context.get_core()
+    refs = list(refs)
+    return core.wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    """Forcibly terminate an actor (reference: ray.kill, worker.py:2939)."""
+    core = runtime_context.get_core()
+    core.kill_actor(actor.actor_id, no_restart=no_restart)
+
+
+def method(**opts):
+    """Decorator for actor methods to set options (num_returns)."""
+
+    def wrap(fn):
+        fn.__rtpu_method_opts__ = opts
+        return fn
+
+    return wrap

@@ -1,0 +1,141 @@
+"""Actor API (reference: python/ray/actor.py — ActorClass :566, ActorHandle :1223).
+
+An actor is a stateful worker: ``@ray_tpu.remote`` on a class gives an
+``ActorClass``; ``.remote(...)`` instantiates it in a dedicated worker
+process; method calls are submitted in order and return ObjectRefs.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, List, Optional, Union
+
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core import runtime_context
+
+
+class ActorMethod:
+    """Bound method accessor: ``handle.method.remote(args)``."""
+
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        core = runtime_context.get_core()
+        refs = core.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=self._num_returns,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._name!r} cannot be called directly; "
+            f"use .{self._name}.remote()."
+        )
+
+
+class ActorHandle:
+    """Serializable reference to a live actor."""
+
+    def __init__(self, actor_id: ActorID, method_opts: Optional[dict] = None):
+        self._actor_id = actor_id
+        self._method_opts = method_opts or {}
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        opts = self._method_opts.get(name, {})
+        return ActorMethod(self, name, num_returns=opts.get("num_returns", 1))
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_opts))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+
+class ActorClass:
+    """A class decorated with ``@ray_tpu.remote``."""
+
+    def __init__(self, cls, default_options: Optional[dict] = None):
+        self._cls = cls
+        self._default_options = dict(default_options or {})
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote()."
+        )
+
+    def options(self, **opts) -> "_ActorOptionWrapper":
+        merged = dict(self._default_options)
+        merged.update(opts)
+        return _ActorOptionWrapper(self, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._default_options)
+
+    def _remote(self, args, kwargs, options) -> ActorHandle:
+        core = runtime_context.get_core()
+        if not hasattr(core, "create_actor") or not hasattr(core, "register_function"):
+            raise NotImplementedError(
+                "creating actors from inside workers is not supported yet"
+            )
+        opts = dict(options)
+        opts["has_async_methods"] = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(self._cls, inspect.isfunction)
+        )
+        # Collect per-method options set via @ray_tpu.method(...) so that
+        # handles (including deserialized ones) know e.g. num_returns.
+        method_opts = {
+            name: m.__rtpu_method_opts__
+            for name, m in inspect.getmembers(self._cls, inspect.isfunction)
+            if getattr(m, "__rtpu_method_opts__", None)
+        }
+        opts["method_opts"] = method_opts
+        cls_fn_id = core.register_function(self._cls)
+        actor_id = core.create_actor(cls_fn_id, args, kwargs, opts)
+        return ActorHandle(actor_id, method_opts)
+
+    @property
+    def underlying_class(self):
+        return self._cls
+
+    def __reduce__(self):
+        return (_rebuild_actor_class, (self._cls, self._default_options))
+
+
+def _rebuild_actor_class(cls, default_options):
+    return ActorClass(cls, default_options)
+
+
+class _ActorOptionWrapper:
+    def __init__(self, ac: ActorClass, options: dict):
+        self._ac = ac
+        self._options = options
+
+    def remote(self, *args, **kwargs):
+        return self._ac._remote(args, kwargs, self._options)
+
+
+def get_actor(name: str) -> ActorHandle:
+    """Look up a named actor (reference: ray.get_actor, worker.py:2904)."""
+    core = runtime_context.get_core()
+    if hasattr(core, "get_named_actor"):
+        aid = core.get_named_actor(name)
+        return ActorHandle(aid, core.get_actor_method_opts(aid))
+    return core.get_actor_handle(name)

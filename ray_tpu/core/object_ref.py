@@ -1,0 +1,82 @@
+"""ObjectRef: a distributed future (reference: python/ray/includes/object_ref.pxi).
+
+Serialization contract: pickling an ObjectRef emits a resolver call so that a
+ref nested inside task args / put objects is reconstructed on the receiving
+process bound to *that* process's core client (reference nests refs the same
+way via CoreWorker serialization context). While the driver serializes task
+args it also *collects* every ref it encounters so the scheduler can wait on
+dependencies (reference: LocalDependencyResolver).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+from ray_tpu.core.ids import ObjectID
+
+_collect_ctx = threading.local()
+
+
+def begin_ref_collection() -> List["ObjectRef"]:
+    refs: List[ObjectRef] = []
+    _collect_ctx.refs = refs
+    return refs
+
+
+def end_ref_collection():
+    _collect_ctx.refs = None
+
+
+def _resolve_ref(oid_bytes: bytes) -> "ObjectRef":
+    """Unpickle hook: rebuild the ref bound to the local core client."""
+    from ray_tpu.core import runtime_context
+
+    return ObjectRef(ObjectID(oid_bytes), core=runtime_context.get_core_or_none())
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_core", "__weakref__")
+
+    def __init__(self, oid: ObjectID, core=None):
+        self._id = oid
+        self._core = core
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        from ray_tpu.core import runtime_context
+
+        core = self._core or runtime_context.get_core()
+        return core.get_objects([self], timeout=timeout)[0]
+
+    def __reduce__(self):
+        refs = getattr(_collect_ctx, "refs", None)
+        if refs is not None:
+            refs.append(self)
+        return (_resolve_ref, (self._id.binary(),))
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __await__(self):
+        """Allow ``await ref`` inside async actors."""
+        from ray_tpu.core import runtime_context
+
+        core = self._core or runtime_context.get_core()
+        fut = core.as_future(self)
+        return fut.__await__()

@@ -1,0 +1,27 @@
+"""Compile the shm store C++ extension on first use (cached by mtime)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_shm_store.cc")
+_LIB = os.path.join(_DIR, "_shm_store.so")
+_lock = threading.Lock()
+
+
+def ensure_built() -> str:
+    """Build _shm_store.so if missing or stale; return its path."""
+    with _lock:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+            return _LIB
+        tmp = _LIB + f".tmp{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            "-o", tmp, _SRC, "-lpthread", "-lrt",
+        ]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, _LIB)
+        return _LIB

@@ -1,0 +1,177 @@
+"""Python client for the shared-memory object store.
+
+Zero-copy reads: ``get`` returns a memoryview directly over the shared
+mapping; the object stays pinned (refcount) until ``release``. The plasma
+equivalent in the reference exposes the same create/seal/get/release/delete
+lifecycle (src/ray/object_manager/plasma/client.h), but over a unix-socket
+protocol — here every process talks to the mapping directly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store.build import ensure_built
+from ray_tpu.exceptions import ObjectStoreFullError, ObjectTimeoutError
+
+
+def _load_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(ensure_built())
+    lib.rtpu_store_create.restype = ctypes.c_void_p
+    lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+    lib.rtpu_store_connect.restype = ctypes.c_void_p
+    lib.rtpu_store_connect.argtypes = [ctypes.c_char_p]
+    lib.rtpu_store_close.argtypes = [ctypes.c_void_p]
+    lib.rtpu_store_destroy.argtypes = [ctypes.c_char_p]
+    lib.rtpu_store_base.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.rtpu_store_base.argtypes = [ctypes.c_void_p]
+    lib.rtpu_store_mapping_size.restype = ctypes.c_uint64
+    lib.rtpu_store_mapping_size.argtypes = [ctypes.c_void_p]
+    lib.rtpu_store_create_object.restype = ctypes.c_uint64
+    lib.rtpu_store_create_object.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.rtpu_store_seal.restype = ctypes.c_int
+    lib.rtpu_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_get.restype = ctypes.c_int
+    lib.rtpu_store_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rtpu_store_release.restype = ctypes.c_int
+    lib.rtpu_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_contains.restype = ctypes.c_int
+    lib.rtpu_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_delete.restype = ctypes.c_int
+    lib.rtpu_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_stats.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 4
+    lib.rtpu_store_prefault.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+class ShmObjectStore:
+    """Handle to a shared-memory object store (creator or connected client)."""
+
+    def __init__(self, name: str, handle: int, owner: bool):
+        self._name = name
+        self._handle = handle
+        self._owner = owner
+        lib = _get_lib()
+        size = lib.rtpu_store_mapping_size(handle)
+        base = lib.rtpu_store_base(handle)
+        # A writable zero-copy view over the whole mapping.
+        self._mv = memoryview(
+            ctypes.cast(base, ctypes.POINTER(ctypes.c_uint8 * size)).contents
+        ).cast("B")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    def create(cls, name: str, capacity: int, table_slots: int = 0) -> "ShmObjectStore":
+        handle = _get_lib().rtpu_store_create(name.encode(), capacity, table_slots)
+        if not handle:
+            raise OSError(f"failed to create shm store {name!r}")
+        return cls(name, handle, owner=True)
+
+    @classmethod
+    def connect(cls, name: str) -> "ShmObjectStore":
+        handle = _get_lib().rtpu_store_connect(name.encode())
+        if not handle:
+            raise OSError(f"failed to connect to shm store {name!r}")
+        return cls(name, handle, owner=False)
+
+    def close(self):
+        if self._handle:
+            try:
+                self._mv.release()
+            except BufferError:
+                pass  # zero-copy views still exported; mapping stays alive
+            _get_lib().rtpu_store_close(self._handle)
+            if self._owner:
+                _get_lib().rtpu_store_destroy(self._name.encode())
+            self._handle = 0
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    # -- object lifecycle ----------------------------------------------------
+
+    def create_object(self, oid: ObjectID, size: int) -> memoryview:
+        """Allocate an unsealed object; returns a writable view of its payload."""
+        off = _get_lib().rtpu_store_create_object(self._handle, oid.binary(), size)
+        if off == 0:
+            raise ObjectStoreFullError(
+                f"cannot allocate {size} bytes for {oid} (store full or duplicate)"
+            )
+        return self._mv[off : off + size]
+
+    def seal(self, oid: ObjectID):
+        if _get_lib().rtpu_store_seal(self._handle, oid.binary()) != 0:
+            raise ValueError(f"seal failed for {oid}")
+
+    def put(self, oid: ObjectID, data) -> None:
+        """Allocate + copy + seal in one call."""
+        view = memoryview(data).cast("B")
+        dst = self.create_object(oid, view.nbytes)
+        dst[:] = view
+        self.seal(oid)
+
+    def get(self, oid: ObjectID, timeout_ms: int = -1) -> memoryview:
+        """Blocking get; returns a zero-copy read view, pinning the object.
+
+        Call :meth:`release` when the view is no longer needed.
+        """
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = _get_lib().rtpu_store_get(
+            self._handle, oid.binary(), timeout_ms, ctypes.byref(off), ctypes.byref(size)
+        )
+        if rc != 0:
+            raise ObjectTimeoutError(f"object {oid} not available within {timeout_ms}ms")
+        return self._mv[off.value : off.value + size.value]
+
+    def release(self, oid: ObjectID):
+        _get_lib().rtpu_store_release(self._handle, oid.binary())
+
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(_get_lib().rtpu_store_contains(self._handle, oid.binary()))
+
+    def delete(self, oid: ObjectID):
+        _get_lib().rtpu_store_delete(self._handle, oid.binary())
+
+    def prefault(self):
+        """Blocking eager population of the heap (content-preserving)."""
+        _get_lib().rtpu_store_prefault(self._handle)
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        _get_lib().rtpu_store_stats(self._handle, *[ctypes.byref(v) for v in vals])
+        return {
+            "heap_size": vals[0].value,
+            "bytes_in_use": vals[1].value,
+            "num_objects": vals[2].value,
+            "evictions": vals[3].value,
+        }
+
+
+def default_store_capacity() -> int:
+    """~30% of system memory, capped at 4 GiB (single host; reference caps at
+    30% of memory too — python/ray/_private/ray_constants.py)."""
+    try:
+        total = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):
+        total = 8 << 30
+    return min(int(total * 0.3), 4 << 30)

@@ -1,0 +1,199 @@
+"""Control-plane wire protocol between driver and workers.
+
+Messages are tuples ``(tag, ...)`` sent over ``multiprocessing.connection``
+(pickle framing). This is the single-node analogue of the reference's gRPC
+services: the task conn carries what ``CoreWorkerService.PushTask``
+(src/ray/protobuf/core_worker.proto:444) carries, and the data conn carries
+the worker→owner requests that in the reference go over dedicated RPCs
+(get/put/submit from inside tasks).
+
+Values travel as *payload descriptors*::
+
+    ("inline", bytes)         - serialization container inlined in the message
+    ("shm", oid_bytes)        - stored in the shared-memory object store
+
+Args additionally carry ``inline_values``: {oid_bytes: payload} for resolved
+dependencies whose values live only in the owner's memory store.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import ObjectRef, begin_ref_collection, end_ref_collection
+
+# driver -> worker (task conn)
+MSG_TASK = "task"                  # (MSG_TASK, task_id_b, fn_id, args_payload, inline_values, return_id_bytes: List[bytes])
+MSG_REGISTER_FN = "reg_fn"         # (MSG_REGISTER_FN, fn_id, pickled_fn)
+MSG_CREATE_ACTOR = "create_actor"  # (.., actor_id_b, cls_fn_id, args_payload, inline_values, opts)
+MSG_ACTOR_CALL = "actor_call"      # (.., task_id_b, actor_id_b, method, args_payload, inline_values, return_id_bytes)
+MSG_SHUTDOWN = "shutdown"
+
+# worker -> driver (task conn)
+MSG_READY = "ready"                # (MSG_READY, pid)
+MSG_DONE = "done"                  # (MSG_DONE, task_id_b, [payload, ...])
+MSG_ERROR = "error"                # (MSG_ERROR, task_id_b, pickled_exc_payload)
+MSG_ACTOR_READY = "actor_ready"    # (.., actor_id_b)
+MSG_ACTOR_ERROR = "actor_error"    # (.., actor_id_b, pickled_exc_payload)
+
+# worker -> driver (data conn, request/response)
+REQ_GET = "get"                    # (REQ_GET, [oid_bytes], timeout) -> ("ok", {oid: payload}) | ("err", payload)
+REQ_PUT_META = "put_meta"          # (REQ_PUT_META, oid_bytes, payload_or_none) -> ("ok",)
+REQ_SUBMIT = "submit"              # (REQ_SUBMIT, fn_id, pickled_fn_or_none, args_payload, inline_values, n_returns, ref_oids) -> ("ok", [oid_bytes])
+REQ_ACTOR_CALL = "actor_call"      # worker-side actor handle call -> ("ok", [oid_bytes])
+REQ_WAIT = "wait"                  # (REQ_WAIT, [oid_bytes], num_returns, timeout_s) -> ("ok", ready, rest)
+REQ_KV = "kv"                      # (REQ_KV, op, key, value) -> ("ok", value)
+REQ_GET_ACTOR = "get_actor"        # (REQ_GET_ACTOR, name) -> ("ok", handle_payload)
+
+class ErrorValue:
+    """Marker wrapping an exception stored as an object's value.
+
+    Distinguishes "the task failed with E" from "the task returned the
+    exception object E" (the reference uses RayTaskError subclassing for the
+    same purpose). ``raise_if_error`` re-raises at get().
+    """
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+    def __reduce__(self):
+        return (ErrorValue, (self.error,))
+
+
+def raise_if_error(value):
+    if isinstance(value, ErrorValue):
+        raise value.error
+    return value
+
+
+class _TopLevelDep:
+    """Sentinel replacing a resolved top-level ObjectRef arg in transit."""
+
+    __slots__ = ("oid_bytes",)
+
+    def __init__(self, oid_bytes: bytes):
+        self.oid_bytes = oid_bytes
+
+    def __reduce__(self):
+        return (_TopLevelDep, (self.oid_bytes,))
+
+
+Payload = Tuple[str, bytes]
+
+
+def serialize_args(
+    args: tuple, kwargs: dict, store=None
+) -> Tuple[Payload, List[ObjectRef]]:
+    """Serialize an (args, kwargs) pair, collecting nested ObjectRefs.
+
+    Large payloads go to the shm ``store`` when provided.
+    Returns (payload_descriptor, collected_refs).
+    """
+    refs = begin_ref_collection()
+    try:
+        pickled, views, total = serialization.serialize((args, kwargs))
+    finally:
+        end_ref_collection()
+    payload = _store_or_inline(pickled, views, total, store)
+    return payload, refs
+
+
+def serialize_value(value: Any, store=None) -> Payload:
+    pickled, views, total = serialization.serialize(value)
+    return _store_or_inline(pickled, views, total, store)
+
+
+def _store_or_inline(pickled, views, total, store) -> Payload:
+    if store is not None and total > serialization.INLINE_THRESHOLD:
+        oid = ObjectID.from_random()
+        try:
+            dst = store.create_object(oid, total)
+            serialization.write_container(dst, pickled, views)
+            store.seal(oid)
+            return ("shm", oid.binary())
+        except Exception:
+            pass  # fall back to inline on store pressure
+    out = bytearray(total)
+    serialization.write_container(memoryview(out), pickled, views)
+    return ("inline", bytes(out))
+
+
+class _Pin:
+    """Keeps one shm object pinned until every wrapped buffer is collected."""
+
+    __slots__ = ("_store", "_oid", "count")
+
+    def __init__(self, store, oid, count):
+        self._store = store
+        self._oid = oid
+        self.count = count
+
+    def decref(self):
+        self.count -= 1
+        if self.count == 0:
+            try:
+                self._store.release(self._oid)
+            except Exception:
+                pass
+
+
+def shm_unpack(store, oid: ObjectID, timeout_ms: int = 0) -> Any:
+    """Fetch + deserialize an object from the shm store with zero-copy
+    buffers that keep the object pinned for the lifetime of the deserialized
+    arrays (the reference pins plasma objects-in-use per worker the same way:
+    src/ray/core_worker/store_provider/plasma_store_provider.h).
+
+    Callers only reach this once the owner reports the object sealed, so a
+    miss means it was LRU-evicted -> ObjectLostError (the reference raises
+    the same; reconstruction via lineage is a later milestone).
+    """
+    import ctypes
+    import weakref
+
+    from ray_tpu.exceptions import ObjectLostError, ObjectTimeoutError
+
+    try:
+        mv = store.get(oid, timeout_ms=timeout_ms)
+    except ObjectTimeoutError:
+        raise ObjectLostError(
+            f"object {oid} was evicted from the object store before it was "
+            f"read (store under memory pressure)"
+        ) from None
+    wrapped_count = 0
+    pin_box = []
+
+    def wrap(chunk: memoryview):
+        nonlocal wrapped_count
+        # ctypes arrays are weakref-able buffer-protocol objects; a numpy
+        # array reconstructed over one keeps it (and thus the pin) alive.
+        blk = (ctypes.c_uint8 * chunk.nbytes).from_buffer(chunk)
+        wrapped_count += 1
+        pin_box.append(blk)
+        return blk
+
+    try:
+        value = serialization.unpack(mv, wrap_buffer=wrap)
+    except Exception:
+        store.release(oid)
+        raise
+    if wrapped_count == 0:
+        store.release(oid)
+    else:
+        pin = _Pin(store, oid, wrapped_count)
+        for blk in pin_box:
+            weakref.finalize(blk, pin.decref)
+    return value
+
+
+def deserialize_payload(payload: Payload, store=None) -> Any:
+    """Decode a payload descriptor (zero-copy + pinned for shm payloads)."""
+    kind, data = payload
+    if kind == "inline":
+        return serialization.unpack(data)
+    if kind == "shm":
+        return shm_unpack(store, ObjectID(data))
+    raise ValueError(f"unknown payload kind {kind!r}")

@@ -1,0 +1,924 @@
+"""Driver-side runtime: object directory, worker pool, and task scheduler.
+
+Single-node analogue of the reference's driver CoreWorker + raylet + GCS
+rolled into the driver process (the multi-node split arrives with the cluster
+control plane):
+
+- Object directory + memory store: the ownership table. The driver owns every
+  object; small values live inline here, large values in the shm store
+  (reference: src/ray/core_worker/store_provider/memory_store/memory_store.h,
+  reference ownership model: src/ray/core_worker/reference_count.h:61).
+- Worker pool: forks/pools worker processes, tracks idle/busy, restarts
+  actors (reference: src/ray/raylet/worker_pool.h:153).
+- Scheduler: FIFO dispatch of ready tasks (deps resolved) onto idle workers;
+  per-actor ordered queues (reference: raylet local_task_manager.cc dispatch
+  loop + actor_task_submitter.h ordering).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from multiprocessing.connection import Listener
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core import protocol, serialization
+from ray_tpu.core.ids import (
+    ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID, make_task_id,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core import runtime_context
+from ray_tpu.core.object_store.store import ShmObjectStore, default_store_capacity
+from ray_tpu.core.protocol import _TopLevelDep
+from ray_tpu.exceptions import (
+    ActorDiedError, GetTimeoutError, TaskError, WorkerCrashedError,
+)
+
+
+class _ObjectEntry:
+    __slots__ = ("event", "payload", "callbacks")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.payload = None  # protocol.Payload once ready
+        self.callbacks: List[Callable[[], None]] = []
+
+
+class _TaskSpec:
+    __slots__ = (
+        "task_id", "fn_id", "args_payload", "deps", "return_ids", "options",
+        "actor_id", "method", "pending_deps",
+    )
+
+    def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
+                 actor_id=None, method=None):
+        self.task_id = task_id
+        self.fn_id = fn_id
+        self.args_payload = args_payload
+        self.deps = deps
+        self.return_ids = return_ids
+        self.options = options
+        self.actor_id = actor_id
+        self.method = method
+        self.pending_deps = 0
+
+
+class _Worker:
+    __slots__ = (
+        "worker_id", "proc", "task_conn", "data_conn", "ready", "alive",
+        "registered_fns", "actor_id", "inflight", "reader", "data_thread",
+        "send_lock", "blocked",
+    )
+
+    def __init__(self, worker_id, proc):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.task_conn = None
+        self.data_conn = None
+        self.ready = False
+        self.alive = True
+        self.registered_fns = set()
+        self.actor_id: Optional[ActorID] = None
+        self.inflight: Optional[_TaskSpec] = None
+        self.reader: Optional[threading.Thread] = None
+        self.data_thread: Optional[threading.Thread] = None
+        # Connection.send is not thread-safe; every task_conn.send goes
+        # through this lock (reader thread, dispatchers, shutdown).
+        self.send_lock = threading.Lock()
+        # True while the worker is blocked in a driver-side get/wait; used
+        # by the scheduler to oversubscribe the pool instead of deadlocking.
+        self.blocked = False
+
+
+class _ActorState:
+    __slots__ = (
+        "actor_id", "worker", "cls_fn_id", "creation_args_payload",
+        "creation_deps", "opts", "queue", "ready", "dead", "death_cause",
+        "restarts_left", "name", "creation_event",
+    )
+
+    def __init__(self, actor_id, cls_fn_id, args_payload, deps, opts):
+        self.actor_id = actor_id
+        self.worker: Optional[_Worker] = None
+        self.cls_fn_id = cls_fn_id
+        self.creation_args_payload = args_payload
+        self.creation_deps = deps
+        self.opts = opts
+        self.queue: deque = deque()
+        self.ready = False
+        self.dead = False
+        self.death_cause: Optional[BaseException] = None
+        self.restarts_left = opts.get("max_restarts", 0)
+        self.name = opts.get("name")
+        self.creation_event = threading.Event()
+
+
+class Runtime:
+    """The driver core client. One per driver process."""
+
+    def __init__(self, num_workers: Optional[int] = None,
+                 object_store_memory: Optional[int] = None,
+                 session_name: Optional[str] = None):
+        self.node_id = NodeID.from_random()
+        self.worker_id = WorkerID.from_random()
+        self.job_id = JobID.from_random()
+        self.num_workers = num_workers or max(2, (os.cpu_count() or 4))
+        self._session = session_name or f"rtpu_{os.getpid()}_{self.node_id.hex()[:8]}"
+        self._sock_path = os.path.join("/tmp", self._session + ".sock")
+        self._authkey = os.urandom(16)
+
+        self.store = ShmObjectStore.create(
+            "/" + self._session,
+            object_store_memory or default_store_capacity(),
+        )
+
+        self._lock = threading.Lock()
+        self._objects: Dict[ObjectID, _ObjectEntry] = {}
+        self._functions: Dict[bytes, bytes] = {}  # fn_id -> pickled
+        self._fn_cache: Dict[int, Tuple[bytes, bytes]] = {}  # id(fn) -> (fn_id, pickled)
+        self._workers: Dict[WorkerID, _Worker] = {}
+        self._idle: deque = deque()
+        self._task_queue: deque = deque()
+        self._actors: Dict[ActorID, _ActorState] = {}
+        self._named_actors: Dict[str, ActorID] = {}
+        self._kv: Dict[str, Any] = {}
+        self._shutdown = False
+        self._spawning = 0
+
+        self._listener = Listener(self._sock_path, family="AF_UNIX",
+                                  authkey=self._authkey)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rtpu-accept"
+        )
+        self._accept_thread.start()
+        for _ in range(self.num_workers):
+            self._spawn_worker()
+
+    # ------------------------------------------------------------------ pool
+
+    def _spawn_worker(self, tpu: bool = False) -> _Worker:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update(
+            RTPU_ADDRESS=self._sock_path,
+            RTPU_AUTH=self._authkey.hex(),
+            RTPU_STORE="/" + self._session,
+            RTPU_NODE_ID=self.node_id.hex(),
+            RTPU_WORKER_ID=worker_id.hex(),
+        )
+        if not tpu:
+            # Plain pool workers skip TPU/PJRT plugin registration, which
+            # this environment's sitecustomize triggers off these vars and
+            # which costs ~2s of jax import per process. Workers that land
+            # TPU actors (num_tpus>0) are spawned with the env intact.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            if env.get("JAX_PLATFORMS") == "axon":
+                env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env, stdin=subprocess.DEVNULL,
+        )
+        w = _Worker(worker_id, proc)
+        with self._lock:
+            self._workers[worker_id] = w
+            self._spawning += 1
+        return w
+
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+                hello = conn.recv()
+            except (OSError, EOFError, Exception):
+                if self._shutdown:
+                    return
+                continue
+            if hello[0] != "hello":
+                conn.close()
+                continue
+            _, kind, wid_bytes = hello
+            wid = WorkerID(wid_bytes)
+            with self._lock:
+                w = self._workers.get(wid)
+            if w is None:
+                conn.close()
+                continue
+            if kind == "task":
+                w.task_conn = conn
+                w.reader = threading.Thread(
+                    target=self._worker_reader, args=(w,), daemon=True,
+                    name=f"rtpu-read-{wid.hex()[:6]}",
+                )
+                w.reader.start()
+            else:
+                w.data_conn = conn
+                w.data_thread = threading.Thread(
+                    target=self._data_server, args=(w,), daemon=True,
+                    name=f"rtpu-data-{wid.hex()[:6]}",
+                )
+                w.data_thread.start()
+
+    # --------------------------------------------------------- reader threads
+
+    def _worker_reader(self, w: _Worker):
+        try:
+            while True:
+                msg = w.task_conn.recv()
+                tag = msg[0]
+                if tag == protocol.MSG_READY:
+                    with self._lock:
+                        w.ready = True
+                        self._spawning -= 1
+                        # Workers pre-claimed for an actor never join the
+                        # general idle pool.
+                        if w.actor_id is None:
+                            self._idle.append(w)
+                    self._dispatch()
+                elif tag == protocol.MSG_DONE:
+                    self._on_task_done(w, msg[1], msg[2])
+                elif tag == protocol.MSG_ERROR:
+                    self._on_task_error(w, msg[1], msg[2])
+                elif tag == protocol.MSG_ACTOR_READY:
+                    self._on_actor_ready(w, ActorID(msg[1]))
+                elif tag == protocol.MSG_ACTOR_ERROR:
+                    self._on_actor_error(w, ActorID(msg[1]), msg[2])
+        except (EOFError, OSError):
+            pass
+        finally:
+            self._on_worker_death(w)
+
+    def _on_worker_death(self, w: _Worker):
+        if self._shutdown:
+            return
+        with self._lock:
+            if not w.alive:
+                return
+            w.alive = False
+            self._workers.pop(w.worker_id, None)
+            try:
+                self._idle.remove(w)
+            except ValueError:
+                pass
+            inflight = w.inflight
+            w.inflight = None
+            actor_id = w.actor_id
+        if inflight is not None:
+            err = WorkerCrashedError(
+                f"worker {w.worker_id.hex()[:8]} died while executing task"
+            )
+            self._store_error(inflight.return_ids, err)
+        if actor_id is not None:
+            self._handle_actor_worker_death(actor_id)
+        else:
+            # replace pool capacity
+            if not self._shutdown:
+                self._spawn_worker()
+        self._dispatch()
+
+    # ------------------------------------------------------------- functions
+
+    def register_function(self, fn) -> bytes:
+        """Pickle a function once; returns its fn_id (content hash).
+
+        The reference exports pickled functions to the GCS function table once
+        per job (python/ray/_private/function_manager.py); here the registry
+        lives in the driver and is lazily pushed per worker.
+        """
+        key = id(fn)
+        cached = self._fn_cache.get(key)
+        if cached is not None and cached[1] is fn:
+            return cached[0]
+        pickled = serialization.pack(fn)
+        import hashlib
+
+        fn_id = hashlib.blake2b(pickled, digest_size=16).digest()
+        with self._lock:
+            self._functions[fn_id] = pickled
+        self._fn_cache[key] = (fn_id, fn)
+        return fn_id
+
+    def _send_msg(self, w: _Worker, msg) -> None:
+        with w.send_lock:
+            w.task_conn.send(msg)
+
+    def _ensure_fn_on_worker(self, w: _Worker, fn_id: bytes):
+        if fn_id not in w.registered_fns:
+            self._send_msg(
+                w, (protocol.MSG_REGISTER_FN, fn_id, self._functions[fn_id])
+            )
+            w.registered_fns.add(fn_id)
+
+    # ------------------------------------------------------------ object dir
+
+    def _entry(self, oid: ObjectID) -> _ObjectEntry:
+        with self._lock:
+            e = self._objects.get(oid)
+            if e is None:
+                e = _ObjectEntry()
+                self._objects[oid] = e
+            return e
+
+    def _store_payload(self, oid: ObjectID, payload: protocol.Payload):
+        e = self._entry(oid)
+        # The event-set + callback-swap must happen under the same lock the
+        # registration sites use for their check-and-append, or a registration
+        # can land on the dead list after the swap (lost wakeup).
+        with self._lock:
+            e.payload = payload
+            e.event.set()
+            callbacks, e.callbacks = e.callbacks, []
+        for cb in callbacks:
+            cb()
+
+    def _store_error(self, oids: List[ObjectID], err: BaseException):
+        payload = protocol.serialize_value(protocol.ErrorValue(err), store=None)
+        for oid in oids:
+            self._store_payload(oid, payload)
+
+    # ------------------------------------------------------------- scheduler
+
+    def submit_task(self, fn_id: bytes, args: tuple, kwargs: dict,
+                    num_returns: int = 1, options: Optional[dict] = None
+                    ) -> List[ObjectRef]:
+        options = options or {}
+        task_id = make_task_id(self.job_id)
+        args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
+        args_payload, _ = protocol.serialize_args(args2, kwargs2, store=self.store)
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        spec = _TaskSpec(task_id, fn_id, args_payload, deps, return_ids, options)
+        for rid in return_ids:
+            self._entry(rid)
+        self._enqueue(spec)
+        return [ObjectRef(rid, core=self) for rid in return_ids]
+
+    def _swap_top_level_refs(self, args, kwargs):
+        deps: List[ObjectID] = []
+
+        def swap(v):
+            if isinstance(v, ObjectRef):
+                deps.append(v.id)
+                return _TopLevelDep(v.binary())
+            return v
+
+        return (tuple(swap(a) for a in args),
+                {k: swap(v) for k, v in kwargs.items()}, deps)
+
+    def _enqueue(self, spec: _TaskSpec):
+        unresolved = []
+        for dep in spec.deps:
+            e = self._entry(dep)
+            if not e.event.is_set():
+                unresolved.append(e)
+        spec.pending_deps = len(unresolved)
+        if unresolved:
+            lock = threading.Lock()
+
+            def on_ready():
+                with lock:
+                    spec.pending_deps -= 1
+                    ready = spec.pending_deps == 0
+                if ready:
+                    self._queue_ready(spec)
+
+            for e in unresolved:
+                with self._lock:
+                    if e.event.is_set():
+                        on_ready()
+                    else:
+                        e.callbacks.append(on_ready)
+        else:
+            self._queue_ready(spec)
+
+    def _queue_ready(self, spec: _TaskSpec):
+        if spec.actor_id is not None:
+            state = self._actors[spec.actor_id]
+            with self._lock:
+                state.queue.append(spec)
+            self._dispatch_actor(state)
+        else:
+            with self._lock:
+                self._task_queue.append(spec)
+            self._dispatch()
+
+    def _maybe_scale_up(self):
+        """Spawn an extra worker when queued tasks cannot run because every
+        pool worker is blocked in a driver-side get/wait (otherwise nested
+        task graphs deadlock). The reference raylet similarly releases the
+        CPU of workers blocked in ray.get (worker_pool/lease semantics)."""
+        with self._lock:
+            if self._shutdown or not self._task_queue or self._idle:
+                return
+            if self._spawning > 0:
+                return
+            pool = [w for w in self._workers.values()
+                    if w.alive and w.actor_id is None]
+            if pool and all(w.blocked or not w.ready for w in pool):
+                spawn = True
+            else:
+                spawn = False
+        if spawn:
+            self._spawn_worker()
+
+    def _dispatch(self):
+        while True:
+            with self._lock:
+                if not self._task_queue or not self._idle:
+                    return
+                w = self._idle.popleft()
+                if not w.alive:
+                    continue
+                spec = self._task_queue.popleft()
+                w.inflight = spec
+            self._send_task(w, spec)
+
+    def _dispatch_actor(self, state: _ActorState):
+        spec = None
+        failed: List[_TaskSpec] = []
+        with self._lock:
+            w = state.worker
+            if state.dead and state.queue:
+                failed = list(state.queue)
+                state.queue.clear()
+            elif (
+                w is not None and state.ready and not state.dead
+                and w.inflight is None and state.queue
+            ):
+                spec = state.queue.popleft()
+                w.inflight = spec
+        for f in failed:
+            self._store_error(
+                f.return_ids,
+                ActorDiedError(str(state.death_cause or "actor is dead")),
+            )
+        if spec is not None:
+            self._send_actor_call(w, spec)
+
+    def _inline_values_for(self, deps: List[ObjectID]) -> Dict[bytes, Any]:
+        out: Dict[bytes, Any] = {}
+        for dep in deps:
+            e = self._objects[dep]
+            kind, data = e.payload
+            if kind == "inline":
+                out[dep.binary()] = e.payload
+            else:
+                out[dep.binary()] = None  # worker reads shm directly
+        return out
+
+    def _send_task(self, w: _Worker, spec: _TaskSpec):
+        try:
+            self._ensure_fn_on_worker(w, spec.fn_id)
+            inline_values = self._inline_values_for(spec.deps)
+            self._send_msg(w, (
+                protocol.MSG_TASK, spec.task_id.binary(), spec.fn_id,
+                spec.args_payload, inline_values,
+                [r.binary() for r in spec.return_ids],
+            ))
+        except (OSError, EOFError, BrokenPipeError):
+            self._on_worker_death(w)
+
+    def _send_actor_call(self, w: _Worker, spec: _TaskSpec):
+        try:
+            inline_values = self._inline_values_for(spec.deps)
+            self._send_msg(w, (
+                protocol.MSG_ACTOR_CALL, spec.task_id.binary(),
+                spec.actor_id.binary(), spec.method, spec.args_payload,
+                inline_values, [r.binary() for r in spec.return_ids],
+            ))
+        except (OSError, EOFError, BrokenPipeError):
+            self._on_worker_death(w)
+
+    def _on_task_done(self, w: _Worker, task_id_b: bytes, payloads):
+        with self._lock:
+            spec = w.inflight
+            w.inflight = None
+        if spec is not None:
+            for rid, payload in zip(spec.return_ids, payloads):
+                self._store_payload(rid, payload)
+        self._worker_now_idle(w)
+
+    def _on_task_error(self, w: _Worker, task_id_b: bytes, err_payload):
+        with self._lock:
+            spec = w.inflight
+            w.inflight = None
+        if spec is not None:
+            for rid in spec.return_ids:
+                self._store_payload(rid, err_payload)
+        self._worker_now_idle(w)
+
+    def _worker_now_idle(self, w: _Worker):
+        if w.actor_id is not None:
+            state = self._actors.get(w.actor_id)
+            if state is not None:
+                self._dispatch_actor(state)
+            return
+        with self._lock:
+            if w.alive:
+                self._idle.append(w)
+        self._dispatch()
+
+    # ------------------------------------------------------------------- api
+
+    def get_objects(self, refs: List[ObjectRef], timeout: Optional[float] = None
+                    ) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            e = self._entry(ref.id)
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if not e.event.wait(remaining):
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+            out.append(protocol.raise_if_error(self._decode_entry(e)))
+        return out
+
+    def _decode_entry(self, e: _ObjectEntry):
+        kind, data = e.payload
+        if kind == "inline":
+            return serialization.unpack(data)
+        return protocol.shm_unpack(self.store, ObjectID(data))
+
+    def put_object(self, value: Any) -> ObjectRef:
+        payload = protocol.serialize_value(value, store=self.store)
+        oid = ObjectID(payload[1]) if payload[0] == "shm" else ObjectID.from_random()
+        self._store_payload(oid, payload)
+        return ObjectRef(oid, core=self)
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = {r.id: r for r in refs}
+        ready: List[ObjectRef] = []
+        cond = threading.Condition()
+
+        def notify():
+            with cond:
+                cond.notify_all()
+
+        for oid in list(pending):
+            e = self._entry(oid)
+            with self._lock:
+                if not e.event.is_set():
+                    e.callbacks.append(notify)
+        while True:
+            ready = [r for r in refs if self._objects[r.id].event.is_set()]
+            if len(ready) >= num_returns:
+                break
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                break
+            with cond:
+                cond.wait(remaining if remaining is None or remaining > 0 else 0)
+        ready_set = {r.id for r in ready[:num_returns]}
+        ready_list = [r for r in refs if r.id in ready_set]
+        rest = [r for r in refs if r.id not in ready_set]
+        return ready_list, rest
+
+    def as_future(self, ref: ObjectRef):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        e = self._entry(ref.id)
+
+        def resolve():
+            try:
+                v = self._decode_entry(e)
+            except BaseException as exc:  # noqa: BLE001
+                loop.call_soon_threadsafe(fut.set_exception, exc)
+                return
+            if isinstance(v, protocol.ErrorValue):
+                loop.call_soon_threadsafe(fut.set_exception, v.error)
+            else:
+                loop.call_soon_threadsafe(fut.set_result, v)
+
+        with self._lock:
+            if e.event.is_set():
+                resolve()
+            else:
+                e.callbacks.append(resolve)
+        return fut
+
+    # ----------------------------------------------------------------- actors
+
+    def create_actor(self, cls_fn_id: bytes, args: tuple, kwargs: dict,
+                     opts: Optional[dict] = None) -> ActorID:
+        opts = opts or {}
+        actor_id = ActorID.from_random()
+        args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
+        args_payload, _ = protocol.serialize_args(args2, kwargs2, store=self.store)
+        state = _ActorState(actor_id, cls_fn_id, args_payload, deps, opts)
+        with self._lock:
+            self._actors[actor_id] = state
+            name = opts.get("name")
+            if name:
+                if name in self._named_actors:
+                    raise ValueError(f"actor name {name!r} already taken")
+                self._named_actors[name] = actor_id
+        self._start_actor(state)
+        return actor_id
+
+    def _start_actor(self, state: _ActorState):
+        needs_tpu = state.opts.get("num_tpus", 0) > 0
+        w = None
+        if not needs_tpu:
+            # Prefer an idle pooled worker; else spawn fresh (+ replace pool).
+            with self._lock:
+                w = self._idle.popleft() if self._idle else None
+        if w is None:
+            w = self._spawn_worker(tpu=needs_tpu)
+        else:
+            self._spawn_worker()  # keep task-pool capacity
+        with self._lock:
+            w.actor_id = state.actor_id
+            state.worker = w
+        self._when_worker_ready(w, lambda: self._send_create_actor(w, state))
+
+    def _when_worker_ready(self, w: _Worker, fn):
+        def poll():
+            while not self._shutdown and w.alive:
+                if w.ready and w.task_conn is not None:
+                    fn()
+                    return
+                time.sleep(0.002)
+        if w.ready and w.task_conn is not None:
+            fn()
+        else:
+            threading.Thread(target=poll, daemon=True).start()
+
+    def _send_create_actor(self, w: _Worker, state: _ActorState):
+        try:
+            self._ensure_fn_on_worker(w, state.cls_fn_id)
+            inline_values = self._inline_values_for(state.creation_deps)
+            self._send_msg(w, (
+                protocol.MSG_CREATE_ACTOR, state.actor_id.binary(),
+                state.cls_fn_id, state.creation_args_payload, inline_values,
+                {k: v for k, v in state.opts.items() if k != "name"},
+            ))
+        except (OSError, EOFError, BrokenPipeError):
+            self._on_worker_death(w)
+
+    def _on_actor_ready(self, w: _Worker, actor_id: ActorID):
+        state = self._actors.get(actor_id)
+        if state is None:
+            return
+        state.ready = True
+        state.creation_event.set()
+        self._dispatch_actor(state)
+
+    def _on_actor_error(self, w: _Worker, actor_id: ActorID, err_payload):
+        state = self._actors.get(actor_id)
+        if state is None:
+            return
+        try:
+            v = protocol.deserialize_payload(err_payload, store=self.store)
+            err = v.error if isinstance(v, protocol.ErrorValue) else v
+        except Exception as e:  # noqa: BLE001
+            err = ActorDiedError(f"actor constructor failed: {e}")
+        self._mark_actor_dead(state, err)
+
+    def _mark_actor_dead(self, state: _ActorState, cause: BaseException):
+        with self._lock:
+            if state.dead:
+                return  # keep the original death cause
+            state.dead = True
+            state.ready = False
+            state.death_cause = cause
+            pending = list(state.queue)
+            state.queue.clear()
+        state.creation_event.set()
+        err = cause if isinstance(cause, ActorDiedError) else ActorDiedError(str(cause))
+        for spec in pending:
+            self._store_error(spec.return_ids, err)
+
+    def _handle_actor_worker_death(self, actor_id: ActorID):
+        state = self._actors.get(actor_id)
+        if state is None:
+            return
+        if state.restarts_left != 0 and not state.dead:
+            if state.restarts_left > 0:
+                state.restarts_left -= 1
+            state.ready = False
+            state.worker = None
+            self._start_actor(state)
+        else:
+            self._mark_actor_dead(
+                state, ActorDiedError("the actor's worker process died")
+            )
+
+    def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
+                          kwargs: dict, num_returns: int = 1) -> List[ObjectRef]:
+        state = self._actors.get(actor_id)
+        if state is None:
+            raise ActorDiedError(f"unknown actor {actor_id}")
+        task_id = make_task_id(self.job_id)
+        args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
+        args_payload, _ = protocol.serialize_args(args2, kwargs2, store=self.store)
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        for rid in return_ids:
+            self._entry(rid)
+        if state.dead:
+            refs = [ObjectRef(rid, core=self) for rid in return_ids]
+            self._store_error(
+                return_ids, ActorDiedError(str(state.death_cause or "actor is dead"))
+            )
+            return refs
+        spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
+                         actor_id=actor_id, method=method)
+        self._enqueue(spec)
+        return [ObjectRef(rid, core=self) for rid in return_ids]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        state = self._actors.get(actor_id)
+        if state is None:
+            return
+        if no_restart:
+            state.restarts_left = 0
+        with self._lock:
+            w = state.worker
+        self._mark_actor_dead(state, ActorDiedError("actor was killed via kill()"))
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.terminate()
+            except OSError:
+                pass
+
+    def get_actor_method_opts(self, actor_id: ActorID) -> dict:
+        state = self._actors.get(actor_id)
+        return state.opts.get("method_opts", {}) if state else {}
+
+    def get_named_actor(self, name: str) -> ActorID:
+        with self._lock:
+            aid = self._named_actors.get(name)
+        if aid is None:
+            raise ValueError(f"no actor named {name!r}")
+        return aid
+
+    # ------------------------------------------------------------ data server
+
+    def _data_server(self, w: _Worker):
+        conn = w.data_conn
+        try:
+            while True:
+                msg = conn.recv()
+                try:
+                    reply = self._handle_data_request(w, msg)
+                except BaseException as e:  # noqa: BLE001
+                    # Preserve the exception type (GetTimeoutError,
+                    # ActorDiedError, ...) so worker-side handlers behave
+                    # exactly like driver-side ones.
+                    reply = ("err", protocol.serialize_value(
+                        protocol.ErrorValue(e), store=None))
+                conn.send(reply)
+        except (EOFError, OSError):
+            pass
+
+    def _handle_data_request(self, w: _Worker, msg):
+        tag = msg[0]
+        if tag == protocol.REQ_GET:
+            _, oid_bytes_list, timeout_ms = msg
+            timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
+            deadline = None if timeout is None else time.monotonic() + timeout
+            payloads = {}
+            entries = [self._entry(ObjectID(b)) for b in oid_bytes_list]
+            if not all(e.event.is_set() for e in entries):
+                w.blocked = True
+                self._maybe_scale_up()
+            try:
+                for b, e in zip(oid_bytes_list, entries):
+                    remaining = None if deadline is None else max(
+                        0.0, deadline - time.monotonic())
+                    if not e.event.wait(remaining):
+                        raise GetTimeoutError("get() timed out in worker request")
+                    payloads[b] = e.payload
+            finally:
+                w.blocked = False
+            return ("ok", payloads)
+        if tag == protocol.REQ_PUT_META:
+            _, oid_bytes, payload = msg
+            oid = ObjectID(oid_bytes)
+            self._store_payload(oid, ("shm", oid_bytes) if payload is None else payload)
+            return ("ok",)
+        if tag == protocol.REQ_SUBMIT:
+            _, fn_id, pickled_fn, args_payload, inline_values, n_returns, options = msg
+            if pickled_fn is not None:
+                with self._lock:
+                    self._functions.setdefault(fn_id, pickled_fn)
+            deps = options.pop("__deps", [])
+            task_id = make_task_id(self.job_id)
+            return_ids = [ObjectID.from_random() for _ in range(n_returns)]
+            for rid in return_ids:
+                self._entry(rid)
+            spec = _TaskSpec(task_id, fn_id, args_payload,
+                             [ObjectID(d) for d in deps], return_ids, options)
+            self._enqueue(spec)
+            return ("ok", [r.binary() for r in return_ids])
+        if tag == protocol.REQ_ACTOR_CALL:
+            _, actor_id_b, method, args_payload, extra, n_returns = msg
+            state = self._actors.get(ActorID(actor_id_b))
+            if state is None:
+                raise ActorDiedError("unknown actor")
+            deps = [ObjectID(d) for d in extra.get("__deps", [])]
+            task_id = make_task_id(self.job_id)
+            return_ids = [ObjectID.from_random() for _ in range(n_returns)]
+            for rid in return_ids:
+                self._entry(rid)
+            spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
+                             actor_id=state.actor_id, method=method)
+            if state.dead:
+                self._store_error(
+                    return_ids,
+                    ActorDiedError(str(state.death_cause or "actor is dead")),
+                )
+            else:
+                self._enqueue(spec)
+            return ("ok", [r.binary() for r in return_ids])
+        if tag == protocol.REQ_WAIT:
+            _, oid_bytes_list, num_returns, timeout_s = msg
+            refs = [ObjectRef(ObjectID(b), core=self) for b in oid_bytes_list]
+            w.blocked = True
+            self._maybe_scale_up()
+            try:
+                ready, rest = self.wait(refs, num_returns=num_returns,
+                                        timeout=timeout_s)
+            finally:
+                w.blocked = False
+            return ("ok", [x.binary() for x in ready], [x.binary() for x in rest])
+        if tag == protocol.REQ_KV:
+            _, op, key, value = msg
+            if op == "get":
+                return ("ok", self._kv.get(key))
+            if op == "put":
+                self._kv[key] = value
+                return ("ok", None)
+            if op == "del":
+                self._kv.pop(key, None)
+                return ("ok", None)
+            raise ValueError(f"bad kv op {op}")
+        if tag == protocol.REQ_GET_ACTOR:
+            _, name = msg
+            aid = self.get_named_actor(name)
+            from ray_tpu.core.actor import ActorHandle
+
+            handle = ActorHandle(aid, self.get_actor_method_opts(aid))
+            return ("ok", protocol.serialize_value(handle, store=None))
+        raise ValueError(f"unknown data request {tag!r}")
+
+    # -------------------------------------------------------------- lifecycle
+
+    def kv_op(self, op: str, key: str, value=None):
+        if op == "get":
+            return self._kv.get(key)
+        if op == "put":
+            self._kv[key] = value
+            return None
+        if op == "del":
+            self._kv.pop(key, None)
+            return None
+        raise ValueError(op)
+
+    def wait_for_workers(self, count: Optional[int] = None, timeout: float = 30.0):
+        count = count or self.num_workers
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                n = sum(1 for w in self._workers.values() if w.ready)
+            if n >= count:
+                return
+            time.sleep(0.005)
+        raise TimeoutError(f"only some workers became ready within {timeout}s")
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            try:
+                if w.task_conn is not None:
+                    self._send_msg(w, (protocol.MSG_SHUTDOWN,))
+            except (OSError, EOFError, BrokenPipeError):
+                pass
+        deadline = time.monotonic() + 2.0
+        for w in workers:
+            try:
+                w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self._sock_path)
+        except OSError:
+            pass
+        self.store.close()
+        if runtime_context.get_core_or_none() is self:
+            runtime_context.set_core(None)

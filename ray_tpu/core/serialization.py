@@ -1,0 +1,113 @@
+"""Serialization for task args/returns and ``put`` objects.
+
+Pickle protocol 5 with out-of-band buffers (the reference uses the same
+approach via cloudpickle: python/ray/_private/serialization.py). Large buffer
+payloads (numpy arrays, jax host arrays, bytes) are written to the
+shared-memory object store and mapped zero-copy on read; small objects are
+inlined into control messages (reference inlines <100KB task returns into the
+in-process memory store).
+
+Wire container format (used both inline and inside a shm object)::
+
+    u32  magic        (0x52545055 'RTPU')
+    u32  num_buffers
+    u64  pickle_len
+    u64  buffer_len[num_buffers]
+    ...  pickled bytes
+    ...  buffers, each 64-byte aligned
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+_MAGIC = 0x52545055
+_ALIGN = 64
+# Objects whose serialized size is below this are inlined into control-plane
+# messages instead of the shm store (reference: 100KB task-return inline cap).
+INLINE_THRESHOLD = 100 * 1024
+
+
+def _align(offset: int) -> int:
+    return (offset + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def serialize(obj: Any) -> Tuple[bytes, List[memoryview], int]:
+    """Serialize ``obj``.
+
+    Returns (pickled_bytes, oob_buffers, total_container_size).
+    """
+    buffers: List[pickle.PickleBuffer] = []
+    pickled = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    views = [b.raw() for b in buffers]
+    header = 16 + 8 * len(views)
+    total = _align(header + len(pickled))
+    for v in views:
+        total = _align(total + v.nbytes)
+    return pickled, views, total
+
+
+def write_container(dst: memoryview, pickled: bytes, views: List[memoryview]) -> int:
+    """Write the container format into ``dst``; returns bytes written."""
+    struct.pack_into("<IIQ", dst, 0, _MAGIC, len(views), len(pickled))
+    off = 16
+    for v in views:
+        struct.pack_into("<Q", dst, off, v.nbytes)
+        off += 8
+    dst[off : off + len(pickled)] = pickled
+    off = _align(off + len(pickled))
+    for v in views:
+        flat = v.cast("B") if v.ndim != 1 or v.format != "B" else v
+        if flat.nbytes >= (1 << 20):
+            # np.copyto streams ~2x faster than memoryview slice assignment
+            # for multi-MB copies (vectorized non-temporal stores).
+            import numpy as _np
+
+            _np.copyto(
+                _np.frombuffer(dst[off : off + flat.nbytes], dtype=_np.uint8),
+                _np.frombuffer(flat, dtype=_np.uint8),
+            )
+        else:
+            dst[off : off + flat.nbytes] = flat
+        off = _align(off + flat.nbytes)
+    return off
+
+
+def pack(obj: Any) -> bytes:
+    """Serialize to a standalone bytes container (for inline transport)."""
+    pickled, views, total = serialize(obj)
+    out = bytearray(total)
+    write_container(memoryview(out), pickled, views)
+    return bytes(out)
+
+
+def unpack(data, wrap_buffer=None) -> Any:
+    """Deserialize a container from bytes/memoryview.
+
+    When ``data`` is a memoryview over shared memory, buffers are zero-copy
+    views into it. ``wrap_buffer(mv_slice)`` lets the caller substitute a
+    lifetime-tracked buffer object (used by the shm store to pin objects for
+    as long as deserialized arrays reference them).
+    """
+    mv = memoryview(data)
+    magic, num_buffers, pickle_len = struct.unpack_from("<IIQ", mv, 0)
+    if magic != _MAGIC:
+        raise ValueError("corrupt object container (bad magic)")
+    off = 16
+    buf_lens = []
+    for _ in range(num_buffers):
+        (n,) = struct.unpack_from("<Q", mv, off)
+        buf_lens.append(n)
+        off += 8
+    pickled = bytes(mv[off : off + pickle_len])
+    off = _align(off + pickle_len)
+    buffers = []
+    for n in buf_lens:
+        chunk = mv[off : off + n]
+        buffers.append(wrap_buffer(chunk) if wrap_buffer is not None else chunk)
+        off = _align(off + n)
+    return pickle.loads(pickled, buffers=buffers)

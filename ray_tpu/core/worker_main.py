@@ -1,0 +1,11 @@
+"""Entry-point shim: ``python -m ray_tpu.core.worker_main``.
+
+Kept separate from the implementation so that classes defined in the worker
+module are never duplicated between ``__main__`` and the canonical module
+path (which would break isinstance checks on unpickled objects).
+"""
+
+from ray_tpu.core.worker_proc import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,350 @@
+"""Worker process: executes tasks and hosts actors.
+
+The per-process core client (``WorkerCore``) mirrors the reference's
+``CoreWorker`` execution side (src/ray/core_worker/core_worker_process.cc:63
+RunTaskExecutionLoop; python/ray/_raylet.pyx:1693 execute_task): a loop that
+receives task specs on the *task connection*, executes them, and writes
+results either straight into the shared-memory store (large) or inline into
+the completion message (small). A second *data connection* carries
+synchronous worker→driver requests (get/put/submit/actor calls), which in the
+reference are CoreWorker RPCs to the owner.
+
+Launched as: python -m ray_tpu.core.worker_main
+with connection info in environment variables (RTPU_ADDRESS, RTPU_AUTH,
+RTPU_STORE, RTPU_NODE_ID, RTPU_WORKER_ID).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from multiprocessing.connection import Client
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import protocol, serialization
+from ray_tpu.core.protocol import _TopLevelDep
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core import runtime_context
+from ray_tpu.core.object_store.store import ShmObjectStore
+from ray_tpu.exceptions import TaskError
+
+
+class WorkerCore:
+    """Core client installed in worker processes."""
+
+    def __init__(self, task_conn, data_conn, store: Optional[ShmObjectStore],
+                 node_id: NodeID, worker_id: WorkerID):
+        self.task_conn = task_conn
+        self.data_conn = data_conn
+        self.store = store
+        self.node_id = node_id
+        self.worker_id = worker_id
+        self.current_task_id: Optional[TaskID] = None
+        self.current_actor_id: Optional[ActorID] = None
+        self._data_lock = threading.Lock()
+        self._functions: Dict[bytes, Any] = {}
+        self._driver_known_fns: set = set()
+        self._actors: Dict[bytes, Any] = {}
+        self._actor_loops: Dict[bytes, Any] = {}  # actor_id -> asyncio loop
+
+    # ---- data-conn RPC ------------------------------------------------------
+
+    def _request(self, *msg):
+        with self._data_lock:
+            self.data_conn.send(msg)
+            reply = self.data_conn.recv()
+        if reply[0] == "err":
+            err = protocol.deserialize_payload(reply[1], store=self.store)
+            raise err.error if isinstance(err, protocol.ErrorValue) else err
+        return reply
+
+    # ---- core-client surface (same as driver Runtime) -----------------------
+
+    def get_objects(self, refs: List[ObjectRef], timeout: Optional[float] = None):
+        oids = [r.id for r in refs]
+        values: Dict[ObjectID, Any] = {}
+        missing: List[ObjectID] = []
+        for oid in oids:
+            if self.store is not None and self.store.contains(oid):
+                values[oid] = protocol.shm_unpack(self.store, oid)
+            else:
+                missing.append(oid)
+        if missing:
+            timeout_ms = -1 if timeout is None else int(timeout * 1000)
+            _, payloads = self._request(
+                protocol.REQ_GET, [o.binary() for o in missing], timeout_ms
+            )
+            for oid in missing:
+                values[oid] = protocol.deserialize_payload(
+                    payloads[oid.binary()], store=self.store
+                )
+        out = []
+        for oid in oids:
+            out.append(protocol.raise_if_error(values[oid]))
+        return out
+
+    def put_object(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        payload = protocol.serialize_value(value, store=self.store)
+        if payload[0] == "shm":
+            # Data already in shm under a scratch id; re-register under oid is
+            # avoided by just using the payload's id as the object id.
+            oid = ObjectID(payload[1])
+            self._request(protocol.REQ_PUT_META, oid.binary(), None)
+        else:
+            self._request(protocol.REQ_PUT_META, oid.binary(), payload)
+        return ObjectRef(oid, core=self)
+
+    def submit_task(self, fn_id: bytes, pickled_fn: Optional[bytes], args: tuple,
+                    kwargs: dict, num_returns: int, options: dict) -> List[ObjectRef]:
+        args_payload, deps = _prepare_args_local(self, args, kwargs)
+        send_fn = None if fn_id in self._driver_known_fns else pickled_fn
+        options = dict(options)
+        options["__deps"] = deps
+        _, oid_bytes_list = self._request(
+            protocol.REQ_SUBMIT, fn_id, send_fn, args_payload, {},
+            num_returns, options,
+        )
+        self._driver_known_fns.add(fn_id)
+        return [ObjectRef(ObjectID(b), core=self) for b in oid_bytes_list]
+
+    def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
+                          kwargs: dict, num_returns: int) -> List[ObjectRef]:
+        args_payload, deps = _prepare_args_local(self, args, kwargs)
+        _, oid_bytes_list = self._request(
+            protocol.REQ_ACTOR_CALL, actor_id.binary(), method, args_payload,
+            {"__deps": deps}, num_returns,
+        )
+        return [ObjectRef(ObjectID(b), core=self) for b in oid_bytes_list]
+
+    def create_actor(self, *a, **k):
+        raise NotImplementedError("actors must be created from the driver in v0")
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        by_id = {r.id.binary(): r for r in refs}
+        _, ready_b, rest_b = self._request(
+            protocol.REQ_WAIT, list(by_id.keys()), num_returns, timeout
+        )
+        return [by_id[b] for b in ready_b], [by_id[b] for b in rest_b]
+
+    def kv_op(self, op: str, key: str, value=None):
+        _, result = self._request(protocol.REQ_KV, op, key, value)
+        return result
+
+    def get_actor_handle(self, name: str):
+        _, payload = self._request(protocol.REQ_GET_ACTOR, name)
+        return protocol.deserialize_payload(payload, store=self.store)
+
+    def as_future(self, ref: ObjectRef):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+
+        def resolve():
+            try:
+                v = self.get_objects([ref])[0]
+                loop.call_soon_threadsafe(fut.set_result, v)
+            except BaseException as e:  # noqa: BLE001
+                loop.call_soon_threadsafe(fut.set_exception, e)
+
+        threading.Thread(target=resolve, daemon=True).start()
+        return fut
+
+    # ---- execution ----------------------------------------------------------
+
+    def run_loop(self):
+        self.task_conn.send((protocol.MSG_READY, os.getpid()))
+        while True:
+            try:
+                msg = self.task_conn.recv()
+            except (EOFError, OSError):
+                break
+            tag = msg[0]
+            if tag == protocol.MSG_SHUTDOWN:
+                break
+            elif tag == protocol.MSG_REGISTER_FN:
+                _, fn_id, pickled_fn = msg
+                self._functions[fn_id] = serialization.unpack(pickled_fn)
+            elif tag == protocol.MSG_TASK:
+                self._execute_task(msg)
+            elif tag == protocol.MSG_CREATE_ACTOR:
+                self._create_actor(msg)
+            elif tag == protocol.MSG_ACTOR_CALL:
+                self._execute_actor_call(msg)
+            else:  # pragma: no cover
+                sys.stderr.write(f"worker: unknown message {tag!r}\n")
+
+    def _decode_args(self, args_payload, inline_values):
+        args, kwargs = protocol.deserialize_payload(args_payload, store=self.store)
+        dep_cache: Dict[bytes, Any] = {}
+
+        def resolve(v):
+            if isinstance(v, _TopLevelDep):
+                b = v.oid_bytes
+                if b not in dep_cache:
+                    if b in inline_values and inline_values[b] is not None:
+                        dep_cache[b] = protocol.deserialize_payload(
+                            inline_values[b], store=self.store
+                        )
+                    else:
+                        dep_cache[b] = protocol.shm_unpack(self.store, ObjectID(b))
+                return protocol.raise_if_error(dep_cache[b])
+            return v
+
+        args = tuple(resolve(a) for a in args)
+        kwargs = {k: resolve(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _send_results(self, task_id_b: bytes, result, num_returns: int,
+                      return_id_bytes: List[bytes]):
+        if num_returns == 1:
+            results = [result]
+        else:
+            results = list(result)
+            if len(results) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(results)} values"
+                )
+        payloads = []
+        for value, rid in zip(results, return_id_bytes):
+            payloads.append(self._serialize_result(value, ObjectID(rid)))
+        self.task_conn.send((protocol.MSG_DONE, task_id_b, payloads))
+
+    def _serialize_result(self, value, rid: ObjectID):
+        pickled, views, total = serialization.serialize(value)
+        if (
+            self.store is not None
+            and total > serialization.INLINE_THRESHOLD
+        ):
+            try:
+                dst = self.store.create_object(rid, total)
+                serialization.write_container(dst, pickled, views)
+                self.store.seal(rid)
+                return ("shm", rid.binary())
+            except Exception:
+                pass
+        out = bytearray(total)
+        serialization.write_container(memoryview(out), pickled, views)
+        return ("inline", bytes(out))
+
+    def _execute_task(self, msg):
+        _, task_id_b, fn_id, args_payload, inline_values, return_id_bytes = msg
+        self.current_task_id = TaskID(task_id_b)
+        try:
+            fn = self._functions[fn_id]
+            args, kwargs = self._decode_args(args_payload, inline_values)
+            result = fn(*args, **kwargs)
+            self._send_results(task_id_b, result, len(return_id_bytes), return_id_bytes)
+        except BaseException as e:  # noqa: BLE001
+            self._send_error(task_id_b, e)
+        finally:
+            self.current_task_id = None
+
+    def _send_error(self, task_id_b: bytes, exc: BaseException):
+        err = exc if isinstance(exc, TaskError) else TaskError(
+            exc, traceback.format_exc()
+        )
+        try:
+            payload = protocol.serialize_value(protocol.ErrorValue(err), store=None)
+        except Exception:
+            payload = protocol.serialize_value(
+                protocol.ErrorValue(
+                    TaskError(RuntimeError(repr(exc)), traceback.format_exc())
+                ),
+                store=None,
+            )
+        self.task_conn.send((protocol.MSG_ERROR, task_id_b, payload))
+
+    def _create_actor(self, msg):
+        _, actor_id_b, cls_fn_id, args_payload, inline_values, opts = msg
+        try:
+            cls = self._functions[cls_fn_id]
+            args, kwargs = self._decode_args(args_payload, inline_values)
+            self.current_actor_id = ActorID(actor_id_b)
+            instance = cls(*args, **kwargs)
+            self._actors[actor_id_b] = instance
+            if opts.get("has_async_methods"):
+                import asyncio
+
+                self._actor_loops[actor_id_b] = asyncio.new_event_loop()
+            self.task_conn.send((protocol.MSG_ACTOR_READY, actor_id_b))
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, traceback.format_exc())
+            self.task_conn.send(
+                (protocol.MSG_ACTOR_ERROR, actor_id_b,
+                 protocol.serialize_value(protocol.ErrorValue(err), store=None))
+            )
+
+    def _execute_actor_call(self, msg):
+        _, task_id_b, actor_id_b, method, args_payload, inline_values, return_ids = msg
+        self.current_task_id = TaskID(task_id_b)
+        self.current_actor_id = ActorID(actor_id_b)
+        try:
+            instance = self._actors[actor_id_b]
+            fn = getattr(instance, method)
+            args, kwargs = self._decode_args(args_payload, inline_values)
+            result = fn(*args, **kwargs)
+            if hasattr(result, "__await__"):
+                import asyncio
+
+                loop = self._actor_loops.get(actor_id_b)
+                if loop is None:
+                    loop = asyncio.new_event_loop()
+                    self._actor_loops[actor_id_b] = loop
+                result = loop.run_until_complete(result)
+            self._send_results(task_id_b, result, len(return_ids), return_ids)
+        except BaseException as e:  # noqa: BLE001
+            self._send_error(task_id_b, e)
+        finally:
+            self.current_task_id = None
+
+
+def _prepare_args_local(core: WorkerCore, args: tuple, kwargs: dict):
+    """Worker-side arg prep for nested submissions: top-level refs become
+    _TopLevelDep markers; the driver re-resolves them (it owns all objects).
+    Returns (args_payload, dep_oid_bytes_list)."""
+    deps: List[bytes] = []
+
+    def swap(v):
+        if isinstance(v, ObjectRef):
+            deps.append(v.binary())
+            return _TopLevelDep(v.binary())
+        return v
+
+    args = tuple(swap(a) for a in args)
+    kwargs = {k: swap(v) for k, v in kwargs.items()}
+    payload, _ = protocol.serialize_args(args, kwargs, store=core.store)
+    return payload, deps
+
+
+def main():
+    address = os.environ["RTPU_ADDRESS"]
+    authkey = bytes.fromhex(os.environ["RTPU_AUTH"])
+    store_name = os.environ.get("RTPU_STORE", "")
+    node_id = NodeID.from_hex(os.environ["RTPU_NODE_ID"])
+    worker_id = WorkerID.from_hex(os.environ["RTPU_WORKER_ID"])
+
+    task_conn = Client(address, authkey=authkey)
+    task_conn.send(("hello", "task", worker_id.binary()))
+    data_conn = Client(address, authkey=authkey)
+    data_conn.send(("hello", "data", worker_id.binary()))
+
+    store = ShmObjectStore.connect(store_name) if store_name else None
+    core = WorkerCore(task_conn, data_conn, store, node_id, worker_id)
+    runtime_context.set_core(core)
+    try:
+        core.run_loop()
+    finally:
+        if store is not None:
+            store.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""User-facing exceptions (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution.
+
+    Re-raised at ``get`` on the caller, wrapping the remote traceback
+    (reference: RayTaskError in python/ray/exceptions.py).
+    """
+
+    def __init__(self, cause: BaseException, remote_traceback: str = ""):
+        self.cause = cause
+        self.remote_traceback = remote_traceback
+        super().__init__(f"{type(cause).__name__}: {cause}\n{remote_traceback}")
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead: it crashed, was killed, or exhausted restarts."""
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` did not complete within the requested timeout."""
+
+
+class ObjectLostError(RayTpuError):
+    """The object's value was evicted and could not be reconstructed."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died unexpectedly."""
+
+
+class RuntimeNotInitializedError(RayTpuError):
+    """An API call was made before ``ray_tpu.init()``."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """Allocation failed after eviction: the object store is out of memory."""
+
+
+class ObjectTimeoutError(RayTpuError, TimeoutError):
+    """A store-level blocking get did not complete in time."""
+
+
+class PlacementGroupError(RayTpuError):
+    pass

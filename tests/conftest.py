@@ -1,0 +1,28 @@
+"""Shared fixtures.
+
+JAX-dependent tests run on a virtual 8-device CPU mesh (the reference's
+analogue is the fake multi-node cluster fixtures in
+python/ray/tests/conftest.py); the env vars must be set before jax import,
+hence they live here at collection time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def rt():
+    """A running ray_tpu runtime shared per test module."""
+    import ray_tpu
+
+    ray_tpu.init(num_workers=4, object_store_memory=256 << 20)
+    yield ray_tpu
+    ray_tpu.shutdown()

@@ -1,0 +1,193 @@
+"""Actor tests (reference coverage model: python/ray/tests/test_actor*.py)."""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu.exceptions import ActorDiedError, TaskError
+
+
+def test_actor_basic(rt):
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def incr(self, n=1):
+            self.v += n
+            return self.v
+
+    c = Counter.remote(5)
+    assert rt.get(c.incr.remote()) == 6
+    assert rt.get(c.incr.remote(4)) == 10
+
+
+def test_actor_method_ordering(rt):
+    @rt.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get_items(self):
+            return self.items
+
+    log = Log.remote()
+    for i in range(50):
+        log.append.remote(i)
+    assert rt.get(log.get_items.remote()) == list(range(50))
+
+
+def test_actor_isolation(rt):
+    @rt.remote
+    class Holder:
+        def __init__(self):
+            self.v = 0
+
+        def setv(self, v):
+            self.v = v
+
+        def getv(self):
+            return self.v
+
+    a, b = Holder.remote(), Holder.remote()
+    rt.get(a.setv.remote(1))
+    rt.get(b.setv.remote(2))
+    assert rt.get(a.getv.remote()) == 1
+    assert rt.get(b.getv.remote()) == 2
+
+
+def test_actor_error_propagation(rt):
+    @rt.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor-task-error")
+
+        def ok(self):
+            return "fine"
+
+    bad = Bad.remote()
+    with pytest.raises(TaskError):
+        rt.get(bad.fail.remote())
+    # actor survives a failed method call
+    assert rt.get(bad.ok.remote()) == "fine"
+
+
+def test_actor_constructor_error(rt):
+    @rt.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("ctor-boom")
+
+        def m(self):
+            return 1
+
+    broken = Broken.remote()
+    with pytest.raises((TaskError, ActorDiedError)):
+        rt.get(broken.m.remote(), timeout=10)
+
+
+def test_named_actor(rt):
+    @rt.remote
+    class Registry:
+        def __init__(self):
+            self.d = {}
+
+        def put_item(self, k, v):
+            self.d[k] = v
+
+        def get_item(self, k):
+            return self.d.get(k)
+
+    Registry.options(name="reg-test").remote()
+    h = rt.get_actor("reg-test")
+    rt.get(h.put_item.remote("k", 42))
+    assert rt.get(h.get_item.remote("k")) == 42
+
+
+def test_actor_handle_in_task(rt):
+    @rt.remote
+    class Sink:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, n):
+            self.total += n
+            return self.total
+
+    @rt.remote
+    def feeder(sink, n):
+        return rt.get(sink.add.remote(n))
+
+    sink = Sink.remote()
+    rt.get([feeder.remote(sink, i) for i in range(5)])
+    assert rt.get(sink.add.remote(0)) == 10
+
+
+def test_kill_actor(rt):
+    @rt.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert rt.get(v.ping.remote()) == "pong"
+    rt.kill(v)
+    time.sleep(0.3)
+    with pytest.raises(ActorDiedError):
+        rt.get(v.ping.remote(), timeout=10)
+
+
+def test_actor_restart(rt):
+    @rt.remote(max_restarts=2)
+    class Phoenix:
+        def pid(self):
+            return os.getpid()
+
+        def crash(self):
+            os._exit(1)
+
+    p = Phoenix.remote()
+    pid1 = rt.get(p.pid.remote())
+    p.crash.remote()
+    deadline = time.monotonic() + 15
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2 = rt.get(p.pid.remote(), timeout=10)
+            break
+        except (ActorDiedError, TaskError, Exception):
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_actor_no_restart_by_default(rt):
+    @rt.remote
+    class Fragile:
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            return 1
+
+    f = Fragile.remote()
+    f.crash.remote()
+    time.sleep(1.0)
+    with pytest.raises(ActorDiedError):
+        rt.get(f.ping.remote(), timeout=10)
+
+
+def test_async_actor_method(rt):
+    @rt.remote
+    class AsyncActor:
+        async def compute(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    assert rt.get(a.compute.remote(21)) == 42

@@ -1,0 +1,189 @@
+"""Core API tests: tasks, objects, errors, wait.
+
+Mirrors the reference's python/ray/tests/test_basic*.py coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+def test_simple_task(rt):
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    assert rt.get(add.remote(1, 2)) == 3
+
+
+def test_task_chaining(rt):
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert rt.get(ref) == 11
+
+
+def test_large_array_roundtrip(rt):
+    @rt.remote
+    def double(x):
+        return x * 2
+
+    arr = np.arange(500_000, dtype=np.float64)
+    out = rt.get(double.remote(arr))
+    assert np.array_equal(out, arr * 2)
+
+
+def test_put_get(rt):
+    arr = np.random.rand(1000)
+    ref = rt.put(arr)
+    assert np.array_equal(rt.get(ref), arr)
+
+
+def test_put_ref_as_task_arg(rt):
+    @rt.remote
+    def total(x):
+        return float(np.sum(x))
+
+    arr = np.ones(100_000)
+    assert rt.get(total.remote(rt.put(arr))) == 100_000.0
+
+
+def test_get_list(rt):
+    @rt.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(20)]
+    assert rt.get(refs) == [i * i for i in range(20)]
+
+
+def test_error_propagation(rt):
+    @rt.remote
+    def fail():
+        raise KeyError("missing-thing")
+
+    with pytest.raises(TaskError) as ei:
+        rt.get(fail.remote())
+    assert "missing-thing" in str(ei.value)
+    assert isinstance(ei.value.cause, KeyError)
+
+
+def test_error_through_dependency(rt):
+    @rt.remote
+    def fail():
+        raise ValueError("upstream")
+
+    @rt.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(TaskError):
+        rt.get(consume.remote(fail.remote()))
+
+
+def test_get_timeout(rt):
+    @rt.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(GetTimeoutError):
+        rt.get(slow.remote(), timeout=0.2)
+
+
+def test_wait_basic(rt):
+    @rt.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(2.0)
+    ready, rest = rt.wait([fast, slow], num_returns=1, timeout=5)
+    assert ready == [fast]
+    assert rest == [slow]
+
+
+def test_wait_timeout(rt):
+    @rt.remote
+    def forever():
+        time.sleep(30)
+
+    ready, rest = rt.wait([forever.remote()], num_returns=1, timeout=0.2)
+    assert ready == []
+    assert len(rest) == 1
+
+
+def test_num_returns(rt):
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c]) == [1, 2, 3]
+
+
+def test_nested_task_submission(rt):
+    @rt.remote
+    def leaf(x):
+        return x * 2
+
+    @rt.remote
+    def branch(x):
+        return rt.get(leaf.remote(x)) + 1
+
+    assert rt.get(branch.remote(10)) == 21
+
+
+def test_nested_refs_in_structures(rt):
+    @rt.remote
+    def make():
+        return 7
+
+    @rt.remote
+    def deref(d):
+        return rt.get(d["ref"])
+
+    assert rt.get(deref.remote({"ref": make.remote()})) == 7
+
+
+def test_kwargs(rt):
+    @rt.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert rt.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_options_num_returns(rt):
+    @rt.remote
+    def pair():
+        return ("x", "y")
+
+    a, b = pair.options(num_returns=2).remote()
+    assert rt.get(a) == "x" and rt.get(b) == "y"
+
+
+def test_remote_function_not_directly_callable(rt):
+    @rt.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_zero_copy_get_is_view(rt):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = rt.put(arr)
+    out = rt.get(ref)
+    # large objects come back as zero-copy views over the shm mapping
+    assert out.base is not None
+    assert np.array_equal(out, arr)

@@ -1,0 +1,136 @@
+"""Unit tests for the C++ shared-memory object store.
+
+Mirrors the reference's plasma test strategy
+(src/ray/object_manager/plasma/test/): lifecycle, eviction, refcount
+pinning, cross-client visibility, blocking gets.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store.store import (
+    ObjectStoreFullError,
+    ObjectTimeoutError,
+    ShmObjectStore,
+)
+
+
+@pytest.fixture()
+def store():
+    name = f"/rtpu_test_{os.getpid()}_{os.urandom(4).hex()}"
+    s = ShmObjectStore.create(name, 32 << 20)
+    yield s
+    s.close()
+
+
+def test_put_get_roundtrip(store):
+    oid = ObjectID.from_random()
+    data = np.arange(10_000, dtype=np.int64)
+    store.put(oid, data.tobytes())
+    mv = store.get(oid, timeout_ms=1000)
+    assert np.array_equal(np.frombuffer(mv, dtype=np.int64), data)
+    mv.release()
+    store.release(oid)
+
+
+def test_create_seal_visibility(store):
+    oid = ObjectID.from_random()
+    dst = store.create_object(oid, 128)
+    # unsealed objects are not visible to contains/get
+    assert not store.contains(oid)
+    dst[:] = b"x" * 128
+    store.seal(oid)
+    assert store.contains(oid)
+
+
+def test_duplicate_create_fails(store):
+    oid = ObjectID.from_random()
+    store.put(oid, b"abc")
+    with pytest.raises(ObjectStoreFullError):
+        store.create_object(oid, 10)
+
+
+def test_get_timeout(store):
+    with pytest.raises(ObjectTimeoutError):
+        store.get(ObjectID.from_random(), timeout_ms=50)
+
+
+def test_blocking_get_wakes_on_seal(store):
+    oid = ObjectID.from_random()
+    result = {}
+
+    def getter():
+        mv = store.get(oid, timeout_ms=5000)
+        result["data"] = bytes(mv[:5])
+        mv.release()
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.1)
+    store.put(oid, b"hello")
+    t.join(timeout=5)
+    assert result["data"] == b"hello"
+
+
+def test_lru_eviction_under_pressure(store):
+    ids = []
+    for _ in range(40):  # 40 MB into a 32 MB store
+        oid = ObjectID.from_random()
+        store.put(oid, os.urandom(1 << 20))
+        ids.append(oid)
+    stats = store.stats()
+    assert stats["evictions"] > 0
+    # oldest evicted, newest present
+    assert not store.contains(ids[0])
+    assert store.contains(ids[-1])
+
+
+def test_pinned_objects_survive_eviction(store):
+    pinned = ObjectID.from_random()
+    store.put(pinned, b"p" * (1 << 20))
+    mv = store.get(pinned, timeout_ms=1000)  # refcount pins it
+    for _ in range(40):
+        store.put(ObjectID.from_random(), os.urandom(1 << 20))
+    assert store.contains(pinned)
+    assert bytes(mv[:1]) == b"p"
+    mv.release()
+    store.release(pinned)
+
+
+def test_cross_client_access(store):
+    client = ShmObjectStore.connect(store.name)
+    oid = ObjectID.from_random()
+    client.put(oid, b"from-client")
+    mv = store.get(oid, timeout_ms=1000)
+    assert bytes(mv) == b"from-client"
+    mv.release()
+    store.release(oid)
+    client.close()
+
+
+def test_delete(store):
+    oid = ObjectID.from_random()
+    store.put(oid, b"gone")
+    store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_allocation_too_large_fails(store):
+    with pytest.raises(ObjectStoreFullError):
+        store.create_object(ObjectID.from_random(), 1 << 30)
+
+
+def test_many_small_objects(store):
+    ids = [ObjectID.from_random() for _ in range(1000)]
+    for i, oid in enumerate(ids):
+        store.put(oid, i.to_bytes(8, "little"))
+    for i, oid in enumerate(ids):
+        mv = store.get(oid, timeout_ms=1000)
+        assert int.from_bytes(bytes(mv), "little") == i
+        mv.release()
+        store.release(oid)
