@@ -28,14 +28,22 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol, serialization
 from ray_tpu.core.ids import (
-    ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID, make_task_id,
+    ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID,
+    make_task_id,
 )
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core import runtime_context
 from ray_tpu.core.object_store.store import ShmObjectStore, default_store_capacity
+from ray_tpu.core.placement_group import (
+    PlacementGroup, PlacementGroupState,
+)
 from ray_tpu.core.protocol import _TopLevelDep
+from ray_tpu.core.resources import (
+    ResourceSet, TpuSliceTopology, node_resources,
+)
 from ray_tpu.exceptions import (
-    ActorDiedError, GetTimeoutError, TaskError, WorkerCrashedError,
+    ActorDiedError, GetTimeoutError, PlacementGroupError, TaskError,
+    WorkerCrashedError,
 )
 
 
@@ -51,7 +59,8 @@ class _ObjectEntry:
 class _TaskSpec:
     __slots__ = (
         "task_id", "fn_id", "args_payload", "deps", "return_ids", "options",
-        "actor_id", "method", "pending_deps",
+        "actor_id", "method", "pending_deps", "request", "pg_wire",
+        "acquired_bundle", "blocked_released",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -65,6 +74,11 @@ class _TaskSpec:
         self.actor_id = actor_id
         self.method = method
         self.pending_deps = 0
+        # Resource accounting (filled by Runtime._prepare_request).
+        self.request: Optional[ResourceSet] = None
+        self.pg_wire = None          # ("pg", pg_id_bytes, bundle_index) | None
+        self.acquired_bundle = None  # Bundle the request was drawn from
+        self.blocked_released = False  # resources credited back while blocked
 
 
 class _Worker:
@@ -98,7 +112,8 @@ class _ActorState:
     __slots__ = (
         "actor_id", "worker", "cls_fn_id", "creation_args_payload",
         "creation_deps", "opts", "queue", "ready", "dead", "death_cause",
-        "restarts_left", "name", "creation_event",
+        "restarts_left", "name", "creation_event", "request", "pg_wire",
+        "acquired_bundle", "chips",
     )
 
     def __init__(self, actor_id, cls_fn_id, args_payload, deps, opts):
@@ -115,6 +130,10 @@ class _ActorState:
         self.restarts_left = opts.get("max_restarts", 0)
         self.name = opts.get("name")
         self.creation_event = threading.Event()
+        self.request: Optional[ResourceSet] = None
+        self.pg_wire = None
+        self.acquired_bundle = None
+        self.chips: List[int] = []
 
 
 class Runtime:
@@ -122,7 +141,8 @@ class Runtime:
 
     def __init__(self, num_workers: Optional[int] = None,
                  object_store_memory: Optional[int] = None,
-                 session_name: Optional[str] = None):
+                 session_name: Optional[str] = None,
+                 topology: Optional[TpuSliceTopology] = None):
         self.node_id = NodeID.from_random()
         self.worker_id = WorkerID.from_random()
         self.job_id = JobID.from_random()
@@ -149,6 +169,18 @@ class Runtime:
         self._shutdown = False
         self._spawning = 0
 
+        # Resource model: CPU slots == pool size; TPU chips from the slice
+        # topology (detected or injected for tests).
+        self.topology = topology if topology is not None else TpuSliceTopology.detect()
+        self._total = ResourceSet(node_resources(
+            num_cpus=self.num_workers, topology=self.topology,
+        ))
+        self._avail = ResourceSet(self._total.to_dict())
+        self._pgs: Dict[PlacementGroupID, PlacementGroupState] = {}
+        self._pending_pgs: List[PlacementGroupState] = []
+        self._pending_actors: List[_ActorState] = []
+        self._pg_ready_waiters: Dict[PlacementGroupID, List[ObjectID]] = {}
+
         self._listener = Listener(self._sock_path, family="AF_UNIX",
                                   authkey=self._authkey)
         self._accept_thread = threading.Thread(
@@ -160,7 +192,8 @@ class Runtime:
 
     # ------------------------------------------------------------------ pool
 
-    def _spawn_worker(self, tpu: bool = False) -> _Worker:
+    def _spawn_worker(self, tpu: bool = False,
+                      extra_env: Optional[Dict[str, str]] = None) -> _Worker:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env.update(
@@ -170,6 +203,8 @@ class Runtime:
             RTPU_NODE_ID=self.node_id.hex(),
             RTPU_WORKER_ID=worker_id.hex(),
         )
+        if extra_env:
+            env.update(extra_env)
         if not tpu:
             # Plain pool workers skip TPU/PJRT plugin registration, which
             # this environment's sitecustomize triggers off these vars and
@@ -268,10 +303,13 @@ class Runtime:
             w.inflight = None
             actor_id = w.actor_id
         if inflight is not None:
+            with self._lock:
+                self._release_spec_locked(inflight)
             err = WorkerCrashedError(
                 f"worker {w.worker_id.hex()[:8]} died while executing task"
             )
             self._store_error(inflight.return_ids, err)
+            self._retry_pending_pgs()
         if actor_id is not None:
             self._handle_actor_worker_death(actor_id)
         else:
@@ -351,6 +389,7 @@ class Runtime:
         args_payload, _ = protocol.serialize_args(args2, kwargs2, store=self.store)
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
         spec = _TaskSpec(task_id, fn_id, args_payload, deps, return_ids, options)
+        spec.request, spec.pg_wire = self._prepare_request(options, is_actor=False)
         for rid in return_ids:
             self._entry(rid)
         self._enqueue(spec)
@@ -369,6 +408,12 @@ class Runtime:
                 {k: swap(v) for k, v in kwargs.items()}, deps)
 
     def _enqueue(self, spec: _TaskSpec):
+        if spec.pg_wire is not None:
+            pg = self._pgs.get(PlacementGroupID(spec.pg_wire[1]))
+            if pg is None or pg.removed:
+                self._store_error(spec.return_ids, PlacementGroupError(
+                    "placement group was removed"))
+                return
         unresolved = []
         for dep in spec.deps:
             e = self._entry(dep)
@@ -405,6 +450,37 @@ class Runtime:
                 self._task_queue.append(spec)
             self._dispatch()
 
+    def _mark_worker_blocked(self, w: _Worker):
+        """Worker enters a blocking get/wait: release its task's resources so
+        dependents can run (reference: raylet releases CPU of workers blocked
+        in ray.get), and scale the pool if everyone is blocked."""
+        released = False
+        with self._lock:
+            if not w.blocked:
+                w.blocked = True
+                spec = w.inflight
+                if spec is not None and spec.request is not None \
+                        and spec.acquired_bundle is None \
+                        and not spec.blocked_released:
+                    self._avail = self._avail + spec.request
+                    spec.blocked_released = True
+                    released = True
+        if released:
+            self._retry_pending_pgs()
+            self._dispatch()
+        self._maybe_scale_up()
+
+    def _unmark_worker_blocked(self, w: _Worker):
+        with self._lock:
+            if w.blocked:
+                w.blocked = False
+                spec = w.inflight
+                if spec is not None and spec.blocked_released:
+                    # Oversubscription debt is allowed; it drains as other
+                    # tasks finish.
+                    self._avail = self._avail.subtract_unchecked(spec.request)
+                    spec.blocked_released = False
+
     def _maybe_scale_up(self):
         """Spawn an extra worker when queued tasks cannot run because every
         pool worker is blocked in a driver-side get/wait (otherwise nested
@@ -427,14 +503,90 @@ class Runtime:
     def _dispatch(self):
         while True:
             with self._lock:
+                while self._idle and not self._idle[0].alive:
+                    self._idle.popleft()
                 if not self._task_queue or not self._idle:
                     return
+                picked = None
+                for i, spec in enumerate(self._task_queue):
+                    if self._try_acquire_spec_locked(spec):
+                        picked = i
+                        break
+                if picked is None:
+                    return
+                del self._task_queue[picked]
                 w = self._idle.popleft()
-                if not w.alive:
-                    continue
-                spec = self._task_queue.popleft()
                 w.inflight = spec
             self._send_task(w, spec)
+
+    # ----------------------------------------------------------- resources
+
+    def _prepare_request(self, options: dict, is_actor: bool):
+        """Normalize task/actor options into (ResourceSet, pg_wire)."""
+        req = {}
+        num_cpus = options.get("num_cpus")
+        if num_cpus is None:
+            num_cpus = 0.0 if is_actor else 1.0
+        if num_cpus:
+            req["CPU"] = float(num_cpus)
+        num_tpus = options.get("num_tpus", 0)
+        if num_tpus:
+            if not is_actor:
+                raise ValueError(
+                    "num_tpus is actor-scoped in this release: TPU chips are "
+                    "bound to dedicated worker processes at spawn time (PJRT "
+                    "plugin registration happens at interpreter startup). "
+                    "Wrap TPU work in an actor with num_tpus=N."
+                )
+            req["TPU"] = float(num_tpus)
+        for k, v in (options.get("resources") or {}).items():
+            req[k] = req.get(k, 0) + float(v)
+        strategy = options.get("scheduling_strategy")
+        pg_wire = None
+        if strategy is not None and hasattr(strategy, "_to_wire"):
+            wire = strategy._to_wire()
+            if wire[0] == "pg":
+                pg_wire = wire
+        elif isinstance(strategy, tuple) and strategy and strategy[0] == "pg":
+            pg_wire = strategy
+        return ResourceSet(req), pg_wire
+
+    def _try_acquire_spec_locked(self, spec) -> bool:
+        """Try to acquire spec.request from its pool. Caller holds _lock."""
+        if spec.request is None:
+            return True
+        if spec.pg_wire is not None:
+            state = self._pgs.get(PlacementGroupID(spec.pg_wire[1]))
+            if state is None or state.removed or not state.ready_event.is_set():
+                return False
+            bundle = state.find_bundle(spec.request, spec.pg_wire[2])
+            if bundle is None:
+                return False
+            bundle.acquire(spec.request)
+            spec.acquired_bundle = bundle
+            return True
+        if spec.request.is_subset_of(self._avail):
+            self._avail = self._avail - spec.request
+            return True
+        return False
+
+    def _release_spec_locked(self, spec):
+        if spec.request is None:
+            return
+        if spec.acquired_bundle is not None:
+            spec.acquired_bundle.release(spec.request)
+            # Resources of a *removed* PG's bundle must flow back to the
+            # node pool, not die inside the dead bundle.
+            if spec.pg_wire is not None:
+                pg = self._pgs.get(PlacementGroupID(spec.pg_wire[1]))
+                if pg is None or pg.removed:
+                    self._avail = self._avail + spec.request
+            spec.acquired_bundle = None
+        elif spec.blocked_released:
+            spec.blocked_released = False  # already credited at block time
+        else:
+            self._avail = self._avail + spec.request
+        spec.request = None
 
     def _dispatch_actor(self, state: _ActorState):
         spec = None
@@ -496,18 +648,24 @@ class Runtime:
         with self._lock:
             spec = w.inflight
             w.inflight = None
+            if spec is not None:
+                self._release_spec_locked(spec)
         if spec is not None:
             for rid, payload in zip(spec.return_ids, payloads):
                 self._store_payload(rid, payload)
+        self._retry_pending_pgs()
         self._worker_now_idle(w)
 
     def _on_task_error(self, w: _Worker, task_id_b: bytes, err_payload):
         with self._lock:
             spec = w.inflight
             w.inflight = None
+            if spec is not None:
+                self._release_spec_locked(spec)
         if spec is not None:
             for rid in spec.return_ids:
                 self._store_payload(rid, err_payload)
+        self._retry_pending_pgs()
         self._worker_now_idle(w)
 
     def _worker_now_idle(self, w: _Worker):
@@ -613,6 +771,7 @@ class Runtime:
         args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
         args_payload, _ = protocol.serialize_args(args2, kwargs2, store=self.store)
         state = _ActorState(actor_id, cls_fn_id, args_payload, deps, opts)
+        state.request, state.pg_wire = self._prepare_request(opts, is_actor=True)
         with self._lock:
             self._actors[actor_id] = state
             name = opts.get("name")
@@ -620,18 +779,29 @@ class Runtime:
                 if name in self._named_actors:
                     raise ValueError(f"actor name {name!r} already taken")
                 self._named_actors[name] = actor_id
-        self._start_actor(state)
+            placed = self._try_acquire_actor_locked(state)
+            if not placed:
+                self._pending_actors.append(state)
+        if placed:
+            self._start_actor(state)
         return actor_id
 
     def _start_actor(self, state: _ActorState):
-        needs_tpu = state.opts.get("num_tpus", 0) > 0
+        needs_tpu = bool(state.chips) or state.opts.get("num_tpus", 0) > 0
         w = None
         if not needs_tpu:
             # Prefer an idle pooled worker; else spawn fresh (+ replace pool).
             with self._lock:
                 w = self._idle.popleft() if self._idle else None
         if w is None:
-            w = self._spawn_worker(tpu=needs_tpu)
+            extra_env = {}
+            if state.chips:
+                chips_str = ",".join(str(c) for c in state.chips)
+                # Same env contract the reference sets for TPU workers
+                # (accelerators/tpu.py:158 set_current_process_visible_accelerator_ids)
+                extra_env["TPU_VISIBLE_CHIPS"] = chips_str
+                extra_env["RTPU_TPU_CHIPS"] = chips_str
+            w = self._spawn_worker(tpu=needs_tpu, extra_env=extra_env)
         else:
             self._spawn_worker()  # keep task-pool capacity
         with self._lock:
@@ -691,10 +861,17 @@ class Runtime:
             state.death_cause = cause
             pending = list(state.queue)
             state.queue.clear()
+            self._release_actor_locked(state)
+            try:
+                self._pending_actors.remove(state)
+            except ValueError:
+                pass
         state.creation_event.set()
         err = cause if isinstance(cause, ActorDiedError) else ActorDiedError(str(cause))
         for spec in pending:
             self._store_error(spec.return_ids, err)
+        self._retry_pending_pgs()
+        self._dispatch()
 
     def _handle_actor_worker_death(self, actor_id: ActorID):
         state = self._actors.get(actor_id)
@@ -759,6 +936,261 @@ class Runtime:
             raise ValueError(f"no actor named {name!r}")
         return aid
 
+    # ------------------------------------------------- placement groups
+
+    def create_placement_group(self, bundles, strategy, name) -> PlacementGroup:
+        pg_id = PlacementGroupID.from_random()
+        state = PlacementGroupState(pg_id, bundles, strategy, name)
+        for b in state.bundles:
+            if not b.reserved.is_subset_of(self._total):
+                raise ValueError(
+                    f"bundle {b.spec} can never fit this node's resources "
+                    f"{self._total.to_dict()}"
+                )
+        if strategy == "STRICT_SPREAD" and len(bundles) > 1:
+            state.infeasible_reason = (
+                "STRICT_SPREAD requires one node per bundle; the single-node "
+                "runtime cannot satisfy it"
+            )
+        reserved = False
+        with self._lock:
+            self._pgs[pg_id] = state
+            if state.infeasible_reason is None:
+                reserved = self._try_reserve_pg_locked(state)
+                if not reserved:
+                    self._pending_pgs.append(state)
+        if reserved:
+            self._resolve_pg_waiters(state)
+        return PlacementGroup(pg_id, bundles)
+
+    def _try_reserve_pg_locked(self, state: PlacementGroupState) -> bool:
+        if state.infeasible_reason or state.removed:
+            return False
+        total = state.total_request()
+        if not total.is_subset_of(self._avail):
+            return False
+        n_total = int(total.get("TPU"))
+        if n_total:
+            if self.topology is None:
+                return False
+            if state.strategy == "STRICT_PACK":
+                # one ICI-contiguous rectangle for the whole gang
+                chips = self.topology.allocate(n_total, contiguous=True)
+                if chips is None:
+                    return False
+                off = 0
+                for b in state.bundles:
+                    n = int(b.reserved.get("TPU"))
+                    b.chips = chips[off:off + n]
+                    b.free_chips = list(b.chips)
+                    off += n
+            else:
+                contig = state.strategy == "PACK"
+                allocs = []
+                ok = True
+                for b in state.bundles:
+                    n = int(b.reserved.get("TPU"))
+                    if not n:
+                        continue
+                    got = self.topology.allocate(n, contiguous=contig)
+                    if got is None and contig:
+                        got = self.topology.allocate(n, contiguous=False)
+                    if got is None:
+                        ok = False
+                        break
+                    allocs.append((b, got))
+                if not ok:
+                    for _, g in allocs:
+                        self.topology.release(g)
+                    return False
+                for b, g in allocs:
+                    b.chips = g
+                    b.free_chips = list(g)
+        self._avail = self._avail - total
+        state.ready_event.set()
+        return True
+
+    def _resolve_pg_waiters(self, state: PlacementGroupState):
+        with self._lock:
+            waiters = self._pg_ready_waiters.pop(state.id, [])
+        payload = protocol.serialize_value(True, store=None)
+        for oid in waiters:
+            self._store_payload(oid, payload)
+
+    def placement_group_ready_ref(self, pg_id: PlacementGroupID) -> ObjectRef:
+        oid = ObjectID.from_random()
+        self._entry(oid)
+        resolve_now = False
+        err = None
+        with self._lock:
+            state = self._pgs.get(pg_id)
+            if state is None:
+                err = PlacementGroupError(f"unknown placement group {pg_id}")
+            elif state.removed:
+                err = PlacementGroupError("placement group was removed")
+            elif state.infeasible_reason:
+                err = PlacementGroupError(state.infeasible_reason)
+            elif state.ready_event.is_set():
+                resolve_now = True
+            else:
+                self._pg_ready_waiters.setdefault(pg_id, []).append(oid)
+        if err is not None:
+            self._store_error([oid], err)
+        elif resolve_now:
+            self._store_payload(oid, protocol.serialize_value(True, store=None))
+        return ObjectRef(oid, core=self)
+
+    def wait_placement_group(self, pg_id: PlacementGroupID,
+                             timeout: float) -> bool:
+        state = self._pgs.get(pg_id)
+        if state is None:
+            raise PlacementGroupError(f"unknown placement group {pg_id}")
+        return state.ready_event.wait(timeout)
+
+    def placement_group_chips(self, pg_id: PlacementGroupID,
+                              index: int) -> List[int]:
+        state = self._pgs.get(pg_id)
+        if state is None:
+            raise PlacementGroupError(f"unknown placement group {pg_id}")
+        return list(state.bundles[index].chips)
+
+    def remove_placement_group(self, pg_id: PlacementGroupID):
+        with self._lock:
+            state = self._pgs.get(pg_id)
+            if state is None or state.removed:
+                return
+            state.removed = True
+            try:
+                self._pending_pgs.remove(state)
+            except ValueError:
+                pass
+            if state.ready_event.is_set():
+                for b in state.bundles:
+                    unconsumed = b.reserved.subtract_unchecked(b.consumed)
+                    self._avail = self._avail + unconsumed
+                    if self.topology is not None and b.free_chips:
+                        self.topology.release(b.free_chips)
+                        b.free_chips = []
+            waiters = self._pg_ready_waiters.pop(pg_id, [])
+            orphaned = [s for s in self._task_queue
+                        if s.pg_wire is not None and s.pg_wire[1] == pg_id.binary()]
+            for s in orphaned:
+                self._task_queue.remove(s)
+            orphaned_actors = [
+                a for a in self._pending_actors
+                if a.pg_wire is not None and a.pg_wire[1] == pg_id.binary()
+            ]
+        err = PlacementGroupError("placement group was removed")
+        if waiters:
+            self._store_error(waiters, err)
+        for s in orphaned:
+            self._store_error(s.return_ids, err)
+        for a in orphaned_actors:
+            self._mark_actor_dead(a, ActorDiedError(
+                "placement group was removed before the actor was placed"))
+        self._retry_pending_pgs()
+        self._dispatch()
+
+    def placement_group_table(self) -> Dict[str, dict]:
+        out = {}
+        with self._lock:
+            for pg_id, state in self._pgs.items():
+                out[pg_id.hex()] = {
+                    "name": state.name,
+                    "strategy": state.strategy,
+                    "bundles": [b.spec for b in state.bundles],
+                    "chips": [b.chips for b in state.bundles],
+                    "state": ("REMOVED" if state.removed else
+                              "CREATED" if state.ready_event.is_set() else
+                              "PENDING"),
+                    "infeasible_reason": state.infeasible_reason,
+                }
+        return out
+
+    def _retry_pending_pgs(self):
+        newly_ready = []
+        to_start = []
+        with self._lock:
+            still = []
+            for st in self._pending_pgs:
+                if self._try_reserve_pg_locked(st):
+                    newly_ready.append(st)
+                else:
+                    still.append(st)
+            self._pending_pgs = still
+            still_a = []
+            for astate in self._pending_actors:
+                if astate.dead:
+                    continue
+                if self._try_acquire_actor_locked(astate):
+                    to_start.append(astate)
+                else:
+                    still_a.append(astate)
+            self._pending_actors = still_a
+        for st in newly_ready:
+            self._resolve_pg_waiters(st)
+        for astate in to_start:
+            self._start_actor(astate)
+        if newly_ready:
+            self._dispatch()
+
+    def _try_acquire_actor_locked(self, state: _ActorState) -> bool:
+        """Acquire an actor's resources (+ concrete chips). Holds _lock."""
+        req = state.request
+        n_tpus = int(req.get("TPU")) if req is not None else 0
+        if state.pg_wire is not None:
+            pg = self._pgs.get(PlacementGroupID(state.pg_wire[1]))
+            if pg is None or pg.removed or not pg.ready_event.is_set():
+                return False
+            bundle = pg.find_bundle(req or ResourceSet(), state.pg_wire[2])
+            if bundle is None:
+                return False
+            if n_tpus and len(bundle.free_chips) < n_tpus:
+                return False
+            bundle.acquire(req or ResourceSet())
+            state.acquired_bundle = bundle
+            state.chips = bundle.take_chips(n_tpus) if n_tpus else []
+            return True
+        if req is not None and not req.is_subset_of(self._avail):
+            return False
+        chips: List[int] = []
+        if n_tpus:
+            if self.topology is None:
+                return False
+            got = self.topology.allocate(n_tpus, contiguous=True)
+            if got is None:
+                got = self.topology.allocate(n_tpus, contiguous=False)
+            if got is None:
+                return False
+            chips = got
+        if req is not None:
+            self._avail = self._avail - req
+        state.chips = chips
+        return True
+
+    def _release_actor_locked(self, state: _ActorState):
+        req = state.request
+        if req is None:
+            return
+        if state.acquired_bundle is not None:
+            state.acquired_bundle.release(req)
+            pg_removed = False
+            if state.pg_wire is not None:
+                pg = self._pgs.get(PlacementGroupID(state.pg_wire[1]))
+                pg_removed = pg is None or pg.removed
+            if pg_removed:
+                if self.topology is not None and state.chips:
+                    self.topology.release(state.chips)
+            else:
+                state.acquired_bundle.return_chips(state.chips)
+            state.acquired_bundle = None
+        else:
+            self._avail = self._avail + req
+            if self.topology is not None and state.chips:
+                self.topology.release(state.chips)
+        state.request = None
+        state.chips = []
+
     # ------------------------------------------------------------ data server
 
     def _data_server(self, w: _Worker):
@@ -787,8 +1219,7 @@ class Runtime:
             payloads = {}
             entries = [self._entry(ObjectID(b)) for b in oid_bytes_list]
             if not all(e.event.is_set() for e in entries):
-                w.blocked = True
-                self._maybe_scale_up()
+                self._mark_worker_blocked(w)
             try:
                 for b, e in zip(oid_bytes_list, entries):
                     remaining = None if deadline is None else max(
@@ -797,7 +1228,7 @@ class Runtime:
                         raise GetTimeoutError("get() timed out in worker request")
                     payloads[b] = e.payload
             finally:
-                w.blocked = False
+                self._unmark_worker_blocked(w)
             return ("ok", payloads)
         if tag == protocol.REQ_PUT_META:
             _, oid_bytes, payload = msg
@@ -841,13 +1272,12 @@ class Runtime:
         if tag == protocol.REQ_WAIT:
             _, oid_bytes_list, num_returns, timeout_s = msg
             refs = [ObjectRef(ObjectID(b), core=self) for b in oid_bytes_list]
-            w.blocked = True
-            self._maybe_scale_up()
+            self._mark_worker_blocked(w)
             try:
                 ready, rest = self.wait(refs, num_returns=num_returns,
                                         timeout=timeout_s)
             finally:
-                w.blocked = False
+                self._unmark_worker_blocked(w)
             return ("ok", [x.binary() for x in ready], [x.binary() for x in rest])
         if tag == protocol.REQ_KV:
             _, op, key, value = msg

@@ -1,0 +1,13 @@
+"""ray_tpu.util: placement groups, scheduling strategies, collectives
+(API parity with the reference's ray.util namespace)."""
+
+from ray_tpu.core.placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.core.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)

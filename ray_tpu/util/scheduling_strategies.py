@@ -1,0 +1,6 @@
+"""Alias module mirroring ray.util.scheduling_strategies."""
+
+from ray_tpu.core.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
