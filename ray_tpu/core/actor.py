@@ -90,10 +90,6 @@ class ActorClass:
 
     def _remote(self, args, kwargs, options) -> ActorHandle:
         core = runtime_context.get_core()
-        if not hasattr(core, "create_actor") or not hasattr(core, "register_function"):
-            raise NotImplementedError(
-                "creating actors from inside workers is not supported yet"
-            )
         opts = dict(options)
         opts["has_async_methods"] = any(
             inspect.iscoroutinefunction(m)
@@ -107,8 +103,18 @@ class ActorClass:
             if getattr(m, "__rtpu_method_opts__", None)
         }
         opts["method_opts"] = method_opts
-        cls_fn_id = core.register_function(self._cls)
-        actor_id = core.create_actor(cls_fn_id, args, kwargs, opts)
+        if hasattr(core, "register_function"):
+            cls_fn_id = core.register_function(self._cls)
+            actor_id = core.create_actor(cls_fn_id, args, kwargs, opts)
+        else:
+            # worker path: ship the pickled class on first use
+            from ray_tpu.core import serialization
+            import hashlib
+
+            pickled = serialization.pack(self._cls)
+            fn_id = hashlib.blake2b(pickled, digest_size=16).digest()
+            actor_id = core.create_actor_from_worker(
+                fn_id, pickled, args, kwargs, opts)
         return ActorHandle(actor_id, method_opts)
 
     @property

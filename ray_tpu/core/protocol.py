@@ -45,6 +45,8 @@ REQ_SUBMIT = "submit"              # (REQ_SUBMIT, fn_id, pickled_fn_or_none, arg
 REQ_ACTOR_CALL = "actor_call"      # worker-side actor handle call -> ("ok", [oid_bytes])
 REQ_WAIT = "wait"                  # (REQ_WAIT, [oid_bytes], num_returns, timeout_s) -> ("ok", ready, rest)
 REQ_KV = "kv"                      # (REQ_KV, op, key, value) -> ("ok", value)
+REQ_CREATE_ACTOR = "create_actor_req"  # (.., fn_id, pickled_cls_or_none, args_payload, deps, opts) -> ("ok", actor_id_bytes)
+REQ_PG = "pg"                      # (REQ_PG, op, *args) -> ("ok", result); op in create/remove/ready_ref/wait/chips/table
 REQ_GET_ACTOR = "get_actor"        # (REQ_GET_ACTOR, name) -> ("ok", handle_payload)
 
 class ErrorValue:

@@ -113,7 +113,7 @@ class _ActorState:
         "actor_id", "worker", "cls_fn_id", "creation_args_payload",
         "creation_deps", "opts", "queue", "ready", "dead", "death_cause",
         "restarts_left", "name", "creation_event", "request", "pg_wire",
-        "acquired_bundle", "chips",
+        "acquired_bundle", "chips", "resources_acquired",
     )
 
     def __init__(self, actor_id, cls_fn_id, args_payload, deps, opts):
@@ -134,6 +134,7 @@ class _ActorState:
         self.pg_wire = None
         self.acquired_bundle = None
         self.chips: List[int] = []
+        self.resources_acquired = False
 
 
 class Runtime:
@@ -408,12 +409,10 @@ class Runtime:
                 {k: swap(v) for k, v in kwargs.items()}, deps)
 
     def _enqueue(self, spec: _TaskSpec):
-        if spec.pg_wire is not None:
-            pg = self._pgs.get(PlacementGroupID(spec.pg_wire[1]))
-            if pg is None or pg.removed:
-                self._store_error(spec.return_ids, PlacementGroupError(
-                    "placement group was removed"))
-                return
+        if self._spec_pg_removed(spec):
+            self._store_error(spec.return_ids, PlacementGroupError(
+                "placement group was removed"))
+            return
         unresolved = []
         for dep in spec.deps:
             e = self._entry(dep)
@@ -439,7 +438,19 @@ class Runtime:
         else:
             self._queue_ready(spec)
 
+    def _spec_pg_removed(self, spec) -> bool:
+        if spec.pg_wire is None:
+            return False
+        pg = self._pgs.get(PlacementGroupID(spec.pg_wire[1]))
+        return pg is None or pg.removed
+
     def _queue_ready(self, spec: _TaskSpec):
+        # Deps may resolve long after submission; re-check the PG here so a
+        # task whose group vanished while it waited fails instead of hanging.
+        if spec.actor_id is None and self._spec_pg_removed(spec):
+            self._store_error(spec.return_ids, PlacementGroupError(
+                "placement group was removed"))
+            return
         if spec.actor_id is not None:
             state = self._actors[spec.actor_id]
             with self._lock:
@@ -767,11 +778,21 @@ class Runtime:
     def create_actor(self, cls_fn_id: bytes, args: tuple, kwargs: dict,
                      opts: Optional[dict] = None) -> ActorID:
         opts = opts or {}
-        actor_id = ActorID.from_random()
         args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
         args_payload, _ = protocol.serialize_args(args2, kwargs2, store=self.store)
+        return self._create_actor_from_payload(cls_fn_id, args_payload, deps, opts)
+
+    def _create_actor_from_payload(self, cls_fn_id: bytes, args_payload,
+                                   deps: List[ObjectID], opts: dict) -> ActorID:
+        actor_id = ActorID.from_random()
         state = _ActorState(actor_id, cls_fn_id, args_payload, deps, opts)
         state.request, state.pg_wire = self._prepare_request(opts, is_actor=True)
+        if self._spec_pg_removed(state):
+            with self._lock:
+                self._actors[actor_id] = state
+            self._mark_actor_dead(state, ActorDiedError(
+                "placement group was removed before the actor was placed"))
+            return actor_id
         with self._lock:
             self._actors[actor_id] = state
             name = opts.get("name")
@@ -1150,6 +1171,7 @@ class Runtime:
             bundle.acquire(req or ResourceSet())
             state.acquired_bundle = bundle
             state.chips = bundle.take_chips(n_tpus) if n_tpus else []
+            state.resources_acquired = True
             return True
         if req is not None and not req.is_subset_of(self._avail):
             return False
@@ -1166,12 +1188,14 @@ class Runtime:
         if req is not None:
             self._avail = self._avail - req
         state.chips = chips
+        state.resources_acquired = True
         return True
 
     def _release_actor_locked(self, state: _ActorState):
         req = state.request
-        if req is None:
-            return
+        if req is None or not state.resources_acquired:
+            return  # never acquired (still pending) -> nothing to credit
+        state.resources_acquired = False
         if state.acquired_bundle is not None:
             state.acquired_bundle.release(req)
             pg_removed = False
@@ -1290,6 +1314,35 @@ class Runtime:
                 self._kv.pop(key, None)
                 return ("ok", None)
             raise ValueError(f"bad kv op {op}")
+        if tag == protocol.REQ_PG:
+            _, op, *args = msg
+            if op == "create":
+                bundles, strategy, name = args
+                pg = self.create_placement_group(bundles, strategy, name)
+                return ("ok", (pg.id.binary(), pg.bundle_specs))
+            if op == "remove":
+                self.remove_placement_group(PlacementGroupID(args[0]))
+                return ("ok", None)
+            if op == "ready_ref":
+                ref = self.placement_group_ready_ref(PlacementGroupID(args[0]))
+                return ("ok", ref.binary())
+            if op == "wait":
+                return ("ok", self.wait_placement_group(
+                    PlacementGroupID(args[0]), args[1]))
+            if op == "chips":
+                return ("ok", self.placement_group_chips(
+                    PlacementGroupID(args[0]), args[1]))
+            if op == "table":
+                return ("ok", self.placement_group_table())
+            raise ValueError(f"unknown pg op {op!r}")
+        if tag == protocol.REQ_CREATE_ACTOR:
+            _, fn_id, pickled_cls, args_payload, deps, opts = msg
+            if pickled_cls is not None:
+                with self._lock:
+                    self._functions.setdefault(fn_id, pickled_cls)
+            actor_id = self._create_actor_from_payload(
+                fn_id, args_payload, [ObjectID(d) for d in deps], opts or {})
+            return ("ok", actor_id.binary())
         if tag == protocol.REQ_GET_ACTOR:
             _, name = msg
             aid = self.get_named_actor(name)

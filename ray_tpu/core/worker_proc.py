@@ -120,8 +120,15 @@ class WorkerCore:
         )
         return [ObjectRef(ObjectID(b), core=self) for b in oid_bytes_list]
 
-    def create_actor(self, *a, **k):
-        raise NotImplementedError("actors must be created from the driver in v0")
+    def create_actor_from_worker(self, fn_id: bytes, pickled_cls: Optional[bytes],
+                                 args: tuple, kwargs: dict, opts: dict) -> ActorID:
+        args_payload, deps = _prepare_args_local(self, args, kwargs)
+        send_cls = None if fn_id in self._driver_known_fns else pickled_cls
+        _, actor_id_b = self._request(
+            protocol.REQ_CREATE_ACTOR, fn_id, send_cls, args_payload, deps, opts
+        )
+        self._driver_known_fns.add(fn_id)
+        return ActorID(actor_id_b)
 
     def wait(self, refs, num_returns=1, timeout=None):
         if num_returns > len(refs):
@@ -131,6 +138,35 @@ class WorkerCore:
             protocol.REQ_WAIT, list(by_id.keys()), num_returns, timeout
         )
         return [by_id[b] for b in ready_b], [by_id[b] for b in rest_b]
+
+    # ---- placement groups (proxied to the driver) ---------------------------
+
+    def create_placement_group(self, bundles, strategy, name):
+        from ray_tpu.core.ids import PlacementGroupID
+        from ray_tpu.core.placement_group import PlacementGroup
+
+        _, (pg_id_b, specs) = self._request(
+            protocol.REQ_PG, "create", bundles, strategy, name)
+        return PlacementGroup(PlacementGroupID(pg_id_b), specs)
+
+    def remove_placement_group(self, pg_id):
+        self._request(protocol.REQ_PG, "remove", pg_id.binary())
+
+    def placement_group_ready_ref(self, pg_id):
+        _, oid_b = self._request(protocol.REQ_PG, "ready_ref", pg_id.binary())
+        return ObjectRef(ObjectID(oid_b), core=self)
+
+    def wait_placement_group(self, pg_id, timeout):
+        _, ok = self._request(protocol.REQ_PG, "wait", pg_id.binary(), timeout)
+        return ok
+
+    def placement_group_chips(self, pg_id, index):
+        _, chips = self._request(protocol.REQ_PG, "chips", pg_id.binary(), index)
+        return chips
+
+    def placement_group_table(self):
+        _, table = self._request(protocol.REQ_PG, "table")
+        return table
 
     def kv_op(self, op: str, key: str, value=None):
         _, result = self._request(protocol.REQ_KV, op, key, value)

@@ -1,0 +1,32 @@
+"""Parallelism layer: meshes, shardings, and collectives.
+
+- mesh: MeshSpec + build_mesh — named-axis device meshes (dp/fsdp/pp/sp/ep/tp)
+- sharding: logical-axis rules → NamedShardings
+- device_collectives: in-program XLA collectives over ICI (psum, all_gather,
+  reduce_scatter, all_to_all, ring_permute)
+- collective: host-level out-of-band collective groups across actors
+
+Import cost note: jax is imported lazily inside functions; importing
+ray_tpu.parallel does not pull jax.
+"""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ORDER,
+    DATA_AXES,
+    MODEL_AXES,
+    MeshSpec,
+    build_mesh,
+    data_shard_axes,
+    local_mesh,
+)
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    batch_sharding,
+    logical_to_pspec,
+    named_sharding,
+    replicated,
+    shard_pytree_like,
+    with_logical_constraint,
+)
+from ray_tpu.parallel import collective  # noqa: F401
+from ray_tpu.parallel import device_collectives  # noqa: F401

@@ -1,0 +1,169 @@
+"""Device meshes: the ICI-native replacement for collective *groups*.
+
+The reference expresses accelerator parallelism as eager NCCL collective
+groups (python/ray/util/collective/collective.py) and process-group setup in
+trainers (train/torch/config.py:66). On TPU the idiomatic equivalent is a
+``jax.sharding.Mesh`` over the slice with named axes; collectives are XLA
+programs over ICI, not runtime services. This module owns the axis
+conventions and mesh construction.
+
+Axis conventions (outer → inner, DCN-most to ICI-most):
+
+    "dp"    pure data parallel (replicated params)
+    "fsdp"  data parallel with sharded params/optimizer (ZeRO-3 style)
+    "pp"    pipeline stages
+    "sp"    sequence/context parallel (ring attention rides this axis)
+    "tp"    tensor parallel (megatron-style, innermost = fastest ICI)
+    "ep"    expert parallel (MoE; shares the tp neighborhood)
+
+``build_mesh`` places later axes on faster (ICI-adjacent) device
+neighborhoods via jax.experimental.mesh_utils.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "ep", "tp")
+
+# Canonical groupings used by shardings and trainers.
+DATA_AXES = ("dp", "fsdp")          # batch is sharded over these
+MODEL_AXES = ("tp", "sp", "ep", "pp")
+REPLICA_AXES = ("dp",)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A named, ordered parallelism layout.
+
+    Example::
+
+        spec = MeshSpec(axes={"fsdp": 2, "tp": 4})
+        mesh = build_mesh(spec)          # uses all visible devices
+    """
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for name in self.axes:
+            if name not in AXIS_ORDER:
+                raise ValueError(
+                    f"unknown mesh axis {name!r}; valid axes: {AXIS_ORDER}"
+                )
+        if any(s <= 0 for s in self.axes.values()):
+            raise ValueError(f"axis sizes must be positive: {self.axes}")
+
+    @property
+    def ordered(self) -> List[Tuple[str, int]]:
+        """Axes in canonical outer→inner order."""
+        return [(a, self.axes[a]) for a in AXIS_ORDER if a in self.axes]
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(a for a, _ in self.ordered)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.ordered)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for _, s in self.ordered:
+            n *= s
+        return n
+
+    def with_axis(self, name: str, size: int) -> "MeshSpec":
+        axes = dict(self.axes)
+        axes[name] = size
+        return MeshSpec(axes)
+
+    @classmethod
+    def data_parallel(cls, num_devices: int, sharded: bool = True) -> "MeshSpec":
+        """All devices on one data axis (fsdp if sharded else dp)."""
+        return cls({"fsdp" if sharded else "dp": num_devices})
+
+    @classmethod
+    def from_devices(cls, num_devices: int, tp: int = 1, pp: int = 1,
+                     sp: int = 1, ep: int = 1, dp: int = 0) -> "MeshSpec":
+        """Fill the data axis with whatever devices remain after model axes."""
+        model = tp * pp * sp * ep
+        if num_devices % model != 0:
+            raise ValueError(
+                f"{num_devices} devices not divisible by tp*pp*sp*ep={model}"
+            )
+        remaining = num_devices // model
+        axes = {}
+        if dp:
+            if dp != remaining:
+                raise ValueError(f"dp={dp} but only {remaining} devices remain")
+        axes_map = {"dp": remaining, "pp": pp, "sp": sp, "ep": ep, "tp": tp}
+        for k, v in axes_map.items():
+            if v > 1 or (k == "dp" and v >= 1):
+                axes[k] = v
+        return cls(axes)
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Construct a ``jax.sharding.Mesh`` for the spec.
+
+    Axis order maps outer axes to DCN/far links and inner axes (tp) to the
+    tightest ICI neighborhoods, via mesh_utils.create_device_mesh's
+    transposition logic ("How to Scale Your Model" mesh recipe).
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = 1
+    for _, s in spec.ordered:
+        n *= s
+    if n != len(devices):
+        raise ValueError(
+            f"mesh spec {dict(spec.axes)} needs {n} devices, "
+            f"got {len(devices)}"
+        )
+    if len(devices) == 1:
+        import numpy as np
+
+        dev_array = np.array(devices).reshape(spec.shape or (1,))
+        return Mesh(dev_array, spec.axis_names or ("dp",))
+    dev_mesh = mesh_utils.create_device_mesh(
+        spec.shape, devices=list(devices)
+    )
+    return Mesh(dev_mesh, spec.axis_names)
+
+
+def local_mesh(tp: int = 0, **axes) -> "object":
+    """Convenience: mesh over all local devices.
+
+    ``local_mesh()`` → pure fsdp over every visible device;
+    ``local_mesh(tp=4)`` → tp=4, data-parallel over the rest.
+    """
+    import jax
+
+    n = len(jax.devices())
+    if not axes and not tp:
+        return build_mesh(MeshSpec.data_parallel(n))
+    if tp:
+        axes["tp"] = tp
+    model = 1
+    for v in axes.values():
+        model *= v
+    if n % model:
+        raise ValueError(f"{n} devices not divisible by {axes}")
+    if n // model > 1:
+        axes = {"fsdp": n // model, **axes}
+    return build_mesh(MeshSpec(axes))
+
+
+def mesh_axis_names(mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def data_shard_axes(mesh) -> Tuple[str, ...]:
+    """Mesh axes the global batch is sharded over."""
+    return tuple(a for a in DATA_AXES if a in mesh.axis_names)

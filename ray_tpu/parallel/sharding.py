@@ -1,0 +1,114 @@
+"""Logical-axis sharding rules → concrete NamedShardings.
+
+Models annotate parameters and activations with *logical* dim names
+("batch", "embed", "mlp", "heads", "kv", "vocab", "seq", "expert", "stage");
+a rule table maps logical names to mesh axes. This is flax's logical
+partitioning pattern, kept framework-agnostic so plain-jax models use it too.
+
+The default rule table implements the standard megatron/ZeRO layout over the
+ray_tpu axis conventions (mesh.py): batch over (dp, fsdp), embed sharded
+over fsdp for ZeRO-3, matmul output dims over tp, sequence over sp, experts
+over ep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+# (logical dim name, mesh axis or tuple of axes or None)
+DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sp"),
+    ("embed", "fsdp"),       # ZeRO-3: params sharded over fsdp on the embed dim
+    ("mlp", "tp"),
+    ("heads", "tp"),
+    ("kv", None),
+    ("qkv", "tp"),
+    ("vocab", "tp"),
+    ("expert", "ep"),
+    ("stage", "pp"),
+    (None, None),
+)
+
+
+def resolve_axis(logical: Optional[str], mesh, rules=DEFAULT_RULES):
+    """Map one logical dim to mesh axes present in `mesh` (else None)."""
+    if logical is None:
+        return None
+    for name, target in rules:
+        if name == logical:
+            if target is None:
+                return None
+            if isinstance(target, str):
+                return target if target in mesh.axis_names else None
+            present = tuple(a for a in target if a in mesh.axis_names)
+            return present if present else None
+    return None
+
+
+def logical_to_pspec(logical_axes: Sequence[Optional[str]], mesh,
+                     rules=DEFAULT_RULES):
+    """('batch','seq','embed') → PartitionSpec over the mesh's real axes."""
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(
+        *(resolve_axis(a, mesh, rules) for a in logical_axes)
+    )
+
+
+def named_sharding(mesh, *logical_axes, rules=DEFAULT_RULES):
+    """NamedSharding for logical dims, e.g. named_sharding(mesh, 'batch', None, 'embed')."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, logical_to_pspec(logical_axes, mesh, rules))
+
+
+def with_logical_constraint(x, logical_axes: Sequence[Optional[str]], mesh=None,
+                            rules=DEFAULT_RULES):
+    """Sharding constraint by logical names inside jitted code."""
+    import jax
+
+    if mesh is None:
+        from jax.interpreters import pxla
+
+        mesh = pxla.thread_resources.env.physical_mesh
+        if mesh.empty:
+            return x
+    return jax.lax.with_sharding_constraint(
+        x, named_sharding(mesh, *logical_axes, rules=rules)
+    )
+
+
+def shard_pytree_like(tree, logical_tree, mesh, rules=DEFAULT_RULES):
+    """Build a NamedSharding pytree from a matching pytree of logical-axis
+    tuples (None entries → fully replicated)."""
+    import jax
+
+    def one(logical):
+        if logical is None:
+            return named_sharding(mesh)
+        return named_sharding(mesh, *logical, rules=rules)
+
+    return jax.tree_util.tree_map(
+        one, logical_tree,
+        is_leaf=lambda x: x is None or (isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x)),
+    )
+
+
+def device_put_sharded(tree, shardings):
+    """jax.device_put a pytree with a matching shardings pytree."""
+    import jax
+
+    return jax.device_put(tree, shardings)
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh):
+    """Sharding for a [global_batch, ...] array over the data axes."""
+    return named_sharding(mesh, "batch")

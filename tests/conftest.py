@@ -8,12 +8,25 @@ hence they live here at collection time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of the ambient TPU env: tests exercise sharding on a
+# virtual 8-device CPU mesh; TPU-hardware checks live in bench/graft entry.
+# NOTE: this environment's sitecustomize imports jax at interpreter startup
+# and pins the TPU platform via jax.config, so env vars alone are too late —
+# we must override through jax.config as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import sys
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -242,3 +242,40 @@ def test_actor_released_resources_reusable(tpu_rt):
     h2 = Hog.options(num_tpus=8).remote()
     assert tpu_rt.get(h2.ping.remote(), timeout=20) == 1
     ray_tpu.kill(h2)
+
+
+def test_worker_side_pg_api(tpu_rt):
+    """PG handles work from inside tasks/actors (proxied to the driver)."""
+
+    @ray_tpu.remote
+    def make_and_query():
+        from ray_tpu.util import placement_group as pg_fn
+        from ray_tpu.util import remove_placement_group as rm
+
+        pg = pg_fn([{"CPU": 1}], strategy="PACK")
+        ok = pg.wait(10)
+        rm(pg)
+        return ok
+
+    assert tpu_rt.get(make_and_query.remote(), timeout=30) is True
+
+
+def test_pending_actor_on_removed_pg_dies(tpu_rt):
+    from ray_tpu.exceptions import ActorDiedError
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote
+    class Big:
+        def ping(self):
+            return 1
+
+    # Wants more CPU than the 1-CPU bundle holds -> stays pending
+    b = Big.options(
+        num_cpus=2,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0),
+    ).remote()
+    remove_placement_group(pg)
+    with pytest.raises(ActorDiedError):
+        tpu_rt.get(b.ping.remote(), timeout=15)
