@@ -1,0 +1,54 @@
+"""Benchmark driver: prints ONE JSON line comparing against the reference.
+
+Metric: single-client async task throughput — the reference's headline core
+microbenchmark (`single_client_tasks_async`, python/ray/_private/ray_perf.py;
+baseline 8011.5 tasks/s on m5.16xlarge, BASELINE.md).
+
+Method mirrors ray_perf.py: submit a batch of trivial remote tasks, then
+resolve them all; rate = N / wall.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_TASKS_ASYNC = 8011.5  # release/perf_metrics/microbenchmark.json
+
+
+def bench_tasks_async(n_warm: int = 500, n: int = 10_000) -> float:
+    import ray_tpu
+
+    import os
+
+    # Submission is driver-bound; on small hosts fewer workers cut GIL and
+    # scheduling contention.
+    nw = 2 if (os.cpu_count() or 1) <= 2 else None
+    ray_tpu.init(num_workers=nw, object_store_memory=512 << 20)
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get([noop.remote() for _ in range(n_warm)])
+
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    ray_tpu.shutdown()
+    return n / dt
+
+
+def main():
+    rate = bench_tasks_async()
+    print(json.dumps({
+        "metric": "single_client_tasks_async",
+        "value": round(rate, 1),
+        "unit": "tasks/s",
+        "vs_baseline": round(rate / BASELINE_TASKS_ASYNC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,21 +25,22 @@ from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_ref import ObjectRef, begin_ref_collection, end_ref_collection
 
 # driver -> worker (task conn)
-MSG_TASK = "task"                  # (MSG_TASK, task_id_b, fn_id, args_payload, inline_values, return_id_bytes: List[bytes])
 MSG_REGISTER_FN = "reg_fn"         # (MSG_REGISTER_FN, fn_id, pickled_fn)
 MSG_CREATE_ACTOR = "create_actor"  # (.., actor_id_b, cls_fn_id, args_payload, inline_values, opts)
 MSG_ACTOR_CALL = "actor_call"      # (.., task_id_b, actor_id_b, method, args_payload, inline_values, return_id_bytes)
+MSG_TASK_BATCH = "task_batch"      # (MSG_TASK_BATCH, [(task_id_b, fn_id, args_payload, inline_values, return_ids), ...])
 MSG_SHUTDOWN = "shutdown"
 
 # worker -> driver (task conn)
 MSG_READY = "ready"                # (MSG_READY, pid)
 MSG_DONE = "done"                  # (MSG_DONE, task_id_b, [payload, ...])
 MSG_ERROR = "error"                # (MSG_ERROR, task_id_b, pickled_exc_payload)
+MSG_DONE_BATCH = "done_batch"      # (MSG_DONE_BATCH, [(task_id_b, ok, payloads_or_errpayload), ...])
 MSG_ACTOR_READY = "actor_ready"    # (.., actor_id_b)
 MSG_ACTOR_ERROR = "actor_error"    # (.., actor_id_b, pickled_exc_payload)
 
 # worker -> driver (data conn, request/response)
-REQ_GET = "get"                    # (REQ_GET, [oid_bytes], timeout) -> ("ok", {oid: payload}) | ("err", payload)
+REQ_GET = "get"                    # (REQ_GET, [oid_bytes], timeout_ms, cur_task_id_b) -> ("ok", {oid: payload}) | ("err", payload)
 REQ_PUT_META = "put_meta"          # (REQ_PUT_META, oid_bytes, payload_or_none) -> ("ok",)
 REQ_SUBMIT = "submit"              # (REQ_SUBMIT, fn_id, pickled_fn_or_none, args_payload, inline_values, n_returns, ref_oids) -> ("ok", [oid_bytes])
 REQ_ACTOR_CALL = "actor_call"      # worker-side actor handle call -> ("ok", [oid_bytes])

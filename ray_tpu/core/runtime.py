@@ -97,7 +97,7 @@ class _Worker:
         self.alive = True
         self.registered_fns = set()
         self.actor_id: Optional[ActorID] = None
-        self.inflight: Optional[_TaskSpec] = None
+        self.inflight: Dict[bytes, _TaskSpec] = {}
         self.reader: Optional[threading.Thread] = None
         self.data_thread: Optional[threading.Thread] = None
         # Connection.send is not thread-safe; every task_conn.send goes
@@ -277,6 +277,8 @@ class Runtime:
                     self._dispatch()
                 elif tag == protocol.MSG_DONE:
                     self._on_task_done(w, msg[1], msg[2])
+                elif tag == protocol.MSG_DONE_BATCH:
+                    self._on_task_done_batch(w, msg[1])
                 elif tag == protocol.MSG_ERROR:
                     self._on_task_error(w, msg[1], msg[2])
                 elif tag == protocol.MSG_ACTOR_READY:
@@ -300,16 +302,18 @@ class Runtime:
                 self._idle.remove(w)
             except ValueError:
                 pass
-            inflight = w.inflight
-            w.inflight = None
+            inflight = list(w.inflight.values())
+            w.inflight.clear()
             actor_id = w.actor_id
-        if inflight is not None:
-            with self._lock:
-                self._release_spec_locked(inflight)
+        if inflight:
             err = WorkerCrashedError(
                 f"worker {w.worker_id.hex()[:8]} died while executing task"
             )
-            self._store_error(inflight.return_ids, err)
+            with self._lock:
+                for spec in inflight:
+                    self._release_spec_locked(spec)
+            for spec in inflight:
+                self._store_error(spec.return_ids, err)
             self._retry_pending_pgs()
         if actor_id is not None:
             self._handle_actor_worker_death(actor_id)
@@ -461,15 +465,16 @@ class Runtime:
                 self._task_queue.append(spec)
             self._dispatch()
 
-    def _mark_worker_blocked(self, w: _Worker):
-        """Worker enters a blocking get/wait: release its task's resources so
-        dependents can run (reference: raylet releases CPU of workers blocked
-        in ray.get), and scale the pool if everyone is blocked."""
+    def _mark_worker_blocked(self, w: _Worker, task_id_b: Optional[bytes]):
+        """Worker enters a blocking get/wait: release the *blocking task's*
+        resources so dependents can run (reference: raylet releases CPU of
+        workers blocked in ray.get), and scale the pool if everyone is
+        blocked."""
         released = False
         with self._lock:
             if not w.blocked:
                 w.blocked = True
-                spec = w.inflight
+                spec = w.inflight.get(task_id_b) if task_id_b else None
                 if spec is not None and spec.request is not None \
                         and spec.acquired_bundle is None \
                         and not spec.blocked_released:
@@ -481,11 +486,11 @@ class Runtime:
             self._dispatch()
         self._maybe_scale_up()
 
-    def _unmark_worker_blocked(self, w: _Worker):
+    def _unmark_worker_blocked(self, w: _Worker, task_id_b: Optional[bytes]):
         with self._lock:
             if w.blocked:
                 w.blocked = False
-                spec = w.inflight
+                spec = w.inflight.get(task_id_b) if task_id_b else None
                 if spec is not None and spec.blocked_released:
                     # Oversubscription debt is allowed; it drains as other
                     # tasks finish.
@@ -511,24 +516,50 @@ class Runtime:
         if spawn:
             self._spawn_worker()
 
+    MAX_DISPATCH_BATCH = 32
+
     def _dispatch(self):
         while True:
+            batch = []
             with self._lock:
                 while self._idle and not self._idle[0].alive:
                     self._idle.popleft()
                 if not self._task_queue or not self._idle:
                     return
-                picked = None
-                for i, spec in enumerate(self._task_queue):
-                    if self._try_acquire_spec_locked(spec):
-                        picked = i
-                        break
-                if picked is None:
+                # Fair division: divide the queue across the whole pool
+                # (busy workers rejoin soon), so one early-finishing worker
+                # cannot swallow work the others would run in parallel.
+                pool = sum(1 for x in self._workers.values()
+                           if x.alive and x.actor_id is None) or 1
+                cap = max(1, min(
+                    self.MAX_DISPATCH_BATCH,
+                    -(-len(self._task_queue) // pool),
+                ))
+                i = 0
+                while i < len(self._task_queue) and len(batch) < cap:
+                    spec = self._task_queue[i]
+                    if spec.request is not None or spec.pg_wire is not None:
+                        # Resource-bearing specs ship alone so their
+                        # resources release at *their* completion, not at
+                        # the end of an unrelated batch.
+                        if batch:
+                            break
+                        if self._try_acquire_spec_locked(spec):
+                            batch.append(spec)
+                            del self._task_queue[i]
+                        else:
+                            i += 1
+                        if batch:
+                            break
+                        continue
+                    batch.append(spec)
+                    del self._task_queue[i]
+                if not batch:
                     return
-                del self._task_queue[picked]
                 w = self._idle.popleft()
-                w.inflight = spec
-            self._send_task(w, spec)
+                for spec in batch:
+                    w.inflight[spec.task_id.binary()] = spec
+            self._send_task_batch(w, batch)
 
     # ----------------------------------------------------------- resources
 
@@ -560,6 +591,13 @@ class Runtime:
                 pg_wire = wire
         elif isinstance(strategy, tuple) and strategy and strategy[0] == "pg":
             pg_wire = strategy
+        if not is_actor and pg_wire is None and req == {"CPU": 1.0}:
+            # The worker slot IS the CPU for a default task (pool size ==
+            # CPU count): gate on worker availability only, which lets the
+            # dispatcher pipeline batches onto workers. Non-default
+            # requests (custom resources, fractional CPU, PG bundles) go
+            # through explicit accounting.
+            return None, None
         return ResourceSet(req), pg_wire
 
     def _try_acquire_spec_locked(self, spec) -> bool:
@@ -609,10 +647,10 @@ class Runtime:
                 state.queue.clear()
             elif (
                 w is not None and state.ready and not state.dead
-                and w.inflight is None and state.queue
+                and not w.inflight and state.queue
             ):
                 spec = state.queue.popleft()
-                w.inflight = spec
+                w.inflight[spec.task_id.binary()] = spec
         for f in failed:
             self._store_error(
                 f.return_ids,
@@ -632,15 +670,17 @@ class Runtime:
                 out[dep.binary()] = None  # worker reads shm directly
         return out
 
-    def _send_task(self, w: _Worker, spec: _TaskSpec):
+    def _send_task_batch(self, w: _Worker, batch: List[_TaskSpec]):
         try:
-            self._ensure_fn_on_worker(w, spec.fn_id)
-            inline_values = self._inline_values_for(spec.deps)
-            self._send_msg(w, (
-                protocol.MSG_TASK, spec.task_id.binary(), spec.fn_id,
-                spec.args_payload, inline_values,
-                [r.binary() for r in spec.return_ids],
-            ))
+            entries = []
+            for spec in batch:
+                self._ensure_fn_on_worker(w, spec.fn_id)
+                inline_values = self._inline_values_for(spec.deps)
+                entries.append((
+                    spec.task_id.binary(), spec.fn_id, spec.args_payload,
+                    inline_values, [r.binary() for r in spec.return_ids],
+                ))
+            self._send_msg(w, (protocol.MSG_TASK_BATCH, entries))
         except (OSError, EOFError, BrokenPipeError):
             self._on_worker_death(w)
 
@@ -657,8 +697,7 @@ class Runtime:
 
     def _on_task_done(self, w: _Worker, task_id_b: bytes, payloads):
         with self._lock:
-            spec = w.inflight
-            w.inflight = None
+            spec = w.inflight.pop(task_id_b, None)
             if spec is not None:
                 self._release_spec_locked(spec)
         if spec is not None:
@@ -667,10 +706,29 @@ class Runtime:
         self._retry_pending_pgs()
         self._worker_now_idle(w)
 
+    def _on_task_done_batch(self, w: _Worker, results):
+        specs = []
+        with self._lock:
+            for task_id_b, ok, payload in results:
+                spec = w.inflight.pop(task_id_b, None)
+                if spec is not None:
+                    self._release_spec_locked(spec)
+                specs.append(spec)
+        for (task_id_b, ok, payload), spec in zip(results, specs):
+            if spec is None:
+                continue
+            if ok:
+                for rid, p in zip(spec.return_ids, payload):
+                    self._store_payload(rid, p)
+            else:
+                for rid in spec.return_ids:
+                    self._store_payload(rid, payload)
+        self._retry_pending_pgs()
+        self._worker_now_idle(w)
+
     def _on_task_error(self, w: _Worker, task_id_b: bytes, err_payload):
         with self._lock:
-            spec = w.inflight
-            w.inflight = None
+            spec = w.inflight.pop(task_id_b, None)
             if spec is not None:
                 self._release_spec_locked(spec)
         if spec is not None:
@@ -685,9 +743,26 @@ class Runtime:
             if state is not None:
                 self._dispatch_actor(state)
             return
+        retire = False
         with self._lock:
-            if w.alive:
+            pool = sum(1 for x in self._workers.values()
+                       if x.alive and x.actor_id is None)
+            if (not self._task_queue and pool > self.num_workers
+                    and not w.inflight):
+                # Surplus worker from blocked-get scale-up: retire it so the
+                # pool (and the implicit CPU cap on default tasks) returns
+                # to its configured size.
+                self._workers.pop(w.worker_id, None)
+                w.alive = False
+                retire = True
+            elif w.alive and not w.inflight and w not in self._idle:
                 self._idle.append(w)
+        if retire:
+            try:
+                self._send_msg(w, (protocol.MSG_SHUTDOWN,))
+            except (OSError, EOFError, BrokenPipeError):
+                pass
+            return
         self._dispatch()
 
     # ------------------------------------------------------------------- api
@@ -1237,13 +1312,13 @@ class Runtime:
     def _handle_data_request(self, w: _Worker, msg):
         tag = msg[0]
         if tag == protocol.REQ_GET:
-            _, oid_bytes_list, timeout_ms = msg
+            _, oid_bytes_list, timeout_ms, cur_task = msg
             timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
             deadline = None if timeout is None else time.monotonic() + timeout
             payloads = {}
             entries = [self._entry(ObjectID(b)) for b in oid_bytes_list]
             if not all(e.event.is_set() for e in entries):
-                self._mark_worker_blocked(w)
+                self._mark_worker_blocked(w, cur_task)
             try:
                 for b, e in zip(oid_bytes_list, entries):
                     remaining = None if deadline is None else max(
@@ -1252,7 +1327,7 @@ class Runtime:
                         raise GetTimeoutError("get() timed out in worker request")
                     payloads[b] = e.payload
             finally:
-                self._unmark_worker_blocked(w)
+                self._unmark_worker_blocked(w, cur_task)
             return ("ok", payloads)
         if tag == protocol.REQ_PUT_META:
             _, oid_bytes, payload = msg
@@ -1294,14 +1369,14 @@ class Runtime:
                 self._enqueue(spec)
             return ("ok", [r.binary() for r in return_ids])
         if tag == protocol.REQ_WAIT:
-            _, oid_bytes_list, num_returns, timeout_s = msg
+            _, oid_bytes_list, num_returns, timeout_s, cur_task = msg
             refs = [ObjectRef(ObjectID(b), core=self) for b in oid_bytes_list]
-            self._mark_worker_blocked(w)
+            self._mark_worker_blocked(w, cur_task)
             try:
                 ready, rest = self.wait(refs, num_returns=num_returns,
                                         timeout=timeout_s)
             finally:
-                self._unmark_worker_blocked(w)
+                self._unmark_worker_blocked(w, cur_task)
             return ("ok", [x.binary() for x in ready], [x.binary() for x in rest])
         if tag == protocol.REQ_KV:
             _, op, key, value = msg

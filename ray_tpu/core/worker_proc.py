@@ -74,8 +74,10 @@ class WorkerCore:
                 missing.append(oid)
         if missing:
             timeout_ms = -1 if timeout is None else int(timeout * 1000)
+            cur = self.current_task_id.binary() if self.current_task_id else None
             _, payloads = self._request(
-                protocol.REQ_GET, [o.binary() for o in missing], timeout_ms
+                protocol.REQ_GET, [o.binary() for o in missing], timeout_ms,
+                cur,
             )
             for oid in missing:
                 values[oid] = protocol.deserialize_payload(
@@ -134,8 +136,9 @@ class WorkerCore:
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
         by_id = {r.id.binary(): r for r in refs}
+        cur = self.current_task_id.binary() if self.current_task_id else None
         _, ready_b, rest_b = self._request(
-            protocol.REQ_WAIT, list(by_id.keys()), num_returns, timeout
+            protocol.REQ_WAIT, list(by_id.keys()), num_returns, timeout, cur
         )
         return [by_id[b] for b in ready_b], [by_id[b] for b in rest_b]
 
@@ -207,8 +210,8 @@ class WorkerCore:
             elif tag == protocol.MSG_REGISTER_FN:
                 _, fn_id, pickled_fn = msg
                 self._functions[fn_id] = serialization.unpack(pickled_fn)
-            elif tag == protocol.MSG_TASK:
-                self._execute_task(msg)
+            elif tag == protocol.MSG_TASK_BATCH:
+                self._execute_task_batch(msg[1])
             elif tag == protocol.MSG_CREATE_ACTOR:
                 self._create_actor(msg)
             elif tag == protocol.MSG_ACTOR_CALL:
@@ -270,18 +273,60 @@ class WorkerCore:
         serialization.write_container(memoryview(out), pickled, views)
         return ("inline", bytes(out))
 
-    def _execute_task(self, msg):
-        _, task_id_b, fn_id, args_payload, inline_values, return_id_bytes = msg
-        self.current_task_id = TaskID(task_id_b)
-        try:
-            fn = self._functions[fn_id]
-            args, kwargs = self._decode_args(args_payload, inline_values)
-            result = fn(*args, **kwargs)
-            self._send_results(task_id_b, result, len(return_id_bytes), return_id_bytes)
-        except BaseException as e:  # noqa: BLE001
-            self._send_error(task_id_b, e)
-        finally:
-            self.current_task_id = None
+    def _execute_task_batch(self, tasks):
+        """Execute a pipelined batch; one reply amortizes the control-plane
+        round trip (the reference gets the same effect from leased-worker
+        pipelining in NormalTaskSubmitter)."""
+        results = []
+        import time as _time
+
+        last_flush = _time.perf_counter()
+
+        def flush():
+            nonlocal last_flush, results
+            if results:
+                self.task_conn.send((protocol.MSG_DONE_BATCH, results))
+                results = []
+            last_flush = _time.perf_counter()
+
+        for task_id_b, fn_id, args_payload, inline_values, return_ids in tasks:
+            self.current_task_id = TaskID(task_id_b)
+            try:
+                fn = self._functions[fn_id]
+                args, kwargs = self._decode_args(args_payload, inline_values)
+                result = fn(*args, **kwargs)
+                if len(return_ids) == 1:
+                    values = [result]
+                else:
+                    values = list(result)
+                    if len(values) != len(return_ids):
+                        raise ValueError(
+                            f"task declared num_returns={len(return_ids)} "
+                            f"but returned {len(values)} values")
+                payloads = [
+                    self._serialize_result(v, ObjectID(rid))
+                    for v, rid in zip(values, return_ids)
+                ]
+                results.append((task_id_b, True, payloads))
+            except BaseException as e:  # noqa: BLE001
+                err = e if isinstance(e, TaskError) else TaskError(
+                    e, traceback.format_exc())
+                try:
+                    payload = protocol.serialize_value(
+                        protocol.ErrorValue(err), store=None)
+                except Exception:
+                    payload = protocol.serialize_value(
+                        protocol.ErrorValue(TaskError(
+                            RuntimeError(repr(e)), traceback.format_exc())),
+                        store=None)
+                results.append((task_id_b, False, payload))
+            finally:
+                self.current_task_id = None
+            # Incremental flush: a slow task must not delay the results of
+            # fast tasks already finished in this batch.
+            if _time.perf_counter() - last_flush > 0.002:
+                flush()
+        flush()
 
     def _send_error(self, task_id_b: bytes, exc: BaseException):
         err = exc if isinstance(exc, TaskError) else TaskError(

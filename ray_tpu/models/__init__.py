@@ -1,0 +1,3 @@
+"""Model zoo: TPU-first implementations with logical-axis shardings."""
+
+from ray_tpu.models import gpt2, llama  # noqa: F401

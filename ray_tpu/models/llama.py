@@ -1,0 +1,247 @@
+"""Llama-3-style decoder-only transformer, TPU-first.
+
+Design (none of this exists in the reference — it delegates models to
+torch; this is the flagship model the north-star configs name):
+
+- plain-jax pytree params with *stacked* layers and a ``lax.scan`` over the
+  stack: one layer traced/compiled once regardless of depth.
+- every parameter carries logical axis names (parallel/sharding.py) so the
+  same model runs dp/fsdp/tp/sp by choosing a mesh; no model code changes.
+- bf16 params/activations with fp32 accumulations (preferred_element_type)
+  — MXU-native.
+- ``jax.checkpoint`` around each layer (rematerialization: HBM traded for
+  FLOPs on the backward pass).
+- attention backend switch: "flash" (Pallas), "reference" (XLA), "ring"
+  (sequence-parallel over the sp axis).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention_reference, flash_attention
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+from ray_tpu.ops.ring_attention import ring_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    hidden_size: int = 4096
+    intermediate_size: int = 14_336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "auto"  # auto | flash | reference | ring
+    remat: bool = True
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    # ---- presets -----------------------------------------------------------
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama3_1b_proxy(cls, **kw) -> "LlamaConfig":
+        cfg = cls(hidden_size=2048, intermediate_size=5504, num_layers=16,
+                  num_heads=16, num_kv_heads=8, vocab_size=32_000)
+        return replace(cfg, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        cfg = cls(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+                  dtype=jnp.float32, remat=False)
+        return replace(cfg, **kw)
+
+
+# Logical axis names for every parameter (rules in parallel/sharding.py map
+# them onto the mesh; the leading "layer" dim of stacked params is unsharded
+# until pipeline parallelism assigns it to "pp").
+def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    L = ("layer",)
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": L + ("embed",),
+            "wq": L + ("embed", "qkv"),
+            "wk": L + ("embed", "qkv"),
+            "wv": L + ("embed", "qkv"),
+            "wo": L + ("qkv", "embed"),
+            "mlp_norm": L + ("embed",),
+            "w_gate": L + ("embed", "mlp"),
+            "w_up": L + ("embed", "mlp"),
+            "w_down": L + ("mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Truncated-normal init (fan-in scaled), params in cfg.param_dtype."""
+    h, ffn, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    hd = cfg.head_dim_
+    qd = cfg.num_heads * hd
+    kvd = cfg.num_kv_heads * hd
+    keys = jax.random.split(key, 8)
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.truncated_normal(k, -3, 3, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(cfg.param_dtype)
+
+    params = {
+        "embed": norm_init(keys[0], (cfg.vocab_size, h), h),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), cfg.param_dtype),
+            "wq": norm_init(keys[1], (L, h, qd), h),
+            "wk": norm_init(keys[2], (L, h, kvd), h),
+            "wv": norm_init(keys[3], (L, h, kvd), h),
+            "wo": norm_init(keys[4], (L, qd, h), qd),
+            "mlp_norm": jnp.ones((L, h), cfg.param_dtype),
+            "w_gate": norm_init(keys[5], (L, h, ffn), h),
+            "w_up": norm_init(keys[6], (L, h, ffn), h),
+            "w_down": norm_init(keys[7], (L, ffn, h), ffn),
+        },
+        "final_norm": jnp.ones((h,), cfg.param_dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm_init(
+            jax.random.fold_in(key, 99), (h, cfg.vocab_size), h)
+    return params
+
+
+def _attend(cfg: LlamaConfig, q, k, v, mesh=None):
+    impl = cfg.attn_impl
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "reference"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=True)
+    if impl == "ring":
+        if mesh is None:
+            raise ValueError("attn_impl='ring' requires a mesh with an 'sp' axis")
+        return ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+    return attention_reference(q, k, v, causal=True)
+
+
+def _layer(cfg: LlamaConfig, x, layer_params, cos, sin, mesh=None):
+    """One decoder block. x: [b, s, h]."""
+    p = layer_params
+    b, s, _ = x.shape
+    hd = cfg.head_dim_
+
+    h1 = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+    q = jnp.dot(h1, p["wq"].astype(cfg.dtype),
+                preferred_element_type=jnp.float32).astype(cfg.dtype)
+    k = jnp.dot(h1, p["wk"].astype(cfg.dtype),
+                preferred_element_type=jnp.float32).astype(cfg.dtype)
+    v = jnp.dot(h1, p["wv"].astype(cfg.dtype),
+                preferred_element_type=jnp.float32).astype(cfg.dtype)
+    q = q.reshape(b, s, cfg.num_heads, hd)
+    k = k.reshape(b, s, cfg.num_kv_heads, hd)
+    v = v.reshape(b, s, cfg.num_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = _attend(cfg, q, k, v, mesh=mesh)
+    attn = attn.reshape(b, s, cfg.num_heads * hd)
+    attn_out = jnp.dot(attn, p["wo"].astype(cfg.dtype),
+                       preferred_element_type=jnp.float32).astype(cfg.dtype)
+    x = x + attn_out
+
+    h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+    mlp = swiglu(h2, p["w_gate"].astype(cfg.dtype),
+                 p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype))
+    return x + mlp
+
+
+def forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
+            mesh=None) -> jax.Array:
+    """tokens [b, s] int32 → logits [b, s, vocab] float32."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    cos, sin = rope_frequencies(cfg.head_dim_, tokens.shape[1],
+                                cfg.rope_theta, dtype=cfg.dtype)
+
+    layer_fn = lambda x_, p_: _layer(cfg, x_, p_, cos, sin, mesh=mesh)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(x_, p_):
+        return layer_fn(x_, p_), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.dot(x, head.astype(cfg.dtype),
+                     preferred_element_type=jnp.float32)
+    return logits
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
+                       mask: Optional[jax.Array] = None,
+                       z_loss: float = 0.0) -> jax.Array:
+    """Token-level CE in fp32 with optional z-loss regularization."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
+    )[..., 0]
+    nll = lse - true_logit
+    if z_loss:
+        nll = nll + z_loss * jnp.square(lse)
+    if mask is not None:
+        nll = nll * mask
+        return nll.sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
+
+
+def loss_fn(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
+            mesh=None) -> jax.Array:
+    """batch: {"tokens": [b, s]} — next-token prediction."""
+    tokens = batch["tokens"]
+    logits = forward(cfg, params, tokens[:, :-1], mesh=mesh)
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+    return cross_entropy_loss(logits, tokens[:, 1:], mask)
+
+
+def num_params(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+def param_shardings(cfg: LlamaConfig, mesh):
+    """NamedSharding pytree for params on a given mesh."""
+    from ray_tpu.parallel.sharding import shard_pytree_like
+
+    return shard_pytree_like(logical_axes_without_layer(cfg), mesh)
+
+
+def logical_axes_without_layer(cfg: LlamaConfig):
+    """Logical axes with the stacked 'layer' dim mapped to None (pipeline
+    parallelism later maps it to 'pp')."""
+    return jax.tree_util.tree_map(
+        lambda t: tuple(None if a == "layer" else a for a in t),
+        logical_axes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def init_shapes(cfg: LlamaConfig):
+    """ShapeDtypeStruct pytree matching init_params (for eval_shape uses)."""
+    return jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))

@@ -1,0 +1,11 @@
+"""TPU compute ops: attention kernels, ring attention, transformer layers."""
+
+from ray_tpu.ops.attention import attention_reference, flash_attention  # noqa: F401
+from ray_tpu.ops.layers import (  # noqa: F401
+    apply_rope,
+    repeat_kv,
+    rms_norm,
+    rope_frequencies,
+    swiglu,
+)
+from ray_tpu.ops.ring_attention import ring_attention, ring_attention_local  # noqa: F401

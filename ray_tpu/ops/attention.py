@@ -1,0 +1,202 @@
+"""Attention: reference jax implementation + Pallas flash-attention kernel.
+
+The Pallas kernel is the TPU hot path: blocked online-softmax attention that
+never materializes the [seq, seq] score matrix in HBM (VMEM-resident tiles,
+MXU matmuls, fp32 accumulation). Grouped-query attention is supported by
+mapping each query head to its KV group via the BlockSpec index maps.
+
+Training uses ``flash_attention`` through a custom_vjp whose backward pass
+recomputes attention with the reference implementation (flash backward
+kernel is a follow-up; ring attention chunks the sequence for long-context
+training so the recompute stays bounded).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.layers import repeat_kv
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True,
+                        sm_scale: Optional[float] = None) -> jax.Array:
+    """Plain softmax attention (fp32 softmax), GQA-aware.
+
+    q: [batch, seq_q, heads, head_dim]
+    k, v: [batch, seq_k, kv_heads, head_dim]
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    # [b, h, sq, sk]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        scores = jnp.where(qi + (sk - sq) >= ki, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------- pallas fwd
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  sm_scale: float, seq_k: int, block_q: int):
+    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_k, d]; o_ref: [1, block_q, d]
+    import jax.experimental.pallas as pl
+
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # [block_q, d]
+    d = q.shape[-1]
+
+    num_kv_blocks = seq_k // block_k
+    if causal:
+        # only blocks whose start is <= the last query position
+        last_q = (qb + 1) * block_q - 1
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            qi = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            ki = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (qb * block_q + qi) >= (kb * block_k + ki)
+            s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    init = (
+        jnp.zeros((block_q, d), jnp.float32),
+        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+    )
+    if causal:
+        upper = jax.lax.div(last_q, block_k) + 1
+    else:
+        upper = num_kv_blocks
+    acc, m, l = jax.lax.fori_loop(0, upper, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """q: [b, sq, h, d]; k/v: [b, sk, kvh, d] → [b, sq, h, d]."""
+    import jax.experimental.pallas as pl
+
+    b, sq, h, d = q.shape
+    _, sk, kvh, _ = k.shape
+    group = h // kvh
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq}, {sk}) must be divisible by blocks "
+            f"({block_q}, {block_k}); pad inputs first"
+        )
+
+    # [b*h, s, d] layout for the kernel
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * kvh, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * kvh, sk, d)
+
+    def q_map(i, qb):
+        return (i, qb, 0)
+
+    def kv_map(i, qb):
+        batch = i // h
+        head = i % h
+        return (batch * kvh + head // group, 0, 0)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
+        seq_k=sk, block_q=block_q,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, sk, d), kv_map),
+            pl.BlockSpec((1, sk, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), q_map),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # Recompute-based backward: differentiate the reference implementation.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal, sm_scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    use_pallas: Optional[bool] = None,
+                    interpret: bool = False) -> jax.Array:
+    """Flash attention. Layout: q [b, sq, heads, d]; k/v [b, sk, kv_heads, d].
+
+    ``use_pallas=None`` auto-selects: the Pallas kernel on TPU backends, the
+    reference path elsewhere (tests force the kernel with interpret=True).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas is None:
+        use_pallas = jax.default_backend() not in ("cpu",)
+    if not use_pallas:
+        return attention_reference(q, k, v, causal, sm_scale)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)

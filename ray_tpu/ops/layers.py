@@ -1,0 +1,87 @@
+"""Transformer building blocks: RMSNorm, RoPE, SwiGLU.
+
+Pure-jax implementations — XLA fuses these elementwise chains into the
+surrounding matmuls on TPU (the guide's rule: don't hand-schedule what the
+compiler already fuses). Pallas is reserved for ops XLA can't fuse well
+(attention — see ops/attention.py).
+
+The reference framework has no kernel library (it delegates to torch); these
+ops underpin the model zoo (models/llama.py etc.).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in fp32 accumulation, cast back to input dtype."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10_000.0,
+                     dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    """Precompute RoPE cos/sin tables: [max_seq_len, head_dim//2]."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+    """Apply rotary embeddings.
+
+    x: [..., seq, heads, head_dim]; cos/sin: [max_seq, head_dim//2];
+    positions: [..., seq] absolute positions (defaults to arange).
+    """
+    seq = x.shape[-3]
+    if positions is None:
+        c = cos[:seq][:, None, :]
+        s = sin[:seq][:, None, :]
+    else:
+        c = cos[positions][..., None, :]
+        s = sin[positions][..., None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cf = c.astype(jnp.float32)
+    sf = s.astype(jnp.float32)
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1f * cf - x2f * sf, x2f * cf + x1f * sf], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: down( silu(x @ gate) * (x @ up) ).
+
+    All matmuls in input dtype (bf16 on TPU) with fp32 accumulation via
+    preferred_element_type.
+    """
+    gate = jnp.dot(x, w_gate, preferred_element_type=jnp.float32)
+    up = jnp.dot(x, w_up, preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(gate) * up).astype(x.dtype)
+    return jnp.dot(h, w_down, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """Expand KV heads for grouped-query attention.
+
+    x: [batch, seq, kv_heads, head_dim] → [batch, seq, kv_heads*n_rep, hd].
+    """
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, s, h, n_rep, d)
+    ).reshape(b, s, h * n_rep, d)

@@ -79,9 +79,9 @@ def with_logical_constraint(x, logical_axes: Sequence[Optional[str]], mesh=None,
     )
 
 
-def shard_pytree_like(tree, logical_tree, mesh, rules=DEFAULT_RULES):
-    """Build a NamedSharding pytree from a matching pytree of logical-axis
-    tuples (None entries → fully replicated)."""
+def shard_pytree_like(logical_tree, mesh, rules=DEFAULT_RULES):
+    """Build a NamedSharding pytree from a pytree of logical-axis tuples
+    (None entries → fully replicated)."""
     import jax
 
     def one(logical):
