@@ -109,9 +109,14 @@ class ShmObjectStore:
 
     # -- object lifecycle ----------------------------------------------------
 
+    def _h(self) -> int:
+        if not self._handle:
+            raise ValueError("object store is closed")
+        return self._handle
+
     def create_object(self, oid: ObjectID, size: int) -> memoryview:
         """Allocate an unsealed object; returns a writable view of its payload."""
-        off = _get_lib().rtpu_store_create_object(self._handle, oid.binary(), size)
+        off = _get_lib().rtpu_store_create_object(self._h(), oid.binary(), size)
         if off == 0:
             raise ObjectStoreFullError(
                 f"cannot allocate {size} bytes for {oid} (store full or duplicate)"
@@ -119,7 +124,7 @@ class ShmObjectStore:
         return self._mv[off : off + size]
 
     def seal(self, oid: ObjectID):
-        if _get_lib().rtpu_store_seal(self._handle, oid.binary()) != 0:
+        if _get_lib().rtpu_store_seal(self._h(), oid.binary()) != 0:
             raise ValueError(f"seal failed for {oid}")
 
     def put(self, oid: ObjectID, data) -> None:
@@ -137,28 +142,32 @@ class ShmObjectStore:
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         rc = _get_lib().rtpu_store_get(
-            self._handle, oid.binary(), timeout_ms, ctypes.byref(off), ctypes.byref(size)
+            self._h(), oid.binary(), timeout_ms, ctypes.byref(off), ctypes.byref(size)
         )
         if rc != 0:
             raise ObjectTimeoutError(f"object {oid} not available within {timeout_ms}ms")
         return self._mv[off.value : off.value + size.value]
 
     def release(self, oid: ObjectID):
+        # Pin finalizers (zero-copy numpy views) can fire at interpreter
+        # exit, after close(); the C handle is freed then — never call in.
+        if not self._handle:
+            return
         _get_lib().rtpu_store_release(self._handle, oid.binary())
 
     def contains(self, oid: ObjectID) -> bool:
-        return bool(_get_lib().rtpu_store_contains(self._handle, oid.binary()))
+        return bool(_get_lib().rtpu_store_contains(self._h(), oid.binary()))
 
     def delete(self, oid: ObjectID):
-        _get_lib().rtpu_store_delete(self._handle, oid.binary())
+        _get_lib().rtpu_store_delete(self._h(), oid.binary())
 
     def prefault(self):
         """Blocking eager population of the heap (content-preserving)."""
-        _get_lib().rtpu_store_prefault(self._handle)
+        _get_lib().rtpu_store_prefault(self._h())
 
     def stats(self) -> dict:
         vals = [ctypes.c_uint64() for _ in range(4)]
-        _get_lib().rtpu_store_stats(self._handle, *[ctypes.byref(v) for v in vals])
+        _get_lib().rtpu_store_stats(self._h(), *[ctypes.byref(v) for v in vals])
         return {
             "heap_size": vals[0].value,
             "bytes_in_use": vals[1].value,

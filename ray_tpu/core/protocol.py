@@ -35,7 +35,6 @@ MSG_SHUTDOWN = "shutdown"
 MSG_READY = "ready"                # (MSG_READY, pid)
 MSG_DONE = "done"                  # (MSG_DONE, task_id_b, [payload, ...])
 MSG_ERROR = "error"                # (MSG_ERROR, task_id_b, pickled_exc_payload)
-MSG_DONE_BATCH = "done_batch"      # (MSG_DONE_BATCH, [(task_id_b, ok, payloads_or_errpayload), ...])
 MSG_ACTOR_READY = "actor_ready"    # (.., actor_id_b)
 MSG_ACTOR_ERROR = "actor_error"    # (.., actor_id_b, pickled_exc_payload)
 

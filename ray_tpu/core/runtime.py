@@ -60,7 +60,7 @@ class _TaskSpec:
     __slots__ = (
         "task_id", "fn_id", "args_payload", "deps", "return_ids", "options",
         "actor_id", "method", "pending_deps", "request", "pg_wire",
-        "acquired_bundle", "blocked_released",
+        "acquired_bundle", "blocked_released", "nested_deps",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -79,6 +79,11 @@ class _TaskSpec:
         self.pg_wire = None          # ("pg", pg_id_bytes, bundle_index) | None
         self.acquired_bundle = None  # Bundle the request was drawn from
         self.blocked_released = False  # resources credited back while blocked
+        # ObjectIDs referenced *inside* arg containers (not top-level args).
+        # They are NOT scheduling dependencies (reference semantics: nested
+        # refs pass through unresolved), but while unavailable the task must
+        # ship alone — batched behind it, its producer could never run.
+        self.nested_deps: List = []
 
 
 class _Worker:
@@ -277,8 +282,6 @@ class Runtime:
                     self._dispatch()
                 elif tag == protocol.MSG_DONE:
                     self._on_task_done(w, msg[1], msg[2])
-                elif tag == protocol.MSG_DONE_BATCH:
-                    self._on_task_done_batch(w, msg[1])
                 elif tag == protocol.MSG_ERROR:
                     self._on_task_error(w, msg[1], msg[2])
                 elif tag == protocol.MSG_ACTOR_READY:
@@ -306,14 +309,26 @@ class Runtime:
             w.inflight.clear()
             actor_id = w.actor_id
         if inflight:
+            # Results flush per task, so inflight = not-yet-completed, in
+            # dispatch order. Only the head task can have been executing
+            # when the process died; the rest never started and are safe to
+            # requeue on another worker (at-least-once, like the reference's
+            # task retries).
+            if actor_id is None:
+                fail, requeue = inflight[:1], inflight[1:]
+            else:
+                fail, requeue = inflight, []
             err = WorkerCrashedError(
                 f"worker {w.worker_id.hex()[:8]} died while executing task"
             )
             with self._lock:
-                for spec in inflight:
+                for spec in fail:
                     self._release_spec_locked(spec)
-            for spec in inflight:
+            for spec in fail:
                 self._store_error(spec.return_ids, err)
+            if requeue:
+                with self._lock:
+                    self._task_queue.extendleft(reversed(requeue))
             self._retry_pending_pgs()
         if actor_id is not None:
             self._handle_actor_worker_death(actor_id)
@@ -391,9 +406,11 @@ class Runtime:
         options = options or {}
         task_id = make_task_id(self.job_id)
         args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
-        args_payload, _ = protocol.serialize_args(args2, kwargs2, store=self.store)
+        args_payload, nested = protocol.serialize_args(
+            args2, kwargs2, store=self.store)
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
         spec = _TaskSpec(task_id, fn_id, args_payload, deps, return_ids, options)
+        spec.nested_deps = [r.id for r in nested]
         spec.request, spec.pg_wire = self._prepare_request(options, is_actor=False)
         for rid in return_ids:
             self._entry(rid)
@@ -552,6 +569,16 @@ class Runtime:
                         if batch:
                             break
                         continue
+                    if spec.nested_deps and self._nested_unready_locked(spec):
+                        # May block in get() on a not-yet-produced object:
+                        # ship alone, so its producer is never ordered
+                        # behind it in the same worker's batch (blocked-
+                        # worker scale-up then guarantees progress).
+                        if batch:
+                            break
+                        batch.append(spec)
+                        del self._task_queue[i]
+                        break
                     batch.append(spec)
                     del self._task_queue[i]
                 if not batch:
@@ -599,6 +626,15 @@ class Runtime:
             # through explicit accounting.
             return None, None
         return ResourceSet(req), pg_wire
+
+    def _nested_unready_locked(self, spec) -> bool:
+        """True if any ObjectID nested inside the task's args is not yet
+        produced (missing entry counts as unready). Caller holds _lock."""
+        for oid in spec.nested_deps:
+            e = self._objects.get(oid)
+            if e is None or not e.event.is_set():
+                return True
+        return False
 
     def _try_acquire_spec_locked(self, spec) -> bool:
         """Try to acquire spec.request from its pool. Caller holds _lock."""
@@ -703,26 +739,6 @@ class Runtime:
         if spec is not None:
             for rid, payload in zip(spec.return_ids, payloads):
                 self._store_payload(rid, payload)
-        self._retry_pending_pgs()
-        self._worker_now_idle(w)
-
-    def _on_task_done_batch(self, w: _Worker, results):
-        specs = []
-        with self._lock:
-            for task_id_b, ok, payload in results:
-                spec = w.inflight.pop(task_id_b, None)
-                if spec is not None:
-                    self._release_spec_locked(spec)
-                specs.append(spec)
-        for (task_id_b, ok, payload), spec in zip(results, specs):
-            if spec is None:
-                continue
-            if ok:
-                for rid, p in zip(spec.return_ids, payload):
-                    self._store_payload(rid, p)
-            else:
-                for rid in spec.return_ids:
-                    self._store_payload(rid, payload)
         self._retry_pending_pgs()
         self._worker_now_idle(w)
 
@@ -1340,12 +1356,16 @@ class Runtime:
                 with self._lock:
                     self._functions.setdefault(fn_id, pickled_fn)
             deps = options.pop("__deps", [])
+            nested = options.pop("__nested", [])
             task_id = make_task_id(self.job_id)
             return_ids = [ObjectID.from_random() for _ in range(n_returns)]
             for rid in return_ids:
                 self._entry(rid)
             spec = _TaskSpec(task_id, fn_id, args_payload,
                              [ObjectID(d) for d in deps], return_ids, options)
+            spec.nested_deps = [ObjectID(b) for b in nested]
+            spec.request, spec.pg_wire = self._prepare_request(
+                options, is_actor=False)
             self._enqueue(spec)
             return ("ok", [r.binary() for r in return_ids])
         if tag == protocol.REQ_ACTOR_CALL:

@@ -102,10 +102,11 @@ class WorkerCore:
 
     def submit_task(self, fn_id: bytes, pickled_fn: Optional[bytes], args: tuple,
                     kwargs: dict, num_returns: int, options: dict) -> List[ObjectRef]:
-        args_payload, deps = _prepare_args_local(self, args, kwargs)
+        args_payload, deps, nested = _prepare_args_local(self, args, kwargs)
         send_fn = None if fn_id in self._driver_known_fns else pickled_fn
         options = dict(options)
         options["__deps"] = deps
+        options["__nested"] = nested
         _, oid_bytes_list = self._request(
             protocol.REQ_SUBMIT, fn_id, send_fn, args_payload, {},
             num_returns, options,
@@ -115,7 +116,7 @@ class WorkerCore:
 
     def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
                           kwargs: dict, num_returns: int) -> List[ObjectRef]:
-        args_payload, deps = _prepare_args_local(self, args, kwargs)
+        args_payload, deps, _nested = _prepare_args_local(self, args, kwargs)
         _, oid_bytes_list = self._request(
             protocol.REQ_ACTOR_CALL, actor_id.binary(), method, args_payload,
             {"__deps": deps}, num_returns,
@@ -124,7 +125,7 @@ class WorkerCore:
 
     def create_actor_from_worker(self, fn_id: bytes, pickled_cls: Optional[bytes],
                                  args: tuple, kwargs: dict, opts: dict) -> ActorID:
-        args_payload, deps = _prepare_args_local(self, args, kwargs)
+        args_payload, deps, _nested = _prepare_args_local(self, args, kwargs)
         send_cls = None if fn_id in self._driver_known_fns else pickled_cls
         _, actor_id_b = self._request(
             protocol.REQ_CREATE_ACTOR, fn_id, send_cls, args_payload, deps, opts
@@ -240,19 +241,37 @@ class WorkerCore:
         kwargs = {k: resolve(v) for k, v in kwargs.items()}
         return args, kwargs
 
+    @staticmethod
+    def _split_returns(result, num_returns: int) -> list:
+        if num_returns == 1:
+            return [result]
+        values = list(result)
+        if len(values) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{len(values)} values"
+            )
+        return values
+
+    @staticmethod
+    def _error_payload(exc: BaseException):
+        """Serialize an exception, falling back to a repr-wrapped error when
+        the original (or its cause chain) does not pickle."""
+        err = exc if isinstance(exc, TaskError) else TaskError(
+            exc, traceback.format_exc())
+        try:
+            return protocol.serialize_value(protocol.ErrorValue(err), store=None)
+        except Exception:
+            return protocol.serialize_value(
+                protocol.ErrorValue(TaskError(
+                    RuntimeError(repr(exc)), traceback.format_exc())),
+                store=None)
+
     def _send_results(self, task_id_b: bytes, result, num_returns: int,
                       return_id_bytes: List[bytes]):
-        if num_returns == 1:
-            results = [result]
-        else:
-            results = list(result)
-            if len(results) != num_returns:
-                raise ValueError(
-                    f"task declared num_returns={num_returns} but returned "
-                    f"{len(results)} values"
-                )
+        values = self._split_returns(result, num_returns)
         payloads = []
-        for value, rid in zip(results, return_id_bytes):
+        for value, rid in zip(values, return_id_bytes):
             payloads.append(self._serialize_result(value, ObjectID(rid)))
         self.task_conn.send((protocol.MSG_DONE, task_id_b, payloads))
 
@@ -274,74 +293,29 @@ class WorkerCore:
         return ("inline", bytes(out))
 
     def _execute_task_batch(self, tasks):
-        """Execute a pipelined batch; one reply amortizes the control-plane
-        round trip (the reference gets the same effect from leased-worker
-        pipelining in NormalTaskSubmitter)."""
-        results = []
-        import time as _time
-
-        last_flush = _time.perf_counter()
-
-        def flush():
-            nonlocal last_flush, results
-            if results:
-                self.task_conn.send((protocol.MSG_DONE_BATCH, results))
-                results = []
-            last_flush = _time.perf_counter()
-
+        """Execute a pipelined batch. The *dispatch* leg is what the batching
+        amortizes (one driver→worker message for N tasks, the reference gets
+        the same from leased-worker pipelining in NormalTaskSubmitter);
+        results are flushed after every task so a finished result is never
+        held hostage by a slow successor, and so the driver's completion
+        log stays exact for crash recovery (requeue of never-started tasks).
+        """
         for task_id_b, fn_id, args_payload, inline_values, return_ids in tasks:
             self.current_task_id = TaskID(task_id_b)
             try:
                 fn = self._functions[fn_id]
                 args, kwargs = self._decode_args(args_payload, inline_values)
                 result = fn(*args, **kwargs)
-                if len(return_ids) == 1:
-                    values = [result]
-                else:
-                    values = list(result)
-                    if len(values) != len(return_ids):
-                        raise ValueError(
-                            f"task declared num_returns={len(return_ids)} "
-                            f"but returned {len(values)} values")
-                payloads = [
-                    self._serialize_result(v, ObjectID(rid))
-                    for v, rid in zip(values, return_ids)
-                ]
-                results.append((task_id_b, True, payloads))
+                self._send_results(task_id_b, result, len(return_ids),
+                                   return_ids)
             except BaseException as e:  # noqa: BLE001
-                err = e if isinstance(e, TaskError) else TaskError(
-                    e, traceback.format_exc())
-                try:
-                    payload = protocol.serialize_value(
-                        protocol.ErrorValue(err), store=None)
-                except Exception:
-                    payload = protocol.serialize_value(
-                        protocol.ErrorValue(TaskError(
-                            RuntimeError(repr(e)), traceback.format_exc())),
-                        store=None)
-                results.append((task_id_b, False, payload))
+                self._send_error(task_id_b, e)
             finally:
                 self.current_task_id = None
-            # Incremental flush: a slow task must not delay the results of
-            # fast tasks already finished in this batch.
-            if _time.perf_counter() - last_flush > 0.002:
-                flush()
-        flush()
 
     def _send_error(self, task_id_b: bytes, exc: BaseException):
-        err = exc if isinstance(exc, TaskError) else TaskError(
-            exc, traceback.format_exc()
-        )
-        try:
-            payload = protocol.serialize_value(protocol.ErrorValue(err), store=None)
-        except Exception:
-            payload = protocol.serialize_value(
-                protocol.ErrorValue(
-                    TaskError(RuntimeError(repr(exc)), traceback.format_exc())
-                ),
-                store=None,
-            )
-        self.task_conn.send((protocol.MSG_ERROR, task_id_b, payload))
+        self.task_conn.send(
+            (protocol.MSG_ERROR, task_id_b, self._error_payload(exc)))
 
     def _create_actor(self, msg):
         _, actor_id_b, cls_fn_id, args_payload, inline_values, opts = msg
@@ -401,8 +375,8 @@ def _prepare_args_local(core: WorkerCore, args: tuple, kwargs: dict):
 
     args = tuple(swap(a) for a in args)
     kwargs = {k: swap(v) for k, v in kwargs.items()}
-    payload, _ = protocol.serialize_args(args, kwargs, store=core.store)
-    return payload, deps
+    payload, nested = protocol.serialize_args(args, kwargs, store=core.store)
+    return payload, deps, [r.binary() for r in nested]
 
 
 def main():

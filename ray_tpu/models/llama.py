@@ -90,7 +90,8 @@ def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
             "w_down": L + ("mlp", "embed"),
         },
         "final_norm": ("embed",),
-        "lm_head": ("embed", "vocab"),
+        # tied embeddings reuse params["embed"]; no separate lm_head leaf
+        **({} if cfg.tie_embeddings else {"lm_head": ("embed", "vocab")}),
     }
 
 

@@ -61,8 +61,12 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  sm_scale: float, seq_k: int, block_q: int):
+                  sm_scale: float, seq_k: int, block_q: int,
+                  causal_offset: int = 0):
     # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_k, d]; o_ref: [1, block_q, d]
+    # causal_offset = seq_k - seq_q: query row i sits at absolute key
+    # position offset + i (decode/chunked-prefill alignment, matching
+    # attention_reference).
     import jax.experimental.pallas as pl
 
     qb = pl.program_id(1)
@@ -71,8 +75,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
 
     num_kv_blocks = seq_k // block_k
     if causal:
-        # only blocks whose start is <= the last query position
-        last_q = (qb + 1) * block_q - 1
+        # only blocks whose start is <= the last query's absolute position
+        last_q = causal_offset + (qb + 1) * block_q - 1
 
     def body(kb, carry):
         acc, m, l = carry
@@ -85,7 +89,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         if causal:
             qi = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             ki = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (qb * block_q + qi) >= (kb * block_k + ki)
+            mask = (causal_offset + qb * block_q + qi) >= (kb * block_k + ki)
             s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -140,7 +144,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
-        seq_k=sk, block_q=block_q,
+        seq_k=sk, block_q=block_q, causal_offset=sk - sq,
     )
     out = pl.pallas_call(
         kernel,
