@@ -1,0 +1,43 @@
+"""ray_tpu.train: distributed training orchestration over the TPU runtime.
+
+Public surface mirrors ray.train: configs, Checkpoint, report/get_checkpoint/
+get_context/get_dataset_shard, DataParallelTrainer/JaxTrainer, Result.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig  # noqa: F401
+from ray_tpu.train.backend_executor import (  # noqa: F401
+    BackendExecutor,
+    TrainingWorkerError,
+)
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.checkpoint_manager import CheckpointManager  # noqa: F401
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
+    DataParallelTrainer,
+    JaxTrainer,
+)
+from ray_tpu.train.result import Result  # noqa: F401
+from ray_tpu.train.session import (  # noqa: F401
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.storage import StorageContext  # noqa: F401
+from ray_tpu.train.worker_group import WorkerGroup  # noqa: F401
+
+__all__ = [
+    "Backend", "BackendConfig", "JaxConfig",
+    "BackendExecutor", "TrainingWorkerError",
+    "Checkpoint", "CheckpointManager", "CheckpointConfig",
+    "FailureConfig", "RunConfig", "ScalingConfig",
+    "DataParallelTrainer", "JaxTrainer", "Result",
+    "TrainContext", "get_checkpoint", "get_context", "get_dataset_shard",
+    "report", "StorageContext", "WorkerGroup",
+]

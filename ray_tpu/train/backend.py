@@ -1,0 +1,118 @@
+"""Backend hooks: per-framework gang wiring.
+
+Reference: python/ray/train/backend.py (Backend/BackendConfig) and
+train/torch/config.py:154 (_TorchBackend wires torch.distributed). Here the
+first-class backend is JAX: set up jax.distributed for multi-host TPU pods,
+or a virtual CPU platform for tests, plus a host-level (DCN) collective
+group for cross-gang reductions outside jitted programs.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class BackendConfig:
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """No-op base backend."""
+
+    def on_start(self, worker_group: WorkerGroup, backend_config: "BackendConfig"):
+        pass
+
+    def on_training_start(self, worker_group: WorkerGroup,
+                          backend_config: "BackendConfig"):
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup):
+        pass
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """JAX gang wiring.
+
+    platform: 'tpu' (real chips), 'cpu' (virtual devices for tests), or None
+        to inherit the ambient platform.
+    cpu_devices_per_worker: when platform='cpu', how many virtual XLA host
+        devices each worker exposes (xla_force_host_platform_device_count).
+    distributed: initialize jax.distributed across the gang (multi-host TPU
+        pods / multi-process CPU). Worker 0 is the coordinator.
+    host_collectives: create a host-level collective group named 'train'
+        over the gang (the DCN/GLOO-equivalent path).
+    """
+
+    platform: Optional[str] = None
+    cpu_devices_per_worker: int = 1
+    distributed: bool = False
+    coordinator_port: int = 37737
+    host_collectives: bool = True
+
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _setup_jax_platform(platform: Optional[str], n_cpu_devices: int):
+    if platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_cpu_devices}"
+            ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    elif platform == "tpu":
+        os.environ.setdefault("JAX_PLATFORMS", "tpu")
+
+
+def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int):
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def _join_host_collective_group(world_size: int, rank: int, group_name: str):
+    from ray_tpu.parallel import collective
+
+    collective.init_collective_group(world_size, rank, backend="host",
+                                     group_name=group_name)
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, cfg: JaxConfig):
+        worker_group.execute(_setup_jax_platform, cfg.platform,
+                             cfg.cpu_devices_per_worker)
+        if cfg.distributed and len(worker_group) > 1:
+            infos = worker_group.execute(lambda: __import__("socket").gethostname())
+            coordinator = f"{infos[0]}:{cfg.coordinator_port}"
+            import ray_tpu
+
+            refs = [
+                w.execute.remote(_init_jax_distributed, coordinator,
+                                 len(worker_group), rank)
+                for rank, w in enumerate(worker_group.workers)
+            ]
+            ray_tpu.get(refs)
+
+    def on_training_start(self, worker_group: WorkerGroup, cfg: JaxConfig):
+        if cfg.host_collectives and len(worker_group) > 1:
+            import ray_tpu
+
+            refs = [
+                w.execute.remote(_join_host_collective_group,
+                                 len(worker_group), rank, "train")
+                for rank, w in enumerate(worker_group.workers)
+            ]
+            ray_tpu.get(refs)

@@ -1,0 +1,90 @@
+"""Drives the worker gang through a training run.
+
+Reference: python/ray/train/_internal/backend_executor.py:67 (start :129,
+start_training :445). The executor owns the WorkerGroup, applies backend
+hooks, fans the train loop out, and pumps synchronized result batches — one
+TrainingResult per worker per report — back to the trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.session import TrainingResult
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainingWorkerError(RayTpuError):
+    """A training worker died or its train loop raised."""
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig, scaling: ScalingConfig):
+        self.backend_config = backend_config
+        self.backend: Backend = backend_config.backend_cls()()
+        self.scaling = scaling
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self):
+        self.worker_group = WorkerGroup(self.scaling)
+        self.worker_group.start()
+        # rank/world-size env before any user code or jax import
+        for rank, w in enumerate(self.worker_group.workers):
+            w.set_env.remote({
+                "RAY_TPU_RANK": str(rank),
+                "RAY_TPU_WORLD_SIZE": str(self.scaling.num_workers),
+            })
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       context_kwargs: Dict[str, Any],
+                       checkpoint_path: Optional[str] = None,
+                       dataset_shards: Optional[List[Dict[str, Any]]] = None,
+                       storage_info: Optional[Dict[str, Any]] = None):
+        assert self.worker_group is not None, "call start() first"
+        self.backend.on_training_start(self.worker_group, self.backend_config)
+        refs = []
+        for rank, w in enumerate(self.worker_group.workers):
+            shards = dataset_shards[rank] if dataset_shards else None
+            refs.append(w.start_training.remote(
+                train_fn, config, context_kwargs, checkpoint_path, shards,
+                storage_info))
+        ray_tpu.get(refs)
+
+    def get_next_results(self) -> Optional[List[TrainingResult]]:
+        """One synchronized batch: the next report from every worker.
+
+        Returns None when all workers finished cleanly. Raises
+        TrainingWorkerError when any worker errored (actor death or user
+        exception), carrying the first underlying error.
+        """
+        assert self.worker_group is not None
+        refs = [w.next_result.remote() for w in self.worker_group.workers]
+        try:
+            results: List[TrainingResult] = ray_tpu.get(refs)
+        except Exception as e:
+            raise TrainingWorkerError(f"training worker died: {e}") from e
+        errors = [r.error for r in results if r.error is not None]
+        if errors:
+            raise TrainingWorkerError(
+                f"train loop failed on a worker: {errors[0]!r}") from errors[0]
+        if all(r.done for r in results):
+            return None
+        # Mixed done/not-done means a worker returned early from its loop —
+        # the remaining workers would deadlock on their next collective.
+        if any(r.done for r in results):
+            raise TrainingWorkerError(
+                "some workers finished while others are still reporting — "
+                "train_loop_per_worker must report the same number of times "
+                "on every rank")
+        return results
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self.backend.on_shutdown(self.worker_group)
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,97 @@
+"""Train/AIR config dataclasses.
+
+Reference surface: python/ray/air/config.py (ScalingConfig, RunConfig,
+FailureConfig, CheckpointConfig). TPU-first difference: ScalingConfig speaks
+the slice/host/chip topology (chips per worker, optional topology string)
+instead of GPU counts.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers, and what each one holds.
+
+    num_workers: size of the worker gang (one actor per worker; on real TPU
+        pods this is one worker per host, multi-controller JAX style).
+    use_tpu: reserve TPU chips for each worker.
+    chips_per_worker: TPU chips each worker owns (maps to the "TPU" resource).
+    resources_per_worker: extra custom resources per worker.
+    placement_strategy: bundle placement (PACK/SPREAD/STRICT_PACK/STRICT_SPREAD);
+        STRICT_PACK keeps the gang on one ICI domain.
+    topology: optional TPU topology hint, e.g. "v5e-8" — lets the scheduler
+        gang-place onto a whole sub-slice.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: Optional[int] = None
+    num_cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None
+
+    def bundle_for_worker(self) -> Dict[str, float]:
+        b: Dict[str, float] = {}
+        if self.num_cpus_per_worker:
+            b["CPU"] = float(self.num_cpus_per_worker)
+        if self.use_tpu:
+            b["TPU"] = float(self.chips_per_worker or 1)
+        for k, v in (self.resources_per_worker or {}).items():
+            b[k] = float(v)
+        return b
+
+    @property
+    def total_chips(self) -> int:
+        if not self.use_tpu:
+            return 0
+        return int(self.chips_per_worker or 1) * self.num_workers
+
+
+@dataclass
+class FailureConfig:
+    """Gang fault tolerance: restart the whole worker group from the last
+    checkpoint up to ``max_failures`` times (reference: air/config.py
+    FailureConfig; executor restart in train/_internal/backend_executor.py)."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Top-k checkpoint retention (reference: air/config.py CheckpointConfig,
+    enforced by train/_internal/checkpoint_manager.py)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """Experiment-level config (reference: air/config.py RunConfig)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+    log_to_file: bool = False
+
+    def resolved_storage_path(self) -> str:
+        if self.storage_path:
+            return self.storage_path
+        return os.environ.get(
+            "RAY_TPU_STORAGE_PATH",
+            os.path.join(os.path.expanduser("~"), "ray_tpu_results"),
+        )

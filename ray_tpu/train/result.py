@@ -1,0 +1,29 @@
+"""Result of a training/tuning run (reference: python/ray/air/result.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[BaseException] = None
+    path: str = ""
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+    @property
+    def metrics_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.metrics_history)
+
+    def __repr__(self):
+        keys = {k: v for k, v in (self.metrics or {}).items()
+                if not k.startswith("_")}
+        return (f"Result(metrics={keys}, checkpoint={self.checkpoint}, "
+                f"error={self.error!r})")

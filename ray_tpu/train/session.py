@@ -1,0 +1,190 @@
+"""In-training-loop session: report/get_checkpoint/get_context.
+
+Reference: python/ray/train/_internal/session.py (_TrainSession :111,
+report :667, get_checkpoint :754). The user loop runs on a thread inside the
+worker actor; ``report`` hands a result to the actor thread and blocks in
+lockstep until the driver has consumed it — that keeps all workers advancing
+step-for-step, which matters on TPU where every mesh member must enter the
+same jitted collective program together.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session: Optional["_TrainSession"] = None
+_session_lock = threading.Lock()
+
+
+@dataclass
+class TrainingResult:
+    metrics: Dict[str, Any]
+    checkpoint_dir: Optional[str] = None   # worker-local dir to persist
+    done: bool = False
+    error: Optional[BaseException] = None
+
+
+@dataclass
+class TrainContext:
+    """What a worker knows about its place in the gang (reference:
+    ray.train.get_context() → TrainContext)."""
+
+    world_size: int = 1
+    world_rank: int = 0
+    local_rank: int = 0
+    local_world_size: int = 1
+    node_rank: int = 0
+    trial_name: str = ""
+    experiment_name: str = ""
+    trial_dir: str = ""
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_trial_name(self) -> str:
+        return self.trial_name
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+
+class _TrainSession:
+    """Pumps results from the user training thread to the actor thread.
+
+    Checkpoint persistence happens HERE, worker-side, inside ``report`` —
+    before the result is handed to the driver — because the worker-local
+    checkpoint dir may be temporary and, on multi-node, not reachable from
+    the driver at all (reference: storage upload in train/_internal/
+    session.py report path).
+    """
+
+    def __init__(self, train_fn, config: Dict[str, Any], context: TrainContext,
+                 checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 storage=None, checkpoint_index_start: int = 0,
+                 checkpoint_upload_rank: Optional[int] = 0):
+        self.context = context
+        self.loaded_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.storage = storage
+        self._ckpt_index = checkpoint_index_start
+        self._ckpt_upload_rank = checkpoint_upload_rank
+        self._result_q: "queue.Queue[TrainingResult]" = queue.Queue(maxsize=1)
+        self._consumed = threading.Semaphore(0)
+        self._finished = False
+
+        def runner():
+            try:
+                train_fn(config) if _wants_config(train_fn) else train_fn()
+                self._result_q.put(TrainingResult(metrics={}, done=True))
+            except BaseException as e:  # surfaced to the driver, not swallowed
+                self._result_q.put(
+                    TrainingResult(metrics={}, done=True, error=e))
+
+        self._thread = threading.Thread(target=runner, daemon=True,
+                                        name="rtpu-train-loop")
+
+    def start(self):
+        self._thread.start()
+
+    # ------------------------------------------------- called by train_fn
+    def report(self, metrics: Dict[str, Any],
+               checkpoint_dir: Optional[str] = None):
+        persisted = None
+        if checkpoint_dir is not None:
+            if (self.storage is not None
+                    and (self._ckpt_upload_rank is None
+                         or self.context.world_rank == self._ckpt_upload_rank)):
+                ckpt = self.storage.persist_checkpoint_dir(
+                    checkpoint_dir, self._ckpt_index)
+                persisted = ckpt.path
+            self._ckpt_index += 1
+        self._result_q.put(TrainingResult(metrics=dict(metrics),
+                                          checkpoint_dir=persisted))
+        # Lockstep: wait until the driver consumed this result before the
+        # training loop continues (mirrors reference's blocking report).
+        self._consumed.acquire()
+
+    # --------------------------------------------------- called by driver
+    def next_result(self, timeout: Optional[float] = None) -> TrainingResult:
+        res = self._result_q.get(timeout=timeout)
+        if res.done:
+            self._finished = True
+        else:
+            self._consumed.release()
+        return res
+
+    def finished(self) -> bool:
+        return self._finished
+
+
+# ------------------------------------------------------------ public API
+
+def _get_session() -> _TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No train session active — this API must be called inside a "
+            "train_loop_per_worker launched by a Trainer.")
+    return _session
+
+
+def _set_session(s: Optional[_TrainSession]):
+    global _session
+    _session = s
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None,
+           *, checkpoint_dir: Optional[str] = None):
+    """Report metrics (and optionally a just-written checkpoint dir) to the
+    driver. Blocks until the driver has processed the result."""
+    s = _get_session()
+    if checkpoint is not None and checkpoint_dir is None:
+        checkpoint_dir = checkpoint.path
+    s.report(metrics, checkpoint_dir=checkpoint_dir)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The latest persisted checkpoint to resume from (None on fresh start)."""
+    return _get_session().loaded_checkpoint
+
+
+def get_context() -> TrainContext:
+    s = _session
+    return s.context if s is not None else TrainContext()
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to the Trainer
+    (reference: ray.train.get_dataset_shard)."""
+    return _get_session().dataset_shards.get(name)
+
+
+def _wants_config(fn) -> bool:
+    import inspect
+
+    try:
+        return len(inspect.signature(fn).parameters) >= 1
+    except (TypeError, ValueError):
+        return False

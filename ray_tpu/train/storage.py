@@ -1,0 +1,73 @@
+"""Storage layout for experiments/trials/checkpoints.
+
+Reference: python/ray/train/_internal/storage.py:352 (StorageContext).
+Layout: <storage_path>/<experiment_name>/<trial_name>/checkpoint_NNNNNN/.
+Local paths use the local fs; remote URIs (s3://, gs://) go through fsspec.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import shutil
+import uuid
+from typing import Optional
+
+import fsspec
+
+from ray_tpu.train.checkpoint import Checkpoint, _is_local
+
+
+class StorageContext:
+    def __init__(self, storage_path: str, experiment_name: Optional[str] = None,
+                 trial_name: Optional[str] = None):
+        if "://" in storage_path:
+            self.fs, _, paths = fsspec.get_fs_token_paths(storage_path)
+            self.root = paths[0] if isinstance(paths, list) else paths
+        else:
+            self.fs = fsspec.filesystem("file")
+            self.root = os.path.abspath(storage_path)
+        if experiment_name is None:
+            stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+            experiment_name = f"rtpu_experiment_{stamp}"
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name or f"trial_{uuid.uuid4().hex[:8]}"
+
+    # ------------------------------------------------------------- paths
+    @property
+    def experiment_path(self) -> str:
+        return os.path.join(self.root, self.experiment_name)
+
+    @property
+    def trial_path(self) -> str:
+        return os.path.join(self.experiment_path, self.trial_name)
+
+    def checkpoint_path(self, index: int) -> str:
+        return os.path.join(self.trial_path, f"checkpoint_{index:06d}")
+
+    def ensure_trial_dir(self):
+        self.fs.makedirs(self.trial_path, exist_ok=True)
+
+    # --------------------------------------------------------- persisting
+    def persist_checkpoint_dir(self, local_dir: str, index: int) -> Checkpoint:
+        """Upload/copy a locally-written checkpoint dir into the trial dir."""
+        dest = self.checkpoint_path(index)
+        if _is_local(self.fs):
+            if os.path.abspath(local_dir) != os.path.abspath(dest):
+                os.makedirs(dest, exist_ok=True)
+                shutil.copytree(local_dir, dest, dirs_exist_ok=True)
+        else:
+            self.fs.put(local_dir.rstrip("/") + "/", dest, recursive=True)
+        return Checkpoint(dest, self.fs)
+
+    def delete_checkpoint(self, checkpoint: Checkpoint):
+        try:
+            checkpoint.filesystem.rm(checkpoint.path, recursive=True)
+        except FileNotFoundError:
+            pass
+
+    def for_trial(self, trial_name: str) -> "StorageContext":
+        s = StorageContext.__new__(StorageContext)
+        s.fs, s.root = self.fs, self.root
+        s.experiment_name, s.trial_name = self.experiment_name, trial_name
+        return s

@@ -1,0 +1,146 @@
+"""Gang of training worker actors placed in a placement group.
+
+Reference: python/ray/train/_internal/worker_group.py:102 (WorkerGroup) and
+backend_executor.py:67. One actor per worker; on real TPU pods each worker is
+one host of the slice (multi-controller JAX), gang-placed STRICT_PACK so the
+gang shares an ICI domain.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.placement_group import PlacementGroup, placement_group, \
+    remove_placement_group
+from ray_tpu.core.scheduling_strategies import PlacementGroupSchedulingStrategy
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.session import TrainContext, TrainingResult, _TrainSession
+
+
+class _TrainWorker:
+    """The actor class hosting one training worker."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self.session: Optional[_TrainSession] = None
+
+    # --------------------------------------------------------- bookkeeping
+    def node_info(self) -> Dict[str, Any]:
+        return {
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            "rank": self.rank,
+        }
+
+    def set_env(self, env: Dict[str, str]):
+        os.environ.update(env)
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        """Run an arbitrary function in the worker process (backend hooks)."""
+        return fn(*args, **kwargs)
+
+    # ----------------------------------------------------------- training
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       context_kwargs: Dict[str, Any],
+                       checkpoint_path: Optional[str],
+                       dataset_shards: Optional[Dict[str, Any]] = None,
+                       storage_info: Optional[Dict[str, Any]] = None):
+        from ray_tpu.train.checkpoint import Checkpoint
+        from ray_tpu.train.storage import StorageContext
+
+        ctx = TrainContext(world_rank=self.rank, world_size=self.world_size,
+                           local_rank=self.rank, local_world_size=self.world_size,
+                           **context_kwargs)
+        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        storage = None
+        ckpt_start = 0
+        if storage_info:
+            storage = StorageContext(storage_info["storage_path"],
+                                     storage_info["experiment_name"],
+                                     storage_info["trial_name"])
+            ckpt_start = storage_info.get("checkpoint_index_start", 0)
+        self.session = _TrainSession(train_fn, config or {}, ctx,
+                                     checkpoint=ckpt,
+                                     dataset_shards=dataset_shards,
+                                     storage=storage,
+                                     checkpoint_index_start=ckpt_start)
+        session_mod._set_session(self.session)
+        self.session.start()
+
+    def next_result(self) -> TrainingResult:
+        assert self.session is not None, "start_training not called"
+        return self.session.next_result()
+
+    def end_session(self):
+        session_mod._set_session(None)
+        self.session = None
+
+
+class WorkerGroup:
+    """Creates and addresses the gang."""
+
+    def __init__(self, scaling: ScalingConfig):
+        self.scaling = scaling
+        self.pg: Optional[PlacementGroup] = None
+        self.workers: List[Any] = []
+
+    def start(self):
+        n = self.scaling.num_workers
+        bundles = [self.scaling.bundle_for_worker() for _ in range(n)]
+        if any(bundles[0].values()):
+            self.pg = placement_group(bundles, strategy=self.scaling.placement_strategy)
+            if not self.pg.wait(timeout_seconds=60.0):
+                pg, self.pg = self.pg, None
+                try:
+                    remove_placement_group(pg)
+                except Exception:
+                    pass
+                raise RuntimeError(
+                    f"placement group for {n} training workers "
+                    f"(bundle={bundles[0]}) not ready within 60s — the "
+                    f"cluster cannot satisfy the ScalingConfig")
+        worker_cls = ray_tpu.remote(_TrainWorker)
+        self.workers = []
+        for rank in range(n):
+            opts: Dict[str, Any] = {"max_restarts": 0}
+            if self.pg is not None:
+                opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg, placement_group_bundle_index=rank)
+                opts["num_cpus"] = self.scaling.num_cpus_per_worker
+                if self.scaling.use_tpu:
+                    opts["resources"] = {"TPU": float(self.scaling.chips_per_worker or 1)}
+            self.workers.append(worker_cls.options(**opts).remote(
+                rank, n))
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Run fn on every worker, return all results (ordered by rank)."""
+        return ray_tpu.get([w.execute.remote(fn, *args, **kwargs)
+                            for w in self.workers])
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(self.workers[rank].execute.remote(fn, *args, **kwargs))
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def __len__(self):
+        return len(self.workers)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self.pg is not None:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            self.pg = None
