@@ -1,0 +1,56 @@
+"""ray_tpu.data: streaming distributed datasets (reference: python/ray/data/).
+
+Lazy logical plans over blocks in the shared-memory object store, lowered
+through an operator-fusing planner to a backpressured streaming executor
+running ray_tpu tasks/actors. Consumption feeds JAX: ``iter_jax_batches``
+stages batches into TPU HBM with double buffering, ``streaming_split``
+fans one execution out to a gang of Train workers.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from ray_tpu.data.context import DataContext  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    Dataset,
+    MaterializedDataset,
+    from_arrow,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+    read_tfrecords,
+)
+from ray_tpu.data.datasource import Datasource, ReadTask  # noqa: F401
+from ray_tpu.data.grouped import (  # noqa: F401
+    AggregateFn,
+    Count,
+    GroupedData,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)
+from ray_tpu.data.iterator import DataIterator  # noqa: F401
+from ray_tpu.data.logical import ActorPoolStrategy, TaskPoolStrategy  # noqa: F401
+
+__all__ = [
+    "Block", "BlockAccessor", "BlockMetadata", "DataContext",
+    "Dataset", "MaterializedDataset", "DataIterator",
+    "Datasource", "ReadTask",
+    "ActorPoolStrategy", "TaskPoolStrategy",
+    "AggregateFn", "Sum", "Min", "Max", "Mean", "Count", "Std",
+    "GroupedData",
+    "range", "range_tensor", "from_items", "from_numpy", "from_arrow",
+    "from_pandas", "from_blocks", "read_datasource", "read_parquet",
+    "read_csv", "read_json", "read_numpy", "read_text",
+    "read_binary_files", "read_tfrecords",
+]

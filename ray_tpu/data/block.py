@@ -1,0 +1,274 @@
+"""Blocks: the unit of data in ray_tpu.data.
+
+Reference: python/ray/data/block.py — Block (Arrow table / pandas frame),
+BlockAccessor :221, BlockMetadata. Here the canonical in-store block is a
+``pyarrow.Table``; simple (untabular) rows are wrapped in a single ``item``
+column, mirroring the reference's strict-mode behavior.
+
+TPU note: batch extraction favors numpy (dict of contiguous ndarrays) since
+that is the zero-copy path into ``jax.device_put`` / HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+# A Block is a pyarrow Table; a Batch is what UDFs/iterators see.
+Block = pa.Table
+Batch = Union[pa.Table, Dict[str, np.ndarray], "pandas.DataFrame"]
+
+ITEM_COL = "item"
+
+
+@dataclass
+class BlockMetadata:
+    """Sidecar metadata, kept small so the executor can plan without
+    fetching block payloads (reference: BlockMetadata in data/block.py)."""
+
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+    input_files: Optional[List[str]] = None
+    exec_stats: Optional[dict] = None
+
+
+def _is_tabular_row(row: Any) -> bool:
+    return isinstance(row, dict)
+
+
+class BlockAccessor:
+    """Uniform view over a block (reference: BlockAccessor, block.py:221)."""
+
+    def __init__(self, block: Block):
+        if not isinstance(block, pa.Table):
+            raise TypeError(f"Block must be a pyarrow.Table, got {type(block)}")
+        self._table = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # ---- builders ----
+
+    @staticmethod
+    def batch_to_block(batch: Batch) -> Block:
+        """Normalize a UDF return / input batch into a pyarrow Table."""
+        import pandas as pd
+
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+        if isinstance(batch, dict):
+            cols = {}
+            shapes = {}
+            for k, v in batch.items():
+                v = np.asarray(v)
+                if v.ndim > 1:
+                    # Tensor column: flattened FixedSizeList; the inner
+                    # shape rides on the table schema metadata so numpy
+                    # round-trips keep (N, *inner_shape).
+                    cols[k] = _tensor_to_arrow(v)
+                    shapes[k] = v.shape[1:]
+                else:
+                    cols[k] = pa.array(v)
+            table = pa.table(cols)
+            if shapes:
+                meta = {f"tensor_shape:{k}".encode(): repr(tuple(s)).encode()
+                        for k, s in shapes.items()}
+                table = table.replace_schema_metadata(
+                    {**(table.schema.metadata or {}), **meta})
+            return table
+        raise TypeError(
+            "Batches must be pyarrow.Table, pandas.DataFrame, or "
+            f"Dict[str, np.ndarray]; got {type(batch)}")
+
+    @staticmethod
+    def rows_to_block(rows: List[Any]) -> Block:
+        if rows and all(_is_tabular_row(r) for r in rows):
+            # Union of keys across rows, first-seen order; rows missing a
+            # key contribute nulls (reference fills missing fields with
+            # null rather than raising).
+            keys = list(rows[0].keys())
+            seen = set(keys)
+            for r in rows[1:]:
+                for k in r:
+                    if k not in seen:
+                        seen.add(k)
+                        keys.append(k)
+            batch = {}
+            obj_cols = {}
+            for k in keys:
+                vals = [r.get(k) for r in rows]
+                if any(v is None for v in vals):
+                    obj_cols[k] = vals
+                    continue
+                try:
+                    arr = np.asarray(vals)
+                except ValueError:
+                    arr = np.empty(len(vals), dtype=object)
+                    arr[:] = vals
+                if arr.dtype == object:
+                    obj_cols[k] = vals
+                else:
+                    batch[k] = arr
+            table = BlockAccessor.batch_to_block(batch) if batch else None
+            if obj_cols:
+                obj_table = pa.table({k: pa.array(v)
+                                      for k, v in obj_cols.items()})
+                if table is None:
+                    table = obj_table
+                else:
+                    for name in obj_table.column_names:
+                        table = table.append_column(
+                            name, obj_table.column(name))
+                    table = table.select(keys)
+            return table
+        return pa.table({ITEM_COL: pa.array(rows)})
+
+    # ---- views ----
+
+    @property
+    def table(self) -> pa.Table:
+        return self._table
+
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def get_metadata(self, input_files: Optional[List[str]] = None,
+                     exec_stats: Optional[dict] = None) -> BlockMetadata:
+        return BlockMetadata(
+            num_rows=self.num_rows(),
+            size_bytes=self.size_bytes(),
+            schema=self.schema(),
+            input_files=input_files,
+            exec_stats=exec_stats,
+        )
+
+    def to_batch(self, batch_format: str) -> Batch:
+        if batch_format in ("numpy", "default"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self._table
+        raise ValueError(f"Unknown batch_format {batch_format!r}")
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        meta = self._table.schema.metadata or {}
+        out = {}
+        for name in self._table.column_names:
+            col = self._table.column(name)
+            arr = _arrow_to_numpy(col)
+            shape_key = f"tensor_shape:{name}".encode()
+            if shape_key in meta and arr.ndim == 2:
+                import ast
+                inner = ast.literal_eval(meta[shape_key].decode())
+                arr = arr.reshape((arr.shape[0],) + inner)
+            out[name] = arr
+        return out
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def iter_rows(self) -> Iterator[Any]:
+        cols = self._table.column_names
+        simple = cols == [ITEM_COL]
+        for i in range(self._table.num_rows):
+            if simple:
+                yield self._table.column(0)[i].as_py()
+            else:
+                yield {c: _cell(self._table.column(c), i) for c in cols}
+
+    # ---- ops ----
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take_rows(self, indices: np.ndarray) -> Block:
+        return self._table.take(pa.array(indices))
+
+    def select_columns(self, cols: List[str]) -> Block:
+        return self._table.select(cols)
+
+    def drop_columns(self, cols: List[str]) -> Block:
+        keep = [c for c in self._table.column_names if c not in cols]
+        return self._table.select(keep)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> Block:
+        names = [mapping.get(c, c) for c in self._table.column_names]
+        return self._table.rename_columns(names)
+
+    def sort_indices(self, key: Union[str, List[str]],
+                     descending: bool = False) -> np.ndarray:
+        keys = [key] if isinstance(key, str) else list(key)
+        order = "descending" if descending else "ascending"
+        idx = pa.compute.sort_indices(
+            self._table, sort_keys=[(k, order) for k in keys])
+        return idx.to_numpy()
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b.num_rows > 0]
+        if not blocks:
+            return pa.table({})
+        if len(blocks) == 1:
+            return blocks[0]
+        return pa.concat_tables(blocks, promote_options="default")
+
+    def random_shuffle_indices(self, seed: Optional[int]) -> np.ndarray:
+        rng = np.random.default_rng(seed)
+        return rng.permutation(self.num_rows())
+
+
+# ---- tensor column helpers -------------------------------------------------
+
+def _tensor_to_arrow(arr: np.ndarray) -> pa.Array:
+    """Store an (N, ...) ndarray as a FixedSizeList arrow column, keeping
+    the inner shape in the field metadata so round-trips preserve it."""
+    n = arr.shape[0]
+    inner_shape = arr.shape[1:]
+    flat = np.ascontiguousarray(arr).reshape(n, -1)
+    inner_len = flat.shape[1]
+    values = pa.array(flat.reshape(-1))
+    fsl = pa.FixedSizeListArray.from_arrays(values, inner_len)
+    # Shape travels via an extension-free side channel: a struct of
+    # (data, shape) would bloat; we instead rebuild from metadata-carrying
+    # schema at table level. Simplest robust approach: attach to field meta.
+    field = pa.field("t", fsl.type,
+                     metadata={b"tensor_shape": repr(inner_shape).encode()})
+    return fsl.cast(field.type)
+
+
+def _arrow_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
+    typ = col.type
+    if pa.types.is_fixed_size_list(typ):
+        combined = col.combine_chunks()
+        if isinstance(combined, pa.ChunkedArray):
+            combined = combined.chunk(0) if combined.num_chunks else \
+                pa.array([], type=typ)
+        values = combined.values.to_numpy(zero_copy_only=False)
+        n = len(combined)
+        width = typ.list_size
+        return values.reshape(n, width)
+    try:
+        return col.to_numpy(zero_copy_only=False)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        return np.asarray(col.to_pylist(), dtype=object)
+
+
+def _cell(col: pa.ChunkedArray, i: int):
+    v = col[i]
+    if pa.types.is_fixed_size_list(col.type):
+        return np.asarray(v.as_py())
+    return v.as_py()

@@ -1,0 +1,61 @@
+"""DataContext: per-dataset execution configuration.
+
+Reference: python/ray/data/context.py (DataContext) — a process-wide
+singleton of execution knobs, snapshotted per-dataset at creation time.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DataContext:
+    """Execution knobs for ray_tpu.data pipelines.
+
+    TPU-first defaults: blocks sized so a handful of them fit in host RAM
+    while batches stream into HBM; numpy is the default batch format since
+    it feeds ``jax.device_put`` zero-copy.
+    """
+
+    # Target size of a block produced by reads/shuffles, in bytes.
+    target_max_block_size: int = 128 * 1024 * 1024
+    # Minimum rows per block before reads further subdivide files.
+    target_min_block_size: int = 1 * 1024 * 1024
+    # Max blocks buffered in an operator's output queue before backpressure.
+    max_op_output_queue_blocks: int = 16
+    # Cap on concurrently running tasks per map operator (None = executor
+    # derives it from the worker pool size).
+    max_tasks_in_flight_per_op: Optional[int] = None
+    # Default batch format for iter_batches / map_batches.
+    batch_format: str = "numpy"
+    # Default parallelism for reads when not specified (-1 = auto).
+    read_parallelism: int = -1
+    # Whether the optimizer fuses compatible map operators.
+    optimizer_enabled: bool = True
+    # Preserve input order of blocks through execution.
+    preserve_order: bool = True
+    # Number of batches prefetched by iterators (double-buffering into HBM).
+    prefetch_batches: int = 2
+    # Raise instead of warn when a map UDF returns an unknown type.
+    strict_mode: bool = True
+    # Extra metadata attached by tests.
+    extras: dict = field(default_factory=dict)
+
+    _current: "DataContext" = None  # class-level, set below
+    _lock = threading.Lock()
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        with DataContext._lock:
+            if DataContext._current is None:
+                DataContext._current = DataContext()
+            return DataContext._current
+
+    def copy(self) -> "DataContext":
+        c = copy.copy(self)
+        c.extras = dict(self.extras)
+        return c

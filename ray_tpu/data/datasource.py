@@ -1,0 +1,276 @@
+"""Datasources: pluggable readers/writers producing ReadTasks.
+
+Reference: python/ray/data/read_api.py + python/ray/data/_internal/datasource/
+(parquet, csv, json, numpy, range, binary, text datasources). A Datasource
+plans itself into independent ``ReadTask``s — serializable thunks the
+streaming executor runs as remote tasks, each yielding blocks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+
+
+class ReadTask:
+    """A serializable unit of read work (reference:
+    python/ray/data/datasource/datasource.py ReadTask)."""
+
+    def __init__(self, read_fn: Callable[[], Iterable[Block]],
+                 metadata: BlockMetadata):
+        self._read_fn = read_fn
+        self.metadata = metadata  # estimate; actual metadata computed on read
+
+    def __call__(self) -> Iterable[Block]:
+        return self._read_fn()
+
+
+class Datasource:
+    """Base class for custom datasources (reference:
+    python/ray/data/datasource/datasource.py Datasource)."""
+
+    def get_name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+
+# ---- built-in sources ------------------------------------------------------
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, use_tensor: bool = False,
+                 tensor_shape: tuple = (1,)):
+        self._n = n
+        self._use_tensor = use_tensor
+        self._tensor_shape = tensor_shape
+
+    def estimate_inmemory_data_size(self):
+        return self._n * 8 * int(np.prod(self._tensor_shape))
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        n, k = self._n, max(1, parallelism)
+        use_tensor, shape = self._use_tensor, self._tensor_shape
+        for i in range(k):
+            start = n * i // k
+            end = n * (i + 1) // k
+            if end <= start:
+                continue
+
+            def read(start=start, end=end):
+                ids = np.arange(start, end, dtype=np.int64)
+                if use_tensor:
+                    data = np.broadcast_to(
+                        ids.reshape((-1,) + (1,) * len(shape)),
+                        (end - start,) + shape).copy()
+                    yield BlockAccessor.batch_to_block({"data": data})
+                else:
+                    yield BlockAccessor.batch_to_block({"id": ids})
+
+            meta = BlockMetadata(num_rows=end - start,
+                                 size_bytes=(end - start) * 8)
+            tasks.append(ReadTask(read, meta))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        n, k = len(self._items), max(1, parallelism)
+        for i in range(k):
+            chunk = self._items[n * i // k:n * (i + 1) // k]
+            if not chunk:
+                continue
+
+            def read(chunk=chunk):
+                yield BlockAccessor.rows_to_block(chunk)
+
+            tasks.append(ReadTask(read, BlockMetadata(len(chunk), 0)))
+        return tasks
+
+
+def _expand_paths(paths, suffixes: Optional[List[str]] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                for f in sorted(files):
+                    if f.startswith((".", "_")):
+                        continue
+                    if suffixes and not any(f.endswith(s) for s in suffixes):
+                        continue
+                    out.append(os.path.join(root, f))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"No input files found for {paths!r}")
+    return out
+
+
+class FileDatasource(Datasource):
+    """Shared logic for file-based sources: split files across read tasks."""
+
+    suffixes: Optional[List[str]] = None
+
+    def __init__(self, paths):
+        self._paths = _expand_paths(paths, self.suffixes)
+
+    def estimate_inmemory_data_size(self):
+        try:
+            return sum(os.path.getsize(p) for p in self._paths)
+        except OSError:
+            return None
+
+    def read_file(self, path: str) -> Iterable[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        groups = np.array_split(np.asarray(self._paths, dtype=object),
+                                max(1, min(parallelism, len(self._paths))))
+        for grp in groups:
+            paths = [str(p) for p in grp]
+            if not paths:
+                continue
+
+            def read(paths=paths, self=self):
+                for p in paths:
+                    yield from self.read_file(p)
+
+            size = sum(os.path.getsize(p) for p in paths
+                       if os.path.exists(p))
+            tasks.append(ReadTask(read, BlockMetadata(
+                num_rows=0, size_bytes=size, input_files=paths)))
+        return tasks
+
+
+class ParquetDatasource(FileDatasource):
+    suffixes = [".parquet"]
+
+    def __init__(self, paths, columns: Optional[List[str]] = None):
+        super().__init__(paths)
+        self._columns = columns
+
+    def read_file(self, path: str):
+        import pyarrow.parquet as pq
+        pf = pq.ParquetFile(path)
+        for batch in pf.iter_batches(columns=self._columns):
+            yield pa.Table.from_batches([batch])
+
+
+class CSVDatasource(FileDatasource):
+    suffixes = [".csv"]
+
+    def read_file(self, path: str):
+        import pyarrow.csv as pacsv
+        yield pacsv.read_csv(path)
+
+
+class JSONDatasource(FileDatasource):
+    suffixes = [".json", ".jsonl"]
+
+    def read_file(self, path: str):
+        import pyarrow.json as pajson
+        yield pajson.read_json(path)
+
+
+class NumpyDatasource(FileDatasource):
+    suffixes = [".npy"]
+
+    def read_file(self, path: str):
+        arr = np.load(path)
+        yield BlockAccessor.batch_to_block({"data": arr})
+
+
+class TextDatasource(FileDatasource):
+    def read_file(self, path: str):
+        with open(path, "r", errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        yield pa.table({"text": pa.array(lines)})
+
+
+class BinaryDatasource(FileDatasource):
+    def read_file(self, path: str):
+        with open(path, "rb") as f:
+            data = f.read()
+        yield pa.table({"bytes": pa.array([data], type=pa.binary()),
+                        "path": pa.array([path])})
+
+
+class TFRecordsDatasource(FileDatasource):
+    """Minimal TFRecord reader (uncompressed): parses the framing format
+    (length/crc framing per the TFRecord spec) and yields raw example
+    bytes; decoding protos is left to a downstream map (torch/tf-free)."""
+
+    suffixes = [".tfrecords", ".tfrecord"]
+
+    def read_file(self, path: str):
+        import struct
+        records = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if len(header) < 8:
+                    break
+                (length,) = struct.unpack("<Q", header)
+                f.read(4)  # length crc
+                records.append(f.read(length))
+                f.read(4)  # data crc
+        yield pa.table({"bytes": pa.array(records, type=pa.binary())})
+
+
+# ---- writers ---------------------------------------------------------------
+
+def write_block(block: Block, path: str, file_format: str, index: int,
+                **kwargs) -> str:
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:06d}.{file_format}")
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(block, out, **kwargs)
+    elif file_format == "csv":
+        import pyarrow.csv as pacsv
+        pacsv.write_csv(block, out)
+    elif file_format == "json":
+        import json
+        rows = list(BlockAccessor(block).iter_rows())
+        with open(out, "w") as f:
+            for r in rows:
+                f.write(json.dumps(_json_safe(r)) + "\n")
+    elif file_format == "npy":
+        data = BlockAccessor(block).to_numpy()
+        if len(data) == 1:
+            np.save(out, next(iter(data.values())))
+        else:
+            np.savez(out, **data)
+    else:
+        raise ValueError(f"Unknown file format {file_format!r}")
+    return out
+
+
+def _json_safe(v):
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    return v

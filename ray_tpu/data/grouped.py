@@ -1,0 +1,168 @@
+"""GroupedData: groupby + aggregations.
+
+Reference: python/ray/data/grouped_data.py — GroupedData.sum/min/max/mean/
+count/std, .aggregate(AggregateFn), .map_groups.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+
+
+class AggregateFn:
+    """A named aggregation over a column, executed via arrow group-bys."""
+
+    def __init__(self, on: str, arrow_name: str,
+                 name: Optional[str] = None):
+        self.on = on
+        self.arrow_name = arrow_name
+        self.name = name or f"{arrow_name}({on})"
+
+
+def Sum(on: str):
+    return AggregateFn(on, "sum")
+
+
+def Min(on: str):
+    return AggregateFn(on, "min")
+
+
+def Max(on: str):
+    return AggregateFn(on, "max")
+
+
+def Mean(on: str):
+    return AggregateFn(on, "mean")
+
+
+def Count(on: str):
+    return AggregateFn(on, "count")
+
+
+def Std(on: str):
+    return AggregateFn(on, "stddev")
+
+
+@ray_tpu.remote
+def _map_groups_partition(key, fn, batch_format: str,
+                          *part_lists: List[Block]):
+    """Merge one hash partition, then apply fn to each key-group."""
+    blocks = [b for parts in part_lists for b in parts]
+    merged = BlockAccessor.concat(blocks)
+    if merged.num_rows == 0:
+        return [], []
+    acc = BlockAccessor(merged)
+    keys = [key] if isinstance(key, str) else list(key)
+    sorted_block = acc.take_rows(acc.sort_indices(keys))
+    sacc = BlockAccessor(sorted_block)
+    cols = sacc.to_numpy()
+    key_col = cols[keys[0]]
+    # Boundaries where any key column changes value.
+    change = np.zeros(len(key_col), dtype=bool)
+    change[0] = True
+    for k in keys:
+        c = cols[k]
+        change[1:] |= c[1:] != c[:-1]
+    starts = np.nonzero(change)[0].tolist() + [len(key_col)]
+    outs = []
+    for s, e in zip(starts[:-1], starts[1:]):
+        group = BlockAccessor(sacc.slice(s, e)).to_batch(batch_format)
+        res = fn(group)
+        outs.append(BlockAccessor.batch_to_block(res))
+    out_blocks = [b for b in outs if b.num_rows]
+    metas = [BlockAccessor(b).get_metadata() for b in out_blocks]
+    return out_blocks, metas
+
+
+class GroupedData:
+    def __init__(self, dataset, key: Union[str, List[str]]):
+        self._ds = dataset
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn):
+        from ray_tpu.data.dataset import Dataset
+        node = L.Aggregate(self._ds._logical_op, self._key, list(aggs))
+        return Dataset(node, self._ds._context)
+
+    def _agg(self, arrow_name: str, on: Union[str, List[str]]):
+        cols = [on] if isinstance(on, str) else list(on)
+        return self.aggregate(*[AggregateFn(c, arrow_name) for c in cols])
+
+    def sum(self, on):
+        return self._agg("sum", on)
+
+    def min(self, on):
+        return self._agg("min", on)
+
+    def max(self, on):
+        return self._agg("max", on)
+
+    def mean(self, on):
+        return self._agg("mean", on)
+
+    def count(self):
+        key0 = self._key if isinstance(self._key, str) else self._key[0]
+        ds = self.aggregate(AggregateFn(key0, "count", name="count()"))
+        return ds
+
+    def std(self, on):
+        return self._agg("stddev", on)
+
+    def map_groups(self, fn: Callable, *,
+                   batch_format: Optional[str] = None):
+        """Apply ``fn`` to each group as one batch (reference:
+        grouped_data.py map_groups)."""
+        from ray_tpu.data.dataset import Dataset
+        fmt = batch_format or self._ds._context.batch_format
+        node = _MapGroups(self._ds._logical_op, self._key, fn, fmt)
+        return Dataset(node, self._ds._context)
+
+
+class _MapGroups(L.LogicalOperator):
+    def __init__(self, input_op, key, fn, batch_format):
+        super().__init__("MapGroups", [input_op])
+        self.key = key
+        self.fn = fn
+        self.batch_format = batch_format
+
+
+from ray_tpu.data.physical import (  # noqa: E402  (after remote defs)
+    AggregateOperator,
+    _hash_partition,
+    _select_partition,
+)
+
+
+class MapGroupsOperator(AggregateOperator):
+    """Physical barrier op for map_groups: hash-partition by key so each
+    group lands whole in one partition, then apply the UDF per group."""
+
+    def __init__(self, key, fn, batch_format, num_partitions=None):
+        super().__init__(key, [], num_partitions)
+        self.name = "MapGroups"
+        self.fn = fn
+        self.batch_format = batch_format
+
+    def launch_one(self):
+        n = self.num_partitions or max(1, min(len(self._collected), 8))
+        map_refs = [_hash_partition.remote(b.blocks_ref, self.key, n)
+                    for b in self._collected]
+        for i in range(n):
+            part_i = [_select_partition.remote(mr, i) for mr in map_refs]
+            blocks_ref, meta_ref = _map_groups_partition.options(
+                num_returns=2).remote(self.key, self.fn,
+                                      self.batch_format, *part_i)
+            self._track(meta_ref, blocks_ref)
+            self.tasks_launched += 1
+        self._collected.clear()
+        self._phase = "reduce"
+
+
+def make_map_groups_operator(key, fn, batch_format, num_partitions=None):
+    return MapGroupsOperator(key, fn, batch_format, num_partitions)

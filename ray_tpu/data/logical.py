@@ -1,0 +1,150 @@
+"""Logical plan: lazy operator DAG built by Dataset transforms.
+
+Reference: python/ray/data/_internal/logical/ — LogicalOperator nodes,
+LogicalPlan, and optimizer rules (operator_fusion.py, limit pushdown).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Union
+
+from ray_tpu.data.context import DataContext
+
+
+class LogicalOperator:
+    def __init__(self, name: str, inputs: List["LogicalOperator"]):
+        self.name = name
+        self.inputs = inputs
+
+    def __repr__(self):
+        return self.name
+
+
+class Read(LogicalOperator):
+    def __init__(self, datasource, parallelism: int):
+        super().__init__(f"Read{datasource.get_name()}", [])
+        self.datasource = datasource
+        self.parallelism = parallelism
+
+
+class InputData(LogicalOperator):
+    """Pre-materialized input: list of (block_ref, metadata)."""
+
+    def __init__(self, ref_bundles):
+        super().__init__("InputData", [])
+        self.ref_bundles = ref_bundles
+
+
+class AbstractMap(LogicalOperator):
+    """Row/batch transform; fusable with adjacent maps.
+
+    kind: one of 'map_batches' | 'map_rows' | 'flat_map' | 'filter'.
+    """
+
+    def __init__(self, name: str, input_op: LogicalOperator, kind: str,
+                 fn: Callable, fn_args: tuple = (), fn_kwargs: dict = None,
+                 batch_size: Optional[int] = None,
+                 batch_format: Optional[str] = None,
+                 compute: Optional["ComputeStrategy"] = None,
+                 num_chips: int = 0,
+                 fn_constructor_args: tuple = ()):
+        super().__init__(name, [input_op])
+        self.kind = kind
+        self.fn = fn
+        self.fn_args = fn_args
+        self.fn_kwargs = fn_kwargs or {}
+        self.batch_size = batch_size
+        self.batch_format = batch_format or DataContext.get_current().batch_format
+        self.compute = compute
+        self.num_chips = num_chips
+        self.fn_constructor_args = fn_constructor_args
+
+
+class Limit(LogicalOperator):
+    def __init__(self, input_op: LogicalOperator, limit: int):
+        super().__init__(f"Limit[{limit}]", [input_op])
+        self.limit = limit
+
+
+class AbstractAllToAll(LogicalOperator):
+    """Materializing exchange: sort, shuffle, repartition (reference:
+    python/ray/data/_internal/planner/exchange/)."""
+
+    def __init__(self, name: str, input_op: LogicalOperator, kind: str,
+                 key: Union[str, List[str], None] = None,
+                 descending: bool = False,
+                 num_outputs: Optional[int] = None,
+                 seed: Optional[int] = None):
+        super().__init__(name, [input_op])
+        self.kind = kind  # 'sort' | 'random_shuffle' | 'repartition'
+        self.key = key
+        self.descending = descending
+        self.num_outputs = num_outputs
+        self.seed = seed
+
+
+class Aggregate(LogicalOperator):
+    def __init__(self, input_op: LogicalOperator,
+                 key: Optional[Union[str, List[str]]], aggs: List[Any]):
+        super().__init__("Aggregate", [input_op])
+        self.key = key
+        self.aggs = aggs
+
+
+class Union(LogicalOperator):
+    def __init__(self, inputs: List[LogicalOperator]):
+        super().__init__("Union", inputs)
+
+
+class Zip(LogicalOperator):
+    def __init__(self, left: LogicalOperator, right: LogicalOperator):
+        super().__init__("Zip", [left, right])
+
+
+class Write(LogicalOperator):
+    def __init__(self, input_op: LogicalOperator, path: str,
+                 file_format: str, write_kwargs: dict = None):
+        super().__init__(f"Write[{file_format}]", [input_op])
+        self.path = path
+        self.file_format = file_format
+        self.write_kwargs = write_kwargs or {}
+
+
+class ComputeStrategy:
+    pass
+
+
+class TaskPoolStrategy(ComputeStrategy):
+    def __init__(self, size: Optional[int] = None):
+        self.size = size
+
+
+class ActorPoolStrategy(ComputeStrategy):
+    """Run the map UDF on a pool of actors (stateful UDF classes;
+    reference: python/ray/data/_internal/compute.py ActorPoolStrategy)."""
+
+    def __init__(self, size: Optional[int] = None,
+                 min_size: Optional[int] = None,
+                 max_size: Optional[int] = None,
+                 max_tasks_in_flight_per_actor: int = 2):
+        self.min_size = min_size or size or 1
+        self.max_size = max_size or size or self.min_size
+        self.max_tasks_in_flight_per_actor = max_tasks_in_flight_per_actor
+
+
+class LogicalPlan:
+    def __init__(self, dag: LogicalOperator, context: DataContext):
+        self.dag = dag
+        self.context = context
+
+    def sources(self) -> List[LogicalOperator]:
+        out, seen, stack = [], set(), [self.dag]
+        while stack:
+            op = stack.pop()
+            if id(op) in seen:
+                continue
+            seen.add(id(op))
+            if not op.inputs:
+                out.append(op)
+            stack.extend(op.inputs)
+        return out

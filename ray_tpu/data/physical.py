@@ -1,0 +1,699 @@
+"""Physical operators for the streaming executor.
+
+Reference: python/ray/data/_internal/execution/operators/ — MapOperator
+(TaskPoolMapOperator / ActorPoolMapOperator), InputDataBuffer, LimitOperator,
+all-to-all exchange ops (python/ray/data/_internal/planner/exchange/).
+
+Data flows between operators as **RefBundles**: an ObjectRef to a
+``List[Block]`` plus fetched-small metadata. Block payloads stay in the
+shared-memory object store; the driver-side executor only ever touches
+metadata.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.transforms import MapTransformChain
+
+
+@dataclass
+class RefBundle:
+    blocks_ref: ObjectRef          # -> List[Block]
+    num_rows: int
+    size_bytes: int
+    metas: List[BlockMetadata] = field(default_factory=list)
+
+    def destroy(self):
+        pass  # refcounting is handled by the object store GC
+
+
+# ---- remote task bodies ----------------------------------------------------
+
+@ray_tpu.remote
+def _run_map_task(chain: MapTransformChain, blocks: List[Block]
+                  ) -> Tuple[List[Block], List[BlockMetadata]]:
+    out = list(chain(blocks))
+    metas = [BlockAccessor(b).get_metadata() for b in out]
+    return out, metas
+
+
+@ray_tpu.remote
+def _run_read_task(read_task, chain: Optional[MapTransformChain]
+                   ) -> Tuple[List[Block], List[BlockMetadata]]:
+    blocks = read_task()
+    if chain is not None:
+        blocks = chain(blocks)
+    out = list(blocks)
+    metas = [BlockAccessor(b).get_metadata() for b in out]
+    return out, metas
+
+
+@ray_tpu.remote
+def _truncate_blocks(blocks: List[Block], rows: int
+                     ) -> Tuple[List[Block], List[BlockMetadata]]:
+    out: List[Block] = []
+    remaining = rows
+    for b in blocks:
+        if remaining <= 0:
+            break
+        if b.num_rows <= remaining:
+            out.append(b)
+            remaining -= b.num_rows
+        else:
+            out.append(BlockAccessor(b).slice(0, remaining))
+            remaining = 0
+    metas = [BlockAccessor(b).get_metadata() for b in out]
+    return out, metas
+
+
+@ray_tpu.remote
+def _partition_blocks(blocks: List[Block], n: int, kind: str,
+                      key, descending: bool, seed: Optional[int],
+                      boundaries: Optional[List[Any]]) -> List[List[Block]]:
+    """Map side of an exchange: split each input block into n partitions."""
+    parts: List[List[Block]] = [[] for _ in range(n)]
+    for b in blocks:
+        acc = BlockAccessor(b)
+        if b.num_rows == 0:
+            continue
+        if kind == "repartition":
+            rows_per = -(-b.num_rows // n)
+            for i in range(n):
+                s = acc.slice(i * rows_per, min((i + 1) * rows_per,
+                                                b.num_rows))
+                if s.num_rows:
+                    parts[i].append(s)
+        elif kind == "random_shuffle":
+            idx = acc.random_shuffle_indices(seed)
+            for i, chunk in enumerate(np.array_split(idx, n)):
+                if len(chunk):
+                    parts[i].append(acc.take_rows(chunk))
+        elif kind == "sort":
+            sort_idx = acc.sort_indices(key, descending)
+            sorted_block = acc.take_rows(sort_idx)
+            sacc = BlockAccessor(sorted_block)
+            k0 = key if isinstance(key, str) else key[0]
+            col = sacc.to_numpy()[k0]
+            if descending:
+                cuts = len(col) - np.searchsorted(col[::-1], boundaries,
+                                                  side="left")
+            else:
+                cuts = np.searchsorted(col, boundaries, side="left")
+            prev = 0
+            for i, cut in enumerate(list(cuts) + [len(col)]):
+                s = sacc.slice(prev, cut)
+                if s.num_rows:
+                    parts[i].append(s)
+                prev = cut
+        else:
+            raise ValueError(kind)
+    return [p for p in parts]
+
+
+@ray_tpu.remote
+def _merge_partition(kind: str, key, descending: bool, seed: Optional[int],
+                     *part_lists: List[Block]
+                     ) -> Tuple[List[Block], List[BlockMetadata]]:
+    """Reduce side of an exchange: merge partition i from every map task."""
+    blocks = [b for parts in part_lists for b in parts]
+    merged = BlockAccessor.concat(blocks)
+    acc = BlockAccessor(merged)
+    if kind == "sort" and merged.num_rows:
+        merged = acc.take_rows(acc.sort_indices(key, descending))
+    elif kind == "random_shuffle" and merged.num_rows:
+        rng_idx = BlockAccessor(merged).random_shuffle_indices(seed)
+        merged = BlockAccessor(merged).take_rows(rng_idx)
+    out = [merged] if merged.num_rows else []
+    metas = [BlockAccessor(b).get_metadata() for b in out]
+    return out, metas
+
+
+@ray_tpu.remote
+def _sample_boundaries(blocks: List[Block], key, n: int) -> List[Any]:
+    k0 = key if isinstance(key, str) else key[0]
+    vals = []
+    for b in blocks:
+        col = BlockAccessor(b).to_numpy().get(k0)
+        if col is not None and len(col):
+            step = max(1, len(col) // 20)
+            vals.extend(col[::step].tolist())
+    return vals
+
+
+@ray_tpu.remote
+def _zip_block_lists(left: List[Block], right: List[Block]
+                     ) -> Tuple[List[Block], List[BlockMetadata]]:
+    lt = BlockAccessor.concat(left)
+    rt = BlockAccessor.concat(right)
+    if lt.num_rows != rt.num_rows:
+        raise ValueError(
+            f"zip: datasets have different row counts "
+            f"({lt.num_rows} vs {rt.num_rows})")
+    out = lt
+    for name in rt.column_names:
+        col_name = name if name not in lt.column_names else f"{name}_1"
+        out = out.append_column(col_name, rt.column(name))
+    return [out], [BlockAccessor(out).get_metadata()]
+
+
+@ray_tpu.remote
+def _write_blocks(blocks: List[Block], path: str, file_format: str,
+                  index: int, write_kwargs: dict
+                  ) -> Tuple[List[Block], List[BlockMetadata]]:
+    from ray_tpu.data.datasource import write_block
+    import pyarrow as pa
+    written = []
+    for j, b in enumerate(blocks):
+        if b.num_rows:
+            written.append(write_block(b, path, file_format,
+                                       index * 10000 + j, **write_kwargs))
+    out = pa.table({"path": pa.array(written)})
+    return [out], [BlockAccessor(out).get_metadata()]
+
+
+# ---- actor pool worker -----------------------------------------------------
+
+@ray_tpu.remote
+class _MapWorker:
+    """Stateful map worker for ActorPoolStrategy: instantiates callable-class
+    UDFs once, then applies the chain per bundle (reference:
+    ActorPoolMapOperator._MapWorker)."""
+
+    def __init__(self, udf_cls=None, fn_constructor_args: tuple = ()):
+        self._udf = udf_cls(*fn_constructor_args) if udf_cls else None
+
+    def ready(self):
+        return True
+
+    def run(self, chain: MapTransformChain, blocks: List[Block]):
+        if self._udf is not None:
+            # Bind the instantiated UDF into steps whose fn is the marker.
+            from ray_tpu.data.transforms import MapStep
+            bound = []
+            for s in chain.steps:
+                if isinstance(s.fn, _CallableClassMarker):
+                    bound.append(MapStep("map_batches", self._udf, s.fn_args,
+                                         s.fn_kwargs, s.batch_size,
+                                         s.batch_format))
+                else:
+                    bound.append(s)
+            chain = MapTransformChain(bound, chain.target_max_block_size)
+        out = list(chain(blocks))
+        metas = [BlockAccessor(b).get_metadata() for b in out]
+        return out, metas
+
+
+class _CallableClassMarker:
+    """Placeholder fn inside a chain; replaced by the actor-held instance."""
+
+    def __call__(self, *a, **k):  # pragma: no cover
+        raise RuntimeError("callable-class UDF must run on an actor pool")
+
+
+_CALLABLE_CLASS_MARKER = _CallableClassMarker()
+
+
+# ---- physical operators ----------------------------------------------------
+
+class PhysicalOperator:
+    """Base: push RefBundles in, pull RefBundles out, track in-flight tasks."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.input_queue: collections.deque = collections.deque()
+        self.output_queue: collections.deque = collections.deque()
+        # meta_ref (waitable) -> (blocks_ref, context)
+        self.pending: Dict[ObjectRef, Any] = {}
+        self.inputs_complete = False
+        self.rows_out = 0
+        self.tasks_launched = 0
+        # Ordered emission: outputs enter output_queue in LAUNCH order even
+        # though tasks complete out of order (reference: preserve_order in
+        # streaming_executor_state; required for sort/zip/limit determinism).
+        self._seq = 0
+        self._emit_next = 0
+        self._pending_seq: Dict[ObjectRef, int] = {}
+        self._outbuf: Dict[int, RefBundle] = {}
+
+    def _track(self, meta_ref: ObjectRef, blocks_ref: ObjectRef):
+        """Register an in-flight task in launch order."""
+        self.pending[meta_ref] = blocks_ref
+        self._pending_seq[meta_ref] = self._seq
+        self._seq += 1
+
+    def _emit(self, seq: int, bundle: RefBundle):
+        self._outbuf[seq] = bundle
+        while self._emit_next in self._outbuf:
+            self.output_queue.append(self._outbuf.pop(self._emit_next))
+            self._emit_next += 1
+
+    def _emit_direct(self, bundle: RefBundle):
+        """Pass a bundle through without a task, keeping order."""
+        seq = self._seq
+        self._seq += 1
+        self._emit(seq, bundle)
+
+    def add_input(self, bundle: RefBundle):
+        self.input_queue.append(bundle)
+
+    def mark_inputs_done(self):
+        self.inputs_complete = True
+
+    def waitable_refs(self) -> List[ObjectRef]:
+        return list(self.pending.keys())
+
+    def can_launch(self, max_in_flight: int) -> bool:
+        return (len(self.input_queue) > 0 and
+                len(self.pending) < max_in_flight)
+
+    def launch_one(self):
+        raise NotImplementedError
+
+    def on_task_done(self, meta_ref: ObjectRef):
+        """A waited ref completed: fetch metadata, enqueue output bundle."""
+        blocks_ref = self.pending.pop(meta_ref)
+        seq = self._pending_seq.pop(meta_ref)
+        metas: List[BlockMetadata] = ray_tpu.get(meta_ref)
+        num_rows = sum(m.num_rows for m in metas)
+        size = sum(m.size_bytes for m in metas)
+        self.rows_out += num_rows
+        self._emit(seq, RefBundle(blocks_ref, num_rows, size, metas))
+
+    @property
+    def done(self) -> bool:
+        return (self.inputs_complete and not self.input_queue and
+                not self.pending)
+
+    def all_inputs_ready(self) -> bool:
+        return self.inputs_complete and not self.pending
+
+    def __repr__(self):
+        return (f"{self.name}(in={len(self.input_queue)} "
+                f"pending={len(self.pending)} out={len(self.output_queue)})")
+
+
+class InputDataBuffer(PhysicalOperator):
+    """Source op over pre-planned read tasks or materialized bundles
+    (reference: operators/input_data_buffer.py)."""
+
+    def __init__(self, read_tasks: Optional[List] = None,
+                 bundles: Optional[List[RefBundle]] = None,
+                 chain: Optional[MapTransformChain] = None,
+                 resources: Optional[dict] = None):
+        super().__init__("Input")
+        self._read_tasks = list(read_tasks or [])
+        self._chain = chain
+        self._resources = resources or {}
+        if bundles:
+            self.output_queue.extend(bundles)
+        self.inputs_complete = True
+
+    def can_launch(self, max_in_flight: int) -> bool:
+        return bool(self._read_tasks) and len(self.pending) < max_in_flight
+
+    def launch_one(self):
+        rt = self._read_tasks.pop(0)
+        opts = dict(num_returns=2, **self._resources)
+        blocks_ref, meta_ref = _run_read_task.options(**opts).remote(
+            rt, self._chain)
+        self._track(meta_ref, blocks_ref)
+        self.tasks_launched += 1
+
+    @property
+    def done(self) -> bool:
+        return not self._read_tasks and not self.pending
+
+
+class TaskPoolMapOperator(PhysicalOperator):
+    """Stateless map over a pool of tasks (reference:
+    operators/task_pool_map_operator.py)."""
+
+    def __init__(self, name: str, chain: MapTransformChain,
+                 resources: Optional[dict] = None):
+        super().__init__(name)
+        self.chain = chain
+        self._resources = resources or {}
+
+    def launch_one(self):
+        bundle: RefBundle = self.input_queue.popleft()
+        opts = dict(num_returns=2, **self._resources)
+        blocks_ref, meta_ref = _run_map_task.options(**opts).remote(
+            self.chain, bundle.blocks_ref)
+        self._track(meta_ref, blocks_ref)
+        self.tasks_launched += 1
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    """Stateful map over a pool of actors (reference:
+    operators/actor_pool_map_operator.py)."""
+
+    def __init__(self, name: str, chain: MapTransformChain, strategy,
+                 udf_cls=None, fn_constructor_args: tuple = (),
+                 resources: Optional[dict] = None):
+        super().__init__(name)
+        self.chain = chain
+        self._strategy = strategy
+        self._actors: List[Any] = []
+        self._actor_load: Dict[int, int] = {}
+        self._meta_to_actor: Dict[ObjectRef, int] = {}
+        self._udf_cls = udf_cls
+        self._ctor_args = fn_constructor_args
+        self._resources = resources or {}
+        self._started = False
+
+    def _ensure_pool(self):
+        if self._started:
+            return
+        for _ in range(self._strategy.min_size):
+            a = _MapWorker.options(**self._resources).remote(
+                self._udf_cls, self._ctor_args)
+            self._actors.append(a)
+            self._actor_load[len(self._actors) - 1] = 0
+        self._started = True
+
+    def can_launch(self, max_in_flight: int) -> bool:
+        if not self.input_queue:
+            return False
+        self._ensure_pool()
+        cap = self._strategy.max_tasks_in_flight_per_actor
+        return any(load < cap for load in self._actor_load.values())
+
+    def launch_one(self):
+        self._ensure_pool()
+        idx = min(self._actor_load, key=self._actor_load.get)
+        # Scale up if every actor is saturated and we're under max_size.
+        if (self._actor_load[idx] > 0 and
+                len(self._actors) < self._strategy.max_size):
+            a = _MapWorker.options(**self._resources).remote(
+                self._udf_cls, self._ctor_args)
+            self._actors.append(a)
+            idx = len(self._actors) - 1
+            self._actor_load[idx] = 0
+        bundle: RefBundle = self.input_queue.popleft()
+        blocks_ref, meta_ref = self._actors[idx].run.options(
+            num_returns=2).remote(self.chain, bundle.blocks_ref)
+        self._track(meta_ref, blocks_ref)
+        self._meta_to_actor[meta_ref] = idx
+        self._actor_load[idx] += 1
+        self.tasks_launched += 1
+
+    def on_task_done(self, meta_ref: ObjectRef):
+        idx = self._meta_to_actor.pop(meta_ref)
+        self._actor_load[idx] -= 1
+        super().on_task_done(meta_ref)
+
+    def shutdown(self):
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors.clear()
+
+
+class LimitOperator(PhysicalOperator):
+    """Truncate the stream at N rows; upstream is halted by the executor
+    once the limit is reached (reference: operators/limit_operator.py)."""
+
+    def __init__(self, limit: int):
+        super().__init__(f"Limit[{limit}]")
+        self.limit = limit
+        self.rows_taken = 0
+
+    @property
+    def reached(self) -> bool:
+        return self.rows_taken >= self.limit
+
+    def can_launch(self, max_in_flight: int) -> bool:
+        return bool(self.input_queue) and not self.reached and \
+            len(self.pending) < max_in_flight
+
+    def launch_one(self):
+        bundle: RefBundle = self.input_queue.popleft()
+        want = self.limit - self.rows_taken
+        if want <= 0:
+            return
+        if bundle.num_rows <= want:
+            self.rows_taken += bundle.num_rows
+            self.rows_out += bundle.num_rows
+            self._emit_direct(bundle)
+        else:
+            blocks_ref, meta_ref = _truncate_blocks.options(
+                num_returns=2).remote(bundle.blocks_ref, want)
+            self._track(meta_ref, blocks_ref)
+            self.rows_taken += want
+            self.tasks_launched += 1
+
+    @property
+    def done(self) -> bool:
+        return super().done or (self.reached and not self.pending)
+
+
+class UnionOperator(PhysicalOperator):
+    """Pass-through merging multiple upstream streams."""
+
+    def __init__(self):
+        super().__init__("Union")
+
+    def can_launch(self, max_in_flight: int) -> bool:
+        return bool(self.input_queue)
+
+    def launch_one(self):
+        self._emit_direct(self.input_queue.popleft())
+
+
+class ZipOperator(PhysicalOperator):
+    """Barrier op pairing two input streams row-for-row. Inputs arrive
+    tagged by branch via add_tagged_input."""
+
+    def __init__(self):
+        super().__init__("Zip")
+        self.left: List[RefBundle] = []
+        self.right: List[RefBundle] = []
+        self._launched = False
+
+    def add_tagged_input(self, branch: int, bundle: RefBundle):
+        (self.left if branch == 0 else self.right).append(bundle)
+
+    def can_launch(self, max_in_flight: int) -> bool:
+        return self.inputs_complete and not self._launched
+
+    def launch_one(self):
+        self._launched = True
+        left_refs = [b.blocks_ref for b in self.left]
+        right_refs = [b.blocks_ref for b in self.right]
+        gather_l = _gather_blocks.remote(*left_refs)
+        gather_r = _gather_blocks.remote(*right_refs)
+        blocks_ref, meta_ref = _zip_block_lists.options(
+            num_returns=2).remote(gather_l, gather_r)
+        self._track(meta_ref, blocks_ref)
+        self.tasks_launched += 1
+
+    @property
+    def done(self) -> bool:
+        return self._launched and not self.pending
+
+
+class AllToAllOperator(PhysicalOperator):
+    """Barrier exchange: sort / random_shuffle / repartition (reference:
+    planner/exchange/ — ExchangeTaskScheduler map+reduce stages)."""
+
+    def __init__(self, kind: str, key=None, descending: bool = False,
+                 num_outputs: Optional[int] = None,
+                 seed: Optional[int] = None):
+        super().__init__(kind)
+        self.kind = kind
+        self.key = key
+        self.descending = descending
+        self.num_outputs = num_outputs
+        self.seed = seed
+        self._collected: List[RefBundle] = []
+        self._phase = "collect"   # collect -> map -> reduce -> done
+        self._map_refs: List[ObjectRef] = []
+        self._boundary_refs: List[ObjectRef] = []
+
+    def add_input(self, bundle: RefBundle):
+        self._collected.append(bundle)
+
+    def can_launch(self, max_in_flight: int) -> bool:
+        return self.inputs_complete and self._phase == "collect"
+
+    def launch_one(self):
+        n_out = self.num_outputs or max(1, len(self._collected))
+        if self.kind == "sort" and n_out > 1:
+            sample_refs = [
+                _sample_boundaries.remote(b.blocks_ref, self.key, n_out)
+                for b in self._collected]
+            samples = [v for ref in sample_refs for v in ray_tpu.get(ref)]
+            samples.sort(reverse=self.descending)
+            if samples:
+                qs = np.linspace(0, len(samples) - 1, n_out + 1)[1:-1]
+                boundaries = [samples[int(q)] for q in qs]
+            else:
+                boundaries = []
+            # Degenerate boundary list (all-equal samples) still works —
+            # empty partitions merge to empty blocks.
+            boundaries = boundaries or [samples[0]] * (n_out - 1) if samples \
+                else []
+            if not boundaries:
+                n_out = 1
+        else:
+            boundaries = ([None] * 0)
+        map_refs = []
+        for b in self._collected:
+            map_refs.append(_partition_blocks.remote(
+                b.blocks_ref, n_out, self.kind, self.key, self.descending,
+                self.seed, boundaries if self.kind == "sort" else None))
+        for i in range(n_out):
+            part_i = [_select_partition.remote(mr, i) for mr in map_refs]
+            blocks_ref, meta_ref = _merge_partition.options(
+                num_returns=2).remote(self.kind, self.key, self.descending,
+                                      None if self.seed is None
+                                      else self.seed + i + 1,
+                                      *part_i)
+            self._track(meta_ref, blocks_ref)
+            self.tasks_launched += 1
+        self._collected.clear()
+        self._phase = "reduce"
+
+    @property
+    def done(self) -> bool:
+        return self._phase == "reduce" and not self.pending
+
+
+class WriteOperator(PhysicalOperator):
+    def __init__(self, path: str, file_format: str, write_kwargs: dict):
+        super().__init__(f"Write[{file_format}]")
+        self.path = path
+        self.file_format = file_format
+        self.write_kwargs = write_kwargs
+        self._index = 0
+
+    def launch_one(self):
+        bundle: RefBundle = self.input_queue.popleft()
+        blocks_ref, meta_ref = _write_blocks.options(num_returns=2).remote(
+            bundle.blocks_ref, self.path, self.file_format, self._index,
+            self.write_kwargs)
+        self._index += 1
+        self._track(meta_ref, blocks_ref)
+        self.tasks_launched += 1
+
+
+@ray_tpu.remote
+def _gather_blocks(*block_lists: List[Block]) -> List[Block]:
+    return [b for blocks in block_lists for b in blocks]
+
+
+@ray_tpu.remote
+def _select_partition(parts: List[List[Block]], i: int) -> List[Block]:
+    return parts[i]
+
+
+# ---- aggregation -----------------------------------------------------------
+
+@ray_tpu.remote
+def _hash_partition(blocks: List[Block], key, n: int) -> List[List[Block]]:
+    """Partition rows so equal keys land in the same partition."""
+    parts: List[List[Block]] = [[] for _ in range(n)]
+    keys = [key] if isinstance(key, str) else list(key or [])
+    for b in blocks:
+        if b.num_rows == 0:
+            continue
+        acc = BlockAccessor(b)
+        if not keys:
+            parts[0].append(b)
+            continue
+        cols = acc.to_numpy()
+        h = np.zeros(b.num_rows, dtype=np.uint64)
+        for k in keys:
+            col = cols[k]
+            if col.dtype.kind in "iub":
+                h = h * np.uint64(1000003) + col.astype(np.uint64)
+            else:
+                # Process-independent hash: builtin hash() is randomized
+                # per interpreter, and map tasks for one exchange run in
+                # different worker processes — equal keys MUST collide.
+                import zlib
+                hv = np.asarray(
+                    [zlib.crc32(str(x).encode()) for x in col],
+                    dtype=np.uint64)
+                h = h * np.uint64(1000003) + hv
+        assign = (h % np.uint64(n)).astype(np.int64)
+        for i in range(n):
+            idx = np.nonzero(assign == i)[0]
+            if len(idx):
+                parts[i].append(acc.take_rows(idx))
+    return parts
+
+
+@ray_tpu.remote
+def _aggregate_partition(key, aggs, *part_lists: List[Block]
+                         ) -> Tuple[List[Block], List[BlockMetadata]]:
+    """Merge one hash partition and compute grouped aggregates with arrow."""
+    import pyarrow as pa
+    blocks = [b for parts in part_lists for b in parts]
+    merged = BlockAccessor.concat(blocks)
+    if merged.num_rows == 0:
+        return [], []
+    keys = [key] if isinstance(key, str) else list(key or [])
+    arrow_aggs = [(a.on, a.arrow_name) for a in aggs]
+    if keys:
+        result = pa.TableGroupBy(merged, keys).aggregate(arrow_aggs)
+        # Rename arrow's col_fn naming to the agg's display name.
+        renames = {f"{a.on}_{a.arrow_name}": a.name for a in aggs}
+        result = result.rename_columns(
+            [renames.get(c, c) for c in result.column_names])
+    else:
+        cols = {}
+        for a in aggs:
+            fn = getattr(pa.compute, a.arrow_name.replace("hash_", ""))
+            val = fn(merged.column(a.on))
+            cols[a.name] = pa.array([val.as_py()])
+        result = pa.table(cols)
+    return [result], [BlockAccessor(result).get_metadata()]
+
+
+class AggregateOperator(PhysicalOperator):
+    """Barrier groupby: hash-partition then per-partition arrow groupby
+    (reference: planner/exchange/aggregate_task_spec.py)."""
+
+    def __init__(self, key, aggs, num_partitions: Optional[int] = None):
+        super().__init__("Aggregate")
+        self.key = key
+        self.aggs = aggs
+        self.num_partitions = num_partitions
+        self._collected: List[RefBundle] = []
+        self._phase = "collect"
+
+    def add_input(self, bundle: RefBundle):
+        self._collected.append(bundle)
+
+    def can_launch(self, max_in_flight: int) -> bool:
+        return self.inputs_complete and self._phase == "collect"
+
+    def launch_one(self):
+        n = self.num_partitions or max(1, min(len(self._collected), 8))
+        if self.key is None:
+            n = 1
+        map_refs = [_hash_partition.remote(b.blocks_ref, self.key, n)
+                    for b in self._collected]
+        for i in range(n):
+            part_i = [_select_partition.remote(mr, i) for mr in map_refs]
+            blocks_ref, meta_ref = _aggregate_partition.options(
+                num_returns=2).remote(self.key, self.aggs, *part_i)
+            self._track(meta_ref, blocks_ref)
+            self.tasks_launched += 1
+        self._collected.clear()
+        self._phase = "reduce"
+
+    @property
+    def done(self) -> bool:
+        return self._phase == "reduce" and not self.pending

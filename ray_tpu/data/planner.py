@@ -1,0 +1,158 @@
+"""Planner: lower the logical plan to physical operators, with fusion.
+
+Reference: python/ray/data/_internal/planner/planner.py plus the optimizer
+rules in _internal/logical/rules/operator_fusion.py — Read→Map and Map→Map
+fusion so a fused pipeline runs as one task per block.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.data import logical as L
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.physical import (
+    ActorPoolMapOperator,
+    AggregateOperator,
+    AllToAllOperator,
+    InputDataBuffer,
+    LimitOperator,
+    PhysicalOperator,
+    TaskPoolMapOperator,
+    UnionOperator,
+    WriteOperator,
+    ZipOperator,
+    _CALLABLE_CLASS_MARKER,
+)
+from ray_tpu.data.streaming_executor import Topology
+from ray_tpu.data.transforms import MapStep, MapTransformChain
+
+
+def _map_step_of(op: L.AbstractMap) -> MapStep:
+    fn = op.fn
+    if isinstance(fn, type):
+        # Callable class: instantiated per actor-pool worker.
+        fn = _CALLABLE_CLASS_MARKER
+    return MapStep(op.kind, fn, op.fn_args, op.fn_kwargs, op.batch_size,
+                   op.batch_format)
+
+
+def _resources_of(op: L.AbstractMap) -> dict:
+    # TPU chips are bound to dedicated actor workers in the core runtime
+    # (runtime.py _prepare_request: num_tpus is actor-scoped), so chip
+    # requests are only meaningful on actor-pool map operators.
+    return {"num_tpus": op.num_chips} if op.num_chips else {}
+
+
+class Planner:
+    def __init__(self, context: Optional[DataContext] = None):
+        self._ctx = context or DataContext.get_current()
+
+    def plan(self, dag: L.LogicalOperator) -> Topology:
+        ops: List[PhysicalOperator] = []
+        edges: Dict[int, List[Tuple[PhysicalOperator, int]]] = {}
+
+        def emit(op: PhysicalOperator) -> PhysicalOperator:
+            ops.append(op)
+            return op
+
+        def connect(up: PhysicalOperator, down: PhysicalOperator,
+                    branch: int = 0):
+            edges.setdefault(id(up), []).append((down, branch))
+
+        def lower(node: L.LogicalOperator) -> PhysicalOperator:
+            ctx = self._ctx
+            if isinstance(node, L.Read):
+                tasks = node.datasource.get_read_tasks(node.parallelism)
+                return emit(InputDataBuffer(read_tasks=tasks))
+            if isinstance(node, L.InputData):
+                return emit(InputDataBuffer(bundles=node.ref_bundles))
+            if isinstance(node, L.AbstractMap):
+                up = lower(node.inputs[0])
+                step = _map_step_of(node)
+                use_actors = isinstance(node.compute, L.ActorPoolStrategy)
+                if ctx.optimizer_enabled and not use_actors:
+                    # Fuse into an upstream read with no consumers yet.
+                    if (isinstance(up, InputDataBuffer) and
+                            not edges.get(id(up)) and
+                            up is ops[-1] and up._read_tasks):
+                        up._chain = (up._chain.fuse(MapTransformChain([step]))
+                                     if up._chain else
+                                     MapTransformChain(
+                                         [step],
+                                         ctx.target_max_block_size))
+                        up.name = f"{up.name}->{node.name}"
+                        return up
+                    # Fuse into an upstream task-pool map.
+                    if (isinstance(up, TaskPoolMapOperator) and
+                            not edges.get(id(up)) and up is ops[-1]):
+                        up.chain = up.chain.fuse(MapTransformChain([step]))
+                        up.name = f"{up.name}->{node.name}"
+                        return up
+                chain = MapTransformChain([step], ctx.target_max_block_size)
+                if use_actors:
+                    udf_cls = node.fn if isinstance(node.fn, type) else None
+                    phys = ActorPoolMapOperator(
+                        node.name, chain, node.compute, udf_cls,
+                        node.fn_constructor_args,
+                        resources=_resources_of(node))
+                else:
+                    phys = TaskPoolMapOperator(
+                        node.name, chain, resources=_resources_of(node))
+                emit(phys)
+                connect(up, phys)
+                return phys
+            if isinstance(node, L.Limit):
+                up = lower(node.inputs[0])
+                phys = emit(LimitOperator(node.limit))
+                connect(up, phys)
+                return phys
+            if isinstance(node, L.AbstractAllToAll):
+                up = lower(node.inputs[0])
+                phys = emit(AllToAllOperator(
+                    node.kind, node.key, node.descending,
+                    node.num_outputs, node.seed))
+                connect(up, phys)
+                return phys
+            if isinstance(node, L.Aggregate):
+                up = lower(node.inputs[0])
+                phys = emit(AggregateOperator(node.key, node.aggs))
+                connect(up, phys)
+                return phys
+            from ray_tpu.data.grouped import (make_map_groups_operator,
+                                              _MapGroups)
+            if isinstance(node, _MapGroups):
+                up = lower(node.inputs[0])
+                phys = emit(make_map_groups_operator(node.key, node.fn,
+                                                     node.batch_format))
+                connect(up, phys)
+                return phys
+            if isinstance(node, L.Union):
+                phys = UnionOperator()
+                for inp in node.inputs:
+                    up = lower(inp)
+                    connect(up, phys)
+                emit(phys)
+                return phys
+            if isinstance(node, L.Zip):
+                left = lower(node.inputs[0])
+                right = lower(node.inputs[1])
+                phys = emit(ZipOperator())
+                connect(left, phys, branch=0)
+                connect(right, phys, branch=1)
+                return phys
+            if isinstance(node, L.Write):
+                up = lower(node.inputs[0])
+                phys = emit(WriteOperator(node.path, node.file_format,
+                                          node.write_kwargs))
+                connect(up, phys)
+                return phys
+            raise TypeError(f"Cannot lower {node!r}")
+
+        sink = lower(dag)
+        # Topological order: ops were emitted post-order (inputs first);
+        # ensure the sink is last.
+        if ops[-1] is not sink:
+            ops.remove(sink)
+            ops.append(sink)
+        return Topology(ops, edges)

@@ -1,0 +1,35 @@
+"""Per-dataset execution statistics.
+
+Reference: python/ray/data/_internal/stats.py — per-operator wall time,
+task counts, and rows, surfaced via Dataset.stats().
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class OpStats:
+    name: str
+    tasks_finished: int = 0
+    rows: int = 0
+
+
+@dataclass
+class DatasetStats:
+    ops: List[OpStats] = field(default_factory=list)
+    wall_time_s: float = 0.0
+
+    def add_op(self, name: str) -> OpStats:
+        s = OpStats(name)
+        self.ops.append(s)
+        return s
+
+    def summary(self) -> str:
+        lines = [f"Dataset execution: {self.wall_time_s:.3f}s"]
+        for s in self.ops:
+            lines.append(
+                f"  {s.name}: {s.tasks_finished} tasks, {s.rows} rows")
+        return "\n".join(lines)

@@ -1,0 +1,113 @@
+"""streaming_split(n): fan a dataset's output out to n concurrent consumers.
+
+Reference: python/ray/data/dataset.py streaming_split :1236 +
+_internal/iterator/stream_split_iterator.py (SplitCoordinator actor :124).
+
+Design here: a SplitCoordinator actor holds per-split queues of block
+ObjectRefs; a driver-side thread runs the streaming executor and feeds
+finished bundles round-robin (or least-loaded when equal=False) into the
+coordinator. Each consumer (e.g. a Train worker) pulls via
+``coordinator.get_next(split)`` and fetches blocks from the shared object
+store — blocks move driver→worker through shm, never through the actor.
+"""
+
+from __future__ import annotations
+
+import threading  # noqa: F401  (also used inside the SplitCoordinator actor)
+from typing import List, Optional
+
+import ray_tpu
+from ray_tpu.data.block import Block
+from ray_tpu.data.iterator import DataIterator
+
+
+@ray_tpu.remote
+class SplitCoordinator:
+    """Queues of blocks_refs per split; epoch-aware.
+
+    Refs arrive/leave wrapped in a 1-element list: top-level ObjectRef
+    arguments are dereferenced by the runtime (pass-by-value semantics),
+    nested ones travel as refs — the blocks themselves never flow through
+    this actor.
+    """
+
+    def __init__(self, n: int):
+        self._n = n
+        self._queues: List[list] = [[] for _ in range(n)]
+        self._done = [False] * n
+        self._lock = threading.Lock()
+
+    def put(self, split: int, wrapped_ref: list):
+        with self._lock:
+            self._queues[split].append(wrapped_ref[0])
+
+    def finish_epoch(self):
+        with self._lock:
+            for i in range(self._n):
+                self._done[i] = True
+
+    def start_epoch(self):
+        with self._lock:
+            self._done = [False] * self._n
+            self._queues = [[] for _ in range(self._n)]
+
+    def get_next(self, split: int):
+        """Returns ([blocks_ref] | None, epoch_done: bool)."""
+        with self._lock:
+            if self._queues[split]:
+                return [self._queues[split].pop(0)], False
+            return None, self._done[split]
+
+
+class StreamSplitDataIterator(DataIterator):
+    """One consumer's view of a streaming_split; blocking iterator over the
+    coordinator's queue for this split index."""
+
+    def __init__(self, coordinator, split: int):
+        self._coord = coordinator
+        self._split = split
+        super().__init__(self._block_lists)
+
+    def _block_lists(self):
+        import time
+        while True:
+            wrapped, done = ray_tpu.get(
+                self._coord.get_next.remote(self._split))
+            if wrapped is not None:
+                yield ray_tpu.get(wrapped[0])
+            elif done:
+                return
+            else:
+                time.sleep(0.005)
+
+
+def make_stream_split_iterators(dataset, n: int, equal: bool = True
+                                ) -> List[StreamSplitDataIterator]:
+    """Launch the feeder thread + coordinator; return n iterators.
+
+    Each call starts ONE epoch of execution feeding all n splits; the
+    feeder re-executes the dataset for subsequent epochs on demand is NOT
+    implemented — Train re-calls per epoch.
+    """
+    coord = SplitCoordinator.remote(n)
+    ray_tpu.get(coord.start_epoch.remote())
+
+    def feed():
+        rows_per_split = [0] * n
+        rr = 0
+        try:
+            for bundle in dataset._execute_bundles():
+                if equal:
+                    # Least-loaded by rows keeps splits balanced.
+                    idx = min(range(n), key=lambda i: rows_per_split[i])
+                else:
+                    idx = rr % n
+                    rr += 1
+                rows_per_split[idx] += bundle.num_rows
+                ray_tpu.get(coord.put.remote(idx, [bundle.blocks_ref]))
+        finally:
+            ray_tpu.get(coord.finish_epoch.remote())
+
+    t = threading.Thread(target=feed, daemon=True, name="rtpu-split-feeder")
+    t.start()
+    return [StreamSplitDataIterator(coord, i) for i in range(n)]

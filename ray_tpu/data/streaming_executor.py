@@ -1,0 +1,175 @@
+"""StreamingExecutor: backpressured pull-based pipeline execution.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py:48 —
+execute() :89, _scheduling_loop_step :272, and streaming_executor_state.py
+(select_operator_to_run :517, process_completed_tasks :379).
+
+The executor topologically orders the physical operators, then loops:
+  1. wait (briefly) on all in-flight task metadata refs,
+  2. route completed outputs downstream,
+  3. launch new tasks on operators that have inputs, respecting per-op
+     concurrency caps and downstream output-queue backpressure,
+  4. yield finished RefBundles from the sink operator to the consumer.
+Because it is a generator, consumer pull rate naturally backpressures the
+whole pipeline.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.physical import (
+    ActorPoolMapOperator,
+    LimitOperator,
+    PhysicalOperator,
+    RefBundle,
+    ZipOperator,
+)
+from ray_tpu.data.stats import DatasetStats, OpStats
+
+
+class Topology:
+    """Operators in topological order with explicit edges.
+
+    edges: map op -> list of (downstream_op, branch_tag). branch_tag
+    matters only for Zip (0=left, 1=right).
+    """
+
+    def __init__(self, ops: List[PhysicalOperator],
+                 edges: Dict[int, List[Tuple[PhysicalOperator, int]]]):
+        self.ops = ops
+        self.edges = edges
+
+    def downstream(self, op: PhysicalOperator):
+        return self.edges.get(id(op), [])
+
+    def upstream_of(self, op: PhysicalOperator) -> List[PhysicalOperator]:
+        return [u for u in self.ops
+                if any(d is op for d, _ in self.downstream(u))]
+
+
+class StreamingExecutor:
+    def __init__(self, topology: Topology,
+                 context: Optional[DataContext] = None):
+        self._topo = topology
+        self._ctx = context or DataContext.get_current()
+        self._stats = DatasetStats()
+
+    @property
+    def stats(self) -> DatasetStats:
+        return self._stats
+
+    def execute(self) -> Iterator[RefBundle]:
+        """Run the pipeline, yielding output bundles of the sink op."""
+        topo = self._topo
+        ops = topo.ops
+        sink = ops[-1]
+        ctx = self._ctx
+        max_in_flight = ctx.max_tasks_in_flight_per_op or self._default_cap()
+        op_stats = {id(op): self._stats.add_op(op.name) for op in ops}
+        t0 = time.perf_counter()
+        try:
+            while True:
+                progressed = self._process_completed(ops, op_stats)
+                self._route_outputs(topo, sink)
+                launched = self._launch_ready(topo, max_in_flight)
+                while sink.output_queue:
+                    bundle = sink.output_queue.popleft()
+                    op_stats[id(sink)].rows += bundle.num_rows
+                    yield bundle
+                # Sink done ⇒ nothing further can reach the consumer, even
+                # if upstream ops were halted mid-stream by a Limit.
+                if sink.done and not sink.output_queue:
+                    break
+                if all(op.done for op in ops) and not sink.output_queue:
+                    break
+                if not progressed and not launched:
+                    # Nothing moved: block on in-flight work instead of
+                    # spinning.
+                    refs = [r for op in ops for r in op.waitable_refs()]
+                    if refs:
+                        ray_tpu.wait(refs, num_returns=1, timeout=10.0)
+                    else:
+                        time.sleep(0.002)
+        finally:
+            self._stats.wall_time_s = time.perf_counter() - t0
+            for op in ops:
+                if isinstance(op, ActorPoolMapOperator):
+                    op.shutdown()
+
+    # ---- internals ----
+
+    def _default_cap(self) -> int:
+        core = ray_tpu.get_runtime_context()
+        n = getattr(core, "num_workers", None) or 8
+        return max(2, int(n))
+
+    def _process_completed(self, ops, op_stats) -> bool:
+        refs: List[ObjectRef] = []
+        owner: Dict[ObjectRef, PhysicalOperator] = {}
+        for op in ops:
+            for r in op.waitable_refs():
+                refs.append(r)
+                owner[r] = op
+        if not refs:
+            return False
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+        for r in ready:
+            op = owner[r]
+            op.on_task_done(r)
+            op_stats[id(op)].tasks_finished += 1
+        return bool(ready)
+
+    def _route_outputs(self, topo: Topology, sink):
+        for op in topo.ops:
+            if op is sink:
+                continue
+            targets = topo.downstream(op)
+            if not targets:
+                continue
+            while op.output_queue:
+                bundle = op.output_queue.popleft()
+                for down, branch in targets:
+                    if isinstance(down, ZipOperator):
+                        down.add_tagged_input(branch, bundle)
+                    else:
+                        down.add_input(bundle)
+            # Propagate completion: once every direct upstream of `down` is
+            # done and drained, `down` will receive no more inputs. Note a
+            # reached Limit is done even while ops further up were halted
+            # mid-stream — its downstreams must still be released.
+            if op.done and not op.output_queue:
+                for down, _ in targets:
+                    if not down.inputs_complete and all(
+                            u.done and not u.output_queue
+                            for u in topo.upstream_of(down)):
+                        down.mark_inputs_done()
+
+    def _launch_ready(self, topo: Topology, max_in_flight: int) -> bool:
+        launched = False
+        ctx = self._ctx
+        # Favor draining downstream ops first (iterate sink -> source) so
+        # the pipeline stays shallow; skip ops whose downstream output
+        # queues are saturated (backpressure).
+        for op in reversed(topo.ops):
+            # Limit reached upstream: stop feeding.
+            if self._limit_reached_below(topo, op):
+                continue
+            while (op.can_launch(max_in_flight) and
+                   len(op.output_queue) < ctx.max_op_output_queue_blocks):
+                op.launch_one()
+                launched = True
+        return launched
+
+    def _limit_reached_below(self, topo: Topology,
+                             op: PhysicalOperator) -> bool:
+        for down, _ in topo.downstream(op):
+            if isinstance(down, LimitOperator) and down.reached:
+                return True
+            if self._limit_reached_below(topo, down):
+                return True
+        return False

@@ -1,0 +1,135 @@
+"""Map transform chains: the fused per-task data path.
+
+Reference: python/ray/data/_internal/execution/operators/map_transformer.py —
+a MapOperator's work is a chain of transforms applied blocks-in → blocks-out
+inside a single task. Fusion = concatenating chains, so a fused
+read→map_batches→filter pipeline runs as ONE task per block with no
+intermediate materialization.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+class MapStep:
+    def __init__(self, kind: str, fn: Callable, fn_args: tuple = (),
+                 fn_kwargs: dict = None, batch_size: Optional[int] = None,
+                 batch_format: str = "numpy"):
+        self.kind = kind  # 'map_batches' | 'map_rows' | 'flat_map' | 'filter'
+        self.fn = fn
+        self.fn_args = fn_args
+        self.fn_kwargs = fn_kwargs or {}
+        self.batch_size = batch_size
+        self.batch_format = batch_format
+
+
+def _iter_batches(blocks: Iterable[Block], batch_size: Optional[int],
+                  batch_format: str) -> Iterator[Any]:
+    """Re-batch a stream of blocks to ``batch_size`` rows (None = one batch
+    per input block), emitting batches in the requested format."""
+    if batch_size is None:
+        for b in blocks:
+            if b.num_rows > 0:
+                yield BlockAccessor(b).to_batch(batch_format)
+        return
+    pending: List[Block] = []
+    pending_rows = 0
+    for b in blocks:
+        if b.num_rows == 0:
+            continue
+        pending.append(b)
+        pending_rows += b.num_rows
+        while pending_rows >= batch_size:
+            merged = BlockAccessor.concat(pending)
+            acc = BlockAccessor(merged)
+            yield BlockAccessor(acc.slice(0, batch_size)).to_batch(batch_format)
+            rest = acc.slice(batch_size, merged.num_rows)
+            pending = [rest] if rest.num_rows else []
+            pending_rows = rest.num_rows
+    if pending_rows:
+        merged = BlockAccessor.concat(pending)
+        yield BlockAccessor(merged).to_batch(batch_format)
+
+
+def _apply_step(step: MapStep, blocks: Iterable[Block]) -> Iterator[Block]:
+    if step.kind == "map_batches":
+        fn = step.fn
+        for batch in _iter_batches(blocks, step.batch_size, step.batch_format):
+            out = fn(batch, *step.fn_args, **step.fn_kwargs)
+            if not isinstance(out, Iterator) and not hasattr(out, "__next__"):
+                out = iter([out])
+            for ob in out:
+                yield BlockAccessor.batch_to_block(ob)
+    elif step.kind == "map_rows":
+        fn = step.fn
+        for b in blocks:
+            rows = [fn(r, *step.fn_args, **step.fn_kwargs)
+                    for r in BlockAccessor(b).iter_rows()]
+            if rows:
+                yield BlockAccessor.rows_to_block(rows)
+    elif step.kind == "flat_map":
+        fn = step.fn
+        for b in blocks:
+            rows = list(itertools.chain.from_iterable(
+                fn(r, *step.fn_args, **step.fn_kwargs)
+                for r in BlockAccessor(b).iter_rows()))
+            if rows:
+                yield BlockAccessor.rows_to_block(rows)
+    elif step.kind == "filter":
+        fn = step.fn
+        for b in blocks:
+            acc = BlockAccessor(b)
+            keep = [i for i, r in enumerate(acc.iter_rows())
+                    if fn(r, *step.fn_args, **step.fn_kwargs)]
+            if keep:
+                import numpy as np
+                yield acc.take_rows(np.asarray(keep))
+    else:
+        raise ValueError(f"Unknown map step kind {step.kind!r}")
+
+
+class MapTransformChain:
+    """A serializable pipeline of MapSteps, applied lazily per task.
+
+    Callable-class UDFs (ActorPoolStrategy) are instantiated once per worker
+    via ``init_fns``.
+    """
+
+    def __init__(self, steps: List[MapStep],
+                 target_max_block_size: Optional[int] = None):
+        self.steps = list(steps)
+        self.target_max_block_size = target_max_block_size
+
+    def fuse(self, other: "MapTransformChain") -> "MapTransformChain":
+        return MapTransformChain(self.steps + other.steps,
+                                 other.target_max_block_size or
+                                 self.target_max_block_size)
+
+    def __call__(self, blocks: Iterable[Block]) -> Iterator[Block]:
+        stream: Iterable[Block] = blocks
+        for step in self.steps:
+            stream = _apply_step(step, stream)
+        yield from _shape_output(stream, self.target_max_block_size)
+
+
+def _shape_output(blocks: Iterable[Block],
+                  target_max_block_size: Optional[int]) -> Iterator[Block]:
+    """Split oversized output blocks so downstream backpressure has
+    reasonable granularity."""
+    if not target_max_block_size:
+        yield from blocks
+        return
+    for b in blocks:
+        nbytes = b.nbytes
+        if nbytes <= target_max_block_size or b.num_rows <= 1:
+            yield b
+            continue
+        n_splits = -(-nbytes // target_max_block_size)
+        rows_per = max(1, b.num_rows // n_splits)
+        acc = BlockAccessor(b)
+        for start in range(0, b.num_rows, rows_per):
+            yield acc.slice(start, min(start + rows_per, b.num_rows))

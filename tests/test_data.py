@@ -1,0 +1,330 @@
+"""ray_tpu.data tests (model: python/ray/data/tests/ — test_map.py,
+test_sort.py, test_consumption.py, test_splitblocks.py...)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu.data as rd
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _rt(rt):
+    yield rt
+
+
+def test_range_count_take():
+    ds = rd.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert rows == [{"id": 0}, {"id": 1}, {"id": 2}, {"id": 3}, {"id": 4}]
+
+
+def test_from_items_simple_rows():
+    ds = rd.from_items([1, 2, 3])
+    assert sorted(ds.take_all()) == [1, 2, 3]
+
+
+def test_map_batches_numpy():
+    ds = rd.range(64).map_batches(lambda b: {"id": b["id"] * 2})
+    out = sorted(r["id"] for r in ds.take_all())
+    assert out == [2 * i for i in range(64)]
+
+
+def test_map_rows_and_filter_and_flat_map():
+    ds = (rd.range(20)
+          .map(lambda r: {"v": r["id"] + 1})
+          .filter(lambda r: r["v"] % 2 == 0)
+          .flat_map(lambda r: [{"v": r["v"]}, {"v": -r["v"]}]))
+    vals = sorted(r["v"] for r in ds.take_all())
+    evens = [i + 1 for i in range(20) if (i + 1) % 2 == 0]
+    assert vals == sorted(evens + [-v for v in evens])
+
+
+def test_fusion_runs_one_task_per_block():
+    ds = (rd.range(32, parallelism=4)
+          .map_batches(lambda b: {"id": b["id"] + 1})
+          .map_batches(lambda b: {"id": b["id"] * 3}))
+    bundles = list(ds._execute_bundles())
+    total = sum(b.num_rows for b in bundles)
+    assert total == 32
+    # Fused: Read->MapBatches->MapBatches in the same task => stats shows
+    # one op doing all the work.
+    assert "->" in ds.stats()
+
+
+def test_limit_short_circuits():
+    ds = rd.range(10_000, parallelism=32).limit(10)
+    rows = ds.take_all()
+    assert [r["id"] for r in rows] == list(range(10))
+
+
+def test_sort():
+    ds = rd.from_items([{"k": i % 7, "v": i} for i in range(50)]).sort("k")
+    ks = [r["k"] for r in ds.take_all()]
+    assert ks == sorted(ks)
+
+
+def test_sort_descending():
+    ds = rd.range(40).sort("id", descending=True)
+    ids = [r["id"] for r in ds.take_all()]
+    assert ids == list(range(39, -1, -1))
+
+
+def test_random_shuffle_preserves_multiset():
+    ds = rd.range(100).random_shuffle(seed=7)
+    ids = sorted(r["id"] for r in ds.take_all())
+    assert ids == list(range(100))
+
+
+def test_repartition():
+    ds = rd.range(100, parallelism=2).repartition(5)
+    bundles = list(ds._execute_bundles())
+    assert sum(b.num_rows for b in bundles) == 100
+    assert len(bundles) == 5
+
+
+def test_groupby_sum_count():
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(30)])
+    out = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    expect = {}
+    for i in range(30):
+        expect[i % 3] = expect.get(i % 3, 0) + i
+    assert out == expect
+    cnt = ds.groupby("k").count().take_all()
+    assert sorted(r["count()"] for r in cnt) == [10, 10, 10]
+
+
+def test_global_aggregate():
+    ds = rd.range(10)
+    res = ds.groupby(None).aggregate(rd.Sum("id")).take_all()
+    assert res[0]["sum(id)"] == 45
+
+
+def test_map_groups():
+    ds = rd.from_items([{"k": i % 4, "v": float(i)} for i in range(40)])
+
+    def norm(batch):
+        return {"k": batch["k"][:1], "mean": [batch["v"].mean()]}
+
+    out = {r["k"]: r["mean"] for r in
+           ds.groupby("k").map_groups(norm).take_all()}
+    for k in range(4):
+        vals = [i for i in range(40) if i % 4 == k]
+        assert out[k] == pytest.approx(np.mean(vals))
+
+
+def test_union_and_zip():
+    a = rd.range(10)
+    b = rd.range(10).map_batches(lambda x: {"id2": x["id"] + 100})
+    u = a.union(rd.range(5))
+    assert u.count() == 15
+    z = a.zip(b)
+    rows = sorted(z.take_all(), key=lambda r: r["id"])
+    assert rows[0] == {"id": 0, "id2": 100}
+    assert len(rows) == 10
+
+
+def test_actor_pool_callable_class():
+    class AddConst:
+        def __init__(self, c):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = rd.range(40, parallelism=4).map_batches(
+        AddConst, concurrency=2, fn_constructor_args=(5,))
+    out = sorted(r["id"] for r in ds.take_all())
+    assert out == [i + 5 for i in range(40)]
+
+
+def test_parquet_roundtrip(tmp_path):
+    ds = rd.range(100).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    ds.write_parquet(str(tmp_path / "pq"))
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    rows = sorted(back.take_all(), key=lambda r: r["id"])
+    assert len(rows) == 100
+    assert rows[7] == {"id": 7, "sq": 49}
+
+
+def test_csv_and_json_roundtrip(tmp_path):
+    ds = rd.from_items([{"a": i, "b": f"s{i}"} for i in range(10)])
+    ds.write_csv(str(tmp_path / "csv"))
+    back = rd.read_csv(str(tmp_path / "csv"))
+    assert sorted(r["a"] for r in back.take_all()) == list(range(10))
+    ds.write_json(str(tmp_path / "js"))
+    back = rd.read_json(str(tmp_path / "js"))
+    assert sorted(r["b"] for r in back.take_all()) == \
+        sorted(f"s{i}" for i in range(10))
+
+
+def test_read_text_binary(tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    ds = rd.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["alpha", "beta", "gamma"]
+    ds = rd.read_binary_files(str(p))
+    row = ds.take_all()[0]
+    assert row["bytes"] == b"alpha\nbeta\ngamma\n"
+
+
+def test_iter_batches_sizes_and_formats():
+    ds = rd.range(100, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=32, batch_format="numpy",
+                                   prefetch_batches=0))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 100
+    assert all(s == 32 for s in sizes[:-1])
+    pdf = next(iter(ds.iter_batches(batch_size=10, batch_format="pandas",
+                                    prefetch_batches=0)))
+    assert list(pdf.columns) == ["id"]
+    tbl = next(iter(ds.iter_batches(batch_size=10, batch_format="pyarrow",
+                                    prefetch_batches=0)))
+    assert tbl.num_rows == 10
+
+
+def test_iter_batches_drop_last_and_prefetch():
+    ds = rd.range(100)
+    batches = list(ds.iter_batches(batch_size=32, drop_last=True,
+                                   prefetch_batches=2))
+    assert [len(b["id"]) for b in batches] == [32, 32, 32]
+
+
+def test_local_shuffle_buffer():
+    ds = rd.range(64, parallelism=2)
+    b = list(ds.iter_batches(batch_size=64, prefetch_batches=0,
+                             local_shuffle_buffer_size=64,
+                             local_shuffle_seed=3))
+    ids = list(b[0]["id"])
+    assert sorted(ids) == list(range(64))
+    assert ids != list(range(64))
+
+
+def test_tensor_blocks_roundtrip():
+    arr = np.arange(24, dtype=np.float32).reshape(6, 2, 2)
+    ds = rd.from_numpy(arr)
+    batch = ds.take_batch(6, batch_format="numpy")
+    assert batch["data"].shape == (6, 2, 2)
+    np.testing.assert_array_equal(batch["data"], arr)
+
+
+def test_add_drop_select_rename_columns():
+    ds = rd.range(10).add_column("double", lambda b: b["id"] * 2)
+    row = sorted(ds.take_all(), key=lambda r: r["id"])[3]
+    assert row == {"id": 3, "double": 6}
+    assert ds.select_columns(["double"]).columns() == ["double"]
+    assert ds.drop_columns(["double"]).columns() == ["id"]
+    assert ds.rename_columns({"id": "idx"}).columns()[0] == "idx"
+
+
+def test_schema_and_count_metadata_only():
+    ds = rd.range(50)
+    s = ds.schema()
+    assert s is not None and s.names == ["id"]
+
+
+def test_split_materialized():
+    parts = rd.range(100, parallelism=10).split(3, equal=True)
+    counts = [p.count() for p in parts]
+    assert sum(counts) == 100
+    assert max(counts) - min(counts) <= 40
+
+
+def test_streaming_split_two_consumers():
+    ds = rd.range(80, parallelism=8)
+    its = ds.streaming_split(2)
+    seen = []
+
+    import threading
+
+    def consume(it, out):
+        out.extend(r["id"] for r in it.iter_rows())
+
+    outs = [[], []]
+    ts = [threading.Thread(target=consume, args=(its[i], outs[i]))
+          for i in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert sorted(outs[0] + outs[1]) == list(range(80))
+    assert outs[0] and outs[1]
+
+
+def test_iter_torch_batches():
+    import torch
+
+    ds = rd.range(16)
+    b = next(iter(ds.iter_torch_batches(batch_size=16, prefetch_batches=0)))
+    assert isinstance(b["id"], torch.Tensor)
+    assert b["id"].sum().item() == sum(range(16))
+
+
+def test_random_sample():
+    ds = rd.range(1000).random_sample(0.1, seed=0)
+    n = ds.count()
+    assert 40 < n < 250
+
+
+def test_stats_populated():
+    ds = rd.range(10).map_batches(lambda b: b)
+    ds.take_all()
+    assert "Dataset execution" in ds.stats()
+
+
+def test_limit_then_map_terminates():
+    # Regression: ops downstream of a reached Limit must still complete
+    # (completion propagation released by the Limit, not by halted reads).
+    ds = (rd.range(10_000, parallelism=32).limit(10)
+          .map_batches(lambda b: {"id": b["id"] + 1}))
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(1, 11))
+
+
+def test_groupby_string_keys_stable_hash():
+    # Regression: builtin hash() is per-process randomized; string keys
+    # must still collide across map tasks run in different workers.
+    ds = rd.from_items([{"k": f"key{i % 5}", "v": i} for i in range(100)])
+    rows = ds.groupby("k").sum("v").take_all()
+    assert len(rows) == 5
+    out = {r["k"]: r["sum(v)"] for r in rows}
+    for j in range(5):
+        assert out[f"key{j}"] == sum(i for i in range(100) if i % 5 == j)
+
+
+def test_heterogeneous_row_keys_fill_null():
+    # Rows with optional fields inside ONE block fill nulls instead of
+    # raising KeyError deep in the remote task.
+    ds = rd.from_items([1, 2, 3, 4], parallelism=1).map(
+        lambda r: {"v": r} if r % 2 else {"v": r, "extra": r * 10})
+    rows = sorted(ds.take_all(), key=lambda r: r["v"])
+    assert rows[0]["v"] == 1 and rows[0]["extra"] is None
+    assert rows[1]["extra"] == 20
+
+
+def test_random_sample_not_periodic():
+    ds = rd.range(1000, parallelism=8).random_sample(0.5, seed=1)
+    ids = [r["id"] for r in ds.take_all()]
+    # Per-batch salted rng: blocks must not select identical offsets.
+    per_block = [{i % 125 for i in ids if lo <= i < lo + 125}
+                 for lo in range(0, 1000, 125)]
+    assert any(per_block[0] != s for s in per_block[1:])
+
+
+def test_iterator_early_abandon_cleans_up():
+    import threading as _t
+    before = {th.name for th in _t.enumerate()}
+    ds = rd.range(10_000, parallelism=16).map_batches(lambda b: b)
+    it = ds.iter_batches(batch_size=100, prefetch_batches=2)
+    next(it)
+    it.close()
+    import time as _time
+    deadline = _time.time() + 10
+    while _time.time() < deadline:
+        now = {th.name for th in _t.enumerate()
+               if th.name.startswith("rtpu-data-prefetch")}
+        if not (now - before):
+            break
+        _time.sleep(0.2)
+    leaked = [n for n in now - before if n.startswith("rtpu-data-prefetch")]
+    assert not leaked, leaked
