@@ -380,6 +380,12 @@ def _prepare_args_local(core: WorkerCore, args: tuple, kwargs: dict):
 
 
 def main():
+    if os.environ.get("RTPU_FAULT_DUMP_AFTER"):
+        # Debug aid: dump all thread stacks after N seconds (hang triage).
+        import faulthandler
+        faulthandler.dump_traceback_later(
+            float(os.environ["RTPU_FAULT_DUMP_AFTER"]),
+            file=open(f"/tmp/rtpu_worker_dump_{os.getpid()}.txt", "w"))
     address = os.environ["RTPU_ADDRESS"]
     authkey = bytes.fromhex(os.environ["RTPU_AUTH"])
     store_name = os.environ.get("RTPU_STORE", "")

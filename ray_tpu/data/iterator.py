@@ -173,10 +173,15 @@ def _prefetch(it: Iterator[Any], depth: int) -> Iterator[Any]:
                         close()
                     except BaseException:
                         pass
-            try:
-                q.put_nowait(END)
-            except queue.Full:
-                pass
+            # END must not be dropped on a momentarily-full queue (the
+            # consumer would block forever); block-put it unless cancelled
+            # (a stopped consumer never reads again).
+            while not stop.is_set():
+                try:
+                    q.put(END, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     t = threading.Thread(target=worker, daemon=True,
                          name="rtpu-data-prefetch")
