@@ -1,0 +1,43 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Reference: python/ray/tune/ — Tuner.fit (tuner.py:44), TuneController
+(execution/tune_controller.py:68), search spaces (search/sample.py),
+schedulers (schedulers/: ASHA, median stopping, PBT).
+"""
+
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search_space import (  # noqa: F401
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trial import (  # noqa: F401
+    Trial,
+    TrialStatus,
+    get_checkpoint,
+    get_trial_dir,
+    report,
+)
+from ray_tpu.tune.tune_controller import TuneController  # noqa: F401
+from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner  # noqa: F401
+
+__all__ = [
+    "Tuner", "TuneConfig", "TuneController", "Result", "ResultGrid",
+    "Trial", "TrialStatus",
+    "report", "get_checkpoint", "get_trial_dir",
+    "uniform", "loguniform", "quniform", "randint", "lograndint",
+    "choice", "sample_from", "grid_search",
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+]

@@ -1,0 +1,197 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+Reference: python/ray/tune/schedulers/ — async_hyperband.py
+(ASHAScheduler), median_stopping_rule.py, pbt.py
+(PopulationBasedTraining). The controller calls ``on_result`` for every
+report and acts on the returned decision; PBT additionally mutates trial
+configs via exploit/explore with checkpoint cloning.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_experiment(self, metric: str, mode: str):
+        self.metric = metric
+        self.mode = mode
+
+    def _score(self, result: Dict[str, Any]) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (reference: FIFOScheduler)."""
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference:
+    schedulers/async_hyperband.py AsyncHyperBandScheduler).
+
+    Rungs at r, r*eta, r*eta^2, ... up to max_t; a trial reaching a rung
+    is stopped unless its score is in the top 1/eta of scores recorded at
+    that rung so far.
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4.0):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.eta = reduction_factor
+        self._rungs: List[tuple] = []  # (milestone, {trial_id: score})
+        m = max_t
+        milestones = []
+        while m > grace_period:
+            milestones.append(m)
+            m = int(m / self.eta)
+        milestones.append(grace_period)
+        # ascending milestones paired with recorded scores
+        self._rungs = [(ms, {}) for ms in sorted(milestones)]
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, trial.iterations)
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for milestone, recorded in self._rungs:
+            if t < milestone or trial.trial_id in recorded:
+                continue
+            recorded[trial.trial_id] = score
+            if len(recorded) > 1:
+                # Continue only in the top 1/eta of scores recorded at this
+                # rung (newcomer included), as in the reference's
+                # AsyncHyperBand cutoff (schedulers/async_hyperband.py).
+                vals = sorted(recorded.values())
+                q = (1.0 - 1.0 / self.eta)
+                cutoff = vals[min(len(vals) - 1,
+                                  int(math.floor(q * (len(vals) - 1))))]
+                if score < cutoff:
+                    decision = STOP
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score so far is below the median of other
+    trials' running averages at the same step (reference:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._avgs: Dict[str, List[float]] = {}
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        hist = self._avgs.setdefault(trial.trial_id, [])
+        hist.append(score)
+        t = result.get(self.time_attr, len(hist))
+        if t < self.grace:
+            return CONTINUE
+        others = [sum(h) / len(h) for tid, h in self._avgs.items()
+                  if tid != trial.trial_id and h]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        best = max(hist)
+        return STOP if best < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: schedulers/pbt.py): at each perturbation interval,
+    bottom-quantile trials exploit a top-quantile trial — clone its latest
+    checkpoint and config — then explore by perturbing hyperparameters.
+
+    Exploitation here restarts the trial actor from the donor checkpoint
+    (the reference's stop-and-restore path; in-place _exploit is an
+    optimization it also only applies with reuse_actors).
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 perturbation_factors=(1.2, 0.8),
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.factors = perturbation_factors
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._scores: Dict[str, float] = {}
+        # controller inspects this after on_result returns EXPLOIT
+        self.pending_exploit: Optional[dict] = None
+
+    EXPLOIT = "EXPLOIT"
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        score = self._score(result)
+        if score is not None:
+            self._scores[trial.trial_id] = score
+        t = result.get(self.time_attr, trial.iterations)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval or len(self._scores) < 2:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        ranked = sorted(self._scores.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom = {tid for tid, _ in ranked[:k]}
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id not in bottom or trial.trial_id in top:
+            return CONTINUE
+        donor_id = self._rng.choice(top)
+        self.pending_exploit = {
+            "donor_id": donor_id,
+            "explore": True,
+        }
+        return self.EXPLOIT
+
+    def explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """Perturb mutated hyperparameters (reference: pbt.py _explore)."""
+        from ray_tpu.tune.search_space import Domain
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_prob or \
+                    key not in out or not isinstance(out[key], (int, float)):
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    out[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            else:
+                factor = self._rng.choice(self.factors)
+                out[key] = out[key] * factor
+                if isinstance(spec, list):
+                    # snap to nearest allowed value
+                    out[key] = min(spec, key=lambda v: abs(v - out[key]))
+        return out

@@ -1,0 +1,205 @@
+"""TuneController: the experiment event loop.
+
+Reference: python/ray/tune/execution/tune_controller.py:68 — manages trials
+as actors, polls results, applies scheduler decisions, persists experiment
+state, and retries failed trials. One in-flight ``ack_and_next`` call per
+running trial; ray_tpu.wait multiplexes across them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune.schedulers import (CONTINUE, STOP, FIFOScheduler,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.trial import Trial, TrialActor, TrialStatus
+
+
+class TuneController:
+    def __init__(self, trainable, *, param_space: Dict[str, Any],
+                 metric: str = "score", mode: str = "max",
+                 num_samples: int = 1,
+                 scheduler: Optional[TrialScheduler] = None,
+                 max_concurrent_trials: Optional[int] = None,
+                 max_failures: int = 0,
+                 experiment_dir: str = "",
+                 trial_resources: Optional[dict] = None,
+                 stop: Optional[Dict[str, Any]] = None,
+                 seed: Optional[int] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self._trainable = trainable
+        self._metric = metric
+        self._mode = mode
+        self._scheduler = scheduler or FIFOScheduler()
+        self._scheduler.set_experiment(metric, mode)
+        self._max_concurrent = max_concurrent_trials or 4
+        self._max_failures = max_failures
+        self._experiment_dir = experiment_dir
+        self._trial_resources = trial_resources or {}
+        self._stop_criteria = stop or {}
+        os.makedirs(experiment_dir, exist_ok=True)
+
+        from ray_tpu.tune.search_space import generate_variants
+        self.trials: List[Trial] = [
+            Trial(trial_id=f"trial_{i:05d}", config=cfg)
+            for i, cfg in enumerate(
+                generate_variants(param_space, num_samples, seed))
+        ]
+
+    # ------------------------------------------------------------- running
+
+    def restore_trials(self, snapshots: List[dict]):
+        restored = {s["trial_id"]: s for s in snapshots}
+        for t in self.trials:
+            snap = restored.get(t.trial_id)
+            if snap:
+                r = Trial.from_snapshot(snap)
+                # Keep the recorded config: fresh variant generation may
+                # have re-sampled random leaves differently.
+                t.config = r.config
+                if r.is_finished:
+                    t.status = r.status
+                    t.last_result = r.last_result
+                    t.error = r.error
+                    t.iterations = r.iterations
+                t.checkpoint_path = r.checkpoint_path
+
+    def run(self) -> List[Trial]:
+        pending = [t for t in self.trials if not t.is_finished]
+        running: Dict[Any, Trial] = {}  # pending_result ref -> trial
+        try:
+            while pending or running:
+                while pending and len(running) < self._max_concurrent:
+                    trial = pending.pop(0)
+                    self._start_trial(trial)
+                    running[trial.pending_result] = trial
+                if not running:
+                    break
+                ready, _ = ray_tpu.wait(list(running.keys()),
+                                        num_returns=1, timeout=5.0)
+                for ref in ready:
+                    trial = running.pop(ref)
+                    requeue = self._process(trial)
+                    if requeue == "requeue":
+                        pending.append(trial)
+                    elif not trial.is_finished:
+                        running[trial.pending_result] = trial
+                self._checkpoint_experiment()
+        finally:
+            for trial in running.values():
+                self._kill_actor(trial)
+            self._checkpoint_experiment()
+        return self.trials
+
+    # ------------------------------------------------------------ internals
+
+    def _start_trial(self, trial: Trial, action: str = "continue"):
+        trial_dir = os.path.join(self._experiment_dir, trial.trial_id)
+        opts = dict(self._trial_resources)
+        trial.actor = TrialActor.options(**opts).remote(
+            self._trainable, trial.config, trial_dir,
+            checkpoint_path=trial.checkpoint_path)
+        ray_tpu.get(trial.actor.start.remote())
+        trial.status = TrialStatus.RUNNING
+        trial.pending_result = trial.actor.ack_and_next.remote()
+
+    def _process(self, trial: Trial) -> Optional[str]:
+        try:
+            kind, metrics, ckpt = ray_tpu.get(trial.pending_result)
+        except Exception as e:  # actor/worker death
+            return self._on_error(trial, repr(e))
+        if kind == "error":
+            return self._on_error(trial, metrics.get("error", "unknown"),
+                                  metrics.get("traceback"))
+        if kind in ("done", "stopped"):
+            trial.status = TrialStatus.TERMINATED
+            self._scheduler.on_trial_complete(trial)
+            self._kill_actor(trial)
+            return None
+
+        # kind == "result"
+        trial.iterations += 1
+        metrics.setdefault("training_iteration", trial.iterations)
+        metrics["trial_id"] = trial.trial_id
+        trial.last_result = metrics
+        trial.metric_history.append(metrics)
+        if ckpt:
+            trial.checkpoint_path = ckpt
+
+        decision = self._scheduler.on_result(trial, metrics)
+        if self._should_stop_by_criteria(metrics):
+            decision = STOP
+        if decision == PopulationBasedTraining.EXPLOIT:
+            return self._exploit(trial)
+        action = "stop" if decision == STOP else "continue"
+        trial.pending_result = trial.actor.ack_and_next.remote(action)
+        return None
+
+    def _exploit(self, trial: Trial) -> str:
+        """PBT exploit: stop this trial, clone donor checkpoint+config
+        (perturbed), and requeue it to restart from there."""
+        sched = self._scheduler
+        info = sched.pending_exploit or {}
+        sched.pending_exploit = None
+        donor = next((t for t in self.trials
+                      if t.trial_id == info.get("donor_id")), None)
+        # Stop the current actor (fn raises StopTrial at its report).
+        trial.pending_result = trial.actor.ack_and_next.remote("stop")
+        try:
+            ray_tpu.get(trial.pending_result, timeout=30)
+        except Exception:
+            pass
+        self._kill_actor(trial)
+        if donor is not None:
+            trial.checkpoint_path = donor.checkpoint_path
+            trial.config = sched.explore(dict(donor.config))
+        trial.status = TrialStatus.PENDING
+        return "requeue"
+
+    def _on_error(self, trial: Trial, err: str,
+                  tb: Optional[str] = None) -> Optional[str]:
+        trial.num_failures += 1
+        self._kill_actor(trial)
+        if trial.num_failures <= self._max_failures:
+            trial.status = TrialStatus.PENDING
+            return "requeue"
+        trial.status = TrialStatus.ERROR
+        trial.error = tb or err
+        self._scheduler.on_trial_complete(trial)
+        return None
+
+    def _should_stop_by_criteria(self, metrics: Dict[str, Any]) -> bool:
+        for key, bound in self._stop_criteria.items():
+            v = metrics.get(key)
+            if v is not None and v >= bound:
+                return True
+        return False
+
+    def _kill_actor(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        trial.pending_result = None
+
+    def _checkpoint_experiment(self):
+        """Persist trial states for Tuner.restore (reference:
+        tune/execution/experiment_state.py)."""
+        path = os.path.join(self._experiment_dir, "experiment_state.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "timestamp": time.time(),
+                "metric": self._metric,
+                "mode": self._mode,
+                "trials": [t.snapshot() for t in self.trials],
+            }, f)
+        os.replace(tmp, path)

@@ -1,0 +1,293 @@
+"""Tune tests (model: python/ray/tune/tests/ — test_tuner.py,
+test_trial_scheduler.py, test_var.py)."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.config import FailureConfig, RunConfig
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _rt(rt):
+    yield rt
+
+
+@pytest.fixture()
+def run_cfg(tmp_path):
+    def make(**kw):
+        kw.setdefault("storage_path", str(tmp_path / "tune"))
+        kw.setdefault("name", "exp")
+        return RunConfig(**kw)
+
+    return make
+
+
+def test_variant_generation_grid_and_samples():
+    from ray_tpu.tune.search_space import generate_variants
+
+    space = {"a": tune.grid_search([1, 2, 3]),
+             "b": tune.choice(["x", "y"]),
+             "nested": {"c": tune.grid_search([10, 20])}}
+    variants = list(generate_variants(space, num_samples=2, seed=0))
+    assert len(variants) == 12  # 3 * 2 grid, x2 samples
+    assert {v["a"] for v in variants} == {1, 2, 3}
+    assert {v["nested"]["c"] for v in variants} == {10, 20}
+    assert all(v["b"] in ("x", "y") for v in variants)
+
+
+def test_sampling_domains():
+    from ray_tpu.tune.search_space import generate_variants
+
+    space = {"lr": tune.loguniform(1e-5, 1e-1),
+             "dim": tune.randint(8, 64),
+             "drop": tune.quniform(0.1, 0.5, 0.1)}
+    vs = list(generate_variants(space, num_samples=50, seed=1))
+    assert all(1e-5 <= v["lr"] <= 1e-1 for v in vs)
+    assert all(8 <= v["dim"] < 64 for v in vs)
+    assert all(abs(v["drop"] * 10 - round(v["drop"] * 10)) < 1e-9
+               for v in vs)
+
+
+def test_tuner_grid_best(run_cfg):
+    def objective(config):
+        # quadratic with max at x=3
+        score = -(config["x"] - 3) ** 2
+        tune.report({"score": score})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4, 5])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=3),
+        run_config=run_cfg())
+    grid = tuner.fit()
+    assert len(grid) == 6
+    best = grid.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 0
+
+
+def test_tuner_multi_step_and_dataframe(run_cfg):
+    def objective(config):
+        acc = 0.0
+        for step in range(5):
+            acc += config["lr"]
+            tune.report({"acc": acc, "step": step})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.1, 0.2])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max"),
+        run_config=run_cfg())
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.config["lr"] == pytest.approx(0.2)
+    assert best.metrics["training_iteration"] == 5
+    df = grid.get_dataframe()
+    assert len(df) == 2 and "config/lr" in df.columns
+
+
+def test_asha_stops_bad_trials(run_cfg):
+    def objective(config):
+        for step in range(1, 21):
+            tune.report({"score": config["quality"] * step,
+                         "training_iteration": step})
+
+    sched = tune.ASHAScheduler(max_t=20, grace_period=2,
+                               reduction_factor=2)
+    # Sequential execution, strong trials first: async SHA can only cut a
+    # trial against scores already recorded at its rung.
+    tuner = tune.Tuner(
+        objective,
+        param_space={"quality": tune.grid_search(
+            [5.0, 2.0, 1.0, 0.5, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=1),
+        run_config=run_cfg())
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.config["quality"] == 5.0
+    # Bad trials must have been cut early.
+    iters = [t.iterations for t in grid._trials]
+    assert min(iters) < 20
+    assert max(iters) == 20
+
+
+def test_median_stopping(run_cfg):
+    def objective(config):
+        for step in range(1, 11):
+            tune.report({"score": config["q"] * step})
+
+    sched = tune.MedianStoppingRule(grace_period=3, min_samples_required=2)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([1.0, 1.0, 0.01])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=3),
+        run_config=run_cfg())
+    grid = tuner.fit()
+    worst = min(grid._trials, key=lambda t: t.config["q"])
+    assert worst.iterations < 10
+
+
+def test_trial_failure_retry(run_cfg, tmp_path):
+    marker = str(tmp_path / "failed_once")
+
+    def objective(config):
+        if config["x"] == 1 and not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("transient")
+        tune.report({"score": config["x"]})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=run_cfg(failure_config=FailureConfig(max_failures=1)))
+    grid = tuner.fit()
+    assert not grid.errors
+    assert len(grid) == 2
+
+
+def test_trial_error_surfaces(run_cfg):
+    def objective(config):
+        raise ValueError("boom")
+
+    tuner = tune.Tuner(
+        objective, param_space={"x": tune.grid_search([1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=run_cfg())
+    grid = tuner.fit()
+    assert grid.errors and "boom" in grid.errors[0]
+
+
+def test_experiment_state_and_restore(run_cfg, tmp_path):
+    storage = str(tmp_path / "tune")
+
+    def objective(config):
+        tune.report({"score": config["x"]})
+
+    rc = RunConfig(storage_path=storage, name="exp1")
+    tuner = tune.Tuner(
+        objective, param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=rc)
+    tuner.fit()
+    exp_dir = os.path.join(storage, "exp1")
+    state = json.load(open(os.path.join(exp_dir, "experiment_state.json")))
+    assert len(state["trials"]) == 3
+    assert all(t["status"] == "TERMINATED" for t in state["trials"])
+
+    # Restore: finished trials are not re-run (objective would now fail).
+    def poisoned(config):
+        raise RuntimeError("must not re-run finished trials")
+
+    restored = tune.Tuner.restore(
+        exp_dir, poisoned,
+        param_space={"x": tune.grid_search([1, 2, 3])})
+    grid = restored.fit()
+    assert not grid.errors
+    assert grid.get_best_result(metric="score", mode="max").metrics[
+        "score"] == 3
+
+
+def test_checkpointed_resume(run_cfg, tmp_path):
+    """Trials save checkpoints; after an interrupt the trial resumes from
+    its checkpoint instead of restarting."""
+    storage = str(tmp_path / "tune")
+
+    def objective(config):
+        import json as _json
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt:
+            start = _json.load(open(os.path.join(ckpt.path, "s.json")))["step"] + 1
+        for step in range(start, 6):
+            d = os.path.join(tune.get_trial_dir(), f"ckpt_{step}")
+            os.makedirs(d, exist_ok=True)
+            _json.dump({"step": step}, open(os.path.join(d, "s.json"), "w"))
+            tune.report({"score": step, "start": start}, checkpoint=d)
+            if step == 2 and start == 0 and config["x"] == 1:
+                raise RuntimeError("interrupt")
+
+    rc = RunConfig(storage_path=storage, name="ck",
+                   failure_config=FailureConfig(max_failures=1))
+    tuner = tune.Tuner(
+        objective, param_space={"x": tune.grid_search([1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=rc)
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 5
+    assert best.metrics["start"] == 3  # resumed, not restarted
+
+
+def test_pbt_exploits_and_perturbs(run_cfg):
+    """Low-performing trials adopt (perturbed) configs of better trials."""
+    def objective(config):
+        import json as _json
+        lr = config["lr"]
+        w = 0.0
+        ckpt = tune.get_checkpoint()
+        start = 0
+        if ckpt:
+            st = _json.load(open(os.path.join(ckpt.path, "w.json")))
+            w, start = st["w"], st["step"] + 1
+        for step in range(start, 12):
+            w += lr  # "performance" ~ lr
+            d = os.path.join(tune.get_trial_dir(), f"c{step}")
+            os.makedirs(d, exist_ok=True)
+            _json.dump({"w": w, "step": step},
+                       open(os.path.join(d, "w.json"), "w"))
+            tune.report({"score": w, "lr": lr,
+                         "training_iteration": step + 1}, checkpoint=d)
+
+    sched = tune.PopulationBasedTraining(
+        perturbation_interval=3,
+        hyperparam_mutations={"lr": tune.uniform(0.5, 2.0)},
+        seed=0)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.001, 1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=2),
+        run_config=run_cfg(name="pbt"))
+    grid = tuner.fit()
+    assert not grid.errors
+    scores = sorted(t.last_result["score"] for t in grid._trials)
+    # The weak trial (lr=0.001 alone would end near 0.012) must have
+    # exploited the strong one's checkpoint + lr.
+    assert scores[0] > 1.0
+
+
+def test_tuner_over_trainer(run_cfg):
+    """Tuner(trainer) runs the full Train gang per trial (reference:
+    Tuner(trainer) in tuner.py — trainers as trainables)."""
+    from ray_tpu import train as rt_train
+    from ray_tpu.train import ScalingConfig
+
+    def loop(config):
+        w = 0.0
+        for _ in range(4):
+            w += config["lr"]
+        rt_train.report({"w": w})
+
+    trainer = rt_train.DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2))
+    tuner = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.5, 1.0])},
+        tune_config=tune.TuneConfig(metric="w", mode="max",
+                                    max_concurrent_trials=1),
+        run_config=run_cfg(name="trainer_tune"))
+    grid = tuner.fit()
+    assert not grid.errors
+    assert grid.get_best_result().metrics["w"] == pytest.approx(4.0)
