@@ -207,7 +207,21 @@ class BlockAccessor:
 
     def rename_columns(self, mapping: Dict[str, str]) -> Block:
         names = [mapping.get(c, c) for c in self._table.column_names]
-        return self._table.rename_columns(names)
+        out = self._table.rename_columns(names)
+        # Tensor columns carry their inner shape in schema metadata keyed
+        # by column name — remap those keys or the renamed column decodes
+        # as a flattened (N, prod(shape)) array.
+        meta = self._table.schema.metadata
+        if meta:
+            new_meta = {}
+            for k, v in meta.items():
+                ks = k.decode() if isinstance(k, bytes) else k
+                if ks.startswith("tensor_shape:"):
+                    col = ks[len("tensor_shape:"):]
+                    ks = f"tensor_shape:{mapping.get(col, col)}"
+                new_meta[ks.encode()] = v
+            out = out.replace_schema_metadata(new_meta)
+        return out
 
     def sort_indices(self, key: Union[str, List[str]],
                      descending: bool = False) -> np.ndarray:

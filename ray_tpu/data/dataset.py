@@ -73,6 +73,9 @@ class Dataset:
 
         Passing a class (callable UDF) implies an actor pool; ``concurrency``
         sets its size (reference's concurrency arg)."""
+        if isinstance(concurrency, int) and concurrency < 1:
+            raise ValueError(
+                f"concurrency must be >= 1, got {concurrency}")
         if compute is None and (isinstance(fn, type) or num_chips):
             # Callable-class UDFs and chip-using UDFs both need stateful
             # workers: chips bind to dedicated actor processes (see
@@ -120,10 +123,13 @@ class Dataset:
                          batch_format="numpy")
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
-        def rename(batch: Dict[str, np.ndarray], _m=dict(mapping)):
-            return {_m.get(k, k): v for k, v in batch.items()}
+        # Arrow-level rename: zero-copy, and keeps tensor_shape:<name>
+        # schema metadata aligned with the new column names.
+        def rename(table, _m=dict(mapping)):
+            from ray_tpu.data.block import BlockAccessor
+            return BlockAccessor(table).rename_columns(_m)
         return self._map("RenameColumns", "map_batches", rename,
-                         batch_format="numpy")
+                         batch_format="pyarrow")
 
     def limit(self, n: int) -> "Dataset":
         return Dataset(L.Limit(self._logical_op, n), self._context)

@@ -158,9 +158,19 @@ def _zip_block_lists(left: List[Block], right: List[Block]
             f"zip: datasets have different row counts "
             f"({lt.num_rows} vs {rt.num_rows})")
     out = lt
+    rmeta = rt.schema.metadata or {}
+    extra_meta = {}
     for name in rt.column_names:
         col_name = name if name not in lt.column_names else f"{name}_1"
         out = out.append_column(col_name, rt.column(name))
+        shape_key = f"tensor_shape:{name}".encode()
+        if shape_key in rmeta:
+            # Carry the right table's tensor inner-shape metadata across,
+            # under the (possibly de-duplicated) output column name.
+            extra_meta[f"tensor_shape:{col_name}".encode()] = rmeta[shape_key]
+    if extra_meta:
+        out = out.replace_schema_metadata(
+            {**(out.schema.metadata or {}), **extra_meta})
     return [out], [BlockAccessor(out).get_metadata()]
 
 
@@ -337,10 +347,19 @@ class TaskPoolMapOperator(PhysicalOperator):
     operators/task_pool_map_operator.py)."""
 
     def __init__(self, name: str, chain: MapTransformChain,
-                 resources: Optional[dict] = None):
+                 resources: Optional[dict] = None,
+                 max_concurrency: Optional[int] = None):
         super().__init__(name)
         self.chain = chain
         self._resources = resources or {}
+        # User-requested concurrency cap (map_batches(concurrency=N) →
+        # TaskPoolStrategy(N)); min()-ed with the executor-wide cap.
+        self._max_concurrency = max_concurrency
+
+    def can_launch(self, max_in_flight: int) -> bool:
+        if self._max_concurrency is not None:
+            max_in_flight = min(max_in_flight, self._max_concurrency)
+        return super().can_launch(max_in_flight)
 
     def launch_one(self):
         bundle: RefBundle = self.input_queue.popleft()

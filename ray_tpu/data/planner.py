@@ -71,7 +71,10 @@ class Planner:
                 up = lower(node.inputs[0])
                 step = _map_step_of(node)
                 use_actors = isinstance(node.compute, L.ActorPoolStrategy)
-                if ctx.optimizer_enabled and not use_actors:
+                has_user_cap = (isinstance(node.compute, L.TaskPoolStrategy)
+                                and node.compute.size is not None)
+                if ctx.optimizer_enabled and not use_actors \
+                        and not has_user_cap:
                     # Fuse into an upstream read with no consumers yet.
                     if (isinstance(up, InputDataBuffer) and
                             not edges.get(id(up)) and
@@ -83,8 +86,11 @@ class Planner:
                                          ctx.target_max_block_size))
                         up.name = f"{up.name}->{node.name}"
                         return up
-                    # Fuse into an upstream task-pool map.
+                    # Fuse into an upstream task-pool map — but never into
+                    # one carrying a user concurrency cap, which would
+                    # silently throttle this uncapped stage too.
                     if (isinstance(up, TaskPoolMapOperator) and
+                            up._max_concurrency is None and
                             not edges.get(id(up)) and up is ops[-1]):
                         up.chain = up.chain.fuse(MapTransformChain([step]))
                         up.name = f"{up.name}->{node.name}"
@@ -97,8 +103,12 @@ class Planner:
                         node.fn_constructor_args,
                         resources=_resources_of(node))
                 else:
+                    cap = (node.compute.size
+                           if isinstance(node.compute, L.TaskPoolStrategy)
+                           else None)
                     phys = TaskPoolMapOperator(
-                        node.name, chain, resources=_resources_of(node))
+                        node.name, chain, resources=_resources_of(node),
+                        max_concurrency=cap)
                 emit(phys)
                 connect(up, phys)
                 return phys

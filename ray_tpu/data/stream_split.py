@@ -4,26 +4,64 @@ Reference: python/ray/data/dataset.py streaming_split :1236 +
 _internal/iterator/stream_split_iterator.py (SplitCoordinator actor :124).
 
 Design here: a SplitCoordinator actor holds per-split queues of block
-ObjectRefs; a driver-side thread runs the streaming executor and feeds
-finished bundles round-robin (or least-loaded when equal=False) into the
-coordinator. Each consumer (e.g. a Train worker) pulls via
-``coordinator.get_next(split)`` and fetches blocks from the shared object
-store — blocks move driver→worker through shm, never through the actor.
+ObjectRefs; a driver-side feeder thread runs the streaming executor and
+distributes finished bundles into the coordinator. Each consumer (e.g. a
+Train worker) pulls via ``coordinator.get_next(split, epoch)`` and fetches
+blocks from the shared object store — blocks move driver→worker through
+shm, never through the actor.
+
+Two guarantees the reference makes that matter for SPMD training:
+
+* **Exactly-equal splits** (``equal=True``): bundles are re-cut at ROW
+  granularity so every split receives exactly the same row count each
+  epoch (the sub-``n``-row tail is truncated, as the reference does).
+  Whole-bundle balancing is not enough — lockstep gangs doing per-batch
+  collectives hang if one worker's shard runs dry early.
+* **Multi-epoch iteration**: each split iterator can be re-iterated; the
+  feeder re-executes the dataset for epoch ``e`` once ALL ``n`` consumers
+  have requested epoch ``e`` (a coordinator handshake), mirroring the
+  reference's per-epoch pipeline re-execution.
 """
 
 from __future__ import annotations
 
-import threading  # noqa: F401  (also used inside the SplitCoordinator actor)
-from typing import List, Optional
+import threading
+import time
+from typing import List, Optional, Tuple
 
 import ray_tpu
-from ray_tpu.data.block import Block
+from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.iterator import DataIterator
 
 
 @ray_tpu.remote
+def _slice_pieces(spec: List[Tuple[int, int, int]], *block_lists
+                  ) -> List[Block]:
+    """Cut row ranges out of block lists: spec entries are
+    (arg_index, start_row, stop_row) into the corresponding block list."""
+    out: List[Block] = []
+    for arg_i, start, stop in spec:
+        blocks = block_lists[arg_i]
+        pos = 0
+        for b in blocks:
+            n = b.num_rows
+            lo = max(start - pos, 0)
+            hi = min(stop - pos, n)
+            if hi > lo:
+                if lo == 0 and hi == n:
+                    out.append(b)
+                else:
+                    out.append(BlockAccessor(b).slice(lo, hi))
+            pos += n
+            if pos >= stop:
+                break
+    return out
+
+
+@ray_tpu.remote
 class SplitCoordinator:
-    """Queues of blocks_refs per split; epoch-aware.
+    """Per-split queues of blocks_refs; epoch-aware with a consumer
+    handshake for multi-epoch re-execution.
 
     Refs arrive/leave wrapped in a 1-element list: top-level ObjectRef
     arguments are dereferenced by the runtime (pass-by-value semantics),
@@ -34,45 +72,121 @@ class SplitCoordinator:
     def __init__(self, n: int):
         self._n = n
         self._queues: List[list] = [[] for _ in range(n)]
-        self._done = [False] * n
+        self._epoch = -1            # epoch currently being fed (or fed last)
+        self._epoch_done = False
+        self._requested = [-1] * n  # highest epoch each consumer asked for
+        self._error: Optional[str] = None
         self._lock = threading.Lock()
+
+    # -- consumer side --
+
+    def request_epoch(self, split: int, epoch: int):
+        with self._lock:
+            self._requested[split] = max(self._requested[split], epoch)
+
+    def get_next(self, split: int, epoch: int):
+        """Returns ([blocks_ref] | None, epoch_done: bool).
+
+        Raises if the feeder hit a pipeline error — consumers must not
+        see a silently truncated epoch as a normal end-of-stream.
+        """
+        with self._lock:
+            if self._error is not None:
+                raise RuntimeError(
+                    f"streaming_split pipeline failed: {self._error}")
+            if self._epoch < epoch:
+                return None, False          # epoch not started yet
+            if self._epoch > epoch:
+                # Stale caller (consumer abandoned this epoch and the
+                # feeder moved on): its epoch is over. Never pop — the
+                # queue now holds the CURRENT epoch's blocks.
+                return None, True
+            if self._queues[split]:
+                return [self._queues[split].pop(0)], False
+            return None, self._epoch_done
+
+    # -- feeder side --
+
+    def ready_epoch(self) -> Optional[int]:
+        """Next epoch to feed, or None.
+
+        Epoch 0 starts as soon as ANY consumer asks (queues are empty, so
+        there is nothing to wipe) — this keeps sequential / partial
+        consumption of splits working. Later epochs wait for ALL
+        consumers, because begin_epoch resets queues and a straggler
+        still draining epoch e must not lose its blocks.
+        """
+        with self._lock:
+            if self._epoch < 0:
+                if max(self._requested) >= 0:
+                    return 0
+                return None
+            if min(self._requested) > self._epoch:
+                return self._epoch + 1
+            return None
+
+    def begin_epoch(self, epoch: int):
+        with self._lock:
+            self._epoch = epoch
+            self._epoch_done = False
+            self._queues = [[] for _ in range(self._n)]
 
     def put(self, split: int, wrapped_ref: list):
         with self._lock:
             self._queues[split].append(wrapped_ref[0])
 
-    def finish_epoch(self):
+    def feed_status(self):
+        """(queue sizes, per-consumer requested epochs, current epoch) —
+        one locked snapshot for the feeder's backpressure decisions."""
         with self._lock:
-            for i in range(self._n):
-                self._done[i] = True
+            return ([len(q) for q in self._queues],
+                    list(self._requested), self._epoch)
 
-    def start_epoch(self):
+    def finish_epoch(self, error: Optional[str] = None):
         with self._lock:
-            self._done = [False] * self._n
-            self._queues = [[] for _ in range(self._n)]
+            self._epoch_done = True
+            if error is not None:
+                self._error = error
 
-    def get_next(self, split: int):
-        """Returns ([blocks_ref] | None, epoch_done: bool)."""
-        with self._lock:
-            if self._queues[split]:
-                return [self._queues[split].pop(0)], False
-            return None, self._done[split]
+
+class _SplitGroup:
+    """Driver-side liveness token shared by one streaming_split's
+    iterators. Once any iterator is serialized (shipped to a worker
+    process), GC of the driver-side copies no longer implies the split is
+    dead — remote consumers still hold it — so the feeder's GC-based
+    teardown is disabled and cleanup falls to runtime shutdown killing
+    the coordinator actor."""
+
+    def __init__(self):
+        self.exported = False
 
 
 class StreamSplitDataIterator(DataIterator):
     """One consumer's view of a streaming_split; blocking iterator over the
-    coordinator's queue for this split index."""
+    coordinator's queue for this split index. Re-iterating requests the
+    next epoch from the coordinator."""
 
-    def __init__(self, coordinator, split: int):
+    def __init__(self, coordinator, split: int, group=None):
         self._coord = coordinator
         self._split = split
+        self._epoch = 0
+        self._group = group
         super().__init__(self._block_lists)
 
+    def __getstate__(self):
+        if self._group is not None:
+            self._group.exported = True
+        state = dict(self.__dict__)
+        state["_group"] = None
+        return state
+
     def _block_lists(self):
-        import time
+        epoch = self._epoch
+        self._epoch += 1
+        ray_tpu.get(self._coord.request_epoch.remote(self._split, epoch))
         while True:
             wrapped, done = ray_tpu.get(
-                self._coord.get_next.remote(self._split))
+                self._coord.get_next.remote(self._split, epoch))
             if wrapped is not None:
                 yield ray_tpu.get(wrapped[0])
             elif done:
@@ -81,33 +195,140 @@ class StreamSplitDataIterator(DataIterator):
                 time.sleep(0.005)
 
 
+class _EqualDistributor:
+    """Re-cuts the bundle stream at row granularity so each of n splits
+    receives exactly ``total_rows // n`` rows (tail truncated)."""
+
+    def __init__(self, coord, n: int):
+        self._coord = coord
+        self._n = n
+        # FIFO of (blocks_ref, start_row, rows_remaining) pieces.
+        self._carry: List[Tuple[object, int, int]] = []
+        self._avail = 0
+        # Splits whose consumer abandoned the epoch: their cuts are
+        # discarded (never enqueued) so their queues stay bounded.
+        self.abandoned: List[bool] = [False] * n
+
+    def add(self, bundle):
+        if bundle.num_rows <= 0:
+            return
+        self._carry.append((bundle.blocks_ref, 0, bundle.num_rows))
+        self._avail += bundle.num_rows
+        self._flush()
+
+    def _flush(self):
+        n = self._n
+        k = self._avail // n
+        if k == 0:
+            return
+        # One contiguous k-row cut per split, consuming the carry FIFO in
+        # order (split 0 gets rows [0,k), split 1 [k,2k), ...).
+        for split in range(n):
+            spec: List[Tuple[int, int, int]] = []
+            refs: List[object] = []
+            need = k
+            while need > 0:
+                ref, start, rows = self._carry[0]
+                take = min(rows, need)
+                refs.append(ref)
+                spec.append((len(refs) - 1, start, start + take))
+                if take == rows:
+                    self._carry.pop(0)
+                else:
+                    self._carry[0] = (ref, start + take, rows - take)
+                need -= take
+            if not self.abandoned[split]:
+                out_ref = _slice_pieces.remote(spec, *refs)
+                ray_tpu.get(self._coord.put.remote(split, [out_ref]))
+        self._avail -= k * n
+
+    def finish(self):
+        # Truncate the sub-n-row tail (reference behavior) so every split
+        # saw exactly the same number of rows this epoch.
+        self._carry.clear()
+        self._avail = 0
+
+
 def make_stream_split_iterators(dataset, n: int, equal: bool = True
                                 ) -> List[StreamSplitDataIterator]:
     """Launch the feeder thread + coordinator; return n iterators.
 
-    Each call starts ONE epoch of execution feeding all n splits; the
-    feeder re-executes the dataset for subsequent epochs on demand is NOT
-    implemented — Train re-calls per epoch.
+    The feeder serves one epoch each time all n consumers have requested
+    it (standard multi-epoch loop: ``for epoch in range(E): for batch in
+    shard.iter_batches()``), re-executing the dataset pipeline per epoch.
     """
     coord = SplitCoordinator.remote(n)
-    ray_tpu.get(coord.start_epoch.remote())
+    max_queued_per_split = 8
 
-    def feed():
-        rows_per_split = [0] * n
+    def feed_epoch(epoch: int):
         rr = 0
-        try:
-            for bundle in dataset._execute_bundles():
-                if equal:
-                    # Least-loaded by rows keeps splits balanced.
-                    idx = min(range(n), key=lambda i: rows_per_split[i])
-                else:
-                    idx = rr % n
-                    rr += 1
-                rows_per_split[idx] += bundle.num_rows
-                ray_tpu.get(coord.put.remote(idx, [bundle.blocks_ref]))
-        finally:
-            ray_tpu.get(coord.finish_epoch.remote())
+        dist = _EqualDistributor(coord, n) if equal else None
+        for bundle in dataset._execute_bundles():
+            # Backpressure: don't run the whole epoch ahead of consumers.
+            # Only splits ACTIVELY consuming this epoch (requested ==
+            # epoch) count: a consumer that hasn't started yet
+            # (requested < epoch, e.g. sequential consumption) must keep
+            # receiving — its queue grows, but its blocks have to be
+            # retained for it regardless — and one that moved on
+            # (requested > epoch) is abandoned; counting either would
+            # deadlock the feeder on a queue nobody is draining.
+            while True:
+                qsizes, requested, _ = ray_tpu.get(
+                    coord.feed_status.remote())
+                if min(requested) > epoch:
+                    return      # everyone moved on: abort this epoch
+                live = [q for q, r in zip(qsizes, requested) if r == epoch]
+                if not live or max(live) < max_queued_per_split:
+                    break
+                time.sleep(0.005)
+            if equal:
+                dist.abandoned = [r > epoch for r in requested]
+                dist.add(bundle)
+            else:
+                if requested[rr % n] <= epoch:
+                    ray_tpu.get(coord.put.remote(rr % n,
+                                                 [bundle.blocks_ref]))
+                rr += 1
+        if equal:
+            dist.finish()
 
-    t = threading.Thread(target=feed, daemon=True, name="rtpu-split-feeder")
+    def feed_forever():
+        while True:
+            # All split iterators garbage-collected (and none were ever
+            # shipped to a worker process) ⇒ nobody can ever request
+            # another epoch: tear down the coordinator and exit instead
+            # of leaking a polling thread + actor per streaming_split.
+            if not group.exported and all(w() is None for w in iter_refs):
+                try:
+                    ray_tpu.kill(coord)
+                except Exception:
+                    pass
+                return
+            try:
+                epoch = ray_tpu.get(coord.ready_epoch.remote())
+            except Exception:
+                return  # coordinator death / runtime shutdown
+            if epoch is None:
+                time.sleep(0.05)
+                continue
+            try:
+                ray_tpu.get(coord.begin_epoch.remote(epoch))
+                err = None
+                try:
+                    feed_epoch(epoch)
+                except Exception as e:   # noqa: BLE001 — surfaced below
+                    err = repr(e)
+                ray_tpu.get(coord.finish_epoch.remote(err))
+                if err is not None:
+                    return  # error latched; consumers will raise
+            except Exception:
+                return  # coordinator death / runtime shutdown
+
+    group = _SplitGroup()
+    iterators = [StreamSplitDataIterator(coord, i, group) for i in range(n)]
+    import weakref
+    iter_refs = [weakref.ref(it) for it in iterators]
+    t = threading.Thread(target=feed_forever, daemon=True,
+                         name="rtpu-split-feeder")
     t.start()
-    return [StreamSplitDataIterator(coord, i) for i in range(n)]
+    return iterators

@@ -153,17 +153,34 @@ class StreamingExecutor:
         launched = False
         ctx = self._ctx
         # Favor draining downstream ops first (iterate sink -> source) so
-        # the pipeline stays shallow; skip ops whose downstream output
-        # queues are saturated (backpressure).
+        # the pipeline stays shallow; skip ops whose downstream input
+        # queues are saturated (backpressure). Gating on the DOWNSTREAM
+        # op's routed-but-unconsumed depth (input_queue + in-flight) is
+        # what actually engages: _route_outputs drains our own
+        # output_queue every tick, so gating on it alone never fires
+        # (reference: OpBufferQueue accounting in streaming_executor_state).
         for op in reversed(topo.ops):
             # Limit reached upstream: stop feeding.
             if self._limit_reached_below(topo, op):
                 continue
             while (op.can_launch(max_in_flight) and
-                   len(op.output_queue) < ctx.max_op_output_queue_blocks):
+                   len(op.output_queue) < ctx.max_op_output_queue_blocks and
+                   not self._backpressured(topo, op, ctx)):
                 op.launch_one()
                 launched = True
         return launched
+
+    def _backpressured(self, topo: Topology, op: PhysicalOperator,
+                       ctx) -> bool:
+        """True if any downstream op has too many routed-but-unconsumed
+        bundles. Barrier ops (AllToAll/Aggregate/Zip) collect into side
+        buffers rather than input_queue, so they are never gated — they
+        need every input before running."""
+        for down, _ in topo.downstream(op):
+            if (len(down.input_queue) + len(down.pending) >=
+                    ctx.max_op_output_queue_blocks):
+                return True
+        return False
 
     def _limit_reached_below(self, topo: Topology,
                              op: PhysicalOperator) -> bool:

@@ -328,3 +328,143 @@ def test_iterator_early_abandon_cleans_up():
         _time.sleep(0.2)
     leaked = [n for n in now - before if n.startswith("rtpu-data-prefetch")]
     assert not leaked, leaked
+
+
+def test_streaming_split_equal_exact_rows():
+    """equal=True must deliver exactly total//n rows per split even when
+    bundle row counts are uneven (row-granularity re-cutting)."""
+    # 7 blocks of 13 rows = 91 rows; 91 // 2 = 45 per split, 1 truncated.
+    ds = rd.range(91, parallelism=7)
+    its = ds.streaming_split(2, equal=True)
+
+    import threading
+
+    outs = [[], []]
+
+    def consume(it, out):
+        out.extend(r["id"] for r in it.iter_rows())
+
+    ts = [threading.Thread(target=consume, args=(its[i], outs[i]))
+          for i in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert len(outs[0]) == len(outs[1]) == 45
+    # No overlap between splits.
+    assert not (set(outs[0]) & set(outs[1]))
+
+
+def test_streaming_split_multi_epoch():
+    """Re-iterating a split must re-execute the pipeline (one epoch per
+    pass), not silently yield zero rows."""
+    ds = rd.range(40, parallelism=4)
+    its = ds.streaming_split(2, equal=True)
+
+    import threading
+
+    epochs_rows = [[0, 0], [0, 0]]
+
+    def consume(idx):
+        for epoch in range(2):
+            n = 0
+            for _ in its[idx].iter_rows():
+                n += 1
+            epochs_rows[idx][epoch] = n
+
+    ts = [threading.Thread(target=consume, args=(i,)) for i in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert epochs_rows[0] == [20, 20]
+    assert epochs_rows[1] == [20, 20]
+
+
+def test_rename_columns_preserves_tensor_shape():
+    data = {"img": np.arange(24, dtype=np.float32).reshape(2, 3, 4)}
+    ds = rd.from_items([{"img": data["img"][i]} for i in range(2)])
+    renamed = ds.rename_columns({"img": "image"})
+    batch = next(iter(renamed.iter_batches(batch_size=2,
+                                           batch_format="numpy")))
+    assert batch["image"].shape == (2, 3, 4)
+
+
+def test_map_batches_concurrency_cap_respected():
+    """map_batches(concurrency=N) must cap in-flight tasks at N."""
+    from ray_tpu.data.physical import TaskPoolMapOperator
+    from ray_tpu.data.planner import Planner
+
+    ds = rd.range(64, parallelism=8).map_batches(
+        lambda b: b, concurrency=2)
+    topo = Planner(ds._context).plan(ds._logical_op)
+    caps = [op._max_concurrency for op in topo.ops
+            if isinstance(op, TaskPoolMapOperator)]
+    assert caps == [2]
+    # And the cap actually gates launches.
+    op = [op for op in topo.ops
+          if isinstance(op, TaskPoolMapOperator)][0]
+    op.input_queue.extend([None] * 5)
+    op.pending = {object(): None, object(): None}
+    assert not op.can_launch(max_in_flight=8)
+
+
+def test_streaming_split_error_propagates():
+    """A UDF failure mid-pipeline must raise at consumers, not silently
+    truncate the epoch."""
+    def boom(b):
+        raise ValueError("udf exploded")
+
+    ds = rd.range(40, parallelism=4).map_batches(boom)
+    its = ds.streaming_split(1, equal=True)
+    with pytest.raises(Exception, match="udf exploded|pipeline failed"):
+        for _ in its[0].iter_rows():
+            pass
+
+
+def test_streaming_split_abandoned_epoch_recovers():
+    """One consumer breaking mid-epoch must not deadlock later epochs."""
+    import itertools
+    import threading
+
+    ds = rd.range(200, parallelism=20)
+    its = ds.streaming_split(2, equal=True)
+    counts = [[], []]
+
+    def consume(idx):
+        # Epoch 0: take only a few rows, then abandon.
+        counts[idx].append(
+            len(list(itertools.islice(its[idx].iter_rows(), 3))))
+        # Epoch 1: consume fully.
+        counts[idx].append(sum(1 for _ in its[idx].iter_rows()))
+
+    ts = [threading.Thread(target=consume, args=(i,)) for i in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in ts), f"deadlocked: {counts}"
+    assert counts[0][1] == counts[1][1] == 100
+
+
+def test_streaming_split_sequential_consumption():
+    """Splits consumed one after another (not concurrently) must still
+    complete — epoch 0 starts on the first request."""
+    its = rd.range(40, parallelism=4).streaming_split(2, equal=True)
+    a = sum(1 for _ in its[0].iter_rows())
+    b = sum(1 for _ in its[1].iter_rows())
+    assert a == b == 20
+
+
+def test_map_batches_concurrency_zero_raises():
+    with pytest.raises(ValueError, match="concurrency"):
+        rd.range(10).map_batches(lambda b: b, concurrency=0)
+
+
+def test_streaming_split_sequential_large():
+    """Sequential consumption past the feeder's queue cap must not
+    deadlock (late consumers don't exert backpressure)."""
+    its = rd.range(400, parallelism=40).streaming_split(2, equal=True)
+    a = sum(1 for _ in its[0].iter_rows())
+    b = sum(1 for _ in its[1].iter_rows())
+    assert a == b == 200
