@@ -25,6 +25,7 @@ layers live under ray_tpu.parallel / ops / models / train and import lazily.
 
 from ray_tpu._version import __version__  # noqa: F401
 from ray_tpu.api import (  # noqa: F401
+    cancel,
     get,
     get_actor,
     init,
@@ -50,6 +51,7 @@ __all__ = [
     "put",
     "wait",
     "kill",
+    "cancel",
     "method",
     "get_actor",
     "ObjectRef",

@@ -108,6 +108,17 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     core.kill_actor(actor.actor_id, no_restart=no_restart)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Cancel the task that produces ``ref`` (reference: ray.cancel,
+    worker.py:2970). Queued tasks are dropped; executing tasks are
+    interrupted (force=False) or their worker killed (force=True). The
+    caller sees TaskCancelledError at ``get``. ``recursive`` is accepted
+    for API parity; child-task cancellation follows worker death."""
+    del recursive
+    core = runtime_context.get_core()
+    core.cancel_task(ref, force=force)
+
+
 def method(**opts):
     """Decorator for actor methods to set options (num_returns)."""
 

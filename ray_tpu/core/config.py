@@ -1,0 +1,124 @@
+"""Central configuration registry: every core tunable in one table.
+
+Analogue of the reference's RayConfig x-macro flag system
+(src/ray/common/ray_config_def.h:22 — 215 ``RAY_CONFIG(type, name,
+default)`` entries, overridable per-process via ``RAY_<name>`` env vars).
+Here the table is a list of ``Flag`` rows; each flag is overridable via the
+``RTPU_<NAME>`` environment variable (upper-cased flag name), read once at
+import and refreshable with ``config.reload()`` (tests) — so a flag set in
+the driver's environment propagates to workers, which inherit the env.
+
+Usage::
+
+    from ray_tpu.core.config import config
+    if config.fault_dump_after_s > 0: ...
+
+``python -m ray_tpu.core.config`` prints the full table with docs,
+defaults, and current values.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List
+
+
+@dataclass(frozen=True)
+class Flag:
+    name: str
+    type: type
+    default: Any
+    doc: str
+
+    @property
+    def env_var(self) -> str:
+        return "RTPU_" + self.name.upper()
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+# The table. Keep alphabetized within each section.
+_FLAGS: List[Flag] = [
+    # ---- core runtime ----------------------------------------------------
+    Flag("fault_dump_after_s", float, 0.0,
+         "If > 0, every worker dumps all thread stacks to "
+         "/tmp/rtpu_worker_dump_<pid>.txt after this many seconds "
+         "(hang triage; reference analogue: RAY_testing_asio_delay_us "
+         "class of debug knobs)."),
+    Flag("inline_threshold_bytes", int, 100 * 1024,
+         "Results/args at or below this size travel inline in control "
+         "messages; larger values go through the shm object store "
+         "(reference: max_direct_call_object_size, ray_config_def.h)."),
+    Flag("max_dispatch_batch", int, 32,
+         "Upper bound on tasks pipelined to one worker in a single "
+         "dispatch message (amortizes the driver->worker message cost; "
+         "reference analogue: leased-worker pipelining)."),
+    Flag("object_store_memory_fraction", float, 0.3,
+         "Default shm store capacity as a fraction of system RAM when "
+         "object_store_memory is not passed to init() (reference: "
+         "object_store_memory default heuristic in services.py)."),
+    Flag("worker_register_timeout_s", float, 30.0,
+         "How long wait_for_workers waits for the pool to come up."),
+    Flag("worker_shutdown_grace_s", float, 2.0,
+         "Grace period for workers to exit at shutdown before SIGKILL."),
+    # ---- chaos / testing -------------------------------------------------
+    Flag("testing_rpc_delay_ms", int, 0,
+         "If > 0, injects a uniform random delay up to this many ms into "
+         "worker<->driver control messages (reference: asio_chaos.cc:35)."),
+    Flag("testing_kill_worker_prob", float, 0.0,
+         "If > 0, each task execution exits the worker with this "
+         "probability before running (chaos; reference: WorkerKillerActor "
+         "test_utils.py:1597)."),
+]
+
+_BY_NAME: Dict[str, Flag] = {f.name: f for f in _FLAGS}
+
+
+class _Config:
+    """Singleton holding resolved flag values as attributes."""
+
+    def __init__(self):
+        self.reload()
+
+    def reload(self, env: Dict[str, str] = None):
+        """Re-resolve every flag from the environment (tests, or after
+        mutating os.environ in-process)."""
+        env = os.environ if env is None else env
+        for f in _FLAGS:
+            raw = env.get(f.env_var)
+            if raw is None:
+                value = f.default
+            elif f.type is bool:
+                value = _parse_bool(raw)
+            else:
+                value = f.type(raw)
+            object.__setattr__(self, f.name, value)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {f.name: getattr(self, f.name) for f in _FLAGS}
+
+    def describe(self) -> List[Dict[str, Any]]:
+        return [
+            {"name": f.name, "env": f.env_var, "type": f.type.__name__,
+             "default": f.default, "value": getattr(self, f.name),
+             "doc": f.doc}
+            for f in _FLAGS
+        ]
+
+
+config = _Config()
+
+
+def flags() -> List[Flag]:
+    return list(_FLAGS)
+
+
+if __name__ == "__main__":
+    for row in config.describe():
+        star = "" if row["value"] == row["default"] else "  *"
+        print(f"{row['name']} ({row['env']}, {row['type']}) = "
+              f"{row['value']!r} [default {row['default']!r}]{star}")
+        print(f"    {row['doc']}")

@@ -177,10 +177,13 @@ class ShmObjectStore:
 
 
 def default_store_capacity() -> int:
-    """~30% of system memory, capped at 4 GiB (single host; reference caps at
-    30% of memory too — python/ray/_private/ray_constants.py)."""
+    """A configurable fraction of system memory (default 30%), capped at
+    4 GiB (single host; same heuristic as the reference —
+    python/ray/_private/ray_constants.py)."""
+    from ray_tpu.core.config import config
+
     try:
         total = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
     except (ValueError, OSError):
         total = 8 << 30
-    return min(int(total * 0.3), 4 << 30)
+    return min(int(total * config.object_store_memory_fraction), 4 << 30)

@@ -48,6 +48,7 @@ REQ_KV = "kv"                      # (REQ_KV, op, key, value) -> ("ok", value)
 REQ_CREATE_ACTOR = "create_actor_req"  # (.., fn_id, pickled_cls_or_none, args_payload, deps, opts) -> ("ok", actor_id_bytes)
 REQ_PG = "pg"                      # (REQ_PG, op, *args) -> ("ok", result); op in create/remove/ready_ref/wait/chips/table
 REQ_GET_ACTOR = "get_actor"        # (REQ_GET_ACTOR, name) -> ("ok", handle_payload)
+REQ_CANCEL = "cancel"              # (REQ_CANCEL, oid_bytes, force) -> ("ok",)
 
 class ErrorValue:
     """Marker wrapping an exception stored as an object's value.
@@ -110,7 +111,7 @@ def serialize_value(value: Any, store=None) -> Payload:
 
 
 def _store_or_inline(pickled, views, total, store) -> Payload:
-    if store is not None and total > serialization.INLINE_THRESHOLD:
+    if store is not None and total > serialization.inline_threshold():
         oid = ObjectID.from_random()
         try:
             dst = store.create_object(oid, total)

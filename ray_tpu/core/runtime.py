@@ -42,8 +42,8 @@ from ray_tpu.core.resources import (
     ResourceSet, TpuSliceTopology, node_resources,
 )
 from ray_tpu.exceptions import (
-    ActorDiedError, GetTimeoutError, PlacementGroupError, TaskError,
-    WorkerCrashedError,
+    ActorDiedError, GetTimeoutError, PlacementGroupError, TaskCancelledError,
+    TaskError, WorkerCrashedError,
 )
 
 
@@ -60,7 +60,7 @@ class _TaskSpec:
     __slots__ = (
         "task_id", "fn_id", "args_payload", "deps", "return_ids", "options",
         "actor_id", "method", "pending_deps", "request", "pg_wire",
-        "acquired_bundle", "blocked_released", "nested_deps",
+        "acquired_bundle", "blocked_released", "nested_deps", "cancelled",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -84,6 +84,7 @@ class _TaskSpec:
         # refs pass through unresolved), but while unavailable the task must
         # ship alone — batched behind it, its producer could never run.
         self.nested_deps: List = []
+        self.cancelled = False
 
 
 class _Worker:
@@ -172,6 +173,9 @@ class Runtime:
         self._actors: Dict[ActorID, _ActorState] = {}
         self._named_actors: Dict[str, ActorID] = {}
         self._kv: Dict[str, Any] = {}
+        # First-return-id -> spec, for ray.cancel lookup; entries drop when
+        # the task finishes (done/error/cancel paths).
+        self._cancellable: Dict[bytes, _TaskSpec] = {}
         self._shutdown = False
         self._spawning = 0
 
@@ -321,11 +325,18 @@ class Runtime:
             err = WorkerCrashedError(
                 f"worker {w.worker_id.hex()[:8]} died while executing task"
             )
+            # Cancelled specs must not come back: report them cancelled
+            # whether they were executing or merely batched behind the head.
+            fail = fail + [s for s in requeue if s.cancelled]
+            requeue = [s for s in requeue if not s.cancelled]
             with self._lock:
                 for spec in fail:
                     self._release_spec_locked(spec)
             for spec in fail:
-                self._store_error(spec.return_ids, err)
+                self._store_error(
+                    spec.return_ids,
+                    TaskCancelledError("task was cancelled")
+                    if spec.cancelled else err)
             if requeue:
                 with self._lock:
                     self._task_queue.extendleft(reversed(requeue))
@@ -396,6 +407,7 @@ class Runtime:
     def _store_error(self, oids: List[ObjectID], err: BaseException):
         payload = protocol.serialize_value(protocol.ErrorValue(err), store=None)
         for oid in oids:
+            self._cancellable.pop(oid.binary(), None)
             self._store_payload(oid, payload)
 
     # ------------------------------------------------------------- scheduler
@@ -414,6 +426,7 @@ class Runtime:
         spec.request, spec.pg_wire = self._prepare_request(options, is_actor=False)
         for rid in return_ids:
             self._entry(rid)
+        self._cancellable[return_ids[0].binary()] = spec
         self._enqueue(spec)
         return [ObjectRef(rid, core=self) for rid in return_ids]
 
@@ -466,6 +479,12 @@ class Runtime:
         return pg is None or pg.removed
 
     def _queue_ready(self, spec: _TaskSpec):
+        if spec.cancelled:
+            # Never dispatched -> no resources were acquired; nothing to
+            # release. (cancel_task already failed the return ids.)
+            self._store_error(spec.return_ids,
+                              TaskCancelledError("task was cancelled"))
+            return
         # Deps may resolve long after submission; re-check the PG here so a
         # task whose group vanished while it waited fails instead of hanging.
         if spec.actor_id is None and self._spec_pg_removed(spec):
@@ -533,7 +552,11 @@ class Runtime:
         if spawn:
             self._spawn_worker()
 
-    MAX_DISPATCH_BATCH = 32
+    @property
+    def MAX_DISPATCH_BATCH(self):
+        from ray_tpu.core.config import config
+
+        return config.max_dispatch_batch
 
     def _dispatch(self):
         while True:
@@ -737,8 +760,15 @@ class Runtime:
             if spec is not None:
                 self._release_spec_locked(spec)
         if spec is not None:
-            for rid, payload in zip(spec.return_ids, payloads):
-                self._store_payload(rid, payload)
+            if spec.cancelled:
+                # cancel() was promised while the task sat batched behind
+                # the worker's head task; honor it even though the task ran.
+                self._store_error(spec.return_ids,
+                                  TaskCancelledError("task was cancelled"))
+            else:
+                self._cancellable.pop(spec.return_ids[0].binary(), None)
+                for rid, payload in zip(spec.return_ids, payloads):
+                    self._store_payload(rid, payload)
         self._retry_pending_pgs()
         self._worker_now_idle(w)
 
@@ -748,8 +778,15 @@ class Runtime:
             if spec is not None:
                 self._release_spec_locked(spec)
         if spec is not None:
-            for rid in spec.return_ids:
-                self._store_payload(rid, err_payload)
+            if spec.cancelled:
+                # SIGINT-interrupted execution surfaces as a cancellation,
+                # not as the raw KeyboardInterrupt TaskError.
+                self._store_error(spec.return_ids,
+                                  TaskCancelledError("task was cancelled"))
+            else:
+                self._cancellable.pop(spec.return_ids[0].binary(), None)
+                for rid in spec.return_ids:
+                    self._store_payload(rid, err_payload)
         self._retry_pending_pgs()
         self._worker_now_idle(w)
 
@@ -1019,8 +1056,73 @@ class Runtime:
             return refs
         spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
                          actor_id=actor_id, method=method)
+        self._cancellable[return_ids[0].binary()] = spec
         self._enqueue(spec)
         return [ObjectRef(rid, core=self) for rid in return_ids]
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False):
+        """Best-effort task cancellation (reference: ray.cancel,
+        python/ray/_private/worker.py:2970).
+
+        A task still queued (or waiting on deps) is dropped and its caller
+        sees TaskCancelledError at get(). A task already executing is
+        interrupted with SIGINT (force=False, raising KeyboardInterrupt in
+        the worker like the reference) or its worker is killed (force=True).
+        Already-finished tasks are unaffected.
+        """
+        key = ref.id.binary()
+        exec_worker = None
+        removed = False
+        inflight = False
+        with self._lock:
+            spec = self._cancellable.get(key)
+            if spec is None:
+                return
+            spec.cancelled = True
+            try:
+                self._task_queue.remove(spec)
+                removed = True
+            except ValueError:
+                pass
+            if not removed and spec.actor_id is not None:
+                state = self._actors.get(spec.actor_id)
+                if state is not None:
+                    try:
+                        state.queue.remove(spec)
+                        removed = True
+                    except ValueError:
+                        pass
+            if not removed:
+                tid = spec.task_id.binary()
+                for w in self._workers.values():
+                    if tid in w.inflight:
+                        inflight = True
+                        # Only signal when the target is the *executing*
+                        # (head) entry — a SIGINT (or force-kill) for a task
+                        # batched behind it would take out an innocent
+                        # neighbour; batched targets are converted at
+                        # completion instead (spec.cancelled check in
+                        # _on_task_done).
+                        if next(iter(w.inflight)) == tid:
+                            exec_worker = w
+                        break
+        if removed or not inflight:
+            # Queued, or still waiting on deps: it never acquired resources
+            # and will never run — fail the caller immediately (the
+            # reference also fails pending tasks at cancel time).
+            self._store_error(spec.return_ids,
+                              TaskCancelledError("task was cancelled"))
+            self._dispatch()
+        elif exec_worker is not None and exec_worker.proc is not None:
+            import signal
+
+            try:
+                if force:
+                    exec_worker.proc.terminate()
+                else:
+                    os.kill(exec_worker.proc.pid, signal.SIGINT)
+            except OSError:
+                pass
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         state = self._actors.get(actor_id)
@@ -1366,6 +1468,7 @@ class Runtime:
             spec.nested_deps = [ObjectID(b) for b in nested]
             spec.request, spec.pg_wire = self._prepare_request(
                 options, is_actor=False)
+            self._cancellable[return_ids[0].binary()] = spec
             self._enqueue(spec)
             return ("ok", [r.binary() for r in return_ids])
         if tag == protocol.REQ_ACTOR_CALL:
@@ -1438,6 +1541,11 @@ class Runtime:
             actor_id = self._create_actor_from_payload(
                 fn_id, args_payload, [ObjectID(d) for d in deps], opts or {})
             return ("ok", actor_id.binary())
+        if tag == protocol.REQ_CANCEL:
+            _, oid_bytes, force = msg
+            self.cancel_task(ObjectRef(ObjectID(oid_bytes), core=self),
+                             force=force)
+            return ("ok", None)
         if tag == protocol.REQ_GET_ACTOR:
             _, name = msg
             aid = self.get_named_actor(name)
@@ -1460,7 +1568,12 @@ class Runtime:
             return None
         raise ValueError(op)
 
-    def wait_for_workers(self, count: Optional[int] = None, timeout: float = 30.0):
+    def wait_for_workers(self, count: Optional[int] = None,
+                         timeout: Optional[float] = None):
+        from ray_tpu.core.config import config
+
+        if timeout is None:
+            timeout = config.worker_register_timeout_s
         count = count or self.num_workers
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -1483,7 +1596,9 @@ class Runtime:
                     self._send_msg(w, (protocol.MSG_SHUTDOWN,))
             except (OSError, EOFError, BrokenPipeError):
                 pass
-        deadline = time.monotonic() + 2.0
+        from ray_tpu.core.config import config
+
+        deadline = time.monotonic() + config.worker_shutdown_grace_s
         for w in workers:
             try:
                 w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))

@@ -29,7 +29,14 @@ _MAGIC = 0x52545055
 _ALIGN = 64
 # Objects whose serialized size is below this are inlined into control-plane
 # messages instead of the shm store (reference: 100KB task-return inline cap).
-INLINE_THRESHOLD = 100 * 1024
+from ray_tpu.core.config import config as _config
+
+
+def inline_threshold() -> int:
+    """Size cutoff below which values travel inline rather than via shm.
+    Read per-call so config.reload() takes effect (flag:
+    inline_threshold_bytes)."""
+    return _config.inline_threshold_bytes
 
 
 def _align(offset: int) -> int:

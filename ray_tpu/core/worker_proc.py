@@ -53,6 +53,14 @@ class WorkerCore:
     # ---- data-conn RPC ------------------------------------------------------
 
     def _request(self, *msg):
+        from ray_tpu.core.config import config
+
+        if config.testing_rpc_delay_ms > 0:
+            # Chaos delay injection (reference: asio_chaos.cc:35).
+            import random
+            import time
+
+            time.sleep(random.uniform(0, config.testing_rpc_delay_ms / 1000))
         with self._data_lock:
             self.data_conn.send(msg)
             reply = self.data_conn.recv()
@@ -176,6 +184,9 @@ class WorkerCore:
         _, result = self._request(protocol.REQ_KV, op, key, value)
         return result
 
+    def cancel_task(self, ref: ObjectRef, force: bool = False):
+        self._request(protocol.REQ_CANCEL, ref.id.binary(), force)
+
     def get_actor_handle(self, name: str):
         _, payload = self._request(protocol.REQ_GET_ACTOR, name)
         return protocol.deserialize_payload(payload, store=self.store)
@@ -279,7 +290,7 @@ class WorkerCore:
         pickled, views, total = serialization.serialize(value)
         if (
             self.store is not None
-            and total > serialization.INLINE_THRESHOLD
+            and total > serialization.inline_threshold()
         ):
             try:
                 dst = self.store.create_object(rid, total)
@@ -300,7 +311,16 @@ class WorkerCore:
         held hostage by a slow successor, and so the driver's completion
         log stays exact for crash recovery (requeue of never-started tasks).
         """
+        from ray_tpu.core.config import config
+
         for task_id_b, fn_id, args_payload, inline_values, return_ids in tasks:
+            if config.testing_kill_worker_prob > 0:
+                # Chaos injection (reference: WorkerKillerActor,
+                # python/ray/_private/test_utils.py:1597).
+                import random
+
+                if random.random() < config.testing_kill_worker_prob:
+                    os._exit(1)
             self.current_task_id = TaskID(task_id_b)
             try:
                 fn = self._functions[fn_id]
@@ -380,11 +400,13 @@ def _prepare_args_local(core: WorkerCore, args: tuple, kwargs: dict):
 
 
 def main():
-    if os.environ.get("RTPU_FAULT_DUMP_AFTER"):
+    from ray_tpu.core.config import config
+
+    if config.fault_dump_after_s > 0:
         # Debug aid: dump all thread stacks after N seconds (hang triage).
         import faulthandler
         faulthandler.dump_traceback_later(
-            float(os.environ["RTPU_FAULT_DUMP_AFTER"]),
+            config.fault_dump_after_s,
             file=open(f"/tmp/rtpu_worker_dump_{os.getpid()}.txt", "w"))
     address = os.environ["RTPU_ADDRESS"]
     authkey = bytes.fromhex(os.environ["RTPU_AUTH"])
@@ -400,6 +422,17 @@ def main():
     store = ShmObjectStore.connect(store_name) if store_name else None
     core = WorkerCore(task_conn, data_conn, store, node_id, worker_id)
     runtime_context.set_core(core)
+
+    # Cancellation SIGINT (ray.cancel force=False) must only interrupt task
+    # execution; landing between tasks (e.g. blocked in recv) it would
+    # otherwise kill the whole worker and its batched neighbours.
+    import signal
+
+    def _on_sigint(signum, frame):
+        if core.current_task_id is not None:
+            raise KeyboardInterrupt
+
+    signal.signal(signal.SIGINT, _on_sigint)
     try:
         core.run_loop()
     finally:

@@ -44,6 +44,11 @@ class WorkerCrashedError(RayTpuError):
     """The worker executing the task died unexpectedly."""
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ``ray_tpu.cancel()`` before completing
+    (reference: TaskCancelledError in python/ray/exceptions.py)."""
+
+
 class RuntimeNotInitializedError(RayTpuError):
     """An API call was made before ``ray_tpu.init()``."""
 

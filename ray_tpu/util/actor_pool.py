@@ -1,0 +1,128 @@
+"""Utility for pipelining work across a fixed pool of actors.
+
+API parity with the reference's ray.util.ActorPool
+(python/ray/util/actor_pool.py): submit/map/map_unordered over a set of
+actor handles, with get_next / get_next_unordered consumption and dynamic
+push/pop of actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, TYPE_CHECKING
+
+import ray_tpu
+
+if TYPE_CHECKING:
+    from ray_tpu.core.actor import ActorHandle
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable["ActorHandle"]):
+        self._idle_actors: List[Any] = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0      # submission order
+        self._next_return_index = 0    # ordered-consumption cursor
+        self._pending_submits: List[tuple] = []
+
+    def map(self, fn: Callable, values: Iterable) -> Iterator:
+        """Apply fn(actor, value) over values; yields results in order."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable) -> Iterator:
+        """Like map, but yields results as they complete."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def submit(self, fn: Callable, value: Any):
+        """Schedule fn(actor, value) on the next idle actor (queued if none)."""
+        if self._idle_actors:
+            actor = self._idle_actors.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Return the next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        i = self._next_return_index
+        while i not in self._index_to_future:
+            # The producing submit is still queued behind busy actors.
+            self._drain_one(timeout)
+        future = self._index_to_future[i]
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out")
+        del self._index_to_future[i]
+        self._next_return_index += 1
+        value = ray_tpu.get(future)
+        self._return_actor_for(future)
+        return value
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Return the next result to complete, in completion order."""
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        while not self._future_to_actor:
+            self._drain_one(timeout)
+        ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1,
+                                timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        i, _ = self._future_to_actor[future]
+        del self._index_to_future[i]
+        value = ray_tpu.get(future)
+        self._return_actor_for(future)
+        return value
+
+    def _drain_one(self, timeout: float = None):
+        """Wait for one in-flight call to finish so a queued submit can run."""
+        if not self._future_to_actor:
+            raise RuntimeError("pool has queued submits but no idle actors "
+                               "and no in-flight calls (no actors in pool?)")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1,
+                                timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for an actor to free up")
+        # Freeing the actor triggers the next queued submit.
+        _, actor = self._future_to_actor.pop(ready[0])
+        self._actor_idle(actor)
+
+    def _return_actor_for(self, future):
+        entry = self._future_to_actor.pop(future, None)
+        if entry is not None:
+            self._actor_idle(entry[1])
+
+    def _actor_idle(self, actor):
+        self._idle_actors.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def push(self, actor):
+        """Add an actor to the pool."""
+        busy = {a for _, a in self._future_to_actor.values()}
+        if actor in self._idle_actors or actor in busy:
+            raise ValueError("actor already belongs to this pool")
+        self._actor_idle(actor)
+
+    def pop_idle(self):
+        """Remove and return an idle actor, or None if all are busy."""
+        if self._idle_actors:
+            return self._idle_actors.pop()
+        return None
+
+    def has_free(self) -> bool:
+        return bool(self._idle_actors) and not self._pending_submits

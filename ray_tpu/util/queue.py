@@ -1,0 +1,142 @@
+"""Distributed FIFO queue shared between drivers, tasks, and actors.
+
+API parity with the reference's ray.util.Queue (python/ray/util/queue.py):
+put/get with block/timeout, put_nowait/get_nowait, batch variants, qsize.
+The reference hosts the buffer in an asyncio actor; here the buffer lives in
+a plain actor with non-blocking methods and the *client* polls with backoff —
+our actor model executes one method at a time, so a method that blocked
+inside the actor would wedge every other client.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray_tpu.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        from collections import deque
+
+        self._maxsize = maxsize  # 0 = unbounded
+        self._buf = deque()
+
+    def qsize(self) -> int:
+        return len(self._buf)
+
+    def put_nowait(self, item) -> bool:
+        if self._maxsize > 0 and len(self._buf) >= self._maxsize:
+            return False
+        self._buf.append(item)
+        return True
+
+    def put_nowait_batch(self, items: List[Any]) -> bool:
+        if self._maxsize > 0 and len(self._buf) + len(items) > self._maxsize:
+            return False
+        self._buf.extend(items)
+        return True
+
+    def get_nowait(self):
+        if not self._buf:
+            return False, None
+        return True, self._buf.popleft()
+
+    def get_nowait_batch(self, n: int):
+        # All-or-nothing, like the reference's Queue.get_nowait_batch.
+        if len(self._buf) < n:
+            return None
+        return [self._buf.popleft() for _ in range(n)]
+
+    def shutdown(self):
+        self._buf.clear()
+
+
+_POLL_START_S = 0.001
+_POLL_MAX_S = 0.05
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        actor_options = dict(actor_options or {})
+        actor_options.setdefault("num_cpus", 0)
+        self.maxsize = maxsize
+        self.actor = _QueueActor.options(**actor_options).remote(maxsize)
+
+    def __getstate__(self):
+        return {"maxsize": self.maxsize, "actor": self.actor}
+
+    def __setstate__(self, state):
+        self.maxsize = state["maxsize"]
+        self.actor = state["actor"]
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            return self.put_nowait(item)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = _POLL_START_S
+        while True:
+            if ray_tpu.get(self.actor.put_nowait.remote(item)):
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full("queue is full")
+            time.sleep(delay)
+            delay = min(delay * 2, _POLL_MAX_S)
+
+    def put_nowait(self, item):
+        if not ray_tpu.get(self.actor.put_nowait.remote(item)):
+            raise Full("queue is full")
+
+    def put_nowait_batch(self, items: List[Any]):
+        if not ray_tpu.get(self.actor.put_nowait_batch.remote(list(items))):
+            raise Full("queue has no room for the batch")
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            return self.get_nowait()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = _POLL_START_S
+        while True:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+            if ok:
+                return item
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty("queue is empty")
+            time.sleep(delay)
+            delay = min(delay * 2, _POLL_MAX_S)
+
+    def get_nowait(self):
+        ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+        if not ok:
+            raise Empty("queue is empty")
+        return item
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        out = ray_tpu.get(self.actor.get_nowait_batch.remote(num_items))
+        if out is None:
+            raise Empty(f"queue holds fewer than {num_items} items")
+        return out
+
+    def shutdown(self):
+        if self.actor is not None:
+            ray_tpu.kill(self.actor)
+            self.actor = None

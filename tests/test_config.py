@@ -1,0 +1,35 @@
+"""Config/flag registry tests (reference analogue: RayConfig,
+src/ray/common/ray_config_def.h)."""
+
+from ray_tpu.core.config import _Config, config, flags
+
+
+def test_defaults_resolve():
+    assert config.max_dispatch_batch >= 1
+    assert 0 < config.object_store_memory_fraction < 1
+    assert config.testing_kill_worker_prob == 0.0
+
+
+def test_env_override():
+    c = _Config()
+    c.reload(env={"RTPU_MAX_DISPATCH_BATCH": "7",
+                  "RTPU_TESTING_KILL_WORKER_PROB": "0.5"})
+    assert c.max_dispatch_batch == 7
+    assert c.testing_kill_worker_prob == 0.5
+    # defaults untouched for non-overridden flags
+    assert c.worker_shutdown_grace_s == 2.0
+
+
+def test_every_flag_documented():
+    for f in flags():
+        assert f.doc and len(f.doc) > 10, f.name
+        assert f.env_var.startswith("RTPU_")
+        # default must match the declared type
+        assert isinstance(f.default, f.type), f.name
+
+
+def test_describe_roundtrip():
+    rows = config.describe()
+    names = {r["name"] for r in rows}
+    assert "max_dispatch_batch" in names
+    assert all("doc" in r for r in rows)

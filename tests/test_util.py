@@ -1,0 +1,200 @@
+"""Tests for ray_tpu.cancel and ray_tpu.util (ActorPool, Queue).
+
+Mirrors the reference's python/ray/tests/test_cancel.py,
+test_actor_pool.py, and test_queue.py coverage.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.exceptions import TaskCancelledError
+from ray_tpu.util import ActorPool, Empty, Full, Queue
+
+
+# ----------------------------------------------------------------- cancel
+
+def test_cancel_queued_task(rt):
+    @rt.remote
+    def sleeper(x):
+        time.sleep(30)
+        return x
+
+    @rt.remote
+    def quick():
+        return 1
+
+    # Saturate the pool so later submissions stay queued.
+    blockers = [sleeper.remote(i) for i in range(8)]
+    victim = sleeper.remote(99)
+    rt.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        rt.get(victim, timeout=10)
+    for b in blockers:
+        rt.cancel(b, force=True)
+
+
+def test_cancel_running_task_force(rt):
+    @rt.remote
+    def hang():
+        time.sleep(60)
+
+    ref = hang.remote()
+    time.sleep(0.5)  # let it start
+    rt.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        rt.get(ref, timeout=10)
+
+
+def test_cancel_running_task_interrupt(rt):
+    @rt.remote
+    def hang():
+        time.sleep(60)
+
+    ref = hang.remote()
+    time.sleep(0.5)
+    rt.cancel(ref)  # SIGINT -> KeyboardInterrupt in the worker
+    with pytest.raises(TaskCancelledError):
+        rt.get(ref, timeout=10)
+
+
+def test_cancel_dep_waiting_task(rt):
+    @rt.remote
+    def slow_dep():
+        time.sleep(30)
+        return 1
+
+    @rt.remote
+    def consumer(x):
+        return x
+
+    dep = slow_dep.remote()
+    ref = consumer.remote(dep)
+    rt.cancel(ref)
+    rt.cancel(dep, force=True)
+    with pytest.raises(TaskCancelledError):
+        rt.get(ref, timeout=10)
+
+
+def test_cancel_finished_task_is_noop(rt):
+    @rt.remote
+    def f():
+        return 7
+
+    ref = f.remote()
+    assert rt.get(ref) == 7
+    rt.cancel(ref)  # no-op
+    assert rt.get(ref) == 7
+
+
+# -------------------------------------------------------------- ActorPool
+
+def test_actor_pool_map(rt):
+    @rt.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_actor_pool_map_unordered(rt):
+    @rt.remote
+    class Worker:
+        def work(self, x):
+            time.sleep(0.05 if x % 2 else 0.0)
+            return x
+
+    pool = ActorPool([Worker.remote() for _ in range(2)])
+    out = list(pool.map_unordered(lambda a, v: a.work.remote(v), range(6)))
+    assert sorted(out) == [0, 1, 2, 3, 4, 5]
+
+
+def test_actor_pool_submit_get_next(rt):
+    @rt.remote
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote()])
+    pool.submit(lambda a, v: a.sq.remote(v), 3)
+    pool.submit(lambda a, v: a.sq.remote(v), 4)
+    assert pool.get_next() == 9
+    assert pool.get_next() == 16
+    assert not pool.has_next()
+
+
+def test_actor_pool_push_pop(rt):
+    @rt.remote
+    class A:
+        def f(self, x):
+            return x
+
+    a1, a2 = A.remote(), A.remote()
+    pool = ActorPool([a1])
+    assert pool.has_free()
+    popped = pool.pop_idle()
+    assert popped is a1
+    pool.push(a2)
+    pool.submit(lambda a, v: a.f.remote(v), 5)
+    assert pool.get_next() == 5
+
+
+# ------------------------------------------------------------------ Queue
+
+def test_queue_basic(rt):
+    q = Queue()
+    q.put(1)
+    q.put("two")
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == "two"
+    assert q.empty()
+
+
+def test_queue_nowait_and_maxsize(rt):
+    q = Queue(maxsize=2)
+    q.put_nowait(1)
+    q.put_nowait(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    with pytest.raises(Full):
+        q.put(3, timeout=0.2)
+    assert q.get_nowait() == 1
+    q.put_nowait(3)
+    assert q.get_nowait_batch(2) == [2, 3]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+
+
+def test_queue_across_tasks(rt):
+    q = Queue()
+
+    @rt.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    @rt.remote
+    def consumer(q, n):
+        return [q.get(timeout=10) for _ in range(n)]
+
+    p = producer.remote(q, 5)
+    c = consumer.remote(q, 5)
+    assert rt.get(p) == 5
+    assert sorted(rt.get(c)) == [0, 1, 2, 3, 4]
+
+
+def test_queue_batch_put(rt):
+    q = Queue(maxsize=3)
+    q.put_nowait_batch([1, 2])
+    with pytest.raises(Full):
+        q.put_nowait_batch([3, 4])
+    q.put_nowait_batch([3])
+    assert q.qsize() == 3
