@@ -95,6 +95,43 @@ def test_flash_attention_grads_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-5)
 
 
+def test_flash_attention_grads_match_gqa():
+    # Grouped-query attention: dK/dV must reduce over the query-head group.
+    b, s, h, kvh, d = 2, 128, 4, 2, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, kvh, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, kvh, d))
+
+    def loss(fn):
+        # non-uniform cotangent so dO varies per element
+        return lambda *a: (fn(*a) * jnp.arange(d, dtype=jnp.float32)).sum()
+
+    gf = jax.grad(loss(lambda *a: flash_attention(
+        *a, causal=True, use_pallas=True, interpret=True,
+        block_q=64, block_k=64)), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda *a: attention_reference(*a, causal=True)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        # arange-weighted cotangent makes grads O(100); compare relatively
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=1e-4)
+
+
+def test_flash_attention_grads_cross_seq():
+    # sk > sq (chunked prefill / decode alignment): causal offset path.
+    b, sq, sk, h, d = 1, 64, 128, 2, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, sq, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, sk, h, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, sk, h, d))
+    gf = jax.grad(lambda *a: flash_attention(
+        *a, causal=True, use_pallas=True, interpret=True,
+        block_q=64, block_k=64).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: attention_reference(*a, causal=True).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-5)
+
+
 def test_flash_attention_rejects_ragged():
     q = jnp.zeros((1, 100, 2, 32))
     with pytest.raises(ValueError, match="divisible"):
