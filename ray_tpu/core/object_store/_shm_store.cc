@@ -373,7 +373,21 @@ void rtpu_store_prefault(void* handle) {
 #define MADV_POPULATE_WRITE 23
 #endif
   auto* s = static_cast<Store*>(handle);
-  madvise(s->base + s->hdr->heap_offset, s->hdr->heap_size, MADV_POPULATE_WRITE);
+  uint8_t* p = s->base + s->hdr->heap_offset;
+  uint64_t len = s->hdr->heap_size;
+  // madvise requires a page-aligned address; heap_offset is only 64B-aligned.
+  uintptr_t addr = reinterpret_cast<uintptr_t>(p);
+  uintptr_t aligned = addr & ~static_cast<uintptr_t>(4095);
+  len += addr - aligned;
+  if (madvise(reinterpret_cast<void*>(aligned), len, MADV_POPULATE_WRITE) != 0) {
+    // Fallback (old kernels / EINVAL): touch one byte per page with an
+    // atomic OR of 0 — faults the page for write while preserving any value
+    // a concurrent put may have stored there.
+    for (uint64_t off = 0; off < len; off += 4096) {
+      __atomic_fetch_or(reinterpret_cast<uint8_t*>(aligned + off), 0,
+                        __ATOMIC_RELAXED);
+    }
+  }
 }
 
 void rtpu_store_destroy(const char* name) { shm_unlink(name); }
