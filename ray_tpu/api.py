@@ -21,8 +21,11 @@ _runtime = None
 def init(num_workers: Optional[int] = None,
          object_store_memory: Optional[int] = None,
          ignore_reinit_error: bool = True,
+         address: Optional[str] = None,
          **kwargs):
-    """Start the local runtime: worker pool + shared-memory object store.
+    """Start the local runtime (worker pool + shm object store), or connect
+    to a running cluster when ``address="host:port"`` names its GCS
+    (reference: ray.init(address=...), python/ray/_private/worker.py:1227).
 
     Returns the runtime context. Safe to call twice with
     ``ignore_reinit_error`` (the default).
@@ -32,10 +35,16 @@ def init(num_workers: Optional[int] = None,
         if ignore_reinit_error:
             return runtime_context.get_runtime_context()
         raise RuntimeError("ray_tpu.init() called twice")
-    from ray_tpu.core.runtime import Runtime
+    if address:
+        from ray_tpu.core.cluster.cluster_core import ClusterCore
 
-    _runtime = Runtime(num_workers=num_workers,
-                       object_store_memory=object_store_memory)
+        host, _, port = address.rpartition(":")
+        _runtime = ClusterCore((host, int(port)))
+    else:
+        from ray_tpu.core.runtime import Runtime
+
+        _runtime = Runtime(num_workers=num_workers,
+                           object_store_memory=object_store_memory)
     runtime_context.set_core(_runtime)
     atexit.register(shutdown)
     return runtime_context.get_runtime_context()

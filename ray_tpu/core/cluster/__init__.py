@@ -1,0 +1,10 @@
+"""Multi-node substrate: GCS control plane, per-node servers, TCP RPC.
+
+The reference splits these across gcs_server (src/ray/gcs/gcs_server/),
+raylet (src/ray/raylet/) and the object manager
+(src/ray/object_manager/object_manager.h) talking gRPC; here the same
+capabilities ride a framed-pickle TCP transport (rpc.py) and each node
+embeds the single-node Runtime as its local scheduler.
+"""
+
+from ray_tpu.core.cluster.fixture import Cluster  # noqa: F401

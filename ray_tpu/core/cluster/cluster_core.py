@@ -1,0 +1,752 @@
+"""Driver-side core client for a multi-node cluster.
+
+Implements the same interface the embedded single-node ``Runtime`` exposes
+to the public API (api.py / actor.py / remote_function.py /
+placement_group.py), but routes every operation to node servers over RPC:
+
+- tasks: resource-fit node selection from the GCS cluster view (least
+  loaded, most available), lazy per-node function shipping
+- objects: owner-hint routed gets (the node a task was sent to serves its
+  returns, proxying if it spilled the task), put to the home node
+- actors: placement like tasks, location-transparent handles, restart on a
+  different node when the hosting node dies (driver-side FSM; the
+  reference's gcs_actor_manager does this inside the GCS)
+- placement groups: cluster PGs composed of node-local PGs (STRICT_PACK
+  pins one node; SPREAD distributes bundles round-robin)
+
+The reference analogue of this layer is the CoreWorker's
+NormalTaskSubmitter + ActorTaskSubmitter + ownership tables
+(src/ray/core_worker/core_worker.h), minus distributed refcounting: the
+driver owns every ref it creates, like the single-node runtime.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import protocol, serialization
+from ray_tpu.core.cluster.rpc import ClientCache, RpcClient, RpcError, cluster_authkey
+from ray_tpu.core.config import config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.placement_group import PlacementGroup
+from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError,
+                                ObjectLostError, PlacementGroupError)
+
+
+class _ClusterPG:
+    __slots__ = ("pg_id", "bundles", "strategy", "name", "placements",
+                 "node_pgs")
+
+    def __init__(self, pg_id, bundles, strategy, name):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        # per-bundle: (node_addr, local_pg_id_bytes, local_bundle_index)
+        self.placements: List[Tuple[Tuple[str, int], bytes, int]] = []
+        # node_addr -> local_pg_id_bytes
+        self.node_pgs: Dict[Tuple[str, int], bytes] = {}
+
+
+class ClusterCore:
+    """Driver client to a ray_tpu cluster (GCS + node servers)."""
+
+    def __init__(self, gcs_address: Tuple[str, int],
+                 authkey: Optional[bytes] = None):
+        self._authkey = authkey or cluster_authkey()
+        self.gcs = RpcClient(tuple(gcs_address), self._authkey)
+        self.gcs.call(("ping",))
+        self._nodes = ClientCache(self._authkey)
+        self.job_id = JobID.from_random()
+        self.node_id = NodeID.from_random()     # driver pseudo-node id
+        self.worker_id = WorkerID.from_random()
+
+        self._lock = threading.Lock()
+        self._functions: Dict[bytes, bytes] = {}
+        self._fn_cache: Dict[int, Tuple[bytes, Any]] = {}
+        self._shipped: Dict[Tuple[str, int], set] = {}
+        self._ref_node: Dict[bytes, Tuple[str, int]] = {}
+        self._actor_node: Dict[ActorID, Tuple[str, int]] = {}
+        self._actor_opts: Dict[ActorID, dict] = {}
+        self._actor_spec: Dict[ActorID, tuple] = {}  # for restart
+        self._pgs: Dict[PlacementGroupID, _ClusterPG] = {}
+        # driver-local sentinel objects (e.g. cluster PG ready refs)
+        self._local: Dict[bytes, Tuple[threading.Event, list]] = {}
+        self._rr = 0
+
+        self._view: Optional[dict] = None
+        self._view_time = 0.0
+        self._death_seq = 0
+        self._monitor_stop = False
+        self._monitor = threading.Thread(target=self._death_watch,
+                                         daemon=True, name="driver-deaths")
+        self._monitor.start()
+
+        view = self._cluster_view(force=True)
+        if not view["nodes"]:
+            raise RuntimeError("cluster has no alive nodes")
+        self._home: Tuple[str, int] = tuple(view["nodes"][0]["address"])
+
+        # local store fast path: if the home node is on this host, read big
+        # objects straight out of its shm store (zero-copy) instead of TCP.
+        self._home_store = None
+        self.store = None
+        try:
+            import socket as _s
+
+            home = next(n for n in view["nodes"]
+                        if tuple(n["address"]) == self._home)
+            if home["topology"].get("hostname") == _s.gethostname():
+                from ray_tpu.core.object_store.store import ShmObjectStore
+
+                self._home_store = ShmObjectStore.connect(
+                    home["topology"]["store"])
+                self.store = self._home_store
+        except Exception:  # noqa: BLE001 — fast path is optional
+            self._home_store = None
+
+    # ------------------------------------------------------------- topology
+
+    @property
+    def topology(self):
+        from ray_tpu.core.resources import TpuSliceTopology
+
+        return TpuSliceTopology.detect()
+
+    def _cluster_view(self, force: bool = False) -> dict:
+        now = time.monotonic()
+        if (not force and self._view is not None
+                and now - self._view_time < config.cluster_view_refresh_s):
+            return self._view
+        view = self.gcs.call(("list_nodes", True))
+        self._view = view
+        self._view_time = now
+        return view
+
+    def _death_watch(self):
+        while not self._monitor_stop:
+            time.sleep(config.gcs_heartbeat_interval_s * 2)
+            try:
+                deaths = self.gcs.call(("deaths_since", self._death_seq))
+            except (RpcError, Exception):  # noqa: BLE001
+                continue
+            for seq, node_id in deaths:
+                self._death_seq = max(self._death_seq, seq)
+                self._on_node_death(node_id)
+
+    def _on_node_death(self, node_id: bytes):
+        view = self.gcs.call(("list_nodes", False))
+        dead = [n for n in view["nodes"] if n["node_id"] == node_id]
+        if not dead:
+            return
+        addr = tuple(dead[0]["address"])
+        self._nodes.drop(addr)
+        self._shipped.pop(addr, None)
+        # restart restartable actors elsewhere
+        with self._lock:
+            lost = [aid for aid, a in self._actor_node.items() if a == addr]
+        for aid in lost:
+            spec = self._actor_spec.get(aid)
+            opts = (spec[3] if spec else {}) or {}
+            if spec is not None and opts.get("max_restarts", 0) != 0:
+                threading.Thread(target=self._restart_actor_with_retry,
+                                 args=(aid, spec), daemon=True,
+                                 name="actor-restart").start()
+            else:
+                with self._lock:
+                    self._actor_node.pop(aid, None)
+
+    def _restart_actor_with_retry(self, actor_id: ActorID, spec,
+                                  timeout: float = 300.0):
+        """Restart pends until a node satisfying the actor's resources is
+        alive (reference: gcs_actor_manager reschedules on node addition)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not self._monitor_stop:
+            try:
+                self._restart_actor(actor_id, spec)
+                return
+            except Exception:  # noqa: BLE001 — no fitting node yet
+                time.sleep(1.0)
+        with self._lock:
+            self._actor_node.pop(actor_id, None)
+
+    def _restart_actor(self, actor_id: ActorID, spec):
+        """Recreate the actor under its ORIGINAL id on a fitting node, so
+        every handle — driver- or worker-held — keeps working unchanged.
+        The decremented max_restarts is persisted back into the spec so the
+        restart budget is actually enforced."""
+        cls_fn_id, payload, deps, opts = spec
+        opts = dict(opts or {})
+        if int(opts.get("max_restarts", 0)) > 0:
+            opts["max_restarts"] = int(opts["max_restarts"]) - 1
+        addr = self._pick_node_strict(opts, is_actor=True)
+        client = self._nodes.get(addr)
+        pickled = self._ship_fn(addr, cls_fn_id)
+        client.call(("create_actor", cls_fn_id, pickled, payload,
+                     deps, opts, None, actor_id.binary()))
+        self._mark_shipped(addr, cls_fn_id)
+        with self._lock:
+            self._actor_node[actor_id] = addr
+            self._actor_spec[actor_id] = (cls_fn_id, payload, deps, opts)
+        self.gcs.try_call(("register_actor", actor_id.binary(),
+                           {"node": addr, "state": "RESTARTED"}))
+
+    # ------------------------------------------------------------ functions
+
+    def register_function(self, fn) -> bytes:
+        key = id(fn)
+        cached = self._fn_cache.get(key)
+        if cached is not None and cached[1] is fn:
+            return cached[0]
+        pickled = serialization.pack(fn)
+        fn_id = hashlib.blake2b(pickled, digest_size=16).digest()
+        with self._lock:
+            self._functions[fn_id] = pickled
+        self._fn_cache[key] = (fn_id, fn)
+        return fn_id
+
+    def _ship_fn(self, addr: Tuple[str, int], fn_id: bytes) -> Optional[bytes]:
+        """Returns the pickled fn to attach if the node hasn't seen it.
+        Callers confirm delivery with _mark_shipped AFTER the RPC succeeds."""
+        if fn_id in self._shipped.setdefault(addr, set()):
+            return None
+        return self._functions.get(fn_id)
+
+    def _mark_shipped(self, addr: Tuple[str, int], fn_id: bytes):
+        self._shipped.setdefault(addr, set()).add(fn_id)
+
+    # ------------------------------------------------------------ scheduling
+
+    def _pick_node_strict(self, options: dict, is_actor: bool
+                          ) -> Tuple[str, int]:
+        return self._pick_node(options, is_actor, strict=True)
+
+    def _pick_node(self, options: dict, is_actor: bool,
+                   exclude: Sequence[Tuple[str, int]] = (),
+                   strict: bool = False) -> Tuple[str, int]:
+        options = options or {}
+        req: Dict[str, float] = {}
+        num_cpus = options.get("num_cpus")
+        if num_cpus is None:
+            num_cpus = 0.0 if is_actor else 1.0
+        if num_cpus:
+            req["CPU"] = float(num_cpus)
+        if options.get("num_tpus"):
+            req["TPU"] = float(options["num_tpus"])
+        for k, v in (options.get("resources") or {}).items():
+            req[k] = req.get(k, 0) + float(v)
+
+        strategy = options.get("scheduling_strategy")
+        wire = None
+        if strategy is not None and hasattr(strategy, "_to_wire"):
+            wire = strategy._to_wire()
+        elif isinstance(strategy, tuple):
+            wire = strategy
+        if wire and wire[0] == "pg":
+            pg = self._pgs.get(PlacementGroupID(wire[1]))
+            if pg is None:
+                raise PlacementGroupError("unknown placement group")
+            idx = wire[2] if wire[2] is not None and wire[2] >= 0 else 0
+            addr, _, _ = pg.placements[idx]
+            return addr
+
+        nodes = self._cluster_view()["nodes"]
+        fit = [n for n in nodes
+               if tuple(n["address"]) not in exclude
+               and all(n["resources"].get(k, 0) >= v for k, v in req.items())]
+        if not fit:
+            if strict:
+                raise RuntimeError("no node satisfies the resource request")
+            # No node's totals fit: park the task on the least-loaded node,
+            # whose queue holds it until resources appear (matches the
+            # reference's infeasible-task pending queue).
+            fit = [n for n in nodes if tuple(n["address"]) not in exclude]
+        if not fit:
+            raise RuntimeError("no alive nodes in cluster")
+        # prefer nodes with availability headroom and low queue, then RR
+        def score(n):
+            avail_ok = all(n["avail"].get(k, 0) >= v for k, v in req.items())
+            return (0 if avail_ok else 1, n["load"])
+        fit.sort(key=score)
+        best = [n for n in fit if score(n) == score(fit[0])]
+        self._rr += 1
+        return tuple(best[self._rr % len(best)]["address"])
+
+    def _localize_pg(self, options: dict, addr: Tuple[str, int]) -> dict:
+        """Rewrite a cluster PG scheduling strategy into the node-local one."""
+        options = dict(options or {})
+        strategy = options.get("scheduling_strategy")
+        wire = None
+        if strategy is not None and hasattr(strategy, "_to_wire"):
+            wire = strategy._to_wire()
+        elif isinstance(strategy, tuple):
+            wire = strategy
+        if wire and wire[0] == "pg":
+            pg = self._pgs.get(PlacementGroupID(wire[1]))
+            idx = wire[2] if wire[2] is not None and wire[2] >= 0 else 0
+            node_addr, local_pg, local_idx = pg.placements[idx]
+            assert node_addr == addr
+            options["scheduling_strategy"] = ("pg", local_pg, local_idx)
+        return options
+
+    # ----------------------------------------------------------------- tasks
+
+    def submit_task(self, fn_id: bytes, args: tuple, kwargs: dict,
+                    num_returns: int = 1, options: Optional[dict] = None
+                    ) -> List[ObjectRef]:
+        options = dict(options or {})
+        args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
+        payload, nested = protocol.serialize_args(args2, kwargs2, store=None)
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        addr = self._pick_node(options, is_actor=False)
+        options2 = self._localize_pg(options, addr)
+        pickled_fn = self._ship_fn(addr, fn_id)
+        locations = {d.binary(): self._ref_node.get(d.binary())
+                     for d in deps}
+        locations = {k: v for k, v in locations.items() if v is not None}
+        self._nodes.get(addr).call(
+            ("submit", fn_id, pickled_fn, payload,
+             [d.binary() for d in deps], [r.binary() for r in nested],
+             [r.binary() for r in return_ids], options2, locations))
+        self._mark_shipped(addr, fn_id)
+        with self._lock:
+            for rid in return_ids:
+                self._ref_node[rid.binary()] = addr
+        return [ObjectRef(rid, core=self) for rid in return_ids]
+
+    def _swap_top_level_refs(self, args, kwargs):
+        deps: List[ObjectID] = []
+
+        def swap(v):
+            if isinstance(v, ObjectRef):
+                deps.append(v.id)
+                return protocol._TopLevelDep(v.binary())
+            return v
+
+        return (tuple(swap(a) for a in args),
+                {k: swap(v) for k, v in kwargs.items()}, deps)
+
+    # --------------------------------------------------------------- objects
+
+    def put_object(self, value: Any) -> ObjectRef:
+        pickled, views, total = serialization.serialize(value)
+        buf = bytearray(total)
+        serialization.write_container(memoryview(buf), pickled, views)
+        oid_b = self._nodes.get(self._home).call(("put", bytes(buf), None))
+        with self._lock:
+            self._ref_node[oid_b] = self._home
+        return ObjectRef(ObjectID(oid_b), core=self)
+
+    def get_objects(self, refs: List[ObjectRef],
+                    timeout: Optional[float] = None) -> List[Any]:
+        out: Dict[bytes, Any] = {}
+        groups: Dict[Tuple[str, int], List[bytes]] = {}
+        for ref in refs:
+            b = ref.binary()
+            if b in self._local:
+                ev, cell = self._local[b]
+                if not ev.wait(timeout):
+                    raise GetTimeoutError("get() timed out")
+                out[b] = cell[0]
+                continue
+            addr = self._ref_node.get(b, self._home)
+            groups.setdefault(addr, []).append(b)
+        errs: List[BaseException] = []
+
+        def fetch(addr, oids):
+            try:
+                allow_shm = (self._home_store is not None
+                             and addr == self._home)
+                payloads = self._nodes.get(addr).call(
+                    ("get", oids, timeout, allow_shm))
+                for b, payload in payloads.items():
+                    out[b] = self._decode(payload)
+            except RpcError:
+                # node died: any other location? (GCS directory)
+                for b in oids:
+                    try:
+                        out[b] = self._fetch_anywhere(b, timeout)
+                    except BaseException as e:  # noqa: BLE001
+                        errs.append(e)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        if len(groups) == 1:
+            ((addr, oids),) = groups.items()
+            fetch(addr, oids)
+        elif groups:
+            threads = [threading.Thread(target=fetch, args=(a, o))
+                       for a, o in groups.items()]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if errs:
+            raise errs[0]
+        values = []
+        for ref in refs:
+            v = out[ref.binary()]
+            values.append(protocol.raise_if_error(v))
+        return values
+
+    def _decode(self, payload):
+        kind, data = payload
+        if kind == "shm" and self._home_store is not None:
+            return protocol.shm_unpack(self._home_store, ObjectID(data))
+        return serialization.unpack(data)
+
+    def _fetch_anywhere(self, oid_b: bytes, timeout: Optional[float]):
+        locs = self.gcs.call(("loc_get", oid_b, 2.0))
+        for addr in locs:
+            try:
+                data = self._nodes.get(tuple(addr)).call(("fetch", oid_b))
+            except RpcError:
+                continue
+            if data is not None:
+                with self._lock:
+                    self._ref_node[oid_b] = tuple(addr)
+                return self._decode(data)
+        raise ObjectLostError(
+            f"object {oid_b.hex()} is lost (owner node died and no other "
+            f"copy exists)")
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready_set: set = set()
+        while True:
+            groups: Dict[Tuple[str, int], List[bytes]] = {}
+            for ref in refs:
+                b = ref.binary()
+                if b in ready_set:
+                    continue
+                if b in self._local:
+                    if self._local[b][0].is_set():
+                        ready_set.add(b)
+                    continue
+                groups.setdefault(self._ref_node.get(b, self._home),
+                                  []).append(b)
+            if len(ready_set) >= num_returns:
+                break
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                break
+            step = 0.2 if remaining is None else max(0.0, min(0.2, remaining))
+            if not groups:
+                # only driver-local sentinels left: block on one of them
+                # instead of spinning
+                unresolved = [self._local[r.binary()][0] for r in refs
+                              if r.binary() in self._local
+                              and not self._local[r.binary()][0].is_set()]
+                if unresolved:
+                    unresolved[0].wait(step)
+                else:
+                    time.sleep(min(0.01, step))
+                continue
+
+            def poll(addr, oids):
+                try:
+                    r, _ = self._nodes.get(addr).call(
+                        ("wait", oids, len(oids), step))
+                    ready_set.update(r)
+                except (RpcError, Exception):  # noqa: BLE001
+                    pass
+
+            threads = [threading.Thread(target=poll, args=(a, o))
+                       for a, o in groups.items()]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        ready = [r for r in refs if r.binary() in ready_set][:num_returns]
+        ready_ids = {r.binary() for r in ready}
+        rest = [r for r in refs if r.binary() not in ready_ids]
+        return ready, rest
+
+    def as_future(self, ref: ObjectRef):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+
+        def run():
+            try:
+                v = self.get_objects([ref], timeout=None)[0]
+            except BaseException as e:  # noqa: BLE001
+                loop.call_soon_threadsafe(fut.set_exception, e)
+                return
+            loop.call_soon_threadsafe(fut.set_result, v)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    # ---------------------------------------------------------------- actors
+
+    def create_actor(self, cls_fn_id: bytes, args: tuple, kwargs: dict,
+                     opts: Optional[dict] = None) -> ActorID:
+        opts = dict(opts or {})
+        args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
+        payload, _ = protocol.serialize_args(args2, kwargs2, store=None)
+        addr = self._pick_node(opts, is_actor=True)
+        opts2 = self._localize_pg(opts, addr)
+        pickled_cls = self._ship_fn(addr, cls_fn_id)
+        locations = {d.binary(): self._ref_node.get(d.binary()) for d in deps}
+        locations = {k: v for k, v in locations.items() if v is not None}
+        dep_b = [d.binary() for d in deps]
+        actor_id_b = self._nodes.get(addr).call(
+            ("create_actor", cls_fn_id, pickled_cls, payload, dep_b, opts2,
+             locations))
+        self._mark_shipped(addr, cls_fn_id)
+        actor_id = ActorID(actor_id_b)
+        with self._lock:
+            self._actor_node[actor_id] = addr
+            self._actor_opts[actor_id] = opts.get("method_opts", {})
+            self._actor_spec[actor_id] = (cls_fn_id, payload, dep_b, opts2)
+        return actor_id
+
+    def _actor_addr(self, actor_id: ActorID) -> Tuple[str, int]:
+        addr = self._actor_node.get(actor_id)
+        if addr is None:
+            info = self.gcs.call(("list_actors",)).get(actor_id.binary())
+            if info is None or "node" not in info:
+                raise ActorDiedError(f"unknown actor {actor_id}")
+            addr = tuple(info["node"])
+            with self._lock:
+                self._actor_node[actor_id] = addr
+        return addr
+
+    def _actor_call_with_retry(self, actor_id: ActorID, msg_fn):
+        """Run an actor-routed RPC; on stale routing (node died, actor was
+        restarted elsewhere) re-resolve via the GCS actor table and retry."""
+        addr = self._actor_addr(actor_id)
+        try:
+            return addr, self._nodes.get(addr).call(msg_fn(addr))
+        except (RpcError, ActorDiedError):
+            with self._lock:
+                self._actor_node.pop(actor_id, None)
+            addr = self._actor_addr(actor_id)
+            return addr, self._nodes.get(addr).call(msg_fn(addr))
+
+    def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
+                          kwargs: dict, num_returns: int = 1
+                          ) -> List[ObjectRef]:
+        args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
+        payload, nested = protocol.serialize_args(args2, kwargs2, store=None)
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        msg = ("actor_call", actor_id.binary(), method, payload,
+               [d.binary() for d in deps], [r.binary() for r in nested],
+               [r.binary() for r in return_ids])
+        try:
+            addr, _ = self._actor_call_with_retry(actor_id, lambda a: msg)
+        except RpcError as e:
+            raise ActorDiedError(
+                f"actor {actor_id} node is unreachable: {e}") from e
+        with self._lock:
+            for rid in return_ids:
+                self._ref_node[rid.binary()] = addr
+        return [ObjectRef(rid, core=self) for rid in return_ids]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        if no_restart:
+            with self._lock:
+                self._actor_spec.pop(actor_id, None)
+        try:
+            self._actor_call_with_retry(
+                actor_id,
+                lambda a: ("kill_actor", actor_id.binary(), no_restart))
+        except (RpcError, ActorDiedError):
+            pass
+
+    def get_actor_method_opts(self, actor_id: ActorID) -> dict:
+        opts = self._actor_opts.get(actor_id)
+        if opts is not None:
+            return opts
+        _, opts = self._actor_call_with_retry(
+            actor_id, lambda a: ("actor_opts", actor_id.binary()))
+        self._actor_opts[actor_id] = opts
+        return opts
+
+    def get_named_actor(self, name: str) -> ActorID:
+        entry = self.gcs.call(("get_named_actor", name))
+        if entry is None:
+            raise ValueError(f"no actor named {name!r}")
+        actor_id = ActorID(entry[0])
+        with self._lock:
+            self._actor_node.setdefault(actor_id, tuple(entry[1]))
+        return actor_id
+
+    def get_actor_handle(self, name: str):
+        from ray_tpu.core.actor import ActorHandle
+
+        aid = self.get_named_actor(name)
+        return ActorHandle(aid, self.get_actor_method_opts(aid))
+
+    # ------------------------------------------------------ placement groups
+
+    def create_placement_group(self, bundles, strategy, name
+                               ) -> PlacementGroup:
+        pg_id = PlacementGroupID.from_random()
+        cpg = _ClusterPG(pg_id, bundles, strategy, name)
+        nodes = self._cluster_view(force=True)["nodes"]
+        if not nodes:
+            raise RuntimeError("no alive nodes")
+
+        def fits(node, bundle_list):
+            need: Dict[str, float] = {}
+            for b in bundle_list:
+                for k, v in b.items():
+                    need[k] = need.get(k, 0) + v
+            return all(node["resources"].get(k, 0) >= v
+                       for k, v in need.items())
+
+        assignments: Dict[Tuple[str, int], List[int]] = {}
+        if strategy in ("PACK", "STRICT_PACK"):
+            host = next((n for n in nodes if fits(n, bundles)), None)
+            if host is None:
+                if strategy == "STRICT_PACK":
+                    raise ValueError(
+                        "no node can hold all STRICT_PACK bundles")
+                host = max(nodes, key=lambda n: sum(n["avail"].values()))
+            assignments[tuple(host["address"])] = list(range(len(bundles)))
+        else:  # SPREAD / STRICT_SPREAD: round-robin over fitting nodes
+            order = sorted(nodes, key=lambda n: n["load"])
+            if strategy == "STRICT_SPREAD" and len(order) < len(bundles):
+                raise ValueError(
+                    f"STRICT_SPREAD needs {len(bundles)} nodes, "
+                    f"cluster has {len(order)}")
+            for i, bundle in enumerate(bundles):
+                cand = [n for n in order if fits(n, [bundle])] or order
+                node = cand[i % len(cand)]
+                assignments.setdefault(tuple(node["address"]), []).append(i)
+
+        placements: List[Optional[Tuple]] = [None] * len(bundles)
+        created: List[Tuple[Tuple[str, int], bytes]] = []
+        try:
+            for addr, idxs in assignments.items():
+                sub = [bundles[i] for i in idxs]
+                local_pg_b = self._nodes.get(addr).call(
+                    ("pg", "create", sub, "PACK", None))
+                created.append((addr, local_pg_b))
+                cpg.node_pgs[addr] = local_pg_b
+                for local_idx, i in enumerate(idxs):
+                    placements[i] = (addr, local_pg_b, local_idx)
+        except Exception:
+            for addr, local_pg_b in created:
+                try:
+                    self._nodes.get(addr).call(("pg", "remove", local_pg_b))
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        cpg.placements = placements
+        with self._lock:
+            self._pgs[pg_id] = cpg
+        return PlacementGroup(pg_id, bundles)
+
+    def _cluster_pg(self, pg_id: PlacementGroupID) -> _ClusterPG:
+        pg = self._pgs.get(pg_id)
+        if pg is None:
+            raise PlacementGroupError(f"unknown placement group {pg_id}")
+        return pg
+
+    def wait_placement_group(self, pg_id: PlacementGroupID,
+                             timeout: float) -> bool:
+        pg = self._cluster_pg(pg_id)
+        deadline = time.monotonic() + timeout
+        for addr, local_pg_b in pg.node_pgs.items():
+            remaining = max(0.0, deadline - time.monotonic())
+            if not self._nodes.get(addr).call(
+                    ("pg", "wait", local_pg_b, remaining)):
+                return False
+        return True
+
+    def placement_group_ready_ref(self, pg_id: PlacementGroupID) -> ObjectRef:
+        oid = ObjectID.from_random()
+        ev = threading.Event()
+        cell: list = [None]
+        self._local[oid.binary()] = (ev, cell)
+
+        def run():
+            try:
+                ok = self.wait_placement_group(pg_id, timeout=3600.0)
+                cell[0] = ok
+            except BaseException as e:  # noqa: BLE001
+                cell[0] = protocol.ErrorValue(e)
+            ev.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        return ObjectRef(oid, core=self)
+
+    def placement_group_chips(self, pg_id: PlacementGroupID,
+                              index: int) -> List[int]:
+        pg = self._cluster_pg(pg_id)
+        addr, local_pg_b, local_idx = pg.placements[index]
+        return self._nodes.get(addr).call(("pg", "chips", local_pg_b,
+                                           local_idx))
+
+    def remove_placement_group(self, pg_id: PlacementGroupID):
+        pg = self._pgs.get(pg_id)
+        if pg is None:
+            return
+        for addr, local_pg_b in pg.node_pgs.items():
+            try:
+                self._nodes.get(addr).call(("pg", "remove", local_pg_b))
+            except (RpcError, Exception):  # noqa: BLE001
+                pass
+        with self._lock:
+            self._pgs.pop(pg_id, None)
+
+    def placement_group_table(self) -> Dict[str, dict]:
+        out = {}
+        with self._lock:
+            pgs = list(self._pgs.items())
+        for pg_id, pg in pgs:
+            out[pg_id.hex()] = {
+                "bundles": pg.bundles,
+                "strategy": pg.strategy,
+                "name": pg.name,
+                "nodes": [list(a) for a in pg.node_pgs],
+            }
+        return out
+
+    # -------------------------------------------------------------- misc api
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False):
+        addr = self._ref_node.get(ref.binary(), self._home)
+        try:
+            self._nodes.get(addr).call(("cancel", ref.binary(), force))
+        except RpcError:
+            pass
+
+    def kv_op(self, op: str, key: str, value=None):
+        return self.gcs.call(("kv", op, key, value))
+
+    def cluster_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for n in self._cluster_view(force=True)["nodes"]:
+            for k, v in n["resources"].items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    def nodes(self) -> List[dict]:
+        return self._cluster_view(force=True)["nodes"]
+
+    def wait_for_workers(self, count: Optional[int] = None,
+                         timeout: Optional[float] = None):
+        return True  # nodes bring their own pools up
+
+    def shutdown(self):
+        self._monitor_stop = True
+        if self._home_store is not None:
+            try:
+                self._home_store.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._nodes.close_all()
+        self.gcs.close()

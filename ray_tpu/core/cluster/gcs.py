@@ -1,0 +1,311 @@
+"""GCS — the cluster control plane.
+
+One process holding the authoritative cluster state, mirroring the
+reference's gcs_server (src/ray/gcs/gcs_server/gcs_server.h:78) at the
+capability level:
+
+- node table + health: registration, periodic heartbeats with resource
+  loads, a monitor thread that marks silent nodes DEAD and records a death
+  event stream (reference: gcs_node_manager.h:45,
+  gcs_health_check_manager.h:39)
+- named actor directory (gcs_actor_manager)
+- object location directory with blocking waits (the reference spreads this
+  across the ownership layer + object directory; here the GCS is the
+  rendezvous so any node can find any object's owner)
+- cluster KV (gcs_kv_manager) and a cluster function table
+  (function_manager.py exports to GCS in the reference)
+
+Run as ``python -m ray_tpu.core.cluster.gcs --port N``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.cluster.rpc import RpcServer, cluster_authkey
+from ray_tpu.core.config import config
+
+
+class _NodeInfo:
+    __slots__ = ("node_id", "address", "resources", "topology", "labels",
+                 "state", "last_heartbeat", "avail", "load", "death_seq")
+
+    def __init__(self, node_id: bytes, address, resources, topology, labels):
+        self.node_id = node_id
+        self.address = tuple(address)
+        self.resources = dict(resources)       # total resources
+        self.topology = topology               # TPU topology summary (dict)
+        self.labels = dict(labels or {})
+        self.state = "ALIVE"
+        self.last_heartbeat = time.monotonic()
+        self.avail = dict(resources)           # latest reported availability
+        self.load = 0                          # queued+running tasks
+        self.death_seq = None
+
+    def view(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "address": self.address,
+            "resources": self.resources,
+            "topology": self.topology,
+            "labels": self.labels,
+            "state": self.state,
+            "avail": self.avail,
+            "load": self.load,
+        }
+
+
+class GcsServer:
+    """In-process GCS server (embed in a dedicated process via main())."""
+
+    def __init__(self, port: int = 0, authkey: Optional[bytes] = None):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._nodes: Dict[bytes, _NodeInfo] = {}
+        self._kv: Dict[str, Any] = {}
+        self._named_actors: Dict[str, Tuple[bytes, tuple]] = {}
+        self._actor_table: Dict[bytes, dict] = {}
+        self._locations: Dict[bytes, List[tuple]] = {}
+        self._functions: Dict[bytes, bytes] = {}
+        self._deaths: List[Tuple[int, bytes]] = []  # (seq, node_id)
+        self._death_seq = 0
+        self._view_version = 0
+        self._stop = False
+        self._server = RpcServer(self._handle, authkey or cluster_authkey(),
+                                 port=port)
+        self.address = self._server.address
+        self._monitor = threading.Thread(target=self._health_loop,
+                                         daemon=True, name="gcs-health")
+        self._monitor.start()
+
+    # ------------------------------------------------------------ health
+
+    def _health_loop(self):
+        timeout = config.gcs_heartbeat_timeout_s
+        while not self._stop:
+            time.sleep(min(0.1, timeout / 4))
+            now = time.monotonic()
+            with self._lock:
+                for info in self._nodes.values():
+                    if (info.state == "ALIVE"
+                            and now - info.last_heartbeat > timeout):
+                        self._mark_dead_locked(info)
+
+    def _mark_dead_locked(self, info: _NodeInfo):
+        info.state = "DEAD"
+        self._death_seq += 1
+        info.death_seq = self._death_seq
+        self._deaths.append((self._death_seq, info.node_id))
+        self._view_version += 1
+        # objects whose only location was the dead node are now lost
+        dead_addr = info.address
+        for oid, locs in list(self._locations.items()):
+            locs = [a for a in locs if a != dead_addr]
+            if locs:
+                self._locations[oid] = locs
+            else:
+                del self._locations[oid]
+        self._cond.notify_all()
+
+    # ------------------------------------------------------------ handler
+
+    def _handle(self, msg, ctx) -> Any:
+        op = msg[0]
+        fn = getattr(self, "_op_" + op, None)
+        if fn is None:
+            raise ValueError(f"unknown GCS op {op!r}")
+        return fn(*msg[1:])
+
+    # -- nodes
+
+    def _op_register_node(self, node_id: bytes, address, resources,
+                          topology, labels=None):
+        with self._lock:
+            self._nodes[node_id] = _NodeInfo(node_id, address, resources,
+                                             topology, labels)
+            self._view_version += 1
+            self._cond.notify_all()
+        return True
+
+    def _op_heartbeat(self, node_id: bytes, avail: dict, load: int):
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or info.state == "DEAD":
+                return {"accepted": False}  # node must re-register
+            info.last_heartbeat = time.monotonic()
+            if info.avail != avail or info.load != load:
+                info.avail = dict(avail)
+                info.load = load
+                self._view_version += 1
+        return {"accepted": True}
+
+    def _op_unregister_node(self, node_id: bytes):
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is not None and info.state == "ALIVE":
+                self._mark_dead_locked(info)
+        return True
+
+    def _op_list_nodes(self, alive_only: bool = False):
+        with self._lock:
+            return {
+                "version": self._view_version,
+                "nodes": [i.view() for i in self._nodes.values()
+                          if not alive_only or i.state == "ALIVE"],
+            }
+
+    def _op_wait_nodes(self, count: int, timeout: float):
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                alive = [i for i in self._nodes.values() if i.state == "ALIVE"]
+                if len(alive) >= count:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+
+    def _op_deaths_since(self, seq: int):
+        with self._lock:
+            return [(s, nid) for s, nid in self._deaths if s > seq]
+
+    # -- kv
+
+    def _op_kv(self, op: str, key: str, value=None):
+        with self._lock:
+            if op == "put":
+                self._kv[key] = value
+                return True
+            if op == "get":
+                return self._kv.get(key)
+            if op == "del":
+                return self._kv.pop(key, None) is not None
+            if op == "exists":
+                return key in self._kv
+            if op == "keys":
+                return [k for k in self._kv if k.startswith(key)]
+        raise ValueError(f"unknown kv op {op!r}")
+
+    # -- named actors / actor table
+
+    def _op_name_actor(self, name: str, actor_id: bytes, node_addr):
+        with self._lock:
+            if name in self._named_actors:
+                existing_id, _ = self._named_actors[name]
+                if existing_id != actor_id:
+                    raise ValueError(f"actor name {name!r} already taken")
+            self._named_actors[name] = (actor_id, tuple(node_addr))
+        return True
+
+    def _op_get_named_actor(self, name: str):
+        with self._lock:
+            return self._named_actors.get(name)
+
+    def _op_drop_actor_name(self, name: str, actor_id: bytes):
+        with self._lock:
+            cur = self._named_actors.get(name)
+            if cur is not None and cur[0] == actor_id:
+                del self._named_actors[name]
+        return True
+
+    def _op_register_actor(self, actor_id: bytes, info: dict):
+        with self._lock:
+            self._actor_table.setdefault(actor_id, {}).update(info)
+        return True
+
+    def _op_list_actors(self):
+        with self._lock:
+            return dict(self._actor_table)
+
+    # -- object directory
+
+    def _op_loc_add(self, oid: bytes, node_addr):
+        with self._lock:
+            locs = self._locations.setdefault(oid, [])
+            addr = tuple(node_addr)
+            if addr not in locs:
+                locs.append(addr)
+            self._cond.notify_all()
+        return True
+
+    def _op_loc_add_batch(self, oids: List[bytes], node_addr):
+        addr = tuple(node_addr)
+        with self._lock:
+            for oid in oids:
+                locs = self._locations.setdefault(oid, [])
+                if addr not in locs:
+                    locs.append(addr)
+            self._cond.notify_all()
+        return True
+
+    def _op_loc_get(self, oid: bytes, timeout: float = 0.0):
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                locs = self._locations.get(oid)
+                if locs:
+                    return list(locs)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(remaining)
+
+    def _op_loc_drop(self, oid: bytes, node_addr):
+        addr = tuple(node_addr)
+        with self._lock:
+            locs = self._locations.get(oid)
+            if locs and addr in locs:
+                locs.remove(addr)
+                if not locs:
+                    del self._locations[oid]
+        return True
+
+    # -- function table
+
+    def _op_register_fn(self, fn_id: bytes, pickled: bytes):
+        with self._lock:
+            self._functions.setdefault(fn_id, pickled)
+        return True
+
+    def _op_get_fn(self, fn_id: bytes):
+        with self._lock:
+            return self._functions.get(fn_id)
+
+    # -- lifecycle
+
+    def _op_ping(self):
+        return "pong"
+
+    def _op_shutdown_gcs(self):
+        threading.Thread(target=self.close, daemon=True).start()
+        return True
+
+    def close(self):
+        self._stop = True
+        self._server.close()
+
+
+def main(argv=None):
+    import argparse
+    import signal
+    import sys
+
+    p = argparse.ArgumentParser(description="ray_tpu GCS server")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(argv)
+    gcs = GcsServer(port=args.port)
+    # Parent reads the bound address from stdout.
+    print(f"GCS_ADDRESS {gcs.address[0]}:{gcs.address[1]}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    gcs.close()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,705 @@
+"""Per-node server: local scheduler + object services behind a TCP RPC.
+
+The capability analogue of the reference's raylet (src/ray/raylet/
+node_manager.h:119) + object manager (src/ray/object_manager/
+object_manager.h:117): each node embeds the single-node ``Runtime`` (worker
+pool, shm store, resource-aware scheduler, local PGs) and this server adds
+
+- payload-level task/actor submission from remote drivers,
+- node-to-node object transfer (peer ``fetch``, pull-based, GCS object
+  directory as the rendezvous),
+- lease-style spillback: a task whose resource request can never be met
+  locally is forwarded to a peer whose totals fit (reference:
+  cluster_task_manager.cc spillback),
+- registration + heartbeats to the GCS, and cluster-wide KV / named actors
+  via the GCS.
+
+Run as ``python -m ray_tpu.core.cluster.node_server --gcs HOST:PORT``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import protocol, serialization
+from ray_tpu.core.cluster.rpc import (ClientCache, RpcClient, RpcError,
+                                      RpcServer, cluster_authkey)
+from ray_tpu.core.config import config
+from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID, make_task_id
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.runtime import Runtime, _TaskSpec
+from ray_tpu.exceptions import ActorDiedError, ObjectLostError
+
+# Tag prefix for ops; kept as plain strings (framed pickle transport).
+
+
+def materialize(runtime: Runtime, payload) -> Tuple[str, bytes]:
+    """Convert a local payload descriptor into wire-safe ("inline", bytes)."""
+    kind, data = payload
+    if kind == "inline":
+        return payload
+    oid = ObjectID(data)
+    view = runtime.store.get(oid, timeout_ms=0)
+    try:
+        return ("inline", bytes(view))
+    finally:
+        del view
+        runtime.store.release(oid)
+
+
+def store_incoming(runtime: Runtime, oid: ObjectID, data: bytes):
+    """Store wire bytes locally: shm when large, inline entry otherwise."""
+    if len(data) > serialization.inline_threshold() and not runtime.store.contains(oid):
+        try:
+            runtime.store.put(oid, data)
+            runtime._store_payload(oid, ("shm", oid.binary()))
+            return
+        except Exception:  # noqa: BLE001 — store full: keep inline
+            pass
+    runtime._store_payload(oid, ("inline", data))
+
+
+class NodeRuntime(Runtime):
+    """Runtime with cluster hooks: remote-object fetch, actor-call routing,
+    cluster KV, spillback, and location publication."""
+
+    def __init__(self, server: "NodeServer", **kw):
+        self._server_ref = server
+        super().__init__(**kw)
+
+    # locations: publish every stored object id to the GCS directory
+    def _store_payload(self, oid, payload):
+        super()._store_payload(oid, payload)
+        srv = self._server_ref
+        if srv is not None:
+            srv.note_location(oid.binary())
+
+    # Worker-originated requests that need cluster awareness: remote-object
+    # gets/waits, cluster KV, and calls on actors living on peer nodes.
+    def _handle_data_request(self, w, msg):
+        srv = self._server_ref
+        tag = msg[0]
+        if srv is not None:
+            if tag in (protocol.REQ_GET, protocol.REQ_WAIT):
+                for b in msg[1]:
+                    srv.ensure_available(b)
+            elif tag == protocol.REQ_KV:
+                _, op, key, value = msg
+                return ("ok", srv.gcs.call(("kv", op, key, value)))
+            elif tag == protocol.REQ_ACTOR_CALL:
+                _, actor_id_b, method, args_payload, extra, n_returns = msg
+                if ActorID(actor_id_b) not in self._actors:
+                    refs = srv.forward_actor_call_payload(
+                        ActorID(actor_id_b), method, args_payload,
+                        extra.get("__deps", []), n_returns)
+                    return ("ok", [r.binary() for r in refs])
+        return super()._handle_data_request(w, msg)
+
+    # spillback: infeasible plain tasks leave for a fitting peer
+    def _enqueue(self, spec: _TaskSpec):
+        srv = self._server_ref
+        if srv is not None:
+            if (spec.actor_id is None and spec.request is not None
+                    and spec.pg_wire is None
+                    and not spec.request.is_subset_of(self._total)
+                    and srv.spill_task(spec)):
+                return
+            srv.mark_local_products(spec.return_ids)
+        super()._enqueue(spec)
+
+    def placement_group_ready_ref(self, pg_id):
+        ref = super().placement_group_ready_ref(pg_id)
+        if self._server_ref is not None:
+            self._server_ref.mark_local_products([ref.id])
+        return ref
+
+    # cluster-wide KV lives in the GCS
+    def kv_op(self, op: str, key: str, value=None):
+        return self._server_ref.gcs.call(("kv", op, key, value))
+
+    # named actors are registered cluster-wide
+    def _create_actor_from_payload(self, cls_fn_id, args_payload, deps, opts,
+                                   actor_id=None):
+        name = (opts or {}).get("name")
+        srv = self._server_ref
+        actor_id = super()._create_actor_from_payload(
+            cls_fn_id, args_payload, deps, opts, actor_id=actor_id)
+        if srv is not None:
+            if name:
+                srv.gcs.call(("name_actor", name, actor_id.binary(),
+                              srv.address))
+            srv.gcs.try_call(("register_actor", actor_id.binary(), {
+                "node": srv.address, "name": name, "state": "ALIVE",
+                "opts": {k: v for k, v in (opts or {}).items()
+                         if k in ("max_restarts", "num_tpus", "num_cpus")},
+            }))
+        return actor_id
+
+    def _mark_actor_dead(self, state, cause):
+        super()._mark_actor_dead(state, cause)
+        srv = self._server_ref
+        if srv is not None and state.restarts_left == 0:
+            name = state.opts.get("name")
+            if name:
+                srv.gcs.try_call(("drop_actor_name", name,
+                                  state.actor_id.binary()))
+            srv.gcs.try_call(("register_actor", state.actor_id.binary(),
+                              {"state": "DEAD"}))
+
+    # actor calls targeting a peer node's actor (worker-held handles)
+    def submit_actor_task(self, actor_id, method, args, kwargs,
+                          num_returns=1):
+        if actor_id in self._actors or self._server_ref is None:
+            return super().submit_actor_task(
+                actor_id, method, args, kwargs, num_returns)
+        return self._server_ref.remote_actor_call(
+            actor_id, method, args, kwargs, num_returns)
+
+    def get_actor_method_opts(self, actor_id):
+        if actor_id in self._actors or self._server_ref is None:
+            return super().get_actor_method_opts(actor_id)
+        return self._server_ref.remote_actor_opts(actor_id)
+
+    def kill_actor(self, actor_id, no_restart=True):
+        if actor_id in self._actors or self._server_ref is None:
+            return super().kill_actor(actor_id, no_restart)
+        return self._server_ref.remote_kill_actor(actor_id, no_restart)
+
+    def get_named_actor(self, name: str):
+        with self._lock:
+            aid = self._named_actors.get(name)
+        if aid is not None:
+            return aid
+        entry = self._server_ref.gcs.call(("get_named_actor", name))
+        if entry is None:
+            raise ValueError(f"no actor named {name!r}")
+        actor_id = ActorID(entry[0])
+        self._server_ref.note_remote_actor(actor_id, tuple(entry[1]))
+        return actor_id
+
+
+class NodeServer:
+    """One per node process. Owns the NodeRuntime and all cluster links."""
+
+    def __init__(self, gcs_address: Tuple[str, int], num_workers=None,
+                 object_store_memory=None, resources: Optional[dict] = None,
+                 port: int = 0, authkey: Optional[bytes] = None,
+                 labels: Optional[dict] = None):
+        self._authkey = authkey or cluster_authkey()
+        self.gcs = RpcClient(tuple(gcs_address), self._authkey)
+        self.gcs.call(("ping",))
+        self._peers = ClientCache(self._authkey)
+        self._stop = False
+
+        self.runtime = NodeRuntime(
+            self, num_workers=num_workers,
+            object_store_memory=object_store_memory)
+        self.node_id = self.runtime.node_id
+        if resources:
+            # extend the node's resource pool with custom resources
+            from ray_tpu.core.resources import ResourceSet
+            extra = ResourceSet(resources)
+            self.runtime._total = self.runtime._total + extra
+            self.runtime._avail = self.runtime._avail + extra
+
+        self._server = RpcServer(self._handle, self._authkey, port=port)
+        self.address = self._server.address
+
+        # object-location publication (batched)
+        self._loc_lock = threading.Lock()
+        self._loc_pending: List[bytes] = []
+        self._loc_thread = threading.Thread(
+            target=self._loc_flush_loop, daemon=True, name="node-locs")
+        self._loc_thread.start()
+
+        # in-flight fetch/proxy threads, keyed by oid bytes
+        self._fetching: set = set()
+        self._fetch_lock = threading.Lock()
+        # return ids a local submission will produce (no fetch needed)
+        self._local_products: set = set()
+
+        # tasks spilled to peers: first-return-id -> peer address
+        self._forwarded: Dict[bytes, Tuple[str, int]] = {}
+        # known remote actors: actor_id -> node address
+        self._remote_actors: Dict[ActorID, Tuple[str, int]] = {}
+
+        topo = self.runtime.topology
+        self.gcs.call(("register_node", self.node_id.binary(), self.address,
+                       self.runtime._total.to_dict(),
+                       {"chips": getattr(topo, "num_chips", 0),
+                        "kind": getattr(topo, "kind", "none"),
+                        "store": self.runtime.store.name,
+                        "hostname": socket.gethostname(), "pid": os.getpid()},
+                       labels or {}))
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="node-heartbeat")
+        self._hb_thread.start()
+
+    # --------------------------------------------------------------- plumbing
+
+    def _heartbeat_loop(self):
+        interval = config.gcs_heartbeat_interval_s
+        while not self._stop:
+            rt = self.runtime
+            with rt._lock:
+                avail = rt._avail.to_dict()
+                load = len(rt._task_queue)
+            reply = self.gcs.try_call(
+                ("heartbeat", self.node_id.binary(), avail, load))
+            if reply is not None and not reply.get("accepted", True):
+                # marked dead (e.g. after a long GC pause): re-register
+                topo = self.runtime.topology
+                self.gcs.try_call((
+                    "register_node", self.node_id.binary(), self.address,
+                    rt._total.to_dict(),
+                    {"chips": getattr(topo, "num_chips", 0),
+                     "store": rt.store.name,
+                     "hostname": socket.gethostname(), "pid": os.getpid()},
+                    {}))
+            time.sleep(interval)
+
+    def note_location(self, oid_bytes: bytes):
+        with self._loc_lock:
+            self._loc_pending.append(oid_bytes)
+
+    def _loc_flush_loop(self):
+        while not self._stop:
+            time.sleep(0.02)
+            with self._loc_lock:
+                batch, self._loc_pending = self._loc_pending, []
+            if batch:
+                self.gcs.try_call(("loc_add_batch", batch, self.address))
+
+    def note_remote_actor(self, actor_id: ActorID, addr: Tuple[str, int]):
+        self._remote_actors[actor_id] = tuple(addr)
+
+    def _alive_peers(self) -> List[dict]:
+        view = self.gcs.call(("list_nodes", True))
+        return [n for n in view["nodes"]
+                if tuple(n["address"]) != self.address]
+
+    # ---------------------------------------------------- object availability
+
+    def mark_local_products(self, oids):
+        for oid in oids:
+            self._local_products.add(
+                oid if isinstance(oid, bytes) else oid.binary())
+
+    def ensure_available(self, oid_bytes: bytes,
+                         hint: Optional[Tuple[str, int]] = None):
+        """Ensure an object id will eventually resolve locally, starting at
+        most one background fetch/proxy per id. No-ops for ids a local
+        submission will produce, and for already-resolved entries."""
+        if oid_bytes in self._local_products:
+            return
+        rt = self.runtime
+        oid = ObjectID(oid_bytes)
+        with rt._lock:
+            e = rt._objects.get(oid)
+            if e is not None and e.event.is_set():
+                return
+        with self._fetch_lock:
+            if oid_bytes in self._fetching:
+                return
+            self._fetching.add(oid_bytes)
+        fwd = self._forwarded.get(oid_bytes)
+        t = threading.Thread(target=self._fetch_object,
+                             args=(oid_bytes, fwd or hint),
+                             daemon=True, name="node-fetch")
+        t.start()
+
+    def _fetch_object(self, oid_bytes: bytes, hint):
+        rt = self.runtime
+        oid = ObjectID(oid_bytes)
+        deadline = time.monotonic() + 600.0
+        try:
+            while not self._stop:
+                e = rt._objects.get(oid)
+                if e is not None and e.event.is_set():
+                    return  # resolved locally meanwhile
+                addrs: List[Tuple[str, int]] = []
+                if hint:
+                    addrs.append(tuple(hint))
+                locs = self.gcs.try_call(("loc_get", oid_bytes, 0.5),
+                                         default=[])
+                addrs.extend(tuple(a) for a in locs or [])
+                for addr in addrs:
+                    if addr == self.address:
+                        continue
+                    try:
+                        data = self._peers.get(addr).call(("fetch", oid_bytes))
+                    except (RpcError, Exception):  # noqa: BLE001
+                        self.gcs.try_call(("loc_drop", oid_bytes, addr))
+                        continue
+                    if data is not None:
+                        store_incoming(rt, oid, data[1])
+                        return
+                if time.monotonic() > deadline:
+                    rt._store_payload(oid, protocol.serialize_value(
+                        protocol.ErrorValue(ObjectLostError(
+                            f"object {oid} could not be fetched from any "
+                            f"node")), store=None))
+                    return
+                time.sleep(0.05)
+        finally:
+            with self._fetch_lock:
+                self._fetching.discard(oid_bytes)
+
+    # --------------------------------------------------------------- spilling
+
+    def spill_task(self, spec: _TaskSpec) -> bool:
+        """Forward an infeasible task to a peer whose totals fit. Returns
+        True when spilled."""
+        try:
+            peers = self._alive_peers()
+        except RpcError:
+            return False
+        req = spec.request.to_dict()
+        fit = [n for n in peers
+               if all(n["resources"].get(k, 0) >= v for k, v in req.items())]
+        if not fit:
+            return False
+        fit.sort(key=lambda n: (n["load"],
+                                -sum(n["avail"].get(k, 0) for k in req)))
+        target = tuple(fit[0]["address"])
+        rt = self.runtime
+        with rt._lock:
+            pickled_fn = rt._functions.get(spec.fn_id)
+        payload = materialize(rt, spec.args_payload)
+        msg = ("submit", spec.fn_id, pickled_fn, payload,
+               [d.binary() for d in spec.deps],
+               [d.binary() for d in spec.nested_deps],
+               [r.binary() for r in spec.return_ids],
+               spec.options, None)
+        try:
+            self._peers.get(target).call(msg)
+        except RpcError:
+            return False
+        for rid in spec.return_ids:
+            self._forwarded[rid.binary()] = target
+        # free the resources this spec reserved from accounting (it never
+        # acquired; request simply never enters the local pool)
+        return True
+
+    # ------------------------------------------------- remote actor routing
+
+    def _actor_addr(self, actor_id: ActorID) -> Tuple[str, int]:
+        addr = self._remote_actors.get(actor_id)
+        if addr is None:
+            table = self.gcs.call(("list_actors",))
+            info = table.get(actor_id.binary())
+            if info is None or info.get("state") == "DEAD" or "node" not in info:
+                raise ActorDiedError(f"unknown actor {actor_id}")
+            addr = tuple(info["node"])
+            self._remote_actors[actor_id] = addr
+        return addr
+
+    def remote_actor_call(self, actor_id: ActorID, method: str, args, kwargs,
+                          num_returns: int) -> List[ObjectRef]:
+        rt = self.runtime
+        args2, kwargs2, deps = rt._swap_top_level_refs(args, kwargs)
+        payload, nested = protocol.serialize_args(args2, kwargs2, store=None)
+        return self._send_actor_call(
+            actor_id, method, payload, [d.binary() for d in deps],
+            [r.binary() for r in nested], num_returns)
+
+    def forward_actor_call_payload(self, actor_id: ActorID, method: str,
+                                   args_payload, deps: List[bytes],
+                                   num_returns: int) -> List[ObjectRef]:
+        """Route a worker's call on a peer node's actor (payload level)."""
+        return self._send_actor_call(
+            actor_id, method, materialize(self.runtime, args_payload),
+            list(deps), [], num_returns)
+
+    def _send_actor_call(self, actor_id, method, payload, deps, nested,
+                         num_returns) -> List[ObjectRef]:
+        rt = self.runtime
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        msg = ("actor_call", actor_id.binary(), method, payload, deps, nested,
+               [r.binary() for r in return_ids])
+        addr = self._actor_addr(actor_id)
+        try:
+            self._peers.get(addr).call(msg)
+        except (RpcError, ActorDiedError):
+            # stale cache: the actor may have been restarted on another node
+            self._remote_actors.pop(actor_id, None)
+            addr = self._actor_addr(actor_id)
+            self._peers.get(addr).call(msg)
+        for rid in return_ids:
+            rt._entry(rid)
+            self.ensure_available(rid.binary(), hint=addr)
+        return [ObjectRef(rid, core=rt) for rid in return_ids]
+
+    def remote_actor_opts(self, actor_id: ActorID) -> dict:
+        addr = self._actor_addr(actor_id)
+        return self._peers.get(addr).call(("actor_opts", actor_id.binary()))
+
+    def remote_kill_actor(self, actor_id: ActorID, no_restart: bool):
+        addr = self._actor_addr(actor_id)
+        return self._peers.get(addr).call(
+            ("kill_actor", actor_id.binary(), no_restart))
+
+    # ---------------------------------------------------------------- handler
+
+    def _handle(self, msg, ctx) -> Any:
+        op = msg[0]
+        fn = getattr(self, "_op_" + op, None)
+        if fn is None:
+            raise ValueError(f"unknown node op {op!r}")
+        return fn(*msg[1:])
+
+    def _op_ping(self):
+        return "pong"
+
+    def _op_status(self):
+        rt = self.runtime
+        with rt._lock:
+            return {
+                "node_id": self.node_id.binary(),
+                "address": self.address,
+                "total": rt._total.to_dict(),
+                "avail": rt._avail.to_dict(),
+                "load": len(rt._task_queue),
+                "num_workers": len(rt._workers),
+                "store": rt.store.stats(),
+            }
+
+    def _op_register_fn(self, fn_id: bytes, pickled: bytes):
+        rt = self.runtime
+        with rt._lock:
+            rt._functions.setdefault(fn_id, pickled)
+        return True
+
+    def _op_submit(self, fn_id, pickled_fn, args_payload, deps, nested,
+                   return_ids, options, locations):
+        rt = self.runtime
+        if pickled_fn is not None:
+            with rt._lock:
+                rt._functions.setdefault(fn_id, pickled_fn)
+        with rt._lock:
+            known = fn_id in rt._functions
+        if not known:
+            raise KeyError(f"function {fn_id.hex()} not registered on node")
+        dep_ids = [ObjectID(b) for b in deps]
+        ret_ids = [ObjectID(b) for b in return_ids]
+        for b, d in zip(deps, dep_ids):
+            self.ensure_available(
+                b, hint=tuple(locations[b]) if locations and b in locations
+                else None)
+        for b in nested:
+            self.ensure_available(b)
+        task_id = make_task_id(rt.job_id)
+        for rid in ret_ids:
+            rt._entry(rid)
+        spec = _TaskSpec(task_id, fn_id, args_payload, dep_ids, ret_ids,
+                         dict(options or {}))
+        spec.nested_deps = [ObjectID(b) for b in nested]
+        spec.request, spec.pg_wire = rt._prepare_request(
+            dict(options or {}), is_actor=False)
+        rt._cancellable[ret_ids[0].binary()] = spec
+        rt._enqueue(spec)
+        return True
+
+    def _op_get(self, oid_bytes_list, timeout, allow_shm=False):
+        rt = self.runtime
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for b in oid_bytes_list:
+            self.ensure_available(b)
+        out = {}
+        for b in oid_bytes_list:
+            e = rt._entry(ObjectID(b))
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not e.event.wait(remaining):
+                from ray_tpu.exceptions import GetTimeoutError
+                raise GetTimeoutError(f"get timed out for {b.hex()}")
+            if allow_shm and e.payload[0] == "shm":
+                # same-host driver reads the store zero-copy
+                out[b] = e.payload
+            else:
+                out[b] = materialize(rt, e.payload)
+        return out
+
+    def _op_fetch(self, oid_bytes):
+        """Peer pull: return materialized payload bytes, or None if this
+        node does not hold the object (no recursive fetch)."""
+        rt = self.runtime
+        oid = ObjectID(oid_bytes)
+        with rt._lock:
+            e = rt._objects.get(oid)
+            if e is None or not e.event.is_set():
+                return None
+            payload = e.payload
+        return materialize(rt, payload)
+
+    def _op_has(self, oid_bytes):
+        rt = self.runtime
+        with rt._lock:
+            e = rt._objects.get(ObjectID(oid_bytes))
+            return e is not None and e.event.is_set()
+
+    def _op_wait(self, oid_bytes_list, num_returns, timeout):
+        rt = self.runtime
+        for b in oid_bytes_list:
+            self.ensure_available(b)
+        refs = [ObjectRef(ObjectID(b), core=rt) for b in oid_bytes_list]
+        ready, rest = rt.wait(refs, num_returns=num_returns, timeout=timeout)
+        return [r.binary() for r in ready], [r.binary() for r in rest]
+
+    def _op_put(self, data: bytes, oid_bytes=None):
+        rt = self.runtime
+        oid = ObjectID(oid_bytes) if oid_bytes else ObjectID.from_random()
+        store_incoming(rt, oid, data)
+        return oid.binary()
+
+    def _op_release(self, oid_bytes_list):
+        rt = self.runtime
+        for b in oid_bytes_list:
+            oid = ObjectID(b)
+            with rt._lock:
+                rt._objects.pop(oid, None)
+            try:
+                rt.store.delete(oid)
+            except Exception:  # noqa: BLE001
+                pass
+            self.gcs.try_call(("loc_drop", b, self.address))
+        return True
+
+    def _op_cancel(self, oid_bytes, force):
+        rt = self.runtime
+        return rt.cancel_task(ObjectRef(ObjectID(oid_bytes), core=rt),
+                              force=force)
+
+    # -- actors
+
+    def _op_create_actor(self, cls_fn_id, pickled_cls, args_payload, deps,
+                         opts, locations, actor_id_b=None):
+        rt = self.runtime
+        if pickled_cls is not None:
+            with rt._lock:
+                rt._functions.setdefault(cls_fn_id, pickled_cls)
+        for b in deps:
+            self.ensure_available(
+                b, hint=tuple(locations[b]) if locations and b in locations
+                else None)
+        actor_id = rt._create_actor_from_payload(
+            cls_fn_id, args_payload, [ObjectID(b) for b in deps],
+            dict(opts or {}),
+            actor_id=ActorID(actor_id_b) if actor_id_b else None)
+        return actor_id.binary()
+
+    def _op_actor_call(self, actor_id_bytes, method, args_payload, deps,
+                       nested, return_ids):
+        rt = self.runtime
+        actor_id = ActorID(actor_id_bytes)
+        state = rt._actors.get(actor_id)
+        if state is None:
+            raise ActorDiedError(f"actor {actor_id} is not on this node")
+        for b in deps:
+            self.ensure_available(b)
+        for b in nested:
+            self.ensure_available(b)
+        ret_ids = [ObjectID(b) for b in return_ids]
+        for rid in ret_ids:
+            rt._entry(rid)
+        task_id = make_task_id(rt.job_id)
+        if state.dead:
+            rt._store_error(ret_ids, ActorDiedError(
+                str(state.death_cause or "actor is dead")))
+            return True
+        spec = _TaskSpec(task_id, None, args_payload,
+                         [ObjectID(b) for b in deps], ret_ids, {},
+                         actor_id=actor_id, method=method)
+        spec.nested_deps = [ObjectID(b) for b in nested]
+        rt._cancellable[ret_ids[0].binary()] = spec
+        rt._enqueue(spec)
+        return True
+
+    def _op_actor_opts(self, actor_id_bytes):
+        return self.runtime.get_actor_method_opts(ActorID(actor_id_bytes))
+
+    def _op_kill_actor(self, actor_id_bytes, no_restart):
+        self.runtime.kill_actor(ActorID(actor_id_bytes), no_restart=no_restart)
+        return True
+
+    # -- placement groups (node-local; the driver composes cluster PGs)
+
+    def _op_pg(self, op, *args):
+        rt = self.runtime
+        if op == "create":
+            bundles, strategy, name = args
+            pg = rt.create_placement_group(bundles, strategy, name)
+            return pg.id.binary()
+        pg_id = PlacementGroupID(args[0])
+        if op == "wait":
+            return rt.wait_placement_group(pg_id, args[1])
+        if op == "remove":
+            rt.remove_placement_group(pg_id)
+            return True
+        if op == "chips":
+            return rt.placement_group_chips(pg_id, args[1])
+        if op == "table":
+            return rt.placement_group_table()
+        raise ValueError(f"unknown pg op {op!r}")
+
+    # -- lifecycle
+
+    def _op_shutdown_node(self):
+        threading.Thread(target=self.close, daemon=True).start()
+        return True
+
+    def close(self):
+        if self._stop:
+            return
+        self._stop = True
+        self.gcs.try_call(("unregister_node", self.node_id.binary()))
+        self._server.close()
+        try:
+            self.runtime.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        self._peers.close_all()
+        self.gcs.close()
+
+
+def _parse_addr(s: str) -> Tuple[str, int]:
+    host, port = s.rsplit(":", 1)
+    return host, int(port)
+
+
+def main(argv=None):
+    import argparse
+    import signal
+    import sys
+
+    p = argparse.ArgumentParser(description="ray_tpu node server")
+    p.add_argument("--gcs", required=True, help="GCS address host:port")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-workers", type=int, default=None)
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--resources", type=str, default=None,
+                   help='JSON dict of extra resources, e.g. {"disk": 2}')
+    args = p.parse_args(argv)
+    resources = None
+    if args.resources:
+        import json
+
+        resources = json.loads(args.resources)
+    node = NodeServer(_parse_addr(args.gcs), num_workers=args.num_workers,
+                      object_store_memory=args.object_store_memory,
+                      resources=resources, port=args.port)
+    print(f"NODE_ADDRESS {node.address[0]}:{node.address[1]}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    node.close()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

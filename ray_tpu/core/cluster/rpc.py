@@ -1,0 +1,223 @@
+"""TCP RPC substrate for the cluster plane.
+
+``multiprocessing.connection`` over AF_INET gives framed pickling plus an
+HMAC authkey handshake; on top of that this module provides a threaded
+request/response server and a pooled client. This fills the role gRPC plays
+in the reference (src/ray/rpc/grpc_server.h) at single-digit-node scale;
+the wire format is an implementation detail hidden behind RpcClient/serve.
+
+Blocking RPCs (e.g. a get that waits for a task) hold one pooled connection
+for their duration; the pool grows on demand and idles out.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from multiprocessing.connection import Client as _MpClient
+from multiprocessing.connection import Listener as _MpListener
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class RpcError(Exception):
+    """Transport-level RPC failure (peer died, connection refused)."""
+
+
+class RemoteError(Exception):
+    """Application-level error raised by the remote handler."""
+
+
+def pick_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class RpcServer:
+    """Threaded request/response server.
+
+    handler(msg, ctx) -> reply. Exceptions in the handler are shipped back
+    and re-raised client-side as RemoteError (or the original exception when
+    picklable). ``ctx`` is a per-connection dict handlers may use to stash
+    state (e.g. peer identity after a hello message).
+    """
+
+    def __init__(self, handler: Callable[[Any, dict], Any],
+                 authkey: bytes, host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._authkey = authkey
+        if port == 0:
+            port = pick_port()
+        self._listener = _MpListener((host, port), authkey=authkey)
+        self.address: Tuple[str, int] = (host, port)
+        self._stop = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rpc-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError, Exception):  # noqa: BLE001
+                if self._stop:
+                    return
+                continue
+            # daemon threads, never joined — don't retain references
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="rpc-conn").start()
+
+    def _serve_conn(self, conn):
+        ctx: dict = {}
+        try:
+            while not self._stop:
+                msg = conn.recv()
+                try:
+                    reply = ("ok", self._handler(msg, ctx))
+                except BaseException as e:  # noqa: BLE001
+                    try:
+                        reply = ("exc", e)
+                    except Exception:  # unpicklable exception
+                        reply = ("exc", RemoteError(repr(e)))
+                conn.send(reply)
+        except (EOFError, OSError):
+            pass
+        finally:
+            on_close = ctx.get("on_close")
+            if on_close is not None:
+                try:
+                    on_close()
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def close(self):
+        self._stop = True
+        try:
+            self._listener.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class RpcClient:
+    """Pooled client to one RpcServer address.
+
+    Thread-safe: each call checks out a connection (creating one if the pool
+    is dry), does one request/response round trip, and returns it.
+    """
+
+    def __init__(self, address: Tuple[str, int], authkey: bytes,
+                 connect_timeout: float = 10.0):
+        self.address = tuple(address)
+        self._authkey = authkey
+        self._timeout = connect_timeout
+        self._pool: List[Any] = []
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _connect(self):
+        deadline = time.monotonic() + self._timeout
+        delay = 0.02
+        while True:
+            try:
+                return _MpClient(self.address, authkey=self._authkey)
+            except (ConnectionRefusedError, OSError) as e:
+                if time.monotonic() >= deadline:
+                    raise RpcError(
+                        f"cannot connect to {self.address}: {e}") from e
+                time.sleep(delay)
+                delay = min(delay * 2, 0.25)
+
+    def call(self, msg: Any) -> Any:
+        if self._closed:
+            raise RpcError("client closed")
+        with self._lock:
+            conn = self._pool.pop() if self._pool else None
+        if conn is None:
+            conn = self._connect()
+        try:
+            conn.send(msg)
+            tag, value = conn.recv()
+        except (EOFError, OSError, BrokenPipeError) as e:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            raise RpcError(f"rpc to {self.address} failed: {e}") from e
+        with self._lock:
+            if self._closed:
+                conn.close()
+            else:
+                self._pool.append(conn)
+        if tag == "exc":
+            raise value
+        return value
+
+    def try_call(self, msg: Any, default=None):
+        """call() that swallows transport errors (for best-effort releases)."""
+        try:
+            return self.call(msg)
+        except RpcError:
+            return default
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class ClientCache:
+    """Process-wide cache of RpcClients keyed by address."""
+
+    def __init__(self, authkey: bytes):
+        self._authkey = authkey
+        self._clients = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: Tuple[str, int]) -> RpcClient:
+        address = tuple(address)
+        with self._lock:
+            c = self._clients.get(address)
+            if c is None:
+                c = self._clients[address] = RpcClient(address, self._authkey)
+            return c
+
+    def drop(self, address: Tuple[str, int]):
+        with self._lock:
+            c = self._clients.pop(tuple(address), None)
+        if c is not None:
+            c.close()
+
+    def close_all(self):
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()
+
+
+def cluster_authkey() -> bytes:
+    """The cluster session authkey (hex in RTPU_CLUSTER_AUTHKEY).
+
+    There is deliberately no default: the transport deserializes pickles,
+    so a well-known key would hand any local user code execution in the
+    cluster processes. Every launcher (Cluster fixture, CLI) generates a
+    random key and passes it via the environment."""
+    key = os.environ.get("RTPU_CLUSTER_AUTHKEY")
+    if key:
+        return bytes.fromhex(key)
+    raise RuntimeError(
+        "RTPU_CLUSTER_AUTHKEY is not set. Generate one (e.g. "
+        "`python -c \"import os; print(os.urandom(16).hex())\"`) and export "
+        "it identically in every cluster process.")

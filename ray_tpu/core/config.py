@@ -64,6 +64,20 @@ _FLAGS: List[Flag] = [
          "How long wait_for_workers waits for the pool to come up."),
     Flag("worker_shutdown_grace_s", float, 2.0,
          "Grace period for workers to exit at shutdown before SIGKILL."),
+    # ---- cluster plane ---------------------------------------------------
+    Flag("gcs_heartbeat_interval_s", float, 0.2,
+         "Node -> GCS heartbeat period (reference: "
+         "raylet_report_resources_period_milliseconds)."),
+    Flag("gcs_heartbeat_timeout_s", float, 3.0,
+         "A node missing heartbeats for this long is marked DEAD "
+         "(reference: health_check_timeout_ms, "
+         "gcs_health_check_manager.h)."),
+    Flag("cluster_view_refresh_s", float, 0.25,
+         "Driver-side cluster view (node table + loads) max staleness "
+         "before re-fetching from the GCS."),
+    Flag("object_fetch_chunk_bytes", int, 8 << 20,
+         "Chunk size for node-to-node object transfers (reference: "
+         "object_manager chunk_size)."),
     # ---- chaos / testing -------------------------------------------------
     Flag("testing_rpc_delay_ms", int, 0,
          "If > 0, injects a uniform random delay up to this many ms into "

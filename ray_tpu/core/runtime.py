@@ -911,8 +911,12 @@ class Runtime:
         return self._create_actor_from_payload(cls_fn_id, args_payload, deps, opts)
 
     def _create_actor_from_payload(self, cls_fn_id: bytes, args_payload,
-                                   deps: List[ObjectID], opts: dict) -> ActorID:
-        actor_id = ActorID.from_random()
+                                   deps: List[ObjectID], opts: dict,
+                                   actor_id: Optional[ActorID] = None
+                                   ) -> ActorID:
+        # A caller-specified id lets the cluster layer recreate a restarted
+        # actor under its original identity on a different node.
+        actor_id = actor_id or ActorID.from_random()
         state = _ActorState(actor_id, cls_fn_id, args_payload, deps, opts)
         state.request, state.pg_wire = self._prepare_request(opts, is_actor=True)
         if self._spec_pg_removed(state):

@@ -1,0 +1,281 @@
+"""Multi-node cluster tests: GCS, cross-node scheduling, object transfer,
+actors, PGs, spillback, and node-failure survival.
+
+Reference test model: python/ray/tests/test_multi_node*.py and
+cluster_utils.Cluster-based suites.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import runtime_context
+from ray_tpu.core.cluster.fixture import Cluster
+from ray_tpu.core.cluster.gcs import GcsServer
+from ray_tpu.core.cluster.rpc import RpcClient
+from ray_tpu.exceptions import ObjectLostError
+
+
+# --------------------------------------------------------------------- GCS
+
+
+def test_gcs_registry_heartbeat_and_death():
+    gcs = GcsServer(authkey=b"k")
+    try:
+        c = RpcClient(gcs.address, b"k")
+        assert c.call(("ping",)) == "pong"
+        c.call(("register_node", b"n1", ("127.0.0.1", 1), {"CPU": 2}, {}, {}))
+        c.call(("register_node", b"n2", ("127.0.0.1", 2), {"CPU": 4}, {}, {}))
+        assert c.call(("wait_nodes", 2, 1.0))
+        view = c.call(("list_nodes", True))
+        assert len(view["nodes"]) == 2
+
+        # kv
+        c.call(("kv", "put", "a/b", 42))
+        assert c.call(("kv", "get", "a/b")) == 42
+        assert c.call(("kv", "keys", "a/")) == ["a/b"]
+
+        # object directory: blocking loc_get
+        t0 = time.monotonic()
+        assert c.call(("loc_get", b"obj1", 0.2)) == []
+        assert time.monotonic() - t0 >= 0.2
+        c.call(("loc_add", b"obj1", ("127.0.0.1", 1)))
+        assert c.call(("loc_get", b"obj1", 0.0)) == [("127.0.0.1", 1)]
+
+        # death: n2 stops heartbeating -> DEAD within timeout; its object
+        # locations are dropped
+        c.call(("loc_add", b"obj2", ("127.0.0.1", 2)))
+        from ray_tpu.core.config import config
+        deadline = time.monotonic() + config.gcs_heartbeat_timeout_s + 2
+        while time.monotonic() < deadline:
+            c.call(("heartbeat", b"n1", {"CPU": 2}, 0))
+            nodes = {n["node_id"]: n["state"]
+                     for n in c.call(("list_nodes", False))["nodes"]}
+            if nodes[b"n2"] == "DEAD":
+                break
+            time.sleep(0.1)
+        assert nodes[b"n2"] == "DEAD"
+        assert nodes[b"n1"] == "ALIVE"
+        assert c.call(("loc_get", b"obj2", 0.0)) == []
+        deaths = c.call(("deaths_since", 0))
+        assert [nid for _, nid in deaths] == [b"n2"]
+        c.close()
+    finally:
+        gcs.close()
+
+
+# ----------------------------------------------------------- cluster basics
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    prev_core = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=3, num_workers_per_node=2,
+                node_resources=[{"res0": 4}, {"res1": 4}, {"res2": 4}])
+    c.wait_for_nodes(3)
+    c.connect()
+    yield c
+    c.shutdown()
+    runtime_context.set_core(prev_core)
+
+
+def _node_pid():
+    return os.getppid()
+
+
+def test_cluster_tasks_schedule_across_nodes(cluster):
+    @ray_tpu.remote
+    def who():
+        return os.getppid()
+
+    # pin one task per node via its unique resource
+    pids = {}
+    for i in range(3):
+        ref = who.options(resources={f"res{i}": 1}).remote()
+        pids[i] = ray_tpu.get(ref, timeout=60)
+    node_pids = {n.proc.pid for n in cluster.nodes}
+    assert set(pids.values()) == node_pids
+
+
+def test_cluster_cross_node_object_transfer(cluster):
+    import numpy as np
+
+    @ray_tpu.remote
+    def produce():
+        import numpy as np
+        return np.arange(200_000, dtype=np.int64)
+
+    @ray_tpu.remote
+    def consume(arr):
+        return int(arr.sum())
+
+    # produce on node 0, consume on node 2 (the arg must travel node->node)
+    ref = produce.options(resources={"res0": 1}).remote()
+    total = ray_tpu.get(
+        consume.options(resources={"res2": 1}).remote(ref), timeout=60)
+    assert total == int(np.arange(200_000, dtype=np.int64).sum())
+
+
+def test_cluster_put_get_and_wait(cluster):
+    refs = [ray_tpu.put(i * 11) for i in range(5)]
+    assert ray_tpu.get(refs) == [0, 11, 22, 33, 44]
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(x)
+        return x
+
+    r_fast = slow.options(resources={"res1": 1}).remote(0.05)
+    r_slow = slow.options(resources={"res2": 1}).remote(5.0)
+    ready, rest = ray_tpu.wait([r_fast, r_slow], num_returns=1, timeout=30)
+    assert ready == [r_fast] and rest == [r_slow]
+
+
+def test_cluster_actor_cross_node_calls(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+            self.pid = os.getppid()
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def where(self):
+            return self.pid
+
+    # place the actor on node 1
+    c = Counter.options(resources={"res1": 1}, name="ctr").remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.where.remote(), timeout=30) == cluster.nodes[1].proc.pid
+
+    # a task on node 2 calls the actor on node 1 through its handle
+    @ray_tpu.remote
+    def poke(h):
+        return ray_tpu.get(h.incr.remote(), timeout=30)
+
+    assert ray_tpu.get(
+        poke.options(resources={"res2": 1}).remote(c), timeout=60) == 2
+
+    # named-actor lookup from the driver
+    h = ray_tpu.get_actor("ctr")
+    assert ray_tpu.get(h.incr.remote(), timeout=30) == 3
+
+
+def test_cluster_placement_group_spread(cluster):
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=30)
+
+    @ray_tpu.remote
+    def who():
+        return os.getppid()
+
+    pids = set()
+    for i in range(3):
+        ref = who.options(
+            scheduling_strategy=("pg", pg.id.binary(), i)).remote()
+        pids.add(ray_tpu.get(ref, timeout=60))
+    assert pids == {n.proc.pid for n in cluster.nodes}
+    remove_placement_group(pg)
+
+
+def test_cluster_spillback_from_worker_submission(cluster):
+    # a worker on node 0 submits a task needing res2 (only node 2 has it):
+    # the node-0 scheduler must spill it to node 2
+    @ray_tpu.remote
+    def inner():
+        return os.getppid()
+
+    @ray_tpu.remote
+    def outer():
+        ref = inner.options(resources={"res2": 1}).remote()
+        return ray_tpu.get(ref, timeout=60)
+
+    pid = ray_tpu.get(
+        outer.options(resources={"res0": 1}).remote(), timeout=90)
+    assert pid == cluster.nodes[2].proc.pid
+
+
+def test_cluster_kv(cluster):
+    core = runtime_context.get_core()
+    core.kv_op("put", "shared", {"x": 1})
+    assert core.kv_op("get", "shared") == {"x": 1}
+
+
+# ------------------------------------------------------------ node failure
+
+
+def test_cluster_remove_node_survival():
+    prev_core = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=3, num_workers_per_node=2,
+                node_resources=[{"ra": 4}, {"rb": 4}, {"rc": 4}])
+    try:
+        c.wait_for_nodes(3)
+        core = c.connect()
+
+        @ray_tpu.remote
+        def who():
+            return os.getppid()
+
+        @ray_tpu.remote
+        class Sticky:
+            def __init__(self):
+                self.v = "alive"
+
+            def ping(self):
+                return self.v
+
+        # object + restartable actor on the doomed node
+        doomed_ref = who.options(resources={"rc": 1}).remote()
+        ray_tpu.wait([doomed_ref], num_returns=1, timeout=60)
+        a = Sticky.options(resources={"CPU": 0.01}, max_restarts=2,
+                           scheduling_strategy=None).remote()
+        # pin actor to doomed node via resource
+        b = Sticky.options(resources={"rc": 0.1}, max_restarts=2).remote()
+        assert ray_tpu.get(b.ping.remote(), timeout=60) == "alive"
+
+        victim = c.nodes[2]
+        c.remove_node(victim, graceful=False)
+
+        # cluster keeps scheduling on surviving nodes
+        surviving = {n.proc.pid for n in c.nodes}
+        pids = {ray_tpu.get(who.options(resources={"ra": 1}).remote(),
+                            timeout=60),
+                ray_tpu.get(who.options(resources={"rb": 1}).remote(),
+                            timeout=60)}
+        assert pids == surviving
+
+        # the dead node's object is lost (no lineage yet -> ObjectLostError;
+        # GetTimeoutError is accepted when the GCS hasn't timed the node out
+        # yet at get() time)
+        from ray_tpu.exceptions import GetTimeoutError
+        with pytest.raises((ObjectLostError, GetTimeoutError)):
+            ray_tpu.get(doomed_ref, timeout=10)
+
+        # a replacement node with the same resource joins; the restartable
+        # actor's pending restart lands on it
+        c.add_node(resources={"rc": 4})
+        c.wait_for_nodes(3)
+        deadline = time.monotonic() + 90
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                if ray_tpu.get(b.ping.remote(), timeout=10) == "alive":
+                    ok = True
+                    break
+            except Exception:
+                time.sleep(0.5)
+        assert ok, "actor did not restart on the replacement node"
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "alive"
+    finally:
+        c.shutdown()
+        runtime_context.set_core(prev_core)
