@@ -70,6 +70,14 @@ class ClusterCore:
         self._fn_cache: Dict[int, Tuple[bytes, Any]] = {}
         self._shipped: Dict[Tuple[str, int], set] = {}
         self._ref_node: Dict[bytes, Tuple[str, int]] = {}
+        # lineage: first-return-id -> resubmittable task description, for
+        # reconstructing objects lost to node death (reference:
+        # object_recovery_manager.h:41). Keyed per return id.
+        # insertion-ordered; evicted oldest-first under the byte budget
+        from collections import OrderedDict
+        self._lineage: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._lineage_bytes = 0
+        self._reconstructions: Dict[bytes, int] = {}
         self._actor_node: Dict[ActorID, Tuple[str, int]] = {}
         self._actor_opts: Dict[ActorID, dict] = {}
         self._actor_spec: Dict[ActorID, tuple] = {}  # for restart
@@ -186,8 +194,9 @@ class ClusterCore:
         addr = self._pick_node_strict(opts, is_actor=True)
         client = self._nodes.get(addr)
         pickled = self._ship_fn(addr, cls_fn_id)
+        opts_local = self._localize_pg(opts, addr)
         client.call(("create_actor", cls_fn_id, pickled, payload,
-                     deps, opts, None, actor_id.binary()))
+                     deps, opts_local, None, actor_id.binary()))
         self._mark_shipped(addr, cls_fn_id)
         with self._lock:
             self._actor_node[actor_id] = addr
@@ -302,20 +311,45 @@ class ClusterCore:
         args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
         payload, nested = protocol.serialize_args(args2, kwargs2, store=None)
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
-        addr = self._pick_node(options, is_actor=False)
-        options2 = self._localize_pg(options, addr)
-        pickled_fn = self._ship_fn(addr, fn_id)
         locations = {d.binary(): self._ref_node.get(d.binary())
                      for d in deps}
         locations = {k: v for k, v in locations.items() if v is not None}
-        self._nodes.get(addr).call(
-            ("submit", fn_id, pickled_fn, payload,
-             [d.binary() for d in deps], [r.binary() for r in nested],
-             [r.binary() for r in return_ids], options2, locations))
+        msg_tail = ([d.binary() for d in deps],
+                    [r.binary() for r in nested],
+                    [r.binary() for r in return_ids])
+        tried: List[Tuple[str, int]] = []
+        while True:
+            addr = self._pick_node(options, is_actor=False, exclude=tried)
+            options2 = self._localize_pg(options, addr)
+            pickled_fn = self._ship_fn(addr, fn_id)
+            try:
+                self._nodes.get(addr).call(
+                    ("submit", fn_id, pickled_fn, payload, *msg_tail,
+                     options2, locations))
+                break
+            except RpcError:
+                # stale view: the node died but isn't marked DEAD yet
+                tried.append(addr)
+                if len(tried) >= 4:
+                    raise
+                self._cluster_view(force=True)
         self._mark_shipped(addr, fn_id)
+        lineage = (fn_id, payload, [d.binary() for d in deps],
+                   [r.binary() for r in nested],
+                   [r.binary() for r in return_ids], options)
+        cost = len(payload[1]) if payload[0] == "inline" else 64
         with self._lock:
             for rid in return_ids:
                 self._ref_node[rid.binary()] = addr
+                self._lineage[rid.binary()] = lineage
+            self._lineage_bytes += cost
+            # byte-budgeted lineage (reference evicts lineage the same way:
+            # max_lineage_bytes); oldest entries lose reconstructability
+            while (self._lineage_bytes > config.lineage_max_bytes
+                   and self._lineage):
+                _, old = self._lineage.popitem(last=False)
+                self._lineage_bytes -= (len(old[1][1])
+                                        if old[1][0] == "inline" else 64)
         return [ObjectRef(rid, core=self) for rid in return_ids]
 
     def _swap_top_level_refs(self, args, kwargs):
@@ -364,7 +398,16 @@ class ClusterCore:
                 payloads = self._nodes.get(addr).call(
                     ("get", oids, timeout, allow_shm))
                 for b, payload in payloads.items():
-                    out[b] = self._decode(payload)
+                    try:
+                        out[b] = self._decode(payload)
+                    except Exception:  # noqa: BLE001
+                        if payload[0] != "shm":
+                            raise
+                        # shm fast path raced a spill: re-request the
+                        # materialized bytes over RPC
+                        p2 = self._nodes.get(addr).call(
+                            ("get", [b], timeout, False))
+                        out[b] = self._decode(p2[b])
             except RpcError:
                 # node died: any other location? (GCS directory)
                 for b in oids:
@@ -410,9 +453,64 @@ class ClusterCore:
                 with self._lock:
                     self._ref_node[oid_b] = tuple(addr)
                 return self._decode(data)
+        # no surviving copy: reconstruct through lineage by resubmitting the
+        # creating task (recursively reconstructing lost deps first)
+        if self._reconstruct(oid_b):
+            payloads = self._nodes.get(self._ref_node[oid_b]).call(
+                ("get", [oid_b], timeout, False))
+            return self._decode(payloads[oid_b])
         raise ObjectLostError(
-            f"object {oid_b.hex()} is lost (owner node died and no other "
-            f"copy exists)")
+            f"object {oid_b.hex()} is lost (owner node died, no other copy "
+            f"exists, and no lineage is available to reconstruct it)")
+
+    def _reconstruct(self, oid_b: bytes, depth: int = 0) -> bool:
+        """Resubmit the creating task of a lost object. Returns True when a
+        resubmission was issued (the object will materialize on the new
+        node). Bounded per object by max_reconstructions."""
+        if depth > 10:
+            return False
+        lineage = self._lineage.get(oid_b)
+        if lineage is None:
+            return False
+        n = self._reconstructions.get(oid_b, 0)
+        if n >= config.max_reconstructions:
+            return False
+        fn_id, payload, deps_b, nested_b, return_ids_b, options = lineage
+        # deps that are lost themselves get reconstructed first
+        for dep_b in deps_b:
+            if not self.gcs.call(("loc_get", dep_b, 0.0)):
+                if not self._reconstruct(dep_b, depth + 1):
+                    return False
+        # the cluster view can lag node death by a heartbeat timeout;
+        # fail over across candidate nodes
+        tried: List[Tuple[str, int]] = []
+        for _ in range(4):
+            try:
+                addr = self._pick_node(dict(options or {}), is_actor=False,
+                                       exclude=tried)
+            except RuntimeError:
+                return False
+            pickled_fn = self._ship_fn(addr, fn_id)
+            options2 = self._localize_pg(dict(options or {}), addr) \
+                if (options or {}).get("scheduling_strategy") \
+                else dict(options or {})
+            try:
+                self._nodes.get(addr).call(
+                    ("submit", fn_id, pickled_fn, payload, deps_b, nested_b,
+                     return_ids_b, options2, None))
+                break
+            except RpcError:
+                tried.append(addr)
+                self._cluster_view(force=True)
+        else:
+            return False
+        self._mark_shipped(addr, fn_id)
+        with self._lock:
+            for rid_b in return_ids_b:
+                self._ref_node[rid_b] = addr
+                self._reconstructions[rid_b] = (
+                    self._reconstructions.get(rid_b, 0) + 1)
+        return True
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None):
@@ -508,7 +606,9 @@ class ClusterCore:
         with self._lock:
             self._actor_node[actor_id] = addr
             self._actor_opts[actor_id] = opts.get("method_opts", {})
-            self._actor_spec[actor_id] = (cls_fn_id, payload, dep_b, opts2)
+            # keep the ORIGINAL opts (cluster-level PG strategy): restart
+            # re-localizes against whichever node it lands on
+            self._actor_spec[actor_id] = (cls_fn_id, payload, dep_b, opts)
         return actor_id
 
     def _actor_addr(self, actor_id: ActorID) -> Tuple[str, int]:

@@ -42,6 +42,10 @@ def materialize(runtime: Runtime, payload) -> Tuple[str, bytes]:
     kind, data = payload
     if kind == "inline":
         return payload
+    if kind == "spilled":
+        path = data[0] if isinstance(data, tuple) else data
+        with open(path, "rb") as f:
+            return ("inline", f.read())
     oid = ObjectID(data)
     view = runtime.store.get(oid, timeout_ms=0)
     try:
@@ -55,7 +59,8 @@ def store_incoming(runtime: Runtime, oid: ObjectID, data: bytes):
     """Store wire bytes locally: shm when large, inline entry otherwise."""
     if len(data) > serialization.inline_threshold() and not runtime.store.contains(oid):
         try:
-            runtime.store.put(oid, data)
+            # retain: _store_payload adopts the ref as the tracking pin
+            runtime.store.put(oid, data, retain=True)
             runtime._store_payload(oid, ("shm", oid.binary()))
             return
         except Exception:  # noqa: BLE001 — store full: keep inline
@@ -339,10 +344,11 @@ class NodeServer:
                         store_incoming(rt, oid, data[1])
                         return
                 if time.monotonic() > deadline:
-                    rt._store_payload(oid, protocol.serialize_value(
-                        protocol.ErrorValue(ObjectLostError(
-                            f"object {oid} could not be fetched from any "
-                            f"node")), store=None))
+                    # Give up WITHOUT storing an error: the producer may
+                    # simply be slow (a >10min task), and a stored error
+                    # would latch the entry and get published as a bogus
+                    # location. Waiters time out on their own; a later get
+                    # restarts the fetch.
                     return
                 time.sleep(0.05)
         finally:
@@ -561,7 +567,13 @@ class NodeServer:
         for b in oid_bytes_list:
             oid = ObjectID(b)
             with rt._lock:
-                rt._objects.pop(oid, None)
+                e = rt._objects.pop(oid, None)
+            if (e is not None and e.payload is not None
+                    and e.payload[0] == "spilled"):
+                try:
+                    os.unlink(e.payload[1][0])
+                except OSError:
+                    pass
             try:
                 rt.store.delete(oid)
             except Exception:  # noqa: BLE001

@@ -79,11 +79,17 @@ class RpcServer:
                 try:
                     reply = ("ok", self._handler(msg, ctx))
                 except BaseException as e:  # noqa: BLE001
-                    try:
-                        reply = ("exc", e)
-                    except Exception:  # unpicklable exception
-                        reply = ("exc", RemoteError(repr(e)))
-                conn.send(reply)
+                    reply = ("exc", e)
+                try:
+                    conn.send(reply)
+                except (EOFError, OSError):
+                    raise
+                except Exception:  # noqa: BLE001 — unpicklable payload/exc:
+                    # degrade to a picklable error instead of killing the
+                    # connection (which clients would misread as node death)
+                    conn.send(("exc", RemoteError(
+                        f"unpicklable {'error' if reply[0] == 'exc' else 'reply'}: "
+                        f"{reply[1]!r}")))
         except (EOFError, OSError):
             pass
         finally:

@@ -64,6 +64,23 @@ _FLAGS: List[Flag] = [
          "How long wait_for_workers waits for the pool to come up."),
     Flag("worker_shutdown_grace_s", float, 2.0,
          "Grace period for workers to exit at shutdown before SIGKILL."),
+    # ---- fault tolerance -------------------------------------------------
+    Flag("task_max_retries", int, 3,
+         "Default retry budget for tasks whose worker died mid-execution "
+         "(reference: max_retries / task_retry_delay_ms, "
+         "src/ray/core_worker/task_manager.h). Application exceptions are "
+         "not retried."),
+    Flag("max_reconstructions", int, 3,
+         "How many times the driver resubmits a task to reconstruct a "
+         "lost object before giving up (reference: "
+         "object_recovery_manager.h)."),
+    Flag("spill_dir", str, "/tmp/ray_tpu_spill",
+         "Directory for objects spilled to disk under store memory "
+         "pressure (reference: object_spilling_config)."),
+    Flag("lineage_max_bytes", int, 256 << 20,
+         "Byte budget for the driver's lineage table (serialized task "
+         "descriptions kept for object reconstruction); oldest entries "
+         "are evicted past it (reference: max_lineage_bytes)."),
     # ---- cluster plane ---------------------------------------------------
     Flag("gcs_heartbeat_interval_s", float, 0.2,
          "Node -> GCS heartbeat period (reference: "
@@ -75,9 +92,6 @@ _FLAGS: List[Flag] = [
     Flag("cluster_view_refresh_s", float, 0.25,
          "Driver-side cluster view (node table + loads) max staleness "
          "before re-fetching from the GCS."),
-    Flag("object_fetch_chunk_bytes", int, 8 << 20,
-         "Chunk size for node-to-node object transfers (reference: "
-         "object_manager chunk_size)."),
     # ---- chaos / testing -------------------------------------------------
     Flag("testing_rpc_delay_ms", int, 0,
          "If > 0, injects a uniform random delay up to this many ms into "

@@ -441,6 +441,24 @@ int rtpu_store_seal(void* handle, const uint8_t* id) {
   return 0;
 }
 
+// Seal keeping the creator reference (refcount stays >= 1). Used for the
+// owner-handoff protocol: a task-return/put container is born referenced,
+// and the owner process adopts that reference as its tracking pin — there
+// is never a refcount==0 window in which the LRU could evict a live object.
+int rtpu_store_seal_retain(void* handle, const uint8_t* id) {
+  auto* s = static_cast<Store*>(handle);
+  lock(s);
+  Slot* slot = find_slot(s, id, false);
+  if (!slot || slot->state != SLOT_CREATED) {
+    unlock(s);
+    return -1;
+  }
+  slot->state = SLOT_SEALED;
+  pthread_cond_broadcast(&s->hdr->seal_cond);
+  unlock(s);
+  return 0;
+}
+
 // Get: waits up to timeout_ms for the object to exist+seal. On success fills
 // offset/size, bumps refcount (pinning it against eviction), returns 0.
 // Returns -1 on timeout.
@@ -489,6 +507,17 @@ int rtpu_store_release(void* handle, const uint8_t* id) {
   if (slot->refcount == 0 && slot->state == SLOT_SEALED) lru_push_back(s, slot);
   unlock(s);
   return 0;
+}
+
+// Current refcount of an object (-1 if absent). Lets the owner identify
+// objects only it references (safe to spill/delete: refcount == its pins).
+int64_t rtpu_store_refcount(void* handle, const uint8_t* id) {
+  auto* s = static_cast<Store*>(handle);
+  lock(s);
+  Slot* slot = find_slot(s, id, false);
+  int64_t r = slot ? slot->refcount : -1;
+  unlock(s);
+  return r;
 }
 
 int rtpu_store_contains(void* handle, const uint8_t* id) {

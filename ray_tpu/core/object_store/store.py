@@ -35,6 +35,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.rtpu_store_create_object.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
     lib.rtpu_store_seal.restype = ctypes.c_int
     lib.rtpu_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_seal_retain.restype = ctypes.c_int
+    lib.rtpu_store_seal_retain.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rtpu_store_get.restype = ctypes.c_int
     lib.rtpu_store_get.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
@@ -48,6 +50,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.rtpu_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rtpu_store_stats.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 4
     lib.rtpu_store_prefault.argtypes = [ctypes.c_void_p]
+    lib.rtpu_store_refcount.restype = ctypes.c_int64
+    lib.rtpu_store_refcount.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     return lib
 
 
@@ -68,6 +72,11 @@ class ShmObjectStore:
         self._name = name
         self._handle = handle
         self._owner = owner
+        # Optional backpressure hook: called with a byte count when an
+        # allocation fails; returns True if space may have been freed
+        # (spilling). The runtime installs its spill manager here; workers
+        # install an RPC to the owner.
+        self.need_space_hook = None
         lib = _get_lib()
         size = lib.rtpu_store_mapping_size(handle)
         base = lib.rtpu_store_base(handle)
@@ -123,16 +132,21 @@ class ShmObjectStore:
             )
         return self._mv[off : off + size]
 
-    def seal(self, oid: ObjectID):
-        if _get_lib().rtpu_store_seal(self._h(), oid.binary()) != 0:
+    def seal(self, oid: ObjectID, retain: bool = False):
+        """Seal an object. With ``retain`` the creator reference is kept
+        (refcount >= 1) for handoff to the owner's tracking pin — there is
+        never an evictable refcount==0 window for a live object."""
+        fn = (_get_lib().rtpu_store_seal_retain if retain
+              else _get_lib().rtpu_store_seal)
+        if fn(self._h(), oid.binary()) != 0:
             raise ValueError(f"seal failed for {oid}")
 
-    def put(self, oid: ObjectID, data) -> None:
+    def put(self, oid: ObjectID, data, retain: bool = False) -> None:
         """Allocate + copy + seal in one call."""
         view = memoryview(data).cast("B")
         dst = self.create_object(oid, view.nbytes)
         dst[:] = view
-        self.seal(oid)
+        self.seal(oid, retain=retain)
 
     def get(self, oid: ObjectID, timeout_ms: int = -1) -> memoryview:
         """Blocking get; returns a zero-copy read view, pinning the object.
@@ -157,6 +171,22 @@ class ShmObjectStore:
 
     def contains(self, oid: ObjectID) -> bool:
         return bool(_get_lib().rtpu_store_contains(self._h(), oid.binary()))
+
+    def refcount(self, oid: ObjectID) -> int:
+        """Current refcount (-1 if absent)."""
+        return int(_get_lib().rtpu_store_refcount(self._h(), oid.binary()))
+
+    def create_object_with_pressure(self, oid: ObjectID, size: int
+                                    ) -> memoryview:
+        """create_object, invoking the need_space hook and retrying once
+        when the store is full."""
+        try:
+            return self.create_object(oid, size)
+        except ObjectStoreFullError:
+            hook = self.need_space_hook
+            if hook is None or not hook(size):
+                raise
+            return self.create_object(oid, size)
 
     def delete(self, oid: ObjectID):
         _get_lib().rtpu_store_delete(self._h(), oid.binary())

@@ -49,6 +49,7 @@ REQ_CREATE_ACTOR = "create_actor_req"  # (.., fn_id, pickled_cls_or_none, args_p
 REQ_PG = "pg"                      # (REQ_PG, op, *args) -> ("ok", result); op in create/remove/ready_ref/wait/chips/table
 REQ_GET_ACTOR = "get_actor"        # (REQ_GET_ACTOR, name) -> ("ok", handle_payload)
 REQ_CANCEL = "cancel"              # (REQ_CANCEL, oid_bytes, force) -> ("ok",)
+REQ_NEED_SPACE = "need_space"      # (REQ_NEED_SPACE, nbytes) -> ("ok", freed_bool)
 
 class ErrorValue:
     """Marker wrapping an exception stored as an object's value.
@@ -114,15 +115,30 @@ def _store_or_inline(pickled, views, total, store) -> Payload:
     if store is not None and total > serialization.inline_threshold():
         oid = ObjectID.from_random()
         try:
-            dst = store.create_object(oid, total)
+            # invokes the store's need_space hook (spilling) when full;
+            # retain-seal hands the creator ref to the owner's tracking pin
+            dst = store.create_object_with_pressure(oid, total)
             serialization.write_container(dst, pickled, views)
-            store.seal(oid)
+            store.seal(oid, retain=True)
             return ("shm", oid.binary())
         except Exception:
             pass  # fall back to inline on store pressure
     out = bytearray(total)
     serialization.write_container(memoryview(out), pickled, views)
     return ("inline", bytes(out))
+
+
+def spilled_unpack(path_and_size) -> Any:
+    """Decode a payload spilled to local disk (reference: external_storage
+    restore, python/ray/_private/external_storage.py). The file holds the
+    same container format as a shm object; mmap it so large tensors stay
+    file-backed until touched."""
+    import mmap as _mmap
+
+    path = path_and_size[0] if isinstance(path_and_size, tuple) else path_and_size
+    with open(path, "rb") as f:
+        mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+    return serialization.unpack(memoryview(mm))
 
 
 class _Pin:
@@ -199,4 +215,6 @@ def deserialize_payload(payload: Payload, store=None) -> Any:
         return serialization.unpack(data)
     if kind == "shm":
         return shm_unpack(store, ObjectID(data))
+    if kind == "spilled":
+        return spilled_unpack(data)
     raise ValueError(f"unknown payload kind {kind!r}")

@@ -27,6 +27,7 @@ from multiprocessing.connection import Listener
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol, serialization
+from ray_tpu.core.config import config
 from ray_tpu.core.ids import (
     ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID,
     make_task_id,
@@ -61,6 +62,7 @@ class _TaskSpec:
         "task_id", "fn_id", "args_payload", "deps", "return_ids", "options",
         "actor_id", "method", "pending_deps", "request", "pg_wire",
         "acquired_bundle", "blocked_released", "nested_deps", "cancelled",
+        "retries_left", "args_pinned", "dep_pins",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -85,6 +87,13 @@ class _TaskSpec:
         # ship alone — batched behind it, its producer could never run.
         self.nested_deps: List = []
         self.cancelled = False
+        # Worker-crash retry budget (reference: max_retries,
+        # src/ray/core_worker/task_manager.h:208); resolved at enqueue.
+        self.retries_left: Optional[int] = None
+        self.args_pinned = False
+        # Real store refs taken at dispatch on shm dep containers, so spill
+        # can never pull a dep out from under a worker mid-read.
+        self.dep_pins: List[bytes] = []
 
 
 class _Worker:
@@ -162,9 +171,20 @@ class Runtime:
             "/" + self._session,
             object_store_memory or default_store_capacity(),
         )
+        self.store.need_space_hook = self._try_free_space
+        self._spill_dir = os.path.join(config.spill_dir, self._session)
 
         self._lock = threading.Lock()
         self._objects: Dict[ObjectID, _ObjectEntry] = {}
+        # Memory management: the runtime pins every tracked shm container so
+        # the LRU can never evict a live object out from under a ref; under
+        # pressure, cold pinned containers are spilled to disk instead
+        # (reference: local_object_manager.h spilling + pinning).
+        self._spill_lock = threading.Lock()
+        self._pinned: Dict[bytes, int] = {}       # container oid -> access seq
+        self._pin_seq = 0
+        self._args_pins: Dict[bytes, int] = {}    # in-flight args refcounts
+        self._spilled_bytes = 0
         self._functions: Dict[bytes, bytes] = {}  # fn_id -> pickled
         self._fn_cache: Dict[int, Tuple[bytes, bytes]] = {}  # id(fn) -> (fn_id, pickled)
         self._workers: Dict[WorkerID, _Worker] = {}
@@ -316,10 +336,16 @@ class Runtime:
             # Results flush per task, so inflight = not-yet-completed, in
             # dispatch order. Only the head task can have been executing
             # when the process died; the rest never started and are safe to
-            # requeue on another worker (at-least-once, like the reference's
-            # task retries).
+            # requeue on another worker. The head itself is retried while
+            # its max_retries budget lasts (reference: task_manager.h
+            # retries apply to system failures, not app exceptions).
             if actor_id is None:
-                fail, requeue = inflight[:1], inflight[1:]
+                head = inflight[0]
+                if head.retries_left and not head.cancelled:
+                    head.retries_left -= 1
+                    fail, requeue = [], inflight
+                else:
+                    fail, requeue = inflight[:1], inflight[1:]
             else:
                 fail, requeue = inflight, []
             err = WorkerCrashedError(
@@ -330,9 +356,21 @@ class Runtime:
             fail = fail + [s for s in requeue if s.cancelled]
             requeue = [s for s in requeue if not s.cancelled]
             with self._lock:
-                for spec in fail:
+                for spec in fail + requeue:
+                    # requeued specs re-acquire at dispatch; holding their
+                    # old grant would double-count
+                    had_request = spec.request is not None
                     self._release_spec_locked(spec)
+                    if spec in requeue and had_request:
+                        # release nulls the request; rebuild it so dispatch
+                        # re-acquires instead of running unaccounted
+                        spec.request, spec.pg_wire = self._prepare_request(
+                            spec.options, is_actor=False)
+            for spec in fail + requeue:
+                # dispatch-time dep pins are re-taken at the next dispatch
+                self._release_spec_deps(spec)
             for spec in fail:
+                self._release_spec_args(spec)
                 self._store_error(
                     spec.return_ids,
                     TaskCancelledError("task was cancelled")
@@ -401,8 +439,118 @@ class Runtime:
             e.payload = payload
             e.event.set()
             callbacks, e.callbacks = e.callbacks, []
+        # Pin tracked shm containers against LRU eviction (spill handles
+        # pressure). Only self-named containers (container id == entry id)
+        # are spill candidates; that is every put/task-return container.
+        if payload[0] == "shm" and payload[1] == oid.binary():
+            self._pin_container(payload[1])
         for cb in callbacks:
             cb()
+
+    # ------------------------------------------------------ pinning + spill
+
+    def _pin_container(self, oid_b: bytes):
+        """Adopt the retained creator reference of a container as this
+        owner's tracking pin (the handoff protocol: every task-return/put
+        container is sealed with retain=True, so it arrives refcount>=1 and
+        there is never an evictable window)."""
+        with self._spill_lock:
+            self._pin_seq += 1
+            self._pinned[oid_b] = self._pin_seq  # insert or LRU-touch
+
+    def _pin_args(self, oid_b: bytes):
+        """Adopt the retained ref of an args container for a task's flight
+        time (refcounted: actor restarts re-pin the same container)."""
+        with self._spill_lock:
+            n = self._args_pins.get(oid_b, 0)
+            self._args_pins[oid_b] = n + 1
+        if n:
+            # extra pins beyond the adopted creator ref take a real one
+            try:
+                self.store.get(ObjectID(oid_b), timeout_ms=0)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _unpin_args(self, oid_b: bytes, delete: bool = True):
+        # Symmetric with _pin_args: every pin holds one ref (the first
+        # adopts the retained creator ref, later ones took real refs), so
+        # every unpin releases one; the last also deletes.
+        with self._spill_lock:
+            n = self._args_pins.get(oid_b, 0) - 1
+            if n > 0:
+                self._args_pins[oid_b] = n
+            else:
+                self._args_pins.pop(oid_b, None)
+        oid = ObjectID(oid_b)
+        try:
+            self.store.release(oid)
+            if n <= 0 and delete:
+                self.store.delete(oid)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _pin_spec_args(self, spec: _TaskSpec):
+        p = spec.args_payload
+        if p is not None and p[0] == "shm" and not spec.args_pinned:
+            spec.args_pinned = True
+            self._pin_args(p[1])
+
+    def _release_spec_args(self, spec: _TaskSpec):
+        # Only task/actor-CALL specs pass through here; actor CREATION
+        # payloads live in _ActorState (kept pinned for restarts).
+        p = spec.args_payload
+        if spec.args_pinned and p is not None and p[0] == "shm":
+            spec.args_pinned = False
+            self._unpin_args(p[1])
+
+    def _try_free_space(self, nbytes: int) -> bool:
+        """Spill cold tracked containers to disk until ``nbytes`` are freed.
+        Called by the store's pressure hook (driver-side) and by workers via
+        REQ_NEED_SPACE. Returns True when anything was spilled."""
+        with self._spill_lock:
+            candidates = sorted(self._pinned.items(), key=lambda kv: kv[1])
+        freed = 0
+        for oid_b, _ in candidates:
+            if freed >= nbytes:
+                break
+            freed += self._spill_one(oid_b)
+        return freed > 0
+
+    def _spill_one(self, oid_b: bytes) -> int:
+        oid = ObjectID(oid_b)
+        # Safe to spill only when our tracking pin is the sole reference —
+        # a reader's zero-copy view must never lose its backing pages.
+        if self.store.refcount(oid) != 1:
+            return 0
+        try:
+            view = self.store.get(oid, timeout_ms=0)
+        except Exception:  # noqa: BLE001
+            return 0
+        try:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir, oid.hex())
+            with open(path, "wb") as f:
+                f.write(view)
+            size = view.nbytes
+        finally:
+            del view
+            try:
+                self.store.release(oid)  # the read pin just taken
+            except Exception:  # noqa: BLE001
+                pass
+        with self._lock:
+            e = self._objects.get(oid)
+            if e is not None and e.payload == ("shm", oid_b):
+                e.payload = ("spilled", (path, size))
+        with self._spill_lock:
+            self._pinned.pop(oid_b, None)
+            self._spilled_bytes += size
+        try:
+            self.store.release(oid)  # the tracking pin
+            self.store.delete(oid)
+        except Exception:  # noqa: BLE001
+            pass
+        return size
 
     def _store_error(self, oids: List[ObjectID], err: BaseException):
         payload = protocol.serialize_value(protocol.ErrorValue(err), store=None)
@@ -447,6 +595,11 @@ class Runtime:
             self._store_error(spec.return_ids, PlacementGroupError(
                 "placement group was removed"))
             return
+        if spec.retries_left is None:
+            spec.retries_left = (0 if spec.actor_id is not None else
+                                 int(spec.options.get("max_retries",
+                                                      config.task_max_retries)))
+        self._pin_spec_args(spec)
         unresolved = []
         for dep in spec.deps:
             e = self._entry(dep)
@@ -718,23 +871,44 @@ class Runtime:
         if spec is not None:
             self._send_actor_call(w, spec)
 
-    def _inline_values_for(self, deps: List[ObjectID]) -> Dict[bytes, Any]:
+    def _inline_values_for(self, deps: List[ObjectID],
+                           spec: Optional[_TaskSpec] = None
+                           ) -> Dict[bytes, Any]:
         out: Dict[bytes, Any] = {}
         for dep in deps:
             e = self._objects[dep]
             kind, data = e.payload
-            if kind == "inline":
-                out[dep.binary()] = e.payload
-            else:
+            if kind == "shm":
                 out[dep.binary()] = None  # worker reads shm directly
+                # Pin the container for the task's flight time: with only
+                # the tracking pin, spill could delete it between dispatch
+                # and the worker's shm read.
+                if spec is not None:
+                    try:
+                        self.store.get(ObjectID(data), timeout_ms=0)
+                        spec.dep_pins.append(data)
+                    except Exception:  # noqa: BLE001
+                        pass
+            else:
+                # inline and spilled payload descriptors travel in-message
+                # (the worker opens spill files itself — same host)
+                out[dep.binary()] = e.payload
         return out
+
+    def _release_spec_deps(self, spec: _TaskSpec):
+        pins, spec.dep_pins = spec.dep_pins, []
+        for oid_b in pins:
+            try:
+                self.store.release(ObjectID(oid_b))
+            except Exception:  # noqa: BLE001
+                pass
 
     def _send_task_batch(self, w: _Worker, batch: List[_TaskSpec]):
         try:
             entries = []
             for spec in batch:
                 self._ensure_fn_on_worker(w, spec.fn_id)
-                inline_values = self._inline_values_for(spec.deps)
+                inline_values = self._inline_values_for(spec.deps, spec)
                 entries.append((
                     spec.task_id.binary(), spec.fn_id, spec.args_payload,
                     inline_values, [r.binary() for r in spec.return_ids],
@@ -745,7 +919,7 @@ class Runtime:
 
     def _send_actor_call(self, w: _Worker, spec: _TaskSpec):
         try:
-            inline_values = self._inline_values_for(spec.deps)
+            inline_values = self._inline_values_for(spec.deps, spec)
             self._send_msg(w, (
                 protocol.MSG_ACTOR_CALL, spec.task_id.binary(),
                 spec.actor_id.binary(), spec.method, spec.args_payload,
@@ -760,6 +934,8 @@ class Runtime:
             if spec is not None:
                 self._release_spec_locked(spec)
         if spec is not None:
+            self._release_spec_args(spec)
+            self._release_spec_deps(spec)
             if spec.cancelled:
                 # cancel() was promised while the task sat batched behind
                 # the worker's head task; honor it even though the task ran.
@@ -778,6 +954,8 @@ class Runtime:
             if spec is not None:
                 self._release_spec_locked(spec)
         if spec is not None:
+            self._release_spec_args(spec)
+            self._release_spec_deps(spec)
             if spec.cancelled:
                 # SIGINT-interrupted execution surfaces as a cancellation,
                 # not as the raw KeyboardInterrupt TaskError.
@@ -836,6 +1014,8 @@ class Runtime:
         kind, data = e.payload
         if kind == "inline":
             return serialization.unpack(data)
+        if kind == "spilled":
+            return protocol.spilled_unpack(data)
         return protocol.shm_unpack(self.store, ObjectID(data))
 
     def put_object(self, value: Any) -> ObjectRef:
@@ -1451,6 +1631,8 @@ class Runtime:
             finally:
                 self._unmark_worker_blocked(w, cur_task)
             return ("ok", payloads)
+        if tag == protocol.REQ_NEED_SPACE:
+            return ("ok", self._try_free_space(msg[1]))
         if tag == protocol.REQ_PUT_META:
             _, oid_bytes, payload = msg
             oid = ObjectID(oid_bytes)
@@ -1617,5 +1799,8 @@ class Runtime:
         except OSError:
             pass
         self.store.close()
+        import shutil
+
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
         if runtime_context.get_core_or_none() is self:
             runtime_context.set_core(None)

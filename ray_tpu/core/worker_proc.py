@@ -40,6 +40,11 @@ class WorkerCore:
         self.task_conn = task_conn
         self.data_conn = data_conn
         self.store = store
+        if store is not None:
+            # Store-full backpressure: ask the owner to spill cold objects
+            # (only the owner knows which containers are safe to spill).
+            store.need_space_hook = (
+                lambda n: self._request(protocol.REQ_NEED_SPACE, n)[1])
         self.node_id = node_id
         self.worker_id = worker_id
         self.current_task_id: Optional[TaskID] = None
@@ -293,9 +298,10 @@ class WorkerCore:
             and total > serialization.inline_threshold()
         ):
             try:
-                dst = self.store.create_object(rid, total)
+                dst = self.store.create_object_with_pressure(rid, total)
                 serialization.write_container(dst, pickled, views)
-                self.store.seal(rid)
+                # retain: the ref is adopted by the owner's tracking pin
+                self.store.seal(rid, retain=True)
                 return ("shm", rid.binary())
             except Exception:
                 pass
