@@ -156,6 +156,56 @@ def bench_flash_numerics():
     return err
 
 
+def bench_serve_ttft(n_requests: int = 16):
+    """Serve LLM engine on the chip: p50 TTFT + decode throughput.
+
+    Drives the continuous-batching engine directly (the TPU lives in this
+    process; Serve's router/replica layers add only IPC, measured by the
+    actor-call rows). BASELINE.json names 'Serve p50 TTFT' as a north-star
+    metric with no published reference number — this establishes it."""
+    import jax
+
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    engine = LLMEngine(
+        model_config=({"preset": "llama3_1b_proxy",
+                       "param_dtype": "bfloat16"} if on_tpu
+                      else {"preset": "tiny"}),
+        num_slots=8, max_len=512 if on_tpu else 64,
+        prefill_buckets=[128] if on_tpu else [16],
+        max_new_tokens=64 if on_tpu else 8,
+        chunk_steps=16)
+    import random as _r
+
+    rng = _r.Random(0)
+    prompts = [[rng.randrange(1000) for _ in range(100)]
+               for _ in range(n_requests)]
+    # warmup: pay prefill+decode jit compilation outside the timed window
+    engine.submit("warmup", prompts[0], 2)
+    deadline = time.monotonic() + 600
+    while not engine.collect() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        engine.submit(f"q{i}", p)
+    done = {}
+    deadline = time.monotonic() + 600
+    while len(done) < n_requests and time.monotonic() < deadline:
+        done.update(engine.collect())
+        time.sleep(0.005)
+    wall = time.perf_counter() - t0
+    engine.shutdown()
+    if len(done) < n_requests:
+        raise RuntimeError(f"engine finished {len(done)}/{n_requests}")
+    ttfts = sorted(r["ttft_s"] for r in done.values())
+    total_tokens = sum(len(r["tokens"]) for r in done.values())
+    # median TTFT over ALL requests under load (jit compilation was paid by
+    # the warmup request, outside the timed window)
+    p50 = ttfts[len(ttfts) // 2]
+    return p50 * 1e3, total_tokens / wall
+
+
 # --- ray_perf-style microbenchmarks ------------------------------------------
 
 def _timeit(fn, n: int, warm: int = 1) -> float:
@@ -323,7 +373,17 @@ def main():
             rows.append({"metric": "flash_bwd_grad_max_err_vs_ref",
                          "value": -1, "unit": f"error: {e}"})
 
-    # 2) core microbenchmarks
+    # 2) serve: p50 TTFT + continuous-batched decode throughput on the chip
+    try:
+        ttft_ms, dec_tok_s = bench_serve_ttft()
+        rows.append(_row("serve_ttft_p50_ms", ttft_ms, "ms"))
+        rows.append(_row("serve_decode_tokens_per_sec", dec_tok_s,
+                         "tokens/s"))
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "serve_ttft_p50_ms", "value": -1,
+                     "unit": f"error: {e}"})
+
+    # 3) core microbenchmarks
     try:
         bench_core(rows)
     except Exception as e:  # pragma: no cover

@@ -342,7 +342,8 @@ class ClusterCore:
             for rid in return_ids:
                 self._ref_node[rid.binary()] = addr
                 self._lineage[rid.binary()] = lineage
-            self._lineage_bytes += cost
+                # cost accrues per entry (eviction also subtracts per entry)
+                self._lineage_bytes += cost
             # byte-budgeted lineage (reference evicts lineage the same way:
             # max_lineage_bytes); oldest entries lose reconstructability
             while (self._lineage_bytes > config.lineage_max_bytes

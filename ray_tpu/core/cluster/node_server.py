@@ -80,7 +80,7 @@ class NodeRuntime(Runtime):
     def _store_payload(self, oid, payload):
         super()._store_payload(oid, payload)
         srv = self._server_ref
-        if srv is not None:
+        if srv is not None and oid.binary() not in srv._unpublished:
             srv.note_location(oid.binary())
 
     # Worker-originated requests that need cluster awareness: remote-object
@@ -226,6 +226,9 @@ class NodeServer:
         self._fetch_lock = threading.Lock()
         # return ids a local submission will produce (no fetch needed)
         self._local_products: set = set()
+        # ids whose stored payload must NOT be published as a location
+        # (locally-synthesized error values)
+        self._unpublished: set = set()
 
         # tasks spilled to peers: first-return-id -> peer address
         self._forwarded: Dict[bytes, Tuple[str, int]] = {}
@@ -344,11 +347,19 @@ class NodeServer:
                         store_incoming(rt, oid, data[1])
                         return
                 if time.monotonic() > deadline:
-                    # Give up WITHOUT storing an error: the producer may
-                    # simply be slow (a >10min task), and a stored error
-                    # would latch the entry and get published as a bogus
-                    # location. Waiters time out on their own; a later get
-                    # restarts the fetch.
+                    # Surface ObjectLostError to local waiters (queued
+                    # tasks would otherwise hang forever on the dep) but
+                    # never publish this node as a location for it — the
+                    # error value is local, not the object.
+                    oid_b = oid.binary()
+                    self._unpublished.add(oid_b)
+                    try:
+                        rt._store_payload(oid, protocol.serialize_value(
+                            protocol.ErrorValue(ObjectLostError(
+                                f"object {oid} could not be fetched from "
+                                f"any node within 600s")), store=None))
+                    finally:
+                        self._unpublished.discard(oid_b)
                     return
                 time.sleep(0.05)
         finally:
@@ -574,7 +585,12 @@ class NodeServer:
                     os.unlink(e.payload[1][0])
                 except OSError:
                     pass
+            # drop the owner tracking pin so delete can actually reclaim
+            with rt._spill_lock:
+                had_pin = rt._pinned.pop(b, None) is not None
             try:
+                if had_pin:
+                    rt.store.release(oid)
                 rt.store.delete(oid)
             except Exception:  # noqa: BLE001
                 pass

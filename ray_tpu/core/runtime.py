@@ -43,8 +43,8 @@ from ray_tpu.core.resources import (
     ResourceSet, TpuSliceTopology, node_resources,
 )
 from ray_tpu.exceptions import (
-    ActorDiedError, GetTimeoutError, PlacementGroupError, TaskCancelledError,
-    TaskError, WorkerCrashedError,
+    ActorDiedError, GetTimeoutError, ObjectLostError, PlacementGroupError,
+    TaskCancelledError, TaskError, WorkerCrashedError,
 )
 
 
@@ -369,6 +369,10 @@ class Runtime:
             for spec in fail + requeue:
                 # dispatch-time dep pins are re-taken at the next dispatch
                 self._release_spec_deps(spec)
+                # a worker that sealed a return container (retain=True) but
+                # died before its DONE message flushed leaves a refcount-1
+                # orphan; reclaim it (and clear the id for a retry's write)
+                self._reap_orphan_returns(spec)
             for spec in fail:
                 self._release_spec_args(spec)
                 self._store_error(
@@ -879,16 +883,26 @@ class Runtime:
             e = self._objects[dep]
             kind, data = e.payload
             if kind == "shm":
-                out[dep.binary()] = None  # worker reads shm directly
                 # Pin the container for the task's flight time: with only
                 # the tracking pin, spill could delete it between dispatch
                 # and the worker's shm read.
+                pinned = False
                 if spec is not None:
                     try:
                         self.store.get(ObjectID(data), timeout_ms=0)
                         spec.dep_pins.append(data)
+                        pinned = True
                     except Exception:  # noqa: BLE001
                         pass
+                if spec is not None and not pinned:
+                    # raced a spill: the entry's payload has moved to disk —
+                    # re-read and ship the current descriptor in-message
+                    with self._lock:
+                        refreshed = self._objects[dep].payload
+                    out[dep.binary()] = (None if refreshed[0] == "shm"
+                                         else refreshed)
+                else:
+                    out[dep.binary()] = None  # worker reads shm directly
             else:
                 # inline and spilled payload descriptors travel in-message
                 # (the worker opens spill files itself — same host)
@@ -900,6 +914,28 @@ class Runtime:
         for oid_b in pins:
             try:
                 self.store.release(ObjectID(oid_b))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _reap_orphan_returns(self, spec: _TaskSpec):
+        """Reclaim sealed-but-unreported return containers of a crashed
+        worker (refcount 1 from seal-retain, never adopted). A container
+        the worker only CREATED (died mid-write) still leaks its creator
+        ref — reclaiming that needs dead-process ref accounting in the C
+        store, a narrower window left for a future round."""
+        for rid in spec.return_ids:
+            rid_b = rid.binary()
+            with self._spill_lock:
+                if rid_b in self._pinned:
+                    continue  # adopted: the result actually arrived
+            with self._lock:
+                e = self._objects.get(rid)
+                if e is not None and e.event.is_set():
+                    continue
+            try:
+                if self.store.contains(rid):
+                    self.store.release(rid)
+                    self.store.delete(rid)
             except Exception:  # noqa: BLE001
                 pass
 
@@ -1016,7 +1052,14 @@ class Runtime:
             return serialization.unpack(data)
         if kind == "spilled":
             return protocol.spilled_unpack(data)
-        return protocol.shm_unpack(self.store, ObjectID(data))
+        try:
+            return protocol.shm_unpack(self.store, ObjectID(data))
+        except ObjectLostError:
+            # raced a concurrent spill: the payload may have moved to disk
+            kind2, data2 = e.payload
+            if kind2 == "spilled":
+                return protocol.spilled_unpack(data2)
+            raise
 
     def put_object(self, value: Any) -> ObjectRef:
         payload = protocol.serialize_value(value, store=self.store)
@@ -1097,6 +1140,10 @@ class Runtime:
         # A caller-specified id lets the cluster layer recreate a restarted
         # actor under its original identity on a different node.
         actor_id = actor_id or ActorID.from_random()
+        if args_payload is not None and args_payload[0] == "shm":
+            # adopt the retained creation-args ref for the actor's lifetime
+            # (restarts re-read the payload); released at terminal death
+            self._pin_args(args_payload[1])
         state = _ActorState(actor_id, cls_fn_id, args_payload, deps, opts)
         state.request, state.pg_wire = self._prepare_request(opts, is_actor=True)
         if self._spec_pg_removed(state):
@@ -1200,6 +1247,12 @@ class Runtime:
             except ValueError:
                 pass
         state.creation_event.set()
+        if (state.restarts_left == 0
+                and state.creation_args_payload is not None
+                and state.creation_args_payload[0] == "shm"):
+            # terminal death: the creation-args container is never needed
+            # again — release the adopted ref and free it
+            self._unpin_args(state.creation_args_payload[1])
         err = cause if isinstance(cause, ActorDiedError) else ActorDiedError(str(cause))
         for spec in pending:
             self._store_error(spec.return_ids, err)

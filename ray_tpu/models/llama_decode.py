@@ -1,0 +1,231 @@
+"""KV-cached inference path for the Llama model: prefill + single-token
+decode over a STATIC slot cache.
+
+TPU-first design (none of this is in the reference — it serves via torch):
+the serving cache is a fixed tensor ``[layers, slots, max_len, kv_heads,
+head_dim]``. Every shape is static, so XLA compiles exactly two programs —
+one prefill per bucket size, one decode step — and reuses them for the
+lifetime of the server. Slot admission/eviction is pure bookkeeping on the
+host; no recompilation, no paging gathers (vLLM-style paged KV is a
+GPU-ism; on TPU the win is static shapes feeding the MXU).
+
+Used by serve/llm_engine.py (continuous batching: new sequences join the
+decode batch between steps by prefilling into a free slot).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+
+
+def init_cache(cfg: LlamaConfig, num_slots: int, max_len: int
+               ) -> Dict[str, jax.Array]:
+    hd = cfg.head_dim_
+    shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, hd)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _project_qkv(cfg: LlamaConfig, p, x):
+    """x [b, s, h] -> q [b,s,H,hd], k/v [b,s,KVH,hd] with rope NOT applied."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim_
+    h1 = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+    q = jnp.dot(h1, p["wq"].astype(cfg.dtype),
+                preferred_element_type=jnp.float32).astype(cfg.dtype)
+    k = jnp.dot(h1, p["wk"].astype(cfg.dtype),
+                preferred_element_type=jnp.float32).astype(cfg.dtype)
+    v = jnp.dot(h1, p["wv"].astype(cfg.dtype),
+                preferred_element_type=jnp.float32).astype(cfg.dtype)
+    return (q.reshape(b, s, cfg.num_heads, hd),
+            k.reshape(b, s, cfg.num_kv_heads, hd),
+            v.reshape(b, s, cfg.num_kv_heads, hd), h1)
+
+
+def _mlp(cfg: LlamaConfig, p, x):
+    h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+    return swiglu(h2, p["w_gate"].astype(cfg.dtype),
+                  p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype))
+
+
+def _gqa_repeat(cfg: LlamaConfig, k):
+    """[.., KVH, hd] -> [.., H, hd] by repeating kv heads."""
+    rep = cfg.num_heads // cfg.num_kv_heads
+    if rep == 1:
+        return k
+    return jnp.repeat(k, rep, axis=-2)
+
+
+def prefill(cfg: LlamaConfig, params, tokens: jax.Array
+            ) -> Tuple[jax.Array, Dict[str, jax.Array], jax.Array]:
+    """Run the prompt through the model capturing per-layer K/V.
+
+    tokens: [1, P] (P = padded bucket length).
+    Returns (logits_last [vocab], kv {"k","v": [L, P, KVH, hd]},
+    hidden-unused) — the engine inserts kv into a cache slot and samples
+    the first generated token from logits_last at the true prompt length.
+    """
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    P = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg.head_dim_, P, cfg.rope_theta,
+                                dtype=cfg.dtype)
+
+    def layer(x, p):
+        b, s, _ = x.shape
+        q, k, v, _ = _project_qkv(cfg, p, x)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kf = _gqa_repeat(cfg, k)
+        vf = _gqa_repeat(cfg, v)
+        # causal attention [b, H, s, s] in fp32
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim_))
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+        attn = attn.reshape(b, s, cfg.num_heads * cfg.head_dim_)
+        x = x + jnp.dot(attn, p["wo"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32).astype(cfg.dtype)
+        x = x + _mlp(cfg, p, x)
+        return x, (k[0], v[0])  # [P, KVH, hd]
+
+    x, kv = jax.lax.scan(lambda x_, p_: layer(x_, p_), x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.dot(x[0], head.astype(cfg.dtype),
+                     preferred_element_type=jnp.float32)  # [P, vocab]
+    return logits, {"k": kv[0], "v": kv[1]}, x
+
+
+def insert_sequence(cache: Dict[str, jax.Array], kv: Dict[str, jax.Array],
+                    slot: jax.Array) -> Dict[str, jax.Array]:
+    """Write a prefilled sequence's K/V into cache slot ``slot``.
+    kv arrays: [L, P, KVH, hd]; cache: [L, S, T, KVH, hd]. P <= T."""
+    def write(c, s):
+        # dynamic_update_slice at [0, slot, 0, 0, 0]
+        return jax.lax.dynamic_update_slice(
+            c, s[:, None], (0, slot, 0, 0, 0))
+    return {"k": write(cache["k"], kv["k"]),
+            "v": write(cache["v"], kv["v"])}
+
+
+def decode_step(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
+                tokens: jax.Array, positions: jax.Array,
+                active: jax.Array
+                ) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    """One token for every slot.
+
+    tokens [S] int32 (last sampled token per slot), positions [S] int32
+    (index the new token is written at), active [S] bool.
+    Returns (cache, logits [S, vocab]).
+    """
+    S = tokens.shape[0]
+    T = cache["k"].shape[2]
+    hd = cfg.head_dim_
+    x = params["embed"].astype(cfg.dtype)[tokens][:, None]  # [S, 1, h]
+    cos_t, sin_t = rope_frequencies(hd, T, cfg.rope_theta, dtype=cfg.dtype)
+    pos2 = positions[:, None]  # [S, 1] — per-slot rope positions
+
+    kv_mask = (jnp.arange(T)[None] <= positions[:, None])  # [S, T]
+
+    def layer(carry, inp):
+        x = carry
+        p, ck, cv = inp
+        q, k, v, _ = _project_qkv(cfg, p, x)     # q [S,1,H,hd], k/v [S,1,KVH,hd]
+        q = apply_rope(q, cos_t, sin_t, positions=pos2)
+        k = apply_rope(k, cos_t, sin_t, positions=pos2)
+        # write the new k/v at [slot, position]; masked by `active`, so
+        # inactive slots' cache lines are untouched (no post-pass needed)
+        ck = _scatter_step(ck, k[:, 0], positions, active)  # [S, T, KVH, hd]
+        cv = _scatter_step(cv, v[:, 0], positions, active)
+        kf = _gqa_repeat(cfg, ck)                # [S, T, H, hd]
+        vf = _gqa_repeat(cfg, cv)
+        scores = jnp.einsum("shd,sthd->sht", q[:, 0], kf,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(hd))
+        scores = jnp.where(kv_mask[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("sht,sthd->shd", probs, vf)
+        attn = attn.reshape(S, 1, cfg.num_heads * hd)
+        x = x + jnp.dot(attn, p["wo"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32).astype(cfg.dtype)
+        x = x + _mlp(cfg, p, x)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.dot(x[:, 0], head.astype(cfg.dtype),
+                     preferred_element_type=jnp.float32)  # [S, vocab]
+    return {"k": new_k, "v": new_v}, logits
+
+
+def decode_chunk(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
+                 tokens: jax.Array, positions: jax.Array, active: jax.Array,
+                 num_steps: int
+                 ) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
+    """``num_steps`` greedy decode steps in ONE device program.
+
+    Amortizes host<->device dispatch latency (dominant over a remote
+    tunnel) across many tokens: the greedy argmax feeds back on-device via
+    lax.scan. Returns (cache, out_tokens [num_steps, S], last_positions).
+    Slots keep generating past EOS inside a chunk; the engine truncates
+    host-side (bounded waste of num_steps-1 tokens per finished slot).
+    """
+    def step(carry, _):
+        cache, toks, pos = carry
+        cache, logits = decode_step(cfg, params, cache, toks, pos, active)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, toks)
+        return (cache, nxt, pos + active.astype(jnp.int32)), nxt
+
+    (cache, _, pos), out = jax.lax.scan(
+        step, (cache, tokens, positions), None, length=num_steps)
+    return cache, out, pos
+
+
+def _scatter_step(c, kv_new, positions, active):
+    """c [S, T, KVH, hd]; kv_new [S, KVH, hd]: write at [s, positions[s]]
+    for active slots only."""
+    T = c.shape[1]
+    onehot = (jnp.arange(T)[None] == positions[:, None]) & active[:, None]
+    return jnp.where(onehot[:, :, None, None], kv_new[:, None], c)
+
+
+def make_engine_fns(cfg: LlamaConfig, params, num_slots: int, max_len: int):
+    """Jitted (prefill_fn(tokens), insert_fn(cache, kv, slot),
+    decode_fn(cache, tokens, positions, active)).
+
+    params are passed as jit ARGUMENTS, never closed over: a closure would
+    bake the full weight tensors into the HLO as literal constants and
+    compilation explodes (GBs of literals). cfg is static (frozen
+    dataclass)."""
+    prefill_j = jax.jit(prefill, static_argnums=(0,))
+    insert_j = jax.jit(insert_sequence, donate_argnums=(0,))
+    decode_j = jax.jit(decode_step, static_argnums=(0,),
+                       donate_argnums=(2,))
+    chunk_j = jax.jit(decode_chunk, static_argnums=(0, 6),
+                      donate_argnums=(2,))
+
+    def pre(tokens):
+        return prefill_j(cfg, params, tokens)
+
+    def dec(cache, tokens, positions, active):
+        return decode_j(cfg, params, cache, tokens, positions, active)
+
+    def dec_chunk(cache, tokens, positions, active, num_steps):
+        return chunk_j(cfg, params, cache, tokens, positions, active,
+                       num_steps)
+
+    return pre, insert_j, dec, dec_chunk

@@ -1,0 +1,35 @@
+"""ray_tpu Serve: model serving on the cluster runtime.
+
+Capability analogue of the reference's Serve (python/ray/serve): a
+controller actor reconciles deployments to their target replica counts and
+health-checks them (serve/_private/controller.py:86,
+deployment_state.py:1226); handles route requests with
+power-of-two-choices load balancing (replica_scheduler/pow_2_scheduler.py:
+51); ``@serve.batch``-style dynamic batching happens in the router
+(batching.py:80); a stdlib HTTP proxy exposes deployments over REST
+(proxy.py:1139).
+
+TPU-first difference: LLM replicas run a continuous-batching decode engine
+with STATIC shapes — a fixed set of sequence slots and a preallocated
+per-slot KV cache — because XLA compiles one decode step once and reuses
+it; vLLM-style dynamic paging is a GPU-ism that forces recompilation or
+gather-heavy kernels on TPU (see serve/llm_engine.py).
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    Deployment,
+    DeploymentHandle,
+    batch,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+
+__all__ = [
+    "Deployment", "DeploymentHandle", "batch", "delete", "deployment",
+    "get_deployment_handle", "run", "shutdown", "start", "status",
+]

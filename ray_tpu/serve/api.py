@@ -1,0 +1,194 @@
+"""Serve public API: @serve.deployment, serve.run, handles, @serve.batch.
+
+Reference surface: python/ray/serve/api.py (deployment :280, run :580),
+serve/handle.py (DeploymentHandle), serve/batching.py:80 (@serve.batch).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, get_or_create_controller
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference:
+    serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, fut: Future):
+        self._fut = fut
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        return self._fut.result(timeout)
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    def exception(self, timeout: Optional[float] = None):
+        return self._fut.exception(timeout)
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = self._handle._get_router()
+        return DeploymentResponse(
+            router.call_method(self._method, args, kwargs))
+
+
+class DeploymentHandle:
+    """Client handle to a deployment; routes via a process-local Router."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._router = None
+        self._router_lock = threading.Lock()
+
+    def _get_router(self):
+        with self._router_lock:
+            if self._router is None:
+                from ray_tpu.serve.router import Router
+
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                self._router = Router(controller, self._name)
+            return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return DeploymentResponse(self._get_router().request(args, kwargs))
+
+    def __getattr__(self, method: str) -> _MethodCaller:
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return _MethodCaller(self, method)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name,))
+
+
+class Deployment:
+    """A deployable callable + its config (reference: serve/deployment.py)."""
+
+    def __init__(self, target: Union[type, Callable], name: str,
+                 config: Optional[Dict[str, Any]] = None):
+        self._target = target
+        self.name = name
+        self.config = dict(config or {})
+        self._init_args: tuple = ()
+        self._init_kwargs: dict = {}
+
+    def options(self, **kwargs) -> "Deployment":
+        d = Deployment(self._target, kwargs.pop("name", self.name),
+                       {**self.config, **kwargs})
+        d._init_args, d._init_kwargs = self._init_args, self._init_kwargs
+        return d
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        d = Deployment(self._target, self.name, self.config)
+        d._init_args, d._init_kwargs = args, kwargs
+        return d
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "deployments are not directly callable; use serve.run() and "
+            "handle.remote()")
+
+
+def deployment(_target=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, num_cpus: float = 0.1,
+               num_tpus: float = 0, resources: Optional[dict] = None,
+               max_batch_size: int = 0, batch_wait_timeout_s: float = 0.01,
+               engine: bool = False, **extra):
+    """Decorator: wrap a class or function as a Deployment."""
+    def wrap(target):
+        cfg = {"num_replicas": num_replicas, "num_cpus": num_cpus,
+               "max_batch_size": max_batch_size,
+               "batch_wait_timeout_s": batch_wait_timeout_s,
+               "engine": engine, **extra}
+        if num_tpus:
+            cfg["num_tpus"] = num_tpus
+        if resources:
+            cfg["resources"] = resources
+        return Deployment(target, name or target.__name__, cfg)
+    return wrap(_target) if _target is not None else wrap
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """@serve.batch: mark a callable for router-side dynamic batching.
+    The wrapped fn receives a LIST of inputs and returns a list of outputs
+    (reference: serve/batching.py:80)."""
+    def wrap(fn):
+        fn.__serve_batch__ = {"max_batch_size": max_batch_size,
+                              "batch_wait_timeout_s": batch_wait_timeout_s}
+        return fn
+    return wrap(_fn) if _fn is not None else wrap
+
+
+# ------------------------------------------------------------------ control
+
+
+def start():
+    """Ensure the Serve control plane exists."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    return get_or_create_controller()
+
+
+def run(target: Deployment, name: Optional[str] = None,
+        wait_for_healthy: bool = True, timeout: float = 120.0
+        ) -> DeploymentHandle:
+    """Deploy and return a handle (reference: serve.run, api.py:580)."""
+    import cloudpickle
+
+    controller = start()
+    dep_name = name or target.name
+    cfg = dict(target.config)
+    cfg["init_args"] = target._init_args
+    cfg["init_kwargs"] = target._init_kwargs
+    # honor @serve.batch annotations on the callable
+    fn = target._target
+    marks = getattr(fn, "__serve_batch__", None) or getattr(
+        getattr(fn, "__call__", None), "__serve_batch__", None)
+    if marks and not cfg.get("max_batch_size"):
+        cfg.update(marks)
+    ray_tpu.get(controller.deploy.remote(
+        dep_name, cloudpickle.dumps(fn), cfg), timeout=30)
+    if wait_for_healthy:
+        ok = ray_tpu.get(
+            controller.wait_healthy.remote(dep_name, timeout), timeout=timeout + 10)
+        if not ok:
+            raise TimeoutError(
+                f"deployment {dep_name!r} did not become healthy")
+    return DeploymentHandle(dep_name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Any]:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def delete(name: str):
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=30)
+
+
+def shutdown():
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001
+        pass

@@ -1,0 +1,262 @@
+"""Serve controller: the deployment control plane, as an actor.
+
+Reconciles every deployment's target replica count against running
+replicas and health-checks them from a background thread (reference:
+serve/_private/controller.py:86 run_control_loop, deployment_state.py:1226
+DeploymentState reconcile). Replica actors are created with max_restarts=0
+— the controller itself is the restart FSM, so a dead replica is replaced
+with a fresh one (and routers drop it on first failed call).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+HEALTH_CHECK_PERIOD_S = 1.0
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _ReplicaInfo:
+    __slots__ = ("replica_id", "handle", "state", "last_healthy", "checking")
+
+    def __init__(self, replica_id: str, handle):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.state = "STARTING"
+        self.last_healthy = time.monotonic()
+        self.checking = False
+
+
+class _DeploymentInfo:
+    def __init__(self, name: str, pickled_def: bytes, config: dict):
+        self.name = name
+        self.pickled_def = pickled_def
+        self.config = dict(config)
+        self.target = int(config.get("num_replicas", 1))
+        self.replicas: Dict[str, _ReplicaInfo] = {}
+        self.version = 0
+        self.next_id = 0
+        self.deleting = False
+
+
+class ServeController:
+    """Actor. One per cluster (named actor SERVE_CONTROLLER)."""
+
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentInfo] = {}
+        self._lock = threading.Lock()
+        self._stop = False
+        self._loop = threading.Thread(target=self._control_loop, daemon=True,
+                                      name="serve-controller")
+        self._loop.start()
+
+    # ------------------------------------------------------------------- API
+
+    def deploy(self, name: str, pickled_def: bytes, config: dict) -> None:
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                self._deployments[name] = _DeploymentInfo(
+                    name, pickled_def, config)
+            else:
+                # redeploy: new code/config, replicas are rolled
+                info.pickled_def = pickled_def
+                info.config = dict(config)
+                info.target = int(config.get("num_replicas", 1))
+                info.version += 1
+                info.deleting = False
+                for r in list(info.replicas.values()):
+                    self._stop_replica(info, r)
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is not None:
+                info.deleting = True
+                info.target = 0
+
+    def scale(self, name: str, num_replicas: int) -> None:
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                raise KeyError(f"no deployment {name!r}")
+            info.target = int(num_replicas)
+            info.config["num_replicas"] = int(num_replicas)
+
+    def get_replicas(self, name: str):
+        """(version, [(replica_id, actor_name)]) for router refresh."""
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                return (0, [])
+            return (info.version,
+                    [(r.replica_id, r.handle)
+                     for r in info.replicas.values() if r.state == "RUNNING"])
+
+    def get_deployment_config(self, name: str) -> Optional[dict]:
+        with self._lock:
+            info = self._deployments.get(name)
+            return dict(info.config) if info else None
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "target": info.target,
+                    "running": sum(1 for r in info.replicas.values()
+                                   if r.state == "RUNNING"),
+                    "starting": sum(1 for r in info.replicas.values()
+                                    if r.state == "STARTING"),
+                    "version": info.version,
+                    "deleting": info.deleting,
+                }
+                for name, info in self._deployments.items()
+            }
+
+    def wait_healthy(self, name: str, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                info = self._deployments.get(name)
+                if info is not None:
+                    running = sum(1 for r in info.replicas.values()
+                                  if r.state == "RUNNING")
+                    if running >= info.target:
+                        return True
+            time.sleep(0.05)
+        return False
+
+    def shutdown(self) -> None:
+        self._stop = True
+        with self._lock:
+            for info in self._deployments.values():
+                info.target = 0
+                for r in list(info.replicas.values()):
+                    self._stop_replica(info, r)
+            self._deployments.clear()
+
+    # --------------------------------------------------------- control loop
+
+    def _control_loop(self):
+        while not self._stop:
+            try:
+                self._reconcile()
+                self._health_check()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                pass
+            time.sleep(0.1)
+
+    def _reconcile(self):
+        with self._lock:
+            deployments = list(self._deployments.values())
+        for info in deployments:
+            with self._lock:
+                n = len(info.replicas)
+                deficit = info.target - n
+                surplus = n - info.target
+            for _ in range(max(0, deficit)):
+                self._start_replica(info)
+            if surplus > 0:
+                with self._lock:
+                    victims = list(info.replicas.values())[:surplus]
+                    for v in victims:
+                        self._stop_replica(info, v)
+            if info.deleting and info.target == 0:
+                with self._lock:
+                    if not info.replicas:
+                        self._deployments.pop(info.name, None)
+
+    def _start_replica(self, info: _DeploymentInfo):
+        import cloudpickle
+
+        from ray_tpu.serve.replica import ReplicaActor
+
+        with self._lock:
+            info.next_id += 1
+            replica_id = f"{info.name}#{info.version}.{info.next_id}"
+        opts = {"num_cpus": float(info.config.get("num_cpus", 0.1))}
+        if info.config.get("num_tpus"):
+            opts["num_tpus"] = info.config["num_tpus"]
+        if info.config.get("resources"):
+            opts["resources"] = info.config["resources"]
+        try:
+            handle = ReplicaActor.options(**opts).remote(
+                info.pickled_def,
+                info.config.get("init_args") or (),
+                info.config.get("init_kwargs") or {})
+        except Exception:  # noqa: BLE001 — no capacity yet; retry next tick
+            return
+        rinfo = _ReplicaInfo(replica_id, handle)
+        with self._lock:
+            info.replicas[replica_id] = rinfo
+        # confirm constructor success asynchronously (the control loop must
+        # not block on a slow model load)
+        def confirm():
+            try:
+                ray_tpu.get(handle.ping.remote(), timeout=120)
+                rinfo.state = "RUNNING"
+                rinfo.last_healthy = time.monotonic()
+            except Exception:  # noqa: BLE001
+                with self._lock:
+                    info.replicas.pop(replica_id, None)
+                try:
+                    ray_tpu.kill(handle)
+                except Exception:  # noqa: BLE001
+                    pass
+        threading.Thread(target=confirm, daemon=True).start()
+
+    def _stop_replica(self, info: _DeploymentInfo, r: _ReplicaInfo):
+        info.replicas.pop(r.replica_id, None)
+        try:
+            ray_tpu.kill(r.handle)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _health_check(self):
+        now = time.monotonic()
+        with self._lock:
+            checks = [(info, r) for info in self._deployments.values()
+                      for r in info.replicas.values()
+                      if r.state == "RUNNING"]
+        for info, r in checks:
+            if now - r.last_healthy < HEALTH_CHECK_PERIOD_S or r.checking:
+                continue
+            r.checking = True
+
+            def check(info=info, r=r):
+                try:
+                    ray_tpu.get(r.handle.ping.remote(), timeout=10)
+                    r.last_healthy = time.monotonic()
+                except Exception:  # noqa: BLE001 — dead/stuck: replace it
+                    with self._lock:
+                        info.replicas.pop(r.replica_id, None)
+                    try:
+                        # a stuck-but-alive actor must not keep its
+                        # resource grant after being replaced
+                        ray_tpu.kill(r.handle)
+                    except Exception:  # noqa: BLE001
+                        pass
+                finally:
+                    r.checking = False
+            threading.Thread(target=check, daemon=True).start()
+
+
+def get_or_create_controller():
+    """Driver/worker helper: the controller is a named detached-style actor."""
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001
+        from ray_tpu.api import remote
+
+        cls = remote(num_cpus=0.05, name=CONTROLLER_NAME)(ServeController)
+        try:
+            return cls.remote()
+        except ValueError:
+            # raced another creator
+            return ray_tpu.get_actor(CONTROLLER_NAME)

@@ -1,0 +1,74 @@
+"""HTTP ingress: a stdlib threaded proxy in front of Serve deployments.
+
+Reference: serve/_private/proxy.py:1139 (uvicorn/ASGI there; stdlib
+ThreadingHTTPServer here — no third-party deps). Routes
+``POST /<deployment>`` with a JSON body ``{"args": [...], "kwargs": {}}``
+to the deployment handle and returns the JSON-encoded result.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ray_tpu.serve.api import DeploymentHandle
+
+
+class _Handler(BaseHTTPRequestHandler):
+    handles: Dict[str, DeploymentHandle] = {}
+    timeout_s = 120.0
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_POST(self):
+        name = self.path.strip("/").split("/")[0]
+        handle = self.handles.get(name)
+        if handle is None:
+            handle = self.handles[name] = DeploymentHandle(name)
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            args = tuple(body.get("args", ()))
+            kwargs = dict(body.get("kwargs", {}))
+            result = handle.remote(*args, **kwargs).result(self.timeout_s)
+            payload = json.dumps({"result": result}).encode()
+            self.send_response(200)
+        except Exception as e:  # noqa: BLE001
+            payload = json.dumps({"error": repr(e)}).encode()
+            self.send_response(500)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class HttpProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.address = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+
+
+_proxy: Optional[HttpProxy] = None
+
+
+def start_http(host: str = "127.0.0.1", port: int = 0) -> HttpProxy:
+    global _proxy
+    if _proxy is None:
+        _proxy = HttpProxy(host, port)
+    return _proxy
+
+
+def stop_http():
+    global _proxy
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None

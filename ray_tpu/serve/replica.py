@@ -1,0 +1,71 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference: serve/_private/replica.py. Request concurrency lives in the
+router (dynamic batching, pow-2 balancing); engine-style deployments (LLM
+continuous batching) run their own background thread and expose a
+submit/collect mailbox the router polls — actor calls stay short so the
+replica's queue never blocks behind a long generation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    def __init__(self, pickled_def: bytes, init_args: tuple,
+                 init_kwargs: dict):
+        import cloudpickle
+
+        target = cloudpickle.loads(pickled_def)
+        if isinstance(target, type):
+            self._instance = target(*init_args, **init_kwargs)
+            self._call = getattr(self._instance, "__call__", None)
+        else:
+            self._instance = None
+            self._call = target
+        # engine-style mailbox (LLM continuous batching)
+        self._is_engine = (self._instance is not None
+                           and hasattr(self._instance, "submit")
+                           and hasattr(self._instance, "collect"))
+
+    def ping(self) -> str:
+        return "ok"
+
+    def is_engine(self) -> bool:
+        return self._is_engine
+
+    def handle(self, args: tuple, kwargs: dict) -> Any:
+        return self._call(*args, **kwargs)
+
+    def handle_batch(self, requests: List[tuple]) -> List[Any]:
+        """Dynamic batching: the router flushes a list of (args, kwargs);
+        the deployment's batch callable receives the list of first args
+        (reference @serve.batch semantics: fn(list) -> list)."""
+        items = [a[0] if a else None for a, _ in requests]
+        out = self._call(items)
+        if not isinstance(out, (list, tuple)) or len(out) != len(items):
+            raise ValueError(
+                "@serve.batch callable must return a list of the same "
+                f"length as its input (got {type(out).__name__})")
+        return list(out)
+
+    def call_method(self, method: str, args: tuple, kwargs: dict) -> Any:
+        return getattr(self._instance, method)(*args, **kwargs)
+
+    # ---- engine mailbox ----------------------------------------------------
+
+    def submit(self, req_id: str, *args, **kwargs) -> None:
+        self._instance.submit(req_id, *args, **kwargs)
+
+    def collect(self) -> Dict[str, Any]:
+        """{req_id: result} for finished requests since last collect."""
+        return self._instance.collect()
+
+    def engine_stats(self) -> dict:
+        if hasattr(self._instance, "stats"):
+            return self._instance.stats()
+        return {}

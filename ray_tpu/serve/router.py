@@ -1,0 +1,268 @@
+"""Request router: replica choice, dynamic batching, engine polling.
+
+Power-of-two-choices over router-local in-flight counts (reference:
+serve/_private/replica_scheduler/pow_2_scheduler.py:51 — the reference
+also uses caller-local accounting). Batching buffers requests per
+deployment and flushes on max_batch_size or batch_wait_timeout_s
+(reference: serve/batching.py:80 _BatchQueue).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError
+
+
+class Router:
+    """One per (process, deployment): routes requests to replicas."""
+
+    def __init__(self, controller, name: str):
+        self._controller = controller
+        self._name = name
+        self._lock = threading.Lock()
+        self._replicas: List[Tuple[str, Any]] = []
+        self._inflight: Dict[str, int] = {}
+        self._version = -1
+        self._last_refresh = 0.0
+        cfg = ray_tpu.get(controller.get_deployment_config.remote(name),
+                          timeout=30) or {}
+        self._max_batch = int(cfg.get("max_batch_size", 0))
+        self._batch_wait_s = float(cfg.get("batch_wait_timeout_s", 0.01))
+        self._engine = bool(cfg.get("engine", False))
+        self._pending: List[Tuple[tuple, dict, Future]] = []
+        self._batch_thread: Optional[threading.Thread] = None
+        self._engine_state: Dict[str, Any] = {}
+        self._req_seq = 0
+
+    # ------------------------------------------------------------- replicas
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < 1.0 and self._replicas:
+            return
+        version, replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self._name), timeout=30)
+        with self._lock:
+            self._last_refresh = now
+            self._version = version
+            self._replicas = replicas
+            for rid, _ in replicas:
+                self._inflight.setdefault(rid, 0)
+
+    def _pick(self) -> Tuple[str, Any]:
+        """Power-of-two-choices on local in-flight counts."""
+        deadline = time.monotonic() + 30
+        while True:
+            self._refresh()
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no running replicas for deployment {self._name!r}")
+            time.sleep(0.05)
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        with self._lock:
+            return a if (self._inflight.get(a[0], 0)
+                         <= self._inflight.get(b[0], 0)) else b
+
+    def _drop_replica(self, rid: str):
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r[0] != rid]
+            self._inflight.pop(rid, None)
+
+    # --------------------------------------------------------------- routing
+
+    def request(self, args: tuple, kwargs: dict) -> Future:
+        fut: Future = Future()
+        if self._engine:
+            threading.Thread(target=self._engine_request,
+                             args=(args, kwargs, fut), daemon=True).start()
+        elif self._max_batch > 1:
+            with self._lock:
+                self._pending.append((args, kwargs, fut))
+                if self._batch_thread is None or not self._batch_thread.is_alive():
+                    self._batch_thread = threading.Thread(
+                        target=self._batch_loop, daemon=True)
+                    self._batch_thread.start()
+        else:
+            threading.Thread(target=self._unary_request,
+                             args=(args, kwargs, fut), daemon=True).start()
+        return fut
+
+    def call_method(self, method: str, args: tuple, kwargs: dict) -> Future:
+        fut: Future = Future()
+
+        def run():
+            err: Optional[BaseException] = None
+            for _ in range(3):
+                try:
+                    rid, handle = self._pick()
+                except RuntimeError as e:
+                    fut.set_exception(e)
+                    return
+                with self._lock:
+                    self._inflight[rid] = self._inflight.get(rid, 0) + 1
+                try:
+                    out = ray_tpu.get(
+                        handle.call_method.remote(method, args, kwargs))
+                    fut.set_result(out)
+                    return
+                except ActorDiedError as e:
+                    self._drop_replica(rid)
+                    self._refresh(force=True)
+                    err = e
+                except BaseException as e:  # noqa: BLE001 — app error: no retry
+                    fut.set_exception(e)
+                    return
+                finally:
+                    with self._lock:
+                        if rid in self._inflight:
+                            self._inflight[rid] -= 1
+            fut.set_exception(err or RuntimeError("request failed"))
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def _unary_request(self, args, kwargs, fut: Future):
+        err: Optional[BaseException] = None
+        for _ in range(3):  # retry across replicas on replica death
+            try:
+                rid, handle = self._pick()
+            except RuntimeError as e:
+                fut.set_exception(e)
+                return
+            with self._lock:
+                self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            try:
+                out = ray_tpu.get(handle.handle.remote(args, kwargs))
+                fut.set_result(out)
+                return
+            except ActorDiedError as e:
+                self._drop_replica(rid)
+                self._refresh(force=True)
+                err = e
+            except BaseException as e:  # noqa: BLE001 — application error
+                fut.set_exception(e)
+                return
+            finally:
+                with self._lock:
+                    if rid in self._inflight:
+                        self._inflight[rid] -= 1
+        fut.set_exception(err or RuntimeError("request failed"))
+
+    # -------------------------------------------------------------- batching
+
+    def _batch_loop(self):
+        # Lives for the router's lifetime (daemon): exiting on idle races
+        # request()'s is_alive() check and could strand a request unflushed.
+        while True:
+            time.sleep(self._batch_wait_s)
+            with self._lock:
+                batch, self._pending = (self._pending[:self._max_batch],
+                                        self._pending[self._max_batch:])
+            if batch:
+                self._flush_batch(batch)
+
+    def _flush_batch(self, batch):
+        reqs = [(a, k) for a, k, _ in batch]
+        futs = [f for _, _, f in batch]
+        err: Optional[BaseException] = None
+        for _ in range(3):
+            try:
+                rid, handle = self._pick()
+            except RuntimeError as e:
+                err = e
+                break
+            with self._lock:
+                self._inflight[rid] = self._inflight.get(rid, 0) + len(batch)
+            try:
+                outs = ray_tpu.get(handle.handle_batch.remote(reqs))
+                for f, o in zip(futs, outs):
+                    f.set_result(o)
+                return
+            except ActorDiedError as e:
+                self._drop_replica(rid)
+                self._refresh(force=True)
+                err = e
+            except BaseException as e:  # noqa: BLE001
+                err = e
+                break
+            finally:
+                with self._lock:
+                    if rid in self._inflight:
+                        self._inflight[rid] -= len(batch)
+        for f in futs:
+            f.set_exception(err or RuntimeError("batch failed"))
+
+    # ---------------------------------------------------------------- engine
+
+    def _engine_request(self, args, kwargs, fut: Future):
+        """Submit to an engine replica's mailbox and poll its collect()."""
+        with self._lock:
+            self._req_seq += 1
+            req_id = f"r{id(self)}-{self._req_seq}"
+        try:
+            rid, handle = self._pick()
+        except RuntimeError as e:
+            fut.set_exception(e)
+            return
+        with self._lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            st = self._engine_state.setdefault(rid, {
+                "futures": {}, "poller": None, "handle": handle,
+            })
+            st["futures"][req_id] = fut
+        try:
+            ray_tpu.get(handle.submit.remote(req_id, *args, **kwargs))
+        except BaseException as e:  # noqa: BLE001
+            with self._lock:
+                st["futures"].pop(req_id, None)
+                self._inflight[rid] -= 1
+            fut.set_exception(e)
+            return
+        with self._lock:
+            if st["poller"] is None or not st["poller"].is_alive():
+                st["poller"] = threading.Thread(
+                    target=self._poll_engine, args=(rid, st), daemon=True)
+                st["poller"].start()
+
+    def _poll_engine(self, rid: str, st: dict):
+        handle = st["handle"]
+        while True:
+            with self._lock:
+                if not st["futures"]:
+                    return
+            try:
+                done = ray_tpu.get(handle.collect.remote(), timeout=60)
+            except BaseException as e:  # noqa: BLE001 — replica died
+                with self._lock:
+                    futs = list(st["futures"].values())
+                    st["futures"].clear()
+                self._drop_replica(rid)
+                for f in futs:
+                    f.set_exception(e)
+                return
+            if done:
+                with self._lock:
+                    n = 0
+                    for req_id, result in done.items():
+                        f = st["futures"].pop(req_id, None)
+                        if f is not None:
+                            n += 1
+                            if isinstance(result, Exception):
+                                f.set_exception(result)
+                            else:
+                                f.set_result(result)
+                    self._inflight[rid] = max(
+                        0, self._inflight.get(rid, 0) - n)
+            else:
+                time.sleep(0.003)

@@ -15,6 +15,10 @@ import os
 # we must override through jax.config as well.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# This build's default matmul precision is low (bf16-like passes) even on
+# CPU; numerics tests compare cached-decode vs full-forward paths and need
+# deterministic fp32 matmuls. Inherited by worker subprocesses.
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -27,6 +31,7 @@ if "jax" in sys.modules:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest
 
