@@ -334,6 +334,34 @@ def bench_core(rows: list):
     rows.append(_row("single_client_wait_1k_refs", rate, "waits/s",
                      BASE["single_client_wait_1k_refs"]))
 
+    # compiled-DAG pipeline dispatch latency vs 3 chained actor calls
+    from ray_tpu.dag import compile_pipeline
+
+    @ray_tpu.remote
+    class Id:
+        def step(self, x):
+            return x
+
+    stages = [Id.remote() for _ in range(3)]
+    for a_ in stages:
+        ray_tpu.get(a_.step.remote(0))
+    n = 300
+    t0 = time.perf_counter()
+    for i in range(n):
+        v = i
+        for a_ in stages:
+            v = ray_tpu.get(a_.step.remote(v))
+    actor_lat = (time.perf_counter() - t0) / n
+    dag = compile_pipeline([(a_, "step") for a_ in stages])
+    dag.execute(0)
+    t0 = time.perf_counter()
+    for i in range(n):
+        dag.execute(i)
+    dag_lat = (time.perf_counter() - t0) / n
+    dag.teardown()
+    rows.append(_row("dag_pipeline_latency_us", dag_lat * 1e6, "us"))
+    rows.append(_row("dag_vs_actor_call_speedup", actor_lat / dag_lat, "x"))
+
     # placement group create/remove
     from ray_tpu.util import placement_group, remove_placement_group
 

@@ -229,6 +229,9 @@ class NodeServer:
         # ids whose stored payload must NOT be published as a location
         # (locally-synthesized error values)
         self._unpublished: set = set()
+        # ids latched with a local fetch-timeout error: a later get clears
+        # the entry and retries the fetch (the producer may just be slow)
+        self._lost_marked: set = set()
 
         # tasks spilled to peers: first-return-id -> peer address
         self._forwarded: Dict[bytes, Tuple[str, int]] = {}
@@ -306,6 +309,13 @@ class NodeServer:
             return
         rt = self.runtime
         oid = ObjectID(oid_bytes)
+        if oid_bytes in self._lost_marked:
+            # previously latched a fetch-timeout error: clear the entry so
+            # this get retries the fetch (waiters of the old error already
+            # observed it)
+            self._lost_marked.discard(oid_bytes)
+            with rt._lock:
+                rt._objects.pop(oid, None)
         with rt._lock:
             e = rt._objects.get(oid)
             if e is not None and e.event.is_set():
@@ -353,6 +363,7 @@ class NodeServer:
                     # error value is local, not the object.
                     oid_b = oid.binary()
                     self._unpublished.add(oid_b)
+                    self._lost_marked.add(oid_b)
                     try:
                         rt._store_payload(oid, protocol.serialize_value(
                             protocol.ErrorValue(ObjectLostError(

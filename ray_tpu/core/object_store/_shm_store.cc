@@ -392,6 +392,106 @@ void rtpu_store_prefault(void* handle) {
 
 void rtpu_store_destroy(const char* name) { shm_unlink(name); }
 
+// ---------------------------------------------------------------- channels
+//
+// Seqno-gated mutable channels for compiled-DAG pipelines (capability
+// analogue of the reference's mutable-object channels,
+// src/ray/core_worker/experimental_mutable_object_manager.h). A channel is
+// an ordinary sealed object whose payload starts with a ChanHeader: two
+// monotonically increasing counters (seqno: writer publishes; ack: reader
+// consumed) plus a PER-CHANNEL process-shared mutex+cond, so a post wakes
+// only this channel's peer — never the whole store (a global cond turns a
+// 3-stage pipeline into a context-switch storm on small hosts).
+
+struct ChanHeader {
+  uint64_t ctr[2];  // [0]=seqno, [1]=ack
+  uint64_t len;     // payload length of the current message
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+};
+
+uint64_t rtpu_chan_header_size() { return sizeof(ChanHeader); }
+
+static ChanHeader* chan_at(void* handle, uint64_t offset) {
+  auto* s = static_cast<Store*>(handle);
+  return reinterpret_cast<ChanHeader*>(s->base + offset);
+}
+
+int rtpu_chan_init(void* handle, uint64_t offset) {
+  ChanHeader* c = chan_at(handle, offset);
+  c->ctr[0] = c->ctr[1] = 0;
+  c->len = 0;
+  pthread_mutexattr_t mattr;
+  pthread_mutexattr_init(&mattr);
+  pthread_mutexattr_setpshared(&mattr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&mattr, PTHREAD_MUTEX_ROBUST);
+  if (pthread_mutex_init(&c->mu, &mattr) != 0) return -1;
+  pthread_condattr_t cattr;
+  pthread_condattr_init(&cattr);
+  pthread_condattr_setpshared(&cattr, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&cattr, CLOCK_MONOTONIC);
+  if (pthread_cond_init(&c->cv, &cattr) != 0) return -1;
+  return 0;
+}
+
+static void chan_lock(ChanHeader* c) {
+  if (pthread_mutex_lock(&c->mu) == EOWNERDEAD)
+    pthread_mutex_consistent(&c->mu);
+}
+
+uint64_t rtpu_chan_seqno(void* handle, uint64_t offset, int which) {
+  ChanHeader* c = chan_at(handle, offset);
+  uint64_t v;
+  __atomic_load(&c->ctr[which], &v, __ATOMIC_ACQUIRE);
+  return v;
+}
+
+// Publish: release-store the counter (payload writes become visible
+// before it), then wake this channel's peer.
+void rtpu_chan_post(void* handle, uint64_t offset, int which,
+                    uint64_t value) {
+  ChanHeader* c = chan_at(handle, offset);
+  __atomic_store(&c->ctr[which], &value, __ATOMIC_RELEASE);
+  chan_lock(c);
+  pthread_cond_broadcast(&c->cv);
+  pthread_mutex_unlock(&c->mu);
+}
+
+// Wait until counter `which` exceeds `last`. Returns the observed value,
+// or 0 on timeout (counters start at 1).
+uint64_t rtpu_chan_wait(void* handle, uint64_t offset, int which,
+                        uint64_t last, int timeout_ms) {
+  ChanHeader* c = chan_at(handle, offset);
+  uint64_t v = rtpu_chan_seqno(handle, offset, which);
+  if (v > last) return v;
+  struct timespec deadline;
+  if (timeout_ms > 0) timespec_in(&deadline, timeout_ms);
+  chan_lock(c);
+  for (;;) {
+    v = rtpu_chan_seqno(handle, offset, which);
+    if (v > last) {
+      pthread_mutex_unlock(&c->mu);
+      return v;
+    }
+    if (timeout_ms == 0) {
+      pthread_mutex_unlock(&c->mu);
+      return 0;
+    }
+    // Bounded waits even for timeout<0: a post can slip between the
+    // atomic check and the cond wait; a 50ms re-check caps that stall
+    // (posts under the mutex make it near-impossible, this is a backstop).
+    struct timespec tick;
+    timespec_in(&tick, 50);
+    int rc = pthread_cond_timedwait(&c->cv, &c->mu,
+                                    timeout_ms < 0 ? &tick : &deadline);
+    if (rc == ETIMEDOUT && timeout_ms > 0) {
+      v = rtpu_chan_seqno(handle, offset, which);
+      pthread_mutex_unlock(&c->mu);
+      return v > last ? v : 0;
+    }
+  }
+}
+
 uint8_t* rtpu_store_base(void* handle) { return static_cast<Store*>(handle)->base; }
 uint64_t rtpu_store_mapping_size(void* handle) { return static_cast<Store*>(handle)->size; }
 

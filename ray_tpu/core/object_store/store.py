@@ -52,6 +52,19 @@ def _load_lib() -> ctypes.CDLL:
     lib.rtpu_store_prefault.argtypes = [ctypes.c_void_p]
     lib.rtpu_store_refcount.restype = ctypes.c_int64
     lib.rtpu_store_refcount.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_chan_header_size.restype = ctypes.c_uint64
+    lib.rtpu_chan_header_size.argtypes = []
+    lib.rtpu_chan_init.restype = ctypes.c_int
+    lib.rtpu_chan_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rtpu_chan_seqno.restype = ctypes.c_uint64
+    lib.rtpu_chan_seqno.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                    ctypes.c_int]
+    lib.rtpu_chan_post.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_int, ctypes.c_uint64]
+    lib.rtpu_chan_wait.restype = ctypes.c_uint64
+    lib.rtpu_chan_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_int, ctypes.c_uint64,
+                                   ctypes.c_int]
     return lib
 
 
@@ -194,6 +207,39 @@ class ShmObjectStore:
     def prefault(self):
         """Blocking eager population of the heap (content-preserving)."""
         _get_lib().rtpu_store_prefault(self._h())
+
+    # -- channel primitives (seqno-gated mutable regions; see dag/channel.py)
+
+    def object_offset(self, oid: ObjectID) -> int:
+        """Mapping offset of a sealed object's payload (pins it)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = _get_lib().rtpu_store_get(
+            self._h(), oid.binary(), 0, ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            raise ObjectTimeoutError(f"object {oid} not found")
+        return off.value
+
+    def chan_header_size(self) -> int:
+        return int(_get_lib().rtpu_chan_header_size())
+
+    def chan_init(self, offset: int):
+        if _get_lib().rtpu_chan_init(self._h(), offset) != 0:
+            raise OSError("channel init failed")
+
+    def chan_counter(self, offset: int, which: int) -> int:
+        return int(_get_lib().rtpu_chan_seqno(self._h(), offset, which))
+
+    def chan_post(self, offset: int, which: int, value: int):
+        _get_lib().rtpu_chan_post(self._h(), offset, which, value)
+
+    def chan_wait(self, offset: int, which: int, last: int,
+                  timeout_ms: int) -> int:
+        return int(_get_lib().rtpu_chan_wait(self._h(), offset, which, last,
+                                             timeout_ms))
+
+    def view(self, offset: int, size: int) -> memoryview:
+        return self._mv[offset: offset + size]
 
     def stats(self) -> dict:
         vals = [ctypes.c_uint64() for _ in range(4)]

@@ -283,6 +283,43 @@ class WorkerCore:
                     RuntimeError(repr(exc)), traceback.format_exc())),
                 store=None)
 
+    def _dag_start(self, instance, in_desc, out_desc, method: str) -> str:
+        """Start a compiled-DAG resident loop: read input channel, invoke
+        the bound method, write output channel. Errors are forwarded as
+        ('e', exc) markers so downstream stages pass them through and the
+        driver re-raises (reference: compiled DAG error propagation)."""
+        import threading
+
+        from ray_tpu.dag.channel import Channel, ChannelClosed
+
+        if self.store is None:
+            raise RuntimeError("compiled DAGs require a shm store")
+        inch = Channel.open(self.store, in_desc)
+        outch = Channel.open(self.store, out_desc)
+        fn = getattr(instance, method)
+
+        def loop():
+            while True:
+                try:
+                    tag, value = inch.read(timeout_ms=-1)
+                except ChannelClosed:
+                    outch.close()
+                    return
+                except Exception:  # noqa: BLE001 — store torn down
+                    return
+                if tag == "e":
+                    outch.write(("e", value))
+                    continue
+                try:
+                    out = ("v", fn(value))
+                except BaseException as e:  # noqa: BLE001
+                    out = ("e", e)
+                outch.write(out)
+
+        threading.Thread(target=loop, daemon=True,
+                         name=f"dag-{method}").start()
+        return "ok"
+
     def _send_results(self, task_id_b: bytes, result, num_returns: int,
                       return_id_bytes: List[bytes]):
         values = self._split_returns(result, num_returns)
@@ -369,7 +406,13 @@ class WorkerCore:
         self.current_actor_id = ActorID(actor_id_b)
         try:
             instance = self._actors[actor_id_b]
-            fn = getattr(instance, method)
+            if method == "__rtpu_dag_start__":
+                # compiled-DAG resident loop (ray_tpu/dag): not a method of
+                # the user class — the worker hosts the loop thread
+                fn = lambda in_d, out_d, m: self._dag_start(  # noqa: E731
+                    instance, in_d, out_d, m)
+            else:
+                fn = getattr(instance, method)
             args, kwargs = self._decode_args(args_payload, inline_values)
             result = fn(*args, **kwargs)
             if hasattr(result, "__await__"):

@@ -1,0 +1,13 @@
+"""Compiled DAGs: µs-dispatch static actor pipelines over shm channels.
+
+Capability analogue of the reference's accelerated/compiled DAGs
+(python/ray/dag/compiled_dag_node.py:482) and mutable-object channels
+(python/ray/experimental/channel/shared_memory_channel.py:147): a static
+graph of actor method calls is "compiled" into resident per-actor loops
+connected by seqno-gated mutable shm channels, so a steady-state pipeline
+invocation costs microseconds of shm handoff instead of a scheduler round
+trip per stage. This is the substrate Serve's TP/PP inference path uses.
+"""
+
+from ray_tpu.dag.api import InputNode, bind, compile_pipeline  # noqa: F401
+from ray_tpu.dag.channel import Channel  # noqa: F401

@@ -1,0 +1,140 @@
+"""Compiled-DAG API: static actor pipelines with resident loops.
+
+Usage::
+
+    dag = compile_pipeline([(actor1, "preprocess"), (actor2, "infer")])
+    out = dag.execute(x)     # microsecond-scale dispatch per call
+    dag.teardown()
+
+Each stage's actor starts a resident thread (reference: the compiled DAG's
+per-actor executable loop, python/ray/dag/compiled_dag_node.py:92) reading
+its input channel, invoking the bound method, and writing the output
+channel. Execution never touches the scheduler: values hop through
+seqno-gated shm channels. Stages run in PIPELINE: call N+1 may enter stage
+1 while call N is in stage 2.
+
+Current scope: all actors on the driver's node (channels live in the
+node's shm store); the driver core must own a store (embedded runtime or
+same-host cluster driver).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+import ray_tpu
+from ray_tpu.core import runtime_context
+from ray_tpu.dag.channel import Channel, ChannelClosed
+
+
+class InputNode:
+    """Placeholder for the DAG input (parity with the reference's
+    `with InputNode() as inp:` style)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _BoundStage:
+    __slots__ = ("actor", "method", "upstream")
+
+    def __init__(self, actor, method: str, upstream):
+        self.actor = actor
+        self.method = method
+        self.upstream = upstream
+
+    def experimental_compile(self, capacity: int = 1 << 20
+                             ) -> "CompiledPipeline":
+        """Walk the bind chain back to the InputNode and compile."""
+        stages: List[Tuple[Any, str]] = []
+        node: Any = self
+        while isinstance(node, _BoundStage):
+            stages.append((node.actor, node.method))
+            node = node.upstream
+        if not isinstance(node, InputNode):
+            raise ValueError("pipeline must terminate at an InputNode")
+        stages.reverse()
+        return compile_pipeline(stages, capacity=capacity)
+
+
+def bind(actor, method: str, upstream) -> _BoundStage:
+    """actor.method(upstream) as a DAG node; chain from an InputNode."""
+    return _BoundStage(actor, method, upstream)
+
+
+class CompiledPipeline:
+    def __init__(self, stages: Sequence[Tuple[Any, str]],
+                 capacity: int = 1 << 20):
+        if not stages:
+            raise ValueError("empty pipeline")
+        core = runtime_context.get_core()
+        store = getattr(core, "store", None)
+        if store is None:
+            raise RuntimeError(
+                "compiled DAGs need a driver-side shm store (embedded "
+                "runtime or same-host cluster driver)")
+        self._store = store
+        self._chans = [Channel.create(store, capacity)
+                       for _ in range(len(stages) + 1)]
+        self._lock = threading.Lock()
+        self._down = False
+        # start each stage's resident loop
+        acks = []
+        for i, (actor, method) in enumerate(stages):
+            acks.append(core.submit_actor_task(
+                actor._actor_id if hasattr(actor, "_actor_id") else actor,
+                "__rtpu_dag_start__",
+                (self._chans[i].descriptor(),
+                 self._chans[i + 1].descriptor(), method), {}, 1)[0])
+        for ref in acks:
+            assert ray_tpu.get(ref, timeout=60) == "ok"
+
+    def execute(self, value: Any, timeout_ms: int = 60_000) -> Any:
+        """Synchronous call through the pipeline."""
+        with self._lock:
+            if self._down:
+                raise RuntimeError("pipeline was torn down")
+            self._chans[0].write(("v", value), timeout_ms=timeout_ms)
+            tag, out = self._chans[-1].read(timeout_ms=timeout_ms)
+        if tag == "e":
+            raise out
+        return out
+
+    def execute_async(self, value: Any, timeout_ms: int = 60_000):
+        """Returns a 0-arg callable resolving the result (the next read).
+        Calls resolve in FIFO order; useful to overlap pipeline stages."""
+        with self._lock:
+            self._chans[0].write(("v", value), timeout_ms=timeout_ms)
+
+        def resolve():
+            with self._lock:
+                tag, out = self._chans[-1].read(timeout_ms=timeout_ms)
+            if tag == "e":
+                raise out
+            return out
+        return resolve
+
+    def teardown(self):
+        with self._lock:
+            if self._down:
+                return
+            self._down = True
+            try:
+                self._chans[0].close()
+                # the close sentinel cascades through every stage loop
+                try:
+                    self._chans[-1].read(timeout_ms=5000)
+                except (ChannelClosed, TimeoutError):
+                    pass
+            finally:
+                for ch in self._chans:
+                    ch.release()
+
+
+def compile_pipeline(stages: Sequence[Tuple[Any, str]],
+                     capacity: int = 1 << 20) -> CompiledPipeline:
+    return CompiledPipeline(stages, capacity=capacity)

@@ -1,0 +1,131 @@
+"""Seqno-gated SPSC shm channel.
+
+Layout inside one sealed store object (mutable by convention — the mapping
+is shared read-write, the seal only fixes the allocation)::
+
+    ChanHeader { seqno, ack, len, per-channel pshared mutex+cond }
+    ...  payload (serialized container, <= capacity)
+
+Single writer, single reader. The writer blocks until the previous message
+is acked (rendezvous semantics, like the reference's mutable-object
+channels, python/ray/experimental/channel/shared_memory_channel.py:147);
+the reader blocks on seqno. Per-channel synchronization means a post wakes
+exactly the peer — pipeline hops cost microseconds. Both sides use timed
+waits so a dead peer surfaces as a timeout rather than a deadlock.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID
+
+_SEQ = 0  # counter index: writer publishes
+_ACK = 1  # counter index: reader consumed
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+_CLOSE_LEN = (1 << 64) - 1  # len sentinel marking a closed channel
+
+
+class Channel:
+    """One endpoint of an SPSC channel (create on the writer side, open
+    from a descriptor anywhere attached to the same store)."""
+
+    def __init__(self, store, oid: ObjectID, capacity: int):
+        self._store = store
+        self._oid = oid
+        self._capacity = capacity
+        self._offset = store.object_offset(oid)  # pins the object
+        self._hdr = store.chan_header_size()
+        self._seq = 0   # last seqno this endpoint saw/wrote
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def create(cls, store, capacity: int = 1 << 20) -> "Channel":
+        oid = ObjectID.from_random()
+        hdr = store.chan_header_size()
+        store.create_object(oid, hdr + capacity)
+        store.seal(oid)
+        ch = cls(store, oid, capacity)
+        store.chan_init(ch._offset)
+        return ch
+
+    def descriptor(self) -> Tuple[bytes, int]:
+        """Picklable descriptor; open with Channel.open on any process
+        attached to the same store."""
+        return (self._oid.binary(), self._capacity)
+
+    @classmethod
+    def open(cls, store, desc: Tuple[bytes, int]) -> "Channel":
+        return cls(store, ObjectID(desc[0]), desc[1])
+
+    # -- data plane ----------------------------------------------------------
+
+    def _set_len(self, n: int):
+        struct.pack_into(
+            "<Q", self._store.view(self._offset + 16, 8), 0, n)
+
+    def _get_len(self) -> int:
+        return struct.unpack(
+            "<Q", self._store.view(self._offset + 16, 8))[0]
+
+    def write(self, value: Any, timeout_ms: int = 10_000):
+        """Serialize + publish; blocks until the reader acked the previous
+        message."""
+        pickled, views, total = serialization.serialize(value)
+        if total > self._capacity:
+            raise ValueError(
+                f"channel message ({total}B) exceeds capacity "
+                f"({self._capacity}B)")
+        # overwrite gate: previous message must be consumed
+        if self._seq:
+            acked = self._store.chan_wait(
+                self._offset, _ACK, self._seq - 1, timeout_ms)
+            if acked == 0:
+                raise TimeoutError("channel reader did not ack in time")
+        body = self._store.view(self._offset + self._hdr, total)
+        serialization.write_container(body, pickled, views)
+        self._set_len(total)
+        self._seq += 1
+        self._store.chan_post(self._offset, _SEQ, self._seq)
+
+    def read(self, timeout_ms: int = 10_000) -> Any:
+        """Block for the next message; deserializes a COPY (the slot is
+        acked + reusable immediately after return)."""
+        seq = self._store.chan_wait(self._offset, _SEQ, self._seq,
+                                    timeout_ms)
+        if seq == 0:
+            raise TimeoutError("channel read timed out")
+        self._seq = seq
+        length = self._get_len()
+        if length == _CLOSE_LEN:
+            raise ChannelClosed
+        data = bytes(self._store.view(self._offset + self._hdr, length))
+        value = serialization.unpack(data)
+        # ack: the writer may overwrite now
+        self._store.chan_post(self._offset, _ACK, seq)
+        return value
+
+    def close(self, timeout_ms: int = 5000):
+        """Writer-side: wake the reader with a close sentinel. Respects the
+        ack gate so an unconsumed in-flight message is never clobbered."""
+        if self._seq:
+            # best effort: a dead reader must not make close() hang
+            self._store.chan_wait(self._offset, _ACK, self._seq - 1,
+                                  timeout_ms)
+        self._set_len(_CLOSE_LEN)
+        self._seq += 1
+        self._store.chan_post(self._offset, _SEQ, self._seq)
+
+    def release(self):
+        try:
+            self._store.release(self._oid)
+        except Exception:  # noqa: BLE001
+            pass

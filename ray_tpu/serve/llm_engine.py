@@ -96,9 +96,16 @@ class LLMEngine:
         self._in.put((req_id, list(prompt_tokens),
                       max_new_tokens or self._max_new, time.monotonic()))
 
-    def collect(self) -> Dict[str, Any]:
+    def collect(self, req_ids: Optional[List[str]] = None) -> Dict[str, Any]:
+        """Drain finished requests. With ``req_ids``, only those are
+        removed — other consumers' results stay (multiple routers may poll
+        the same engine)."""
         with self._done_lock:
-            out, self._done = self._done, {}
+            if req_ids is None:
+                out, self._done = self._done, {}
+            else:
+                out = {r: self._done.pop(r) for r in req_ids
+                       if r in self._done}
         return out
 
     def stats(self) -> dict:
@@ -120,14 +127,26 @@ class LLMEngine:
                 req_id, toks, max_new, t0 = self._in.get_nowait()
             except queue.Empty:
                 break
-            if len(toks) >= self._max_len:
-                toks = toks[: self._max_len - 1]
-            slot = self._free.pop()
-            P = _bucket(len(toks), self._buckets)
-            padded = jnp.array([toks + [0] * (P - len(toks))], jnp.int32)
-            logits, kv, _ = self._prefill(padded)
-            self._cache = self._insert(self._cache, kv, jnp.int32(slot))
-            first = int(jnp.argmax(logits[len(toks) - 1]))
+            slot = None
+            try:
+                toks = [int(t) for t in toks]
+                if not toks:
+                    raise ValueError("empty prompt")
+                if len(toks) >= self._max_len:
+                    toks = toks[: self._max_len - 1]
+                slot = self._free.pop()
+                P = _bucket(len(toks), self._buckets)
+                padded = jnp.array([toks + [0] * (P - len(toks))], jnp.int32)
+                logits, kv, _ = self._prefill(padded)
+                self._cache = self._insert(self._cache, kv, jnp.int32(slot))
+                first = int(jnp.argmax(logits[len(toks) - 1]))
+            except Exception as e:  # noqa: BLE001 — fail THIS request only
+                if slot is not None:
+                    self._free.append(slot)
+                with self._done_lock:
+                    self._done[req_id] = ValueError(
+                        f"request rejected: {e!r}")
+                continue
             self._slot_req[slot] = req_id
             self._slot_tokens[slot] = [first]
             self._slot_budget[slot] = max_new
@@ -161,42 +180,59 @@ class LLMEngine:
         jnp = self._jnp
         S = self._num_slots
         while not self._stop:
-            self._admit()
-            active_slots = sorted(self._slot_req)
-            if not active_slots:
-                time.sleep(0.002)
-                continue
-            toks = np.zeros((S,), np.int32)
-            poss = np.zeros((S,), np.int32)
-            act = np.zeros((S,), bool)
-            for s in active_slots:
-                toks[s] = self._slot_tokens[s][-1]
-                poss[s] = self._slot_pos[s]
-                act[s] = True
-            # Chunked decode when no request is waiting to join (admission
-            # happens at chunk boundaries); single step when the queue has
-            # work, to keep TTFT low.
-            k = 1 if not self._in.empty() else self._chunk_steps
-            k = min(k, max(1, self._max_len - 1 - max(
-                self._slot_pos[s] for s in active_slots)))
-            if k > 1:
-                self._cache, out, _ = self._decode_chunk(
-                    self._cache, jnp.asarray(toks), jnp.asarray(poss),
-                    jnp.asarray(act), k)
-                steps_tokens = np.asarray(out)          # [k, S]
-            else:
-                self._cache, logits = self._decode(
-                    self._cache, jnp.asarray(toks), jnp.asarray(poss),
-                    jnp.asarray(act))
-                steps_tokens = np.asarray(
-                    jnp.argmax(logits, axis=-1))[None]  # [1, S]
-            self._steps += steps_tokens.shape[0]
-            for s in active_slots:
-                for step in range(steps_tokens.shape[0]):
-                    tok = int(steps_tokens[step, s])
-                    self._slot_tokens[s].append(tok)
-                    self._slot_pos[s] += 1
-                    if self._slot_pos[s] >= self._max_len - 1:
-                        self._slot_budget[s] = len(self._slot_tokens[s])
-                    if self._maybe_finish(s, tok):
-                        break
+            try:
+                self._tick(np, jnp, S)
+            except Exception as e:  # noqa: BLE001 — fail in-flight, live on
+                failed = list(self._slot_req.items())
+                with self._done_lock:
+                    for slot, req_id in failed:
+                        self._done[req_id] = RuntimeError(
+                            f"engine step failed: {e!r}")
+                for slot, _ in failed:
+                    self._slot_req.pop(slot, None)
+                    for d in (self._slot_tokens, self._slot_budget,
+                              self._slot_pos, self._slot_start,
+                              self._slot_ttft):
+                        d.pop(slot, None)
+                    self._free.append(slot)
+
+    def _tick(self, np, jnp, S):
+        self._admit()
+        active_slots = sorted(self._slot_req)
+        if not active_slots:
+            time.sleep(0.002)
+            return
+        toks = np.zeros((S,), np.int32)
+        poss = np.zeros((S,), np.int32)
+        act = np.zeros((S,), bool)
+        for s in active_slots:
+            toks[s] = self._slot_tokens[s][-1]
+            poss[s] = self._slot_pos[s]
+            act[s] = True
+        # Chunked decode when no request is waiting to join (admission
+        # happens at chunk boundaries); single step when the queue has
+        # work, to keep TTFT low.
+        k = 1 if not self._in.empty() else self._chunk_steps
+        k = min(k, max(1, self._max_len - 1 - max(
+            self._slot_pos[s] for s in active_slots)))
+        if k > 1:
+            self._cache, out, _ = self._decode_chunk(
+                self._cache, jnp.asarray(toks), jnp.asarray(poss),
+                jnp.asarray(act), k)
+            steps_tokens = np.asarray(out)          # [k, S]
+        else:
+            self._cache, logits = self._decode(
+                self._cache, jnp.asarray(toks), jnp.asarray(poss),
+                jnp.asarray(act))
+            steps_tokens = np.asarray(
+                jnp.argmax(logits, axis=-1))[None]  # [1, S]
+        self._steps += steps_tokens.shape[0]
+        for s in active_slots:
+            for step in range(steps_tokens.shape[0]):
+                tok = int(steps_tokens[step, s])
+                self._slot_tokens[s].append(tok)
+                self._slot_pos[s] += 1
+                if self._slot_pos[s] >= self._max_len - 1:
+                    self._slot_budget[s] = len(self._slot_tokens[s])
+                if self._maybe_finish(s, tok):
+                    break

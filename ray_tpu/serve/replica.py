@@ -61,9 +61,12 @@ class ReplicaActor:
     def submit(self, req_id: str, *args, **kwargs) -> None:
         self._instance.submit(req_id, *args, **kwargs)
 
-    def collect(self) -> Dict[str, Any]:
+    def collect(self, req_ids=None) -> Dict[str, Any]:
         """{req_id: result} for finished requests since last collect."""
-        return self._instance.collect()
+        try:
+            return self._instance.collect(req_ids)
+        except TypeError:
+            return self._instance.collect()
 
     def engine_stats(self) -> dict:
         if hasattr(self._instance, "stats"):

@@ -165,7 +165,15 @@ class Router:
         # Lives for the router's lifetime (daemon): exiting on idle races
         # request()'s is_alive() check and could strand a request unflushed.
         while True:
-            time.sleep(self._batch_wait_s)
+            with self._lock:
+                n = len(self._pending)
+            if n >= self._max_batch:
+                pass  # full batch: flush immediately, no added latency
+            elif n > 0:
+                time.sleep(self._batch_wait_s)  # let the batch fill
+            else:
+                time.sleep(min(self._batch_wait_s, 0.002))
+                continue
             with self._lock:
                 batch, self._pending = (self._pending[:self._max_batch],
                                         self._pending[self._max_batch:])
@@ -241,8 +249,11 @@ class Router:
             with self._lock:
                 if not st["futures"]:
                     return
+                mine = list(st["futures"])
             try:
-                done = ray_tpu.get(handle.collect.remote(), timeout=60)
+                # only this router's ids: collect() is destructive and
+                # other handles/processes poll the same engine
+                done = ray_tpu.get(handle.collect.remote(mine), timeout=60)
             except BaseException as e:  # noqa: BLE001 — replica died
                 with self._lock:
                     futs = list(st["futures"].values())
