@@ -429,7 +429,8 @@ int rtpu_chan_init(void* handle, uint64_t offset) {
   pthread_condattr_t cattr;
   pthread_condattr_init(&cattr);
   pthread_condattr_setpshared(&cattr, PTHREAD_PROCESS_SHARED);
-  pthread_condattr_setclock(&cattr, CLOCK_MONOTONIC);
+  // deadlines come from timespec_in (CLOCK_REALTIME); the cond must use
+  // the same clock or timedwait deadlines never fire
   if (pthread_cond_init(&c->cv, &cattr) != 0) return -1;
   return 0;
 }

@@ -299,22 +299,26 @@ class WorkerCore:
         fn = getattr(instance, method)
 
         def loop():
-            while True:
-                try:
-                    tag, value = inch.read(timeout_ms=-1)
-                except ChannelClosed:
-                    outch.close()
-                    return
-                except Exception:  # noqa: BLE001 — store torn down
-                    return
-                if tag == "e":
-                    outch.write(("e", value))
-                    continue
-                try:
-                    out = ("v", fn(value))
-                except BaseException as e:  # noqa: BLE001
-                    out = ("e", e)
-                outch.write(out)
+            try:
+                while True:
+                    try:
+                        tag, value = inch.read(timeout_ms=-1)
+                    except ChannelClosed:
+                        outch.close()
+                        return
+                    except Exception:  # noqa: BLE001 — store torn down
+                        return
+                    if tag == "e":
+                        outch.write(("e", value))
+                        continue
+                    try:
+                        out = ("v", fn(value))
+                    except BaseException as e:  # noqa: BLE001
+                        out = ("e", e)
+                    outch.write(out)
+            finally:
+                inch.release()
+                outch.release()
 
         threading.Thread(target=loop, daemon=True,
                          name=f"dag-{method}").start()

@@ -80,8 +80,13 @@ class CompiledPipeline:
         self._store = store
         self._chans = [Channel.create(store, capacity)
                        for _ in range(len(stages) + 1)]
-        self._lock = threading.Lock()
+        # Separate writer/reader locks: a write blocked on the input
+        # channel's ack gate (pipeline at capacity) must not stop a reader
+        # from draining the output channel — that drain is what unblocks it.
+        self._wlock = threading.Lock()
+        self._rlock = threading.Lock()
         self._down = False
+        self._broken = False
         # start each stage's resident loop
         acks = []
         for i, (actor, method) in enumerate(stages):
@@ -93,13 +98,32 @@ class CompiledPipeline:
         for ref in acks:
             assert ray_tpu.get(ref, timeout=60) == "ok"
 
+    def _check_usable(self):
+        if self._down:
+            raise RuntimeError("pipeline was torn down")
+        if self._broken:
+            raise RuntimeError(
+                "pipeline is broken (a previous call timed out, so the "
+                "request/response pairing is no longer trustworthy); "
+                "teardown and recompile")
+
+    def _read_out(self, timeout_ms: int):
+        """FIFO-ordered output read; a timeout poisons the pipeline — the
+        unconsumed in-flight result would otherwise be returned to the
+        NEXT caller (off-by-one forever)."""
+        try:
+            return self._chans[-1].read(timeout_ms=timeout_ms)
+        except TimeoutError:
+            self._broken = True
+            raise
+
     def execute(self, value: Any, timeout_ms: int = 60_000) -> Any:
         """Synchronous call through the pipeline."""
-        with self._lock:
-            if self._down:
-                raise RuntimeError("pipeline was torn down")
+        with self._wlock:
+            self._check_usable()
             self._chans[0].write(("v", value), timeout_ms=timeout_ms)
-            tag, out = self._chans[-1].read(timeout_ms=timeout_ms)
+        with self._rlock:
+            tag, out = self._read_out(timeout_ms)
         if tag == "e":
             raise out
         return out
@@ -107,32 +131,34 @@ class CompiledPipeline:
     def execute_async(self, value: Any, timeout_ms: int = 60_000):
         """Returns a 0-arg callable resolving the result (the next read).
         Calls resolve in FIFO order; useful to overlap pipeline stages."""
-        with self._lock:
+        with self._wlock:
+            self._check_usable()
             self._chans[0].write(("v", value), timeout_ms=timeout_ms)
 
         def resolve():
-            with self._lock:
-                tag, out = self._chans[-1].read(timeout_ms=timeout_ms)
+            with self._rlock:
+                tag, out = self._read_out(timeout_ms)
             if tag == "e":
                 raise out
             return out
         return resolve
 
     def teardown(self):
-        with self._lock:
+        with self._wlock:
             if self._down:
                 return
             self._down = True
-            try:
-                self._chans[0].close()
-                # the close sentinel cascades through every stage loop
+        try:
+            self._chans[0].close()
+            # the close sentinel cascades through every stage loop
+            with self._rlock:
                 try:
                     self._chans[-1].read(timeout_ms=5000)
                 except (ChannelClosed, TimeoutError):
                     pass
-            finally:
-                for ch in self._chans:
-                    ch.release()
+        finally:
+            for ch in self._chans:
+                ch.release()
 
 
 def compile_pipeline(stages: Sequence[Tuple[Any, str]],

@@ -1,0 +1,13 @@
+"""RLlib-lite: JAX-native reinforcement learning on the cluster runtime.
+
+Capability analogue of the reference's RLlib new API stack
+(rllib/algorithms/algorithm.py:227, rllib/core/learner/learner.py:116,
+rllib/env/env_runner.py:22), re-designed TPU-first: the RLModule is a pure
+function over a jax pytree, the Learner's update is ONE jitted program
+(minibatch loop via lax.scan — no per-minibatch dispatch), and EnvRunners
+are actors collecting vectorized numpy rollouts in parallel.
+"""
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.rl_module import MLPModule  # noqa: F401

@@ -1,0 +1,131 @@
+"""Algorithm base: config + train-iteration driver over the runner gang.
+
+Reference: rllib/algorithms/algorithm.py:227 (Algorithm.train) and
+algorithm_config.py. The driver loop each iteration: broadcast weights ->
+parallel sample() on the EnvRunner gang -> learner.update -> metrics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class AlgorithmConfig:
+    """Fluent config (subset of the reference's AlgorithmConfig)."""
+
+    def __init__(self):
+        self.env_name = "CartPole-v1"
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 8
+        self.rollout_len = 128
+        self.gamma = 0.99
+        self.lr = 3e-4
+        self.train_kwargs: Dict[str, Any] = {}
+        self.module_hidden = (64, 64)
+        self.seed = 0
+
+    def environment(self, env: str) -> "AlgorithmConfig":
+        self.env_name = env
+        return self
+
+    def env_runners(self, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 8,
+                    rollout_fragment_length: int = 128
+                    ) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_len = rollout_fragment_length
+        return self
+
+    def training(self, lr: float = None, gamma: float = None,
+                 **kwargs) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        self.train_kwargs.update(kwargs)
+        return self
+
+    def rl_module(self, hidden=(64, 64)) -> "AlgorithmConfig":
+        self.module_hidden = tuple(hidden)
+        return self
+
+    def debugging(self, seed: int = 0) -> "AlgorithmConfig":
+        self.seed = seed
+        return self
+
+
+class Algorithm:
+    """Drives a learner + an EnvRunner gang. Subclasses build the learner."""
+
+    def __init__(self, config: AlgorithmConfig):
+        from ray_tpu.rllib.env_runner import EnvRunner
+        from ray_tpu.rllib.envs import make_env
+
+        self.config = config
+        probe = make_env(config.env_name, 1)
+        self.module_spec = {"obs_dim": probe.obs_dim,
+                            "num_actions": probe.num_actions,
+                            "hidden": config.module_hidden}
+        self.learner = self._build_learner()
+        self.runners = [
+            EnvRunner.remote(config.env_name, config.num_envs_per_runner,
+                             config.rollout_len, self.module_spec,
+                             gamma=config.gamma,
+                             lam=config.train_kwargs.get("lam", 0.95),
+                             seed=config.seed + 1000 * i)
+            for i in range(config.num_env_runners)
+        ]
+        self.iteration = 0
+        self._recent_returns: List[float] = []
+
+    def _build_learner(self):
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (reference: Algorithm.train())."""
+        t0 = time.perf_counter()
+        weights = self.learner.get_weights()
+        w_ref = ray_tpu.put(weights)
+        batches = ray_tpu.get(
+            [r.sample.remote(w_ref) for r in self.runners], timeout=300)
+        batch = {
+            k: np.concatenate([b[k] for b in batches])
+            for k in batches[0] if k != "episode_returns"
+        }
+        for b in batches:
+            self._recent_returns.extend(b["episode_returns"].tolist())
+        self._recent_returns = self._recent_returns[-100:]
+        # advantage normalization (standard PPO practice)
+        adv = batch["advantages"]
+        batch["advantages"] = ((adv - adv.mean())
+                               / (adv.std() + 1e-8)).astype(np.float32)
+        metrics = self.learner.update(batch)
+        self.iteration += 1
+        mean_ret = (float(np.mean(self._recent_returns))
+                    if self._recent_returns else 0.0)
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled": batch["obs"].shape[0],
+            "time_this_iter_s": time.perf_counter() - t0,
+            **metrics,
+        }
+
+    def evaluate(self, num_episodes: int = 8) -> float:
+        weights = self.learner.get_weights()
+        return float(ray_tpu.get(
+            self.runners[0].evaluate.remote(weights, num_episodes),
+            timeout=120))
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,110 @@
+"""EnvRunner: an actor collecting vectorized rollouts.
+
+Reference: rllib/env/env_runner.py:22 / single_agent_env_runner. The gang
+of runners samples in parallel (one actor each); weights are broadcast as
+numpy pytrees each round. GAE is computed runner-side so the learner batch
+arrives ready.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.envs import make_env
+from ray_tpu.rllib.rl_module import MLPModule
+
+
+@ray_tpu.remote
+class EnvRunner:
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 module_spec: dict, gamma: float = 0.99, lam: float = 0.95,
+                 seed: int = 0):
+        self.env = make_env(env_name, num_envs, seed=seed)
+        self.module = MLPModule(**module_spec)
+        self.rollout_len = rollout_len
+        self.gamma = gamma
+        self.lam = lam
+        self.rng = np.random.default_rng(seed + 1)
+        self.obs = self.env.reset()
+        # episode-return tracking (completed episodes since last sample)
+        self._ep_ret = np.zeros(self.env.n, np.float64)
+        self._completed: list = []
+
+    def sample(self, weights) -> Dict[str, np.ndarray]:
+        """Collect rollout_len vectorized steps; returns a flat GAE batch
+        plus episode stats."""
+        T, N = self.rollout_len, self.env.n
+        obs_buf = np.empty((T, N, self.env.obs_dim), np.float32)
+        act_buf = np.empty((T, N), np.int32)
+        logp_buf = np.empty((T, N), np.float32)
+        val_buf = np.empty((T + 1, N), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        done_buf = np.empty((T, N), bool)
+
+        obs = self.obs
+        for t in range(T):
+            logits, value = self.module.apply_np(weights, obs)
+            # sample from the categorical (gumbel trick, vectorized)
+            g = self.rng.gumbel(size=logits.shape)
+            actions = np.argmax(logits + g, axis=-1)
+            logp = logits - _logsumexp(logits)
+            logp_t = np.take_along_axis(
+                logp, actions[:, None], axis=-1)[:, 0]
+            nxt, rew, done = self.env.step(actions)
+            obs_buf[t], act_buf[t] = obs, actions
+            logp_buf[t], val_buf[t] = logp_t, value
+            rew_buf[t], done_buf[t] = rew, done
+            self._ep_ret += rew
+            if done.any():
+                for i in np.nonzero(done)[0]:
+                    self._completed.append(self._ep_ret[i])
+                    self._ep_ret[i] = 0.0
+            obs = nxt
+        self.obs = obs
+        _, last_value = self.module.apply_np(weights, obs)
+        val_buf[T] = last_value
+
+        # GAE(lambda)
+        adv = np.zeros((T, N), np.float32)
+        gae = np.zeros(N, np.float32)
+        for t in reversed(range(T)):
+            nonterminal = 1.0 - done_buf[t].astype(np.float32)
+            delta = (rew_buf[t] + self.gamma * val_buf[t + 1] * nonterminal
+                     - val_buf[t])
+            gae = delta + self.gamma * self.lam * nonterminal * gae
+            adv[t] = gae
+        ret = adv + val_buf[:T]
+
+        completed, self._completed = self._completed, []
+        return {
+            "obs": obs_buf.reshape(T * N, -1),
+            "actions": act_buf.reshape(-1).astype(np.int32),
+            "logp_old": logp_buf.reshape(-1),
+            "advantages": adv.reshape(-1),
+            "returns": ret.reshape(-1),
+            "episode_returns": np.asarray(completed, np.float64),
+        }
+
+    def evaluate(self, weights, num_episodes: int = 8) -> float:
+        """Mean greedy-policy episode return."""
+        env = make_env(type(self.env).__name__ and "CartPole-v1",
+                       num_episodes, seed=int(self.rng.integers(1 << 30)))
+        obs = env.reset()
+        total = np.zeros(num_episodes, np.float64)
+        finished = np.zeros(num_episodes, bool)
+        for _ in range(env.max_steps + 1):
+            logits, _ = self.module.apply_np(weights, obs)
+            obs, rew, done = env.step(np.argmax(logits, axis=-1))
+            total += rew * (~finished)
+            finished |= done
+            if finished.all():
+                break
+        return float(total.mean())
+
+
+def _logsumexp(x: np.ndarray) -> np.ndarray:
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))

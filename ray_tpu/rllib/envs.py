@@ -1,0 +1,81 @@
+"""Built-in vectorized environments (numpy, no gym dependency).
+
+The reference wraps gymnasium; this image ships no gym, so the standard
+benchmark env is implemented directly. The interface is the vectorized
+subset RLlib's EnvRunner needs: reset() -> obs [N, obs_dim];
+step(actions [N]) -> (obs, reward [N], done [N]).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CartPoleVec:
+    """Classic CartPole-v1 dynamics (Barto-Sutton-Anderson), vectorized.
+
+    Matches the gymnasium implementation's constants: episode ends on
+    |x| > 2.4, |theta| > 12deg, or 500 steps; reward 1 per step. Done envs
+    auto-reset.
+    """
+
+    obs_dim = 4
+    num_actions = 2
+    max_steps = 500
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        self.n = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.state = np.zeros((num_envs, 4), np.float64)
+        self.steps = np.zeros(num_envs, np.int64)
+        self.reset()
+
+    def _sample_state(self, n: int) -> np.ndarray:
+        return self.rng.uniform(-0.05, 0.05, size=(n, 4))
+
+    def reset(self) -> np.ndarray:
+        self.state = self._sample_state(self.n)
+        self.steps[:] = 0
+        return self.state.astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        gravity, masscart, masspole = 9.8, 1.0, 0.1
+        total_mass = masscart + masspole
+        length = 0.5
+        polemass_length = masspole * length
+        force_mag, tau = 10.0, 0.02
+
+        x, x_dot, theta, theta_dot = self.state.T
+        force = np.where(actions == 1, force_mag, -force_mag)
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (gravity * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - masspole * costheta**2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * xacc
+        theta = theta + tau * theta_dot
+        theta_dot = theta_dot + tau * thetaacc
+        self.state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self.steps += 1
+
+        done = (np.abs(x) > 2.4) | (np.abs(theta) > 12 * np.pi / 180) | (
+            self.steps >= self.max_steps)
+        reward = np.ones(self.n, np.float32)
+        if done.any():
+            idx = np.nonzero(done)[0]
+            self.state[idx] = self._sample_state(len(idx))
+            self.steps[idx] = 0
+        return self.state.astype(np.float32), reward, done
+
+
+ENVS = {"CartPole-v1": CartPoleVec}
+
+
+def make_env(name: str, num_envs: int, seed: int = 0):
+    try:
+        return ENVS[name](num_envs, seed=seed)
+    except KeyError:
+        raise ValueError(
+            f"unknown env {name!r}; registered: {sorted(ENVS)}") from None

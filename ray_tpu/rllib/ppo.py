@@ -1,0 +1,29 @@
+"""PPO (reference: rllib/algorithms/ppo/ppo.py:401), JAX Learner path."""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import PPOLearner
+from ray_tpu.rllib.rl_module import MLPModule
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.train_kwargs = {
+            "clip": 0.2, "vf_coef": 0.5, "ent_coef": 0.01,
+            "num_epochs": 10, "minibatch_size": 256, "lam": 0.95,
+            "max_grad_norm": 0.5,
+        }
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO(Algorithm):
+    def _build_learner(self) -> PPOLearner:
+        cfg = self.config
+        kw = dict(cfg.train_kwargs)
+        kw.pop("lam", None)
+        return PPOLearner(MLPModule(**self.module_spec), lr=cfg.lr,
+                          seed=cfg.seed, **kw)

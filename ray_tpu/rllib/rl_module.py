@@ -1,0 +1,70 @@
+"""RLModule: the model abstraction (reference: rllib/core/rl_module/).
+
+Pure-function design: a module is (init_params, apply) over a jax pytree —
+the same params run in the Learner (jitted update on TPU/CPU) and in
+EnvRunners (host-side numpy inference), with no framework object to ship.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import numpy as np
+
+
+class MLPModule:
+    """Policy+value MLP with shared trunk (discrete actions)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        keys = jax.random.split(jax.random.PRNGKey(seed),
+                                len(self.hidden) + 2)
+        sizes = (self.obs_dim,) + self.hidden
+        params = {"trunk": []}
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            w = jax.random.normal(keys[i], (a, b)) * np.sqrt(2.0 / a)
+            params["trunk"].append({"w": w, "b": jnp.zeros((b,))})
+        h = sizes[-1]
+        params["pi"] = {
+            "w": jax.random.normal(keys[-2], (h, self.num_actions)) * 0.01,
+            "b": jnp.zeros((self.num_actions,)),
+        }
+        params["v"] = {"w": jax.random.normal(keys[-1], (h, 1)) * 1.0,
+                       "b": jnp.zeros((1,))}
+        return params
+
+    def apply(self, params, obs) -> Tuple[Any, Any]:
+        """obs [B, obs_dim] -> (logits [B, A], value [B]). jax-traceable."""
+        import jax.numpy as jnp
+
+        x = obs
+        for layer in params["trunk"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["v"]["w"] + params["v"]["b"])[..., 0]
+        return logits, value
+
+    # -- host-side (EnvRunner) inference: numpy mirror of apply ------------
+
+    def apply_np(self, params_np, obs: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        x = obs
+        for layer in params_np["trunk"]:
+            x = np.tanh(x @ layer["w"] + layer["b"])
+        logits = x @ params_np["pi"]["w"] + params_np["pi"]["b"]
+        value = (x @ params_np["v"]["w"] + params_np["v"]["b"])[..., 0]
+        return logits, value
+
+
+def to_numpy(params) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), params)

@@ -31,6 +31,15 @@ class ReplicaActor:
         self._is_engine = (self._instance is not None
                            and hasattr(self._instance, "submit")
                            and hasattr(self._instance, "collect"))
+        self._collect_takes_ids = False
+        if self._is_engine:
+            import inspect
+
+            try:
+                sig = inspect.signature(self._instance.collect)
+                self._collect_takes_ids = len(sig.parameters) >= 1
+            except (TypeError, ValueError):
+                pass
 
     def ping(self) -> str:
         return "ok"
@@ -63,10 +72,9 @@ class ReplicaActor:
 
     def collect(self, req_ids=None) -> Dict[str, Any]:
         """{req_id: result} for finished requests since last collect."""
-        try:
+        if self._collect_takes_ids:
             return self._instance.collect(req_ids)
-        except TypeError:
-            return self._instance.collect()
+        return self._instance.collect()
 
     def engine_stats(self) -> dict:
         if hasattr(self._instance, "stats"):

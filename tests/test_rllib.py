@@ -1,0 +1,96 @@
+"""RLlib-lite: env dynamics, learner update mechanics, and PPO-on-CartPole
+convergence to >=450 (the verdict's acceptance bar; reference test model:
+rllib/algorithms/ppo/tests/test_ppo.py learning tests).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import runtime_context
+from ray_tpu.rllib.envs import CartPoleVec
+from ray_tpu.rllib.learner import PPOLearner
+from ray_tpu.rllib.rl_module import MLPModule, to_numpy
+
+
+@pytest.fixture(scope="module")
+def rl_ray():
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    ray_tpu.init(num_workers=3, object_store_memory=256 << 20)
+    yield
+    core = runtime_context.get_core_or_none()
+    if core is not None:
+        core.shutdown()
+    runtime_context.set_core(prev)
+
+
+def test_cartpole_dynamics():
+    env = CartPoleVec(4, seed=0)
+    obs = env.reset()
+    assert obs.shape == (4, 4) and np.abs(obs).max() <= 0.05
+    total_done = 0
+    for _ in range(400):
+        obs, rew, done = env.step(np.zeros(4, np.int64))  # constant force
+        assert rew.shape == (4,) and (rew == 1.0).all()
+        total_done += int(done.sum())
+    # pushing left forever must topple the pole repeatedly
+    assert total_done >= 4
+
+
+def test_module_numpy_matches_jax():
+    m = MLPModule(4, 2)
+    params = m.init_params(0)
+    obs = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    import jax.numpy as jnp
+
+    lj, vj = m.apply(params, jnp.asarray(obs))
+    ln, vn = m.apply_np(to_numpy(params), obs)
+    assert np.allclose(np.asarray(lj), ln, atol=1e-5)
+    assert np.allclose(np.asarray(vj), vn, atol=1e-5)
+
+
+def test_learner_update_improves_objective():
+    m = MLPModule(4, 2)
+    learner = PPOLearner(m, num_epochs=2, minibatch_size=64)
+    rng = np.random.default_rng(0)
+    n = 256
+    batch = {
+        "obs": rng.normal(size=(n, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, size=n).astype(np.int32),
+        "logp_old": np.full(n, -0.7, np.float32),
+        "advantages": rng.normal(size=n).astype(np.float32),
+        "returns": rng.normal(size=n).astype(np.float32),
+    }
+    metrics = learner.update(batch)
+    assert set(metrics) == {"pg_loss", "vf_loss", "entropy"}
+    assert np.isfinite(list(metrics.values())).all()
+
+
+def test_ppo_cartpole_reaches_450(rl_ray):
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=128)
+            .training(lr=3e-4, gamma=0.99)
+            .debugging(seed=0)
+            .build())
+    try:
+        best_eval = 0.0
+        for i in range(300):
+            result = algo.train()
+            # greedy eval once the stochastic mean is close (the greedy
+            # policy typically clears 500 well before the sampled mean)
+            if result["episode_return_mean"] >= 380 and i >= 10:
+                best_eval = max(best_eval, algo.evaluate(num_episodes=8))
+                if best_eval >= 450:
+                    break
+        assert best_eval >= 450, (
+            f"PPO did not reach 450 (last mean "
+            f"{result['episode_return_mean']:.1f}, eval {best_eval:.1f})")
+    finally:
+        algo.stop()
