@@ -29,6 +29,11 @@ from ray_tpu.tune.trial import (  # noqa: F401
     get_trial_dir,
     report,
 )
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
+)
 from ray_tpu.tune.tune_controller import TuneController  # noqa: F401
 from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner  # noqa: F401
 
@@ -40,4 +45,5 @@ __all__ = [
     "choice", "sample_from", "grid_search",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
+    "Searcher", "BasicVariantGenerator", "TPESearcher",
 ]

@@ -1,0 +1,209 @@
+"""Pluggable search algorithms (reference: python/ray/tune/search/ —
+Searcher base searcher.py:40, BasicVariantGenerator basic_variant.py, and
+the Optuna/HyperOpt integrations whose role the built-in TPE fills here,
+since no external search library ships in this image).
+
+A Searcher proposes configs one trial at a time and learns from completed
+results, so proposals sharpen as the experiment progresses (vs the
+variant generator's up-front sampling).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.search_space import (Categorical, Domain, Float, Integer,
+                                       _is_grid, generate_variants)
+
+
+class Searcher:
+    """Interface: suggest(trial_id) -> config | None (None = budget done);
+    on_trial_complete(trial_id, result) feeds the metric back."""
+
+    def set_experiment(self, space: Dict[str, Any], metric: str, mode: str,
+                       num_samples: int, seed: Optional[int]):
+        self._space = space
+        self._metric = metric
+        self._mode = mode
+        self._num_samples = num_samples
+        self._seed = seed
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]):
+        pass
+
+    def on_restore(self, num_existing: int):
+        """Called after an experiment restore with the number of trials
+        already created, so the suggestion budget accounts for them."""
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Random/grid sampling behind the Searcher interface (reference:
+    tune/search/basic_variant.py)."""
+
+    def set_experiment(self, space, metric, mode, num_samples, seed):
+        super().set_experiment(space, metric, mode, num_samples, seed)
+        self._variants = generate_variants(space, num_samples, seed)
+
+    def suggest(self, trial_id: str):
+        try:
+            return next(self._variants)
+        except StopIteration:
+            return None
+
+    def on_restore(self, num_existing: int):
+        for _ in range(num_existing):
+            next(self._variants, None)
+
+
+def _flatten(space: Dict[str, Any], prefix: Tuple[str, ...] = ()
+             ) -> Dict[Tuple[str, ...], Any]:
+    out: Dict[Tuple[str, ...], Any] = {}
+    for k, v in space.items():
+        if isinstance(v, dict) and not _is_grid(v):
+            out.update(_flatten(v, prefix + (k,)))
+        else:
+            out[prefix + (k,)] = v
+    return out
+
+
+def _set_path(cfg: Dict[str, Any], path: Tuple[str, ...], value: Any):
+    d = cfg
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (independent per dimension).
+
+    Completed trials are split into good/bad by the gamma quantile of the
+    metric; numeric dimensions model each group with a Gaussian KDE and
+    propose the candidate maximizing l(x)/g(x); categorical dimensions use
+    smoothed count ratios. The first ``n_startup`` trials sample randomly.
+    """
+
+    def __init__(self, n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24):
+        self._n_startup = n_startup
+        self._gamma = gamma
+        self._n_cand = n_candidates
+        self._obs: List[Tuple[Dict[Tuple[str, ...], Any], float]] = []
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._count = 0
+
+    def set_experiment(self, space, metric, mode, num_samples, seed):
+        super().set_experiment(space, metric, mode, num_samples, seed)
+        self._rng = random.Random(seed)
+        self._dims = _flatten(space)
+        for path, dom in self._dims.items():
+            if _is_grid(dom):
+                raise ValueError(
+                    f"TPESearcher does not support grid_search (at "
+                    f"{'.'.join(path)}); use tune.choice() so the searcher "
+                    f"can model the dimension")
+
+    # ---- proposal -----------------------------------------------------------
+
+    def suggest(self, trial_id: str):
+        if self._count >= self._num_samples:
+            return None
+        self._count += 1
+        cfg: Dict[str, Any] = {}
+        use_tpe = len(self._obs) >= self._n_startup
+        split = self._split() if use_tpe else None
+        flat: Dict[Tuple[str, ...], Any] = {}
+        for path, dom in self._dims.items():
+            if isinstance(dom, (Float, Integer)) and use_tpe:
+                value = self._suggest_numeric(path, dom, split)
+            elif isinstance(dom, Categorical) and use_tpe:
+                value = self._suggest_categorical(path, dom, split)
+            elif isinstance(dom, Domain):
+                value = dom.sample(self._rng)
+            else:
+                value = dom  # constant
+            flat[path] = value
+            _set_path(cfg, path, value)
+        self._configs[trial_id] = flat
+        return cfg
+
+    def _split(self):
+        ordered = sorted(self._obs, key=lambda o: o[1],
+                         reverse=(self._mode == "max"))
+        n_good = max(1, int(math.ceil(self._gamma * len(ordered))))
+        return ordered[:n_good], ordered[n_good:]
+
+    def _suggest_numeric(self, path, dom, split):
+        good, bad = split
+        log = getattr(dom, "log", False)
+
+        def xform(v):
+            return math.log(v) if log else float(v)
+
+        lo, hi = xform(dom.lower), xform(dom.upper)
+        gx = [xform(o[0][path]) for o in good if path in o[0]]
+        bx = [xform(o[0][path]) for o in bad if path in o[0]]
+        if not gx:
+            return dom.sample(self._rng)
+        bw = max((hi - lo) / max(len(gx), 1) ** 0.5, 1e-3 * (hi - lo))
+
+        def kde(xs, x):
+            if not xs:
+                return 1.0 / (hi - lo)
+            s = sum(math.exp(-0.5 * ((x - xi) / bw) ** 2) for xi in xs)
+            return s / (len(xs) * bw * math.sqrt(2 * math.pi)) + 1e-12
+
+        best_x, best_score = None, -1.0
+        for _ in range(self._n_cand):
+            center = self._rng.choice(gx)
+            x = min(hi, max(lo, self._rng.gauss(center, bw)))
+            score = kde(gx, x) / kde(bx, x)
+            if score > best_score:
+                best_x, best_score = x, score
+        v = math.exp(best_x) if log else best_x
+        if isinstance(dom, Integer):
+            return max(dom.lower, min(dom.upper - 1, int(round(v))))
+        if getattr(dom, "q", None):
+            v = round(v / dom.q) * dom.q
+        return min(dom.upper, max(dom.lower, v))
+
+    def _suggest_categorical(self, path, dom, split):
+        good, bad = split
+        cats = dom.categories
+
+        def counts(obs):
+            c = {repr(v): 1.0 for v in cats}  # +1 smoothing
+            for o in obs:
+                if path in o[0]:
+                    c[repr(o[0][path])] = c.get(repr(o[0][path]), 1.0) + 1
+            total = sum(c.values())
+            return {k: v / total for k, v in c.items()}
+
+    # pick the category maximizing p_good/p_bad
+        pg, pb = counts(good), counts(bad)
+        return max(cats, key=lambda v: pg[repr(v)] / pb[repr(v)])
+
+    # ---- feedback -----------------------------------------------------------
+
+    def on_trial_complete(self, trial_id, result):
+        flat = self._configs.pop(trial_id, None)
+        if flat is None or not result:
+            return
+        score = result.get(self._metric)
+        if score is None:
+            return
+        self._obs.append((flat, float(score)))
+
+    def observe(self, config: Dict[str, Any], score: float):
+        """Feed an externally-known (config, score) pair — used when an
+        interrupted experiment is restored."""
+        self._obs.append((_flatten(config), float(score)))
+
+    def on_restore(self, num_existing: int):
+        self._count = max(self._count, num_existing)

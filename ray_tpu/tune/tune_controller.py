@@ -25,6 +25,7 @@ class TuneController:
                  metric: str = "score", mode: str = "max",
                  num_samples: int = 1,
                  scheduler: Optional[TrialScheduler] = None,
+                 search_alg=None,
                  max_concurrent_trials: Optional[int] = None,
                  max_failures: int = 0,
                  experiment_dir: str = "",
@@ -45,16 +46,62 @@ class TuneController:
         self._stop_criteria = stop or {}
         os.makedirs(experiment_dir, exist_ok=True)
 
-        from ray_tpu.tune.search_space import generate_variants
-        self.trials: List[Trial] = [
-            Trial(trial_id=f"trial_{i:05d}", config=cfg)
-            for i, cfg in enumerate(
-                generate_variants(param_space, num_samples, seed))
-        ]
+        # With a search algorithm, trials are created LAZILY so each
+        # suggestion can learn from completed results (reference:
+        # tune/search/searcher.py); otherwise variants are pre-generated.
+        self._searcher = search_alg
+        self._next_trial_idx = 0
+        if search_alg is not None:
+            search_alg.set_experiment(param_space, metric, mode,
+                                      num_samples, seed)
+            self.trials: List[Trial] = []
+        else:
+            from ray_tpu.tune.search_space import generate_variants
+            self.trials = [
+                Trial(trial_id=f"trial_{i:05d}", config=cfg)
+                for i, cfg in enumerate(
+                    generate_variants(param_space, num_samples, seed))
+            ]
+            self._next_trial_idx = len(self.trials)
+
+    def _maybe_suggest_trial(self) -> Optional[Trial]:
+        if self._searcher is None:
+            return None
+        trial_id = f"trial_{self._next_trial_idx:05d}"
+        cfg = self._searcher.suggest(trial_id)
+        if cfg is None:
+            return None
+        self._next_trial_idx += 1
+        trial = Trial(trial_id=trial_id, config=cfg)
+        self.trials.append(trial)
+        return trial
 
     # ------------------------------------------------------------- running
 
     def restore_trials(self, snapshots: List[dict]):
+        if self._searcher is not None:
+            # Searcher mode creates trials lazily, so restored trials are
+            # reconstructed directly from their snapshots; completed ones
+            # are fed back so the searcher resumes with full history.
+            for s in snapshots:
+                r = Trial.from_snapshot(s)
+                if not r.is_finished:
+                    r.status = TrialStatus.PENDING
+                self.trials.append(r)
+                try:
+                    idx = int(r.trial_id.rsplit("_", 1)[-1]) + 1
+                    self._next_trial_idx = max(self._next_trial_idx, idx)
+                except ValueError:
+                    pass
+                if r.is_finished and r.last_result:
+                    score = r.last_result.get(self._metric)
+                    observe = getattr(self._searcher, "observe", None)
+                    if observe is not None and score is not None:
+                        observe(r.config, score)
+            on_restore = getattr(self._searcher, "on_restore", None)
+            if on_restore is not None:
+                on_restore(len(self.trials))
+            return
         restored = {s["trial_id"]: s for s in snapshots}
         for t in self.trials:
             snap = restored.get(t.trial_id)
@@ -73,8 +120,19 @@ class TuneController:
     def run(self) -> List[Trial]:
         pending = [t for t in self.trials if not t.is_finished]
         running: Dict[Any, Trial] = {}  # pending_result ref -> trial
+        exhausted = False
         try:
-            while pending or running:
+            while True:
+                while len(pending) + len(running) < self._max_concurrent \
+                        and not exhausted:
+                    t = self._maybe_suggest_trial()
+                    if t is None:
+                        exhausted = True
+                    else:
+                        pending.append(t)
+                if not (pending or running):
+                    if self._searcher is None or exhausted:
+                        break
                 while pending and len(running) < self._max_concurrent:
                     trial = pending.pop(0)
                     self._start_trial(trial)
@@ -120,6 +178,9 @@ class TuneController:
         if kind in ("done", "stopped"):
             trial.status = TrialStatus.TERMINATED
             self._scheduler.on_trial_complete(trial)
+            if self._searcher is not None:
+                self._searcher.on_trial_complete(trial.trial_id,
+                                                 trial.last_result)
             self._kill_actor(trial)
             return None
 
@@ -172,6 +233,8 @@ class TuneController:
         trial.status = TrialStatus.ERROR
         trial.error = tb or err
         self._scheduler.on_trial_complete(trial)
+        if self._searcher is not None:
+            self._searcher.on_trial_complete(trial.trial_id, None)
         return None
 
     def _should_stop_by_criteria(self, metrics: Dict[str, Any]) -> bool:

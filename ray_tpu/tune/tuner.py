@@ -27,6 +27,7 @@ class TuneConfig:
     mode: str = "max"
     num_samples: int = 1
     scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Any] = None  # a tune.search.Searcher
     max_concurrent_trials: Optional[int] = None
     seed: Optional[int] = None
     trial_resources: Dict[str, Any] = field(default_factory=dict)
@@ -148,6 +149,7 @@ class Tuner:
             metric=cfg.metric, mode=cfg.mode,
             num_samples=cfg.num_samples,
             scheduler=cfg.scheduler,
+            search_alg=cfg.search_alg,
             max_concurrent_trials=cfg.max_concurrent_trials,
             max_failures=fc.max_failures if fc else 0,
             experiment_dir=self._experiment_dir(),

@@ -291,3 +291,116 @@ def test_tuner_over_trainer(run_cfg):
     grid = tuner.fit()
     assert not grid.errors
     assert grid.get_best_result().metrics["w"] == pytest.approx(4.0)
+
+
+def test_tpe_searcher_beats_random_on_quadratic(run_cfg):
+    """TPE must concentrate samples near the optimum of a smooth function
+    (reference analogue: search-algorithm convergence tests)."""
+    from ray_tpu.tune import TPESearcher
+
+    def objective(config):
+        x, y = config["x"], config["y"]
+        tune.report({"score": -(x - 3.0) ** 2 - (y + 1.0) ** 2})
+
+    space = {"x": tune.uniform(-10, 10), "y": tune.uniform(-10, 10)}
+    tuner = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=40,
+            search_alg=TPESearcher(n_startup=8), seed=3,
+            # sequential: every suggestion sees every completed result, so
+            # the run is deterministic for the seed (async mode works but
+            # its outcome varies with completion order)
+            max_concurrent_trials=1),
+        run_config=run_cfg(name="tpe"))
+    results = tuner.fit()
+    best = results.get_best_result()
+    # 40 samples over a 20x20 box: pure random's best is ~-3 in
+    # expectation; TPE must land clearly closer to the optimum
+    assert best.metrics["score"] > -2.5, best.metrics
+    # and the post-startup suggestions must outperform the random phase
+    scores = [r.metrics["score"] for r in results if r.metrics]
+    startup_best = max(scores[:8])
+    late_best = max(scores[8:])
+    assert late_best >= startup_best, (startup_best, late_best)
+
+
+def test_searcher_interface_basic_variant(run_cfg):
+    from ray_tpu.tune import BasicVariantGenerator
+
+    def objective(config):
+        tune.report({"score": config["a"]})
+
+    tuner = tune.Tuner(
+        objective, param_space={"a": tune.choice([1, 2, 5])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=6,
+            search_alg=BasicVariantGenerator(), seed=0),
+        run_config=run_cfg(name="bvg"))
+    results = tuner.fit()
+    assert len(results) == 6
+    assert results.get_best_result().metrics["score"] == 5
+
+
+def test_tpe_categorical_and_log(run_cfg):
+    from ray_tpu.tune import TPESearcher
+
+    def objective(config):
+        bonus = 5.0 if config["opt"] == "adam" else 0.0
+        tune.report(
+            {"score": bonus - abs(__import__("math").log10(config["lr"])
+                                  + 3.0)})
+
+    space = {"lr": tune.loguniform(1e-5, 1e-1),
+             "opt": tune.choice(["sgd", "adam", "rmsprop"])}
+    tuner = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=30,
+            search_alg=TPESearcher(n_startup=6), seed=1),
+        run_config=run_cfg(name="tpelog"))
+    best = tuner.fit().get_best_result()
+    assert best.config["opt"] == "adam"
+    assert best.metrics["score"] > 4.0
+
+
+def test_restore_with_searcher(run_cfg, tmp_path):
+    """Interrupted searcher-driven experiment resumes with history intact
+    and completes the remaining budget (verdict acceptance: no lost
+    trials)."""
+    from ray_tpu.tune import TPESearcher
+
+    def objective(config):
+        tune.report({"score": -(config["x"] - 1.0) ** 2})
+
+    space = {"x": tune.uniform(-5, 5)}
+    rc = run_cfg(name="restore_tpe")
+
+    # phase 1: run a partial budget
+    r1 = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=6,
+                                    search_alg=TPESearcher(n_startup=4),
+                                    seed=0),
+        run_config=rc).fit()
+    assert len(r1) == 6
+    exp_dir = os.path.join(rc.resolved_storage_path(), "restore_tpe")
+
+    # phase 2: restore with a LARGER budget; the 6 finished trials must be
+    # kept (not rerun) and only the delta executed
+    tuner = tune.Tuner.restore(
+        exp_dir, objective, param_space=space,
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=10,
+                                    search_alg=TPESearcher(n_startup=4),
+                                    seed=0))
+    r2 = tuner.fit()
+    assert len(r2) == 10
+    ids = [r.trial_id for r in r2]
+    assert len(set(ids)) == 10
+    # the original trials' results survived
+    old = {r.trial_id: r.metrics.get("score") for r in r1}
+    new = {r.trial_id: r.metrics.get("score") for r in r2}
+    for tid, score in old.items():
+        assert new[tid] == score
