@@ -35,6 +35,7 @@ from ray_tpu.api import (  # noqa: F401
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
@@ -54,6 +55,7 @@ __all__ = [
     "cancel",
     "method",
     "get_actor",
+    "timeline",
     "ObjectRef",
     "get_runtime_context",
     "exceptions",

@@ -8,6 +8,7 @@ on the TPU-native runtime.
 from __future__ import annotations
 
 import atexit
+import os
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
 from ray_tpu.core import runtime_context
@@ -35,6 +36,9 @@ def init(num_workers: Optional[int] = None,
         if ignore_reinit_error:
             return runtime_context.get_runtime_context()
         raise RuntimeError("ray_tpu.init() called twice")
+    if address is None:
+        # submitted jobs inherit the cluster address from the job agent
+        address = os.environ.get("RTPU_ADDRESS")
     if address:
         from ray_tpu.core.cluster.cluster_core import ClusterCore
 
@@ -126,6 +130,45 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     del recursive
     core = runtime_context.get_core()
     core.cancel_task(ref, force=force)
+
+
+def timeline(filename: Optional[str] = None):
+    """Export recorded task events as a chrome://tracing trace (reference:
+    ray.timeline, python/ray/_private/worker.py). Requires the
+    RTPU_TASK_EVENTS_ENABLED=1 flag; returns the event list when no
+    filename is given."""
+    import json
+
+    core = runtime_context.get_core()
+    events = getattr(core, "_events", None)
+    if events is None:
+        if hasattr(core, "_cluster_view"):
+            raise RuntimeError(
+                "timeline() reads the embedded runtime's event log; "
+                "cluster drivers do not record one yet — run with a "
+                "local init() to trace")
+        raise RuntimeError(
+            "task events are disabled; set RTPU_TASK_EVENTS_ENABLED=1 "
+            "before init()")
+    trace = [{
+        "name": e["fn"],
+        "cat": "actor_task" if e["actor"] else "task",
+        "ph": "X",
+        "ts": e["dispatched"] * 1e6,
+        "dur": max(0.0, (e["done"] - e["dispatched"]) * 1e6),
+        "pid": e["pid"],
+        "tid": e["worker"],
+        "args": {"task_id": e["task_id"],
+                 "queued_ms": round(max(
+                     0.0, (e["dispatched"] - e.get("submitted",
+                                                   e["dispatched"]))
+                 ) * 1e3, 3)},
+    } for e in events]
+    if filename is None:
+        return trace
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return filename
 
 
 def method(**opts):

@@ -1,0 +1,161 @@
+"""Autoscaler: demand-driven node scale-up/down over a NodeProvider.
+
+Reference: autoscaler v1's monitor loop + provider interface
+(python/ray/autoscaler/_private/monitor.py:126, node_provider.py) and
+v2's instance-manager split. The monitor polls the GCS cluster view plus
+per-node state (queued tasks): sustained queueing with no headroom
+launches a node; sustained idleness above min_nodes terminates one. The
+provider abstracts WHERE nodes come from — the built-in subprocess
+provider launches node-server processes on this host (the fixture/test
+path); a TPU-pod provider would request slices instead.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.core.cluster.rpc import ClientCache, RpcClient, RpcError
+
+
+class NodeProvider:
+    """Interface: launch/terminate cluster nodes."""
+
+    def launch_node(self) -> None:
+        raise NotImplementedError
+
+    def terminate_node(self, address: Tuple[str, int]) -> None:
+        raise NotImplementedError
+
+
+class SubprocessNodeProvider(NodeProvider):
+    """Launches node-server subprocesses on this host (the local
+    deployment mode; reference analogue: local/node_provider.py)."""
+
+    def __init__(self, gcs_address: Tuple[str, int], num_workers: int = 2,
+                 object_store_memory: int = 128 << 20):
+        self._gcs_address = gcs_address
+        self._nw = num_workers
+        self._mem = object_store_memory
+        self.procs: List = []
+
+    def launch_node(self) -> None:
+        import subprocess
+        import sys
+
+        self.procs.append(subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.cluster.node_server",
+             "--gcs", f"{self._gcs_address[0]}:{self._gcs_address[1]}",
+             "--num-workers", str(self._nw),
+             "--object-store-memory", str(self._mem)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+    def terminate_node(self, address: Tuple[str, int]) -> None:
+        # ask the node to drain and exit; its process follows
+        try:
+            from ray_tpu.core.cluster.rpc import cluster_authkey
+
+            RpcClient(address, cluster_authkey(), connect_timeout=2.0
+                      ).call(("shutdown_node",))
+        except (RpcError, Exception):  # noqa: BLE001
+            pass
+
+
+class AutoscalerMonitor:
+    """The control loop (reference: monitor.py:126 StandardAutoscaler)."""
+
+    def __init__(self, gcs_address: Tuple[str, int], provider: NodeProvider,
+                 min_nodes: int = 1, max_nodes: int = 4,
+                 scale_up_after_ticks: int = 3,
+                 scale_down_after_ticks: int = 20,
+                 tick_s: float = 0.5,
+                 authkey: Optional[bytes] = None):
+        from ray_tpu.core.cluster.rpc import cluster_authkey
+
+        self._authkey = authkey or cluster_authkey()
+        self._gcs = RpcClient(tuple(gcs_address), self._authkey)
+        self._nodes = ClientCache(self._authkey)
+        self._provider = provider
+        self._min = min_nodes
+        self._max = max_nodes
+        self._up_after = scale_up_after_ticks
+        self._down_after = scale_down_after_ticks
+        self._tick_s = tick_s
+        self._busy_ticks = 0
+        self._idle_ticks: Dict[Tuple[str, int], int] = {}
+        self._launching_until = 0.0
+        self.events: List[dict] = []
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    # ------------------------------------------------------------------ loop
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                pass
+            time.sleep(self._tick_s)
+
+    def _tick(self):
+        view = self._gcs.call(("list_nodes", True))
+        nodes = view["nodes"]
+        n = len(nodes)
+
+        queued = 0
+        per_node_busy: Dict[Tuple[str, int], bool] = {}
+        for node in nodes:
+            addr = tuple(node["address"])
+            try:
+                s = self._nodes.get(addr).call(("state",))
+            except RpcError:
+                continue
+            q = s["tasks"]["queued"]
+            running = s["tasks"]["running"]
+            active_actors = sum(1 for a in s["actors"]
+                                if a["state"] != "DEAD")
+            # demand = explicit queue + tasks batched beyond the worker
+            # slots (the dispatcher pipelines onto workers, so a saturated
+            # node can show an empty queue with a deep inflight backlog)
+            slots = max(1, len(s["workers"]))
+            queued += q + max(0, running - slots)
+            per_node_busy[addr] = bool(q or running or active_actors)
+
+        # ---- scale up: sustained queueing and room to grow
+        if queued > 0 and n < self._max:
+            self._busy_ticks += 1
+        else:
+            self._busy_ticks = 0
+        if (self._busy_ticks >= self._up_after
+                and time.monotonic() >= self._launching_until):
+            self._provider.launch_node()
+            self._launching_until = time.monotonic() + 15.0
+            self._busy_ticks = 0
+            self.events.append({"action": "launch", "queued": queued,
+                                "nodes": n, "ts": time.time()})
+
+        # ---- scale down: a node idle long enough, above the floor
+        for addr, busy in per_node_busy.items():
+            self._idle_ticks[addr] = (0 if busy
+                                      else self._idle_ticks.get(addr, 0) + 1)
+        if n > self._min:
+            victim = next(
+                (a for a, t in sorted(self._idle_ticks.items(),
+                                      key=lambda kv: -kv[1])
+                 if t >= self._down_after and a in per_node_busy),
+                None)
+            if victim is not None:
+                self._provider.terminate_node(victim)
+                self._idle_ticks.pop(victim, None)
+                self.events.append({"action": "terminate",
+                                    "address": list(victim),
+                                    "ts": time.time()})
+
+    def stop(self):
+        self._stop = True
+        self._gcs.close()
+        self._nodes.close_all()

@@ -187,6 +187,23 @@ class GcsServer:
                 return key in self._kv
             if op == "keys":
                 return [k for k in self._kv if k.startswith(key)]
+            if op == "merge":
+                # atomic read-modify-write for dict values: concurrent
+                # writers can't lose each other's fields
+                cur = self._kv.setdefault(key, {})
+                cur.update(value or {})
+                return dict(cur)
+            if op == "cas_merge":
+                # value = (expect: {field: val}, updates: {field: val});
+                # merge only if every expected field matches; returns the
+                # merged dict or None on mismatch
+                expect, updates = value
+                cur = self._kv.get(key)
+                if cur is None or any(cur.get(k) != v
+                                      for k, v in expect.items()):
+                    return None
+                cur.update(updates)
+                return dict(cur)
         raise ValueError(f"unknown kv op {op!r}")
 
     # -- named actors / actor table

@@ -496,6 +496,9 @@ class NodeServer:
                 "store": rt.store.stats(),
             }
 
+    def _op_state(self):
+        return self.runtime.state_summary()
+
     def _op_register_fn(self, fn_id: bytes, pickled: bytes):
         rt = self.runtime
         with rt._lock:
@@ -722,6 +725,8 @@ def main(argv=None):
     p.add_argument("--object-store-memory", type=int, default=None)
     p.add_argument("--resources", type=str, default=None,
                    help='JSON dict of extra resources, e.g. {"disk": 2}')
+    p.add_argument("--head", action="store_true",
+                   help="run head-node services (job agent)")
     args = p.parse_args(argv)
     resources = None
     if args.resources:
@@ -731,11 +736,19 @@ def main(argv=None):
     node = NodeServer(_parse_addr(args.gcs), num_workers=args.num_workers,
                       object_store_memory=args.object_store_memory,
                       resources=resources, port=args.port)
+    agent = None
+    if args.head:
+        from ray_tpu.job.agent import JobAgent
+
+        agent = JobAgent(node.gcs, _parse_addr(args.gcs),
+                         agent_id=node.node_id.hex())
     print(f"NODE_ADDRESS {node.address[0]}:{node.address[1]}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    if agent is not None:
+        agent.close()
     node.close()
     sys.exit(0)
 

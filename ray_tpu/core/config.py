@@ -64,6 +64,12 @@ _FLAGS: List[Flag] = [
          "How long wait_for_workers waits for the pool to come up."),
     Flag("worker_shutdown_grace_s", float, 2.0,
          "Grace period for workers to exit at shutdown before SIGKILL."),
+    # ---- observability ---------------------------------------------------
+    Flag("task_events_enabled", bool, False,
+         "Record task lifecycle events (submit/dispatch/done per task) "
+         "for ray_tpu.timeline() chrome-trace export (reference: "
+         "RAY_task_events_* flags + ray.timeline, "
+         "python/ray/_private/state.py chrome_tracing_dump)."),
     # ---- fault tolerance -------------------------------------------------
     Flag("task_max_retries", int, 3,
          "Default retry budget for tasks whose worker died mid-execution "

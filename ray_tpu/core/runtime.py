@@ -62,7 +62,8 @@ class _TaskSpec:
         "task_id", "fn_id", "args_payload", "deps", "return_ids", "options",
         "actor_id", "method", "pending_deps", "request", "pg_wire",
         "acquired_bundle", "blocked_released", "nested_deps", "cancelled",
-        "retries_left", "args_pinned", "dep_pins",
+        "retries_left", "args_pinned", "dep_pins", "submitted_ts",
+        "dispatched_ts",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -94,6 +95,9 @@ class _TaskSpec:
         # Real store refs taken at dispatch on shm dep containers, so spill
         # can never pull a dep out from under a worker mid-read.
         self.dep_pins: List[bytes] = []
+        # timeline timestamps (recorded when task_events_enabled)
+        self.submitted_ts = 0.0
+        self.dispatched_ts = 0.0
 
 
 class _Worker:
@@ -185,6 +189,9 @@ class Runtime:
         self._pin_seq = 0
         self._args_pins: Dict[bytes, int] = {}    # in-flight args refcounts
         self._spilled_bytes = 0
+        # task lifecycle events for ray_tpu.timeline() (bounded; flag-gated)
+        self._events: Optional[List[dict]] = (
+            [] if config.task_events_enabled else None)
         self._functions: Dict[bytes, bytes] = {}  # fn_id -> pickled
         self._fn_cache: Dict[int, Tuple[bytes, bytes]] = {}  # id(fn) -> (fn_id, pickled)
         self._workers: Dict[WorkerID, _Worker] = {}
@@ -603,6 +610,8 @@ class Runtime:
             spec.retries_left = (0 if spec.actor_id is not None else
                                  int(spec.options.get("max_retries",
                                                       config.task_max_retries)))
+        if self._events is not None and not spec.submitted_ts:
+            spec.submitted_ts = time.time()
         self._pin_spec_args(spec)
         unresolved = []
         for dep in spec.deps:
@@ -943,6 +952,8 @@ class Runtime:
         try:
             entries = []
             for spec in batch:
+                if self._events is not None:
+                    spec.dispatched_ts = time.time()
                 self._ensure_fn_on_worker(w, spec.fn_id)
                 inline_values = self._inline_values_for(spec.deps, spec)
                 entries.append((
@@ -955,6 +966,8 @@ class Runtime:
 
     def _send_actor_call(self, w: _Worker, spec: _TaskSpec):
         try:
+            if self._events is not None:
+                spec.dispatched_ts = time.time()
             inline_values = self._inline_values_for(spec.deps, spec)
             self._send_msg(w, (
                 protocol.MSG_ACTOR_CALL, spec.task_id.binary(),
@@ -970,6 +983,20 @@ class Runtime:
             if spec is not None:
                 self._release_spec_locked(spec)
         if spec is not None:
+            if self._events is not None and len(self._events) < 200_000:
+                now = time.time()
+                self._events.append({
+                    "task_id": spec.task_id.hex(),
+                    "fn": (spec.method if spec.method
+                           else (spec.fn_id.hex()[:8] if spec.fn_id
+                                 else "task")),
+                    "actor": spec.actor_id.hex() if spec.actor_id else None,
+                    "worker": w.worker_id.hex()[:8],
+                    "pid": w.proc.pid if w.proc else 0,
+                    "submitted": spec.submitted_ts or now,
+                    "dispatched": spec.dispatched_ts or now,
+                    "done": now,
+                })
             self._release_spec_args(spec)
             self._release_spec_deps(spec)
             if spec.cancelled:
@@ -1795,6 +1822,48 @@ class Runtime:
         raise ValueError(f"unknown data request {tag!r}")
 
     # -------------------------------------------------------------- lifecycle
+
+    def state_summary(self) -> dict:
+        """Introspection snapshot for the state API (reference:
+        python/ray/util/state/api.py:781 backed by the GCS/raylet state
+        services; here the runtime answers directly)."""
+        with self._lock:
+            workers = [{
+                "worker_id": w.worker_id.hex(),
+                "pid": w.proc.pid if w.proc else None,
+                "alive": w.alive,
+                "actor_id": w.actor_id.hex() if w.actor_id else None,
+                "inflight": len(w.inflight),
+                "blocked": w.blocked,
+            } for w in self._workers.values()]
+            actors = [{
+                "actor_id": s.actor_id.hex(),
+                "name": s.name,
+                "state": ("DEAD" if s.dead else
+                          "ALIVE" if s.ready else "PENDING"),
+                "restarts_left": s.restarts_left,
+                "queued_calls": len(s.queue),
+            } for s in self._actors.values()]
+            queued = len(self._task_queue)
+            running = sum(len(w.inflight) for w in self._workers.values())
+            objects = len(self._objects)
+            resolved = sum(1 for e in self._objects.values()
+                           if e.event.is_set())
+        with self._spill_lock:
+            pinned = len(self._pinned)
+            spilled_bytes = self._spilled_bytes
+        return {
+            "node_id": self.node_id.hex(),
+            "workers": workers,
+            "actors": actors,
+            "tasks": {"queued": queued, "running": running},
+            "objects": {"tracked": objects, "resolved": resolved,
+                        "pinned": pinned, "spilled_bytes": spilled_bytes},
+            "resources": {"total": self._total.to_dict(),
+                          "available": self._avail.to_dict()},
+            "store": self.store.stats(),
+            "placement_groups": len(self._pgs),
+        }
 
     def kv_op(self, op: str, key: str, value=None):
         if op == "get":

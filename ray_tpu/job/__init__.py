@@ -1,0 +1,11 @@
+"""Job submission (reference: python/ray/dashboard/modules/job/ —
+JobSubmissionClient sdk.py, JobManager job_manager.py:58).
+
+Jobs are driver scripts run as subprocesses against the cluster: the
+client records the job spec in the GCS job table (cluster KV under
+``job/``), a JobAgent on one node claims it, spawns the entrypoint with
+RTPU_ADDRESS pointing at the GCS, captures logs, and updates status.
+"""
+
+from ray_tpu.job.client import JobStatus, JobSubmissionClient  # noqa: F401
+from ray_tpu.job.agent import JobAgent  # noqa: F401

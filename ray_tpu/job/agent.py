@@ -1,0 +1,127 @@
+"""JobAgent: claims PENDING jobs from the GCS table and runs them.
+
+Reference: the JobManager/JobSupervisor pair
+(dashboard/modules/job/job_manager.py:58) — there a supervisor actor per
+job; here a thread on the head node spawns the entrypoint subprocess with
+RTPU_ADDRESS pointing at the cluster, streams logs to a file, honors stop
+requests, and writes terminal status back to the table.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.core.cluster.rpc import RpcClient
+
+from ray_tpu.job.client import JobStatus
+
+
+class JobAgent:
+    def __init__(self, gcs: RpcClient, gcs_address: Tuple[str, int],
+                 agent_id: str, log_dir: str = "/tmp/ray_tpu_jobs",
+                 poll_s: float = 0.25):
+        self._gcs = gcs
+        self._gcs_address = gcs_address
+        self._agent_id = agent_id
+        self._log_dir = log_dir
+        self._poll_s = poll_s
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="job-agent")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                self._claim_pending()
+                self._reap()
+            except Exception:  # noqa: BLE001 — the agent must survive
+                pass
+            time.sleep(self._poll_s)
+
+    def _claim_pending(self):
+        for key in self._gcs.call(("kv", "keys", "job/")):
+            spec = self._gcs.call(("kv", "get", key))
+            if not spec or spec.get("status") != JobStatus.PENDING.value:
+                continue
+            os.makedirs(self._log_dir, exist_ok=True)
+            log_path = os.path.join(self._log_dir,
+                                    f"{spec['job_id']}.log")
+            # atomic claim: only one agent flips PENDING -> RUNNING, and a
+            # concurrent stop_job's merge can't be overwritten
+            claimed = self._gcs.call(("kv", "cas_merge", key, (
+                {"status": JobStatus.PENDING.value},
+                {"status": JobStatus.RUNNING.value,
+                 "agent": self._agent_id, "log_path": log_path})))
+            if claimed is None:
+                continue
+            spec = claimed
+            env = dict(os.environ)
+            env.update(spec.get("env") or {})
+            env["RTPU_ADDRESS"] = (
+                f"{self._gcs_address[0]}:{self._gcs_address[1]}")
+            log = open(log_path, "w")
+            try:
+                proc = subprocess.Popen(
+                    spec["entrypoint"], shell=True, env=env,
+                    stdout=log, stderr=subprocess.STDOUT,
+                    start_new_session=True)
+            except OSError as e:
+                self._gcs.call(("kv", "merge", key, {
+                    "status": JobStatus.FAILED.value, "error": repr(e)}))
+                continue
+            self._procs[spec["job_id"]] = proc
+            self._gcs.call(("kv", "merge", key, {"pid": proc.pid}))
+
+    def _reap(self):
+        for job_id, proc in list(self._procs.items()):
+            key = f"job/{job_id}"
+            spec = self._gcs.call(("kv", "get", key)) or {}
+            if spec.get("stop_requested") and proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except OSError:
+                    pass
+                try:
+                    proc.wait(5)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+                self._gcs.call(("kv", "merge", key, {
+                    "status": JobStatus.STOPPED.value,
+                    "finished_at": time.time()}))
+                del self._procs[job_id]
+                continue
+            rc = proc.poll()
+            if rc is None:
+                continue
+            self._gcs.call(("kv", "merge", key, {
+                "status": (JobStatus.SUCCEEDED.value if rc == 0
+                           else JobStatus.FAILED.value),
+                "returncode": rc, "finished_at": time.time()}))
+            del self._procs[job_id]
+
+    def close(self):
+        self._stop = True
+        for job_id, proc in list(self._procs.items()):
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            # record a terminal status so clients never spin on RUNNING
+            try:
+                self._gcs.call(("kv", "merge", f"job/{job_id}", {
+                    "status": JobStatus.STOPPED.value,
+                    "finished_at": time.time(),
+                    "error": "job agent shut down"}))
+            except Exception:  # noqa: BLE001 — GCS may be gone too
+                pass

@@ -1,0 +1,216 @@
+"""CLI: cluster lifecycle + observability + jobs.
+
+Reference: python/ray/scripts/scripts.py:571 (`ray start/stop/status`),
+the `ray job` and `ray list` command families. Invoked as
+``python -m ray_tpu <command>``.
+
+Session state (head pid/address) lives in /tmp/ray_tpu_session.json so
+``stop``/``status`` find the cluster without arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+SESSION_FILE = "/tmp/ray_tpu_session.json"
+
+
+def _save_session(data: dict):
+    with open(SESSION_FILE, "w") as f:
+        json.dump(data, f)
+
+
+def _load_session() -> dict:
+    if not os.path.exists(SESSION_FILE):
+        raise SystemExit(
+            "no running session found (did you `ray_tpu start --head`?)")
+    with open(SESSION_FILE) as f:
+        return json.load(f)
+
+
+def _ensure_authkey() -> str:
+    key = os.environ.get("RTPU_CLUSTER_AUTHKEY")
+    if not key:
+        key = os.urandom(16).hex()
+        os.environ["RTPU_CLUSTER_AUTHKEY"] = key
+    return key
+
+
+def cmd_start(args):
+    env = dict(os.environ)
+    if args.head:
+        key = _ensure_authkey()
+        env["RTPU_CLUSTER_AUTHKEY"] = key
+        gcs = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.cluster.gcs",
+             "--port", str(args.port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            start_new_session=True)
+        line = gcs.stdout.readline().decode()
+        address = line.split()[-1]
+        node = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.cluster.node_server",
+             "--gcs", address, "--head",
+             "--num-workers", str(args.num_workers)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            start_new_session=True)
+        node_line = node.stdout.readline().decode()
+        _save_session({"address": address, "authkey": key,
+                       "pids": [gcs.pid, node.pid]})
+        print(f"ray_tpu head started.\n  GCS address: {address}\n"
+              f"  node: {node_line.split()[-1]}\n"
+              f"  connect: ray_tpu.init(address=\"{address}\")  "
+              f"(RTPU_CLUSTER_AUTHKEY={key})")
+    else:
+        if not args.address:
+            raise SystemExit("--address host:port required to join")
+        node = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.cluster.node_server",
+             "--gcs", args.address,
+             "--num-workers", str(args.num_workers)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            start_new_session=True)
+        line = node.stdout.readline().decode()
+        print(f"node started at {line.split()[-1]} "
+              f"(joined {args.address})")
+        try:
+            sess = _load_session()
+            sess.setdefault("pids", []).append(node.pid)
+            _save_session(sess)
+        except SystemExit:
+            pass
+
+
+def cmd_stop(args):
+    sess = _load_session()
+    for pid in sess.get("pids", []):
+        try:
+            os.killpg(pid, signal.SIGTERM)
+        except OSError:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+    time.sleep(0.5)
+    for pid in sess.get("pids", []):
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    os.unlink(SESSION_FILE)
+    print("stopped.")
+
+
+def _connect():
+    import ray_tpu
+
+    sess = _load_session()
+    os.environ.setdefault("RTPU_CLUSTER_AUTHKEY", sess["authkey"])
+    ray_tpu.init(address=sess["address"])
+    return sess
+
+
+def cmd_status(args):
+    _connect()
+    from ray_tpu import state
+
+    s = state.state_summary()
+    print(f"nodes: {len(s['nodes'])}")
+    for n in s["nodes"]:
+        print(f"  {n['node_id'][:12]}  {n['address']}  {n['state']}  "
+              f"{n['resources']}")
+    print(f"tasks: {s['tasks']}")
+    print(f"objects: {s['objects']}")
+    print(f"resources: {s['cluster_resources']} "
+          f"(available {s['available_resources']})")
+
+
+def cmd_state(args):
+    _connect()
+    from ray_tpu import state
+
+    fn = {"nodes": state.list_nodes, "actors": state.list_actors,
+          "workers": state.list_workers, "tasks": state.summarize_tasks,
+          "objects": state.summarize_objects,
+          "summary": state.state_summary}[args.what]
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_job(args):
+    from ray_tpu.job import JobSubmissionClient
+
+    sess = _load_session()
+    os.environ.setdefault("RTPU_CLUSTER_AUTHKEY", sess["authkey"])
+    client = JobSubmissionClient(sess["address"])
+    if args.job_cmd == "submit":
+        import shlex
+
+        parts = [a for i, a in enumerate(args.entrypoint)
+                 if not (i == 0 and a == "--")]
+        job_id = client.submit_job(entrypoint=shlex.join(parts))
+        print(job_id)
+        if args.wait:
+            status = client.wait_until_finished(job_id)
+            print(status.value)
+            print(client.get_job_logs(job_id), end="")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id).value)
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.job_id) else "not running")
+    elif args.job_cmd == "list":
+        for j in client.list_jobs():
+            print(f"{j['job_id']}  {j['status']}  {j['entrypoint']!r}")
+    client.close()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head node or join a cluster")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None, help="GCS host:port to join")
+    sp.add_argument("--port", type=int, default=0, help="GCS port (head)")
+    sp.add_argument("--num-workers", type=int, default=2)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the local session")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster overview")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("state", help="state API queries")
+    sp.add_argument("what", choices=["nodes", "actors", "workers", "tasks",
+                                     "objects", "summary"])
+    sp.set_defaults(fn=cmd_state)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    j = jsub.add_parser("status")
+    j.add_argument("job_id")
+    j = jsub.add_parser("logs")
+    j.add_argument("job_id")
+    j = jsub.add_parser("stop")
+    j.add_argument("job_id")
+    jsub.add_parser("list")
+    sp.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""State API: observability over nodes, actors, tasks, and objects.
+
+Reference: python/ray/util/state/api.py:781 (list_nodes/list_actors/
+list_tasks/list_objects, summarize_*). Works against both cores: the
+embedded runtime answers from its own tables; a cluster driver aggregates
+the GCS node/actor tables plus per-node state RPCs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu.core import runtime_context
+
+
+def _core():
+    return runtime_context.get_core()
+
+
+def _is_cluster(core) -> bool:
+    return hasattr(core, "_cluster_view")
+
+
+def _node_summaries(core) -> List[dict]:
+    from ray_tpu.core.cluster.rpc import RpcError
+
+    out = []
+    for n in core.nodes():
+        addr = tuple(n["address"])
+        entry = {"node_id": n["node_id"].hex(), "address": list(addr),
+                 "state": n["state"], "resources": n["resources"],
+                 "labels": n.get("topology", {})}
+        try:
+            entry["summary"] = core._nodes.get(addr).call(("state",))
+        except RpcError:
+            entry["summary"] = None  # unreachable node
+        out.append(entry)
+    return out
+
+
+def _workers_from(summaries: List[dict]) -> List[dict]:
+    out = []
+    for n in summaries:
+        if n["summary"]:
+            for w in n["summary"]["workers"]:
+                out.append({**w, "node_id": n["node_id"]})
+    return out
+
+
+def _tasks_from(summaries: List[dict]) -> Dict[str, int]:
+    total = {"queued": 0, "running": 0}
+    for n in summaries:
+        if n["summary"]:
+            total["queued"] += n["summary"]["tasks"]["queued"]
+            total["running"] += n["summary"]["tasks"]["running"]
+    return total
+
+
+def _objects_from(summaries: List[dict]) -> Dict[str, Any]:
+    agg = {"tracked": 0, "resolved": 0, "pinned": 0, "spilled_bytes": 0,
+           "store_bytes_in_use": 0}
+    for n in summaries:
+        s = n["summary"]
+        if s:
+            for k in ("tracked", "resolved", "pinned", "spilled_bytes"):
+                agg[k] += s["objects"][k]
+            agg["store_bytes_in_use"] += s["store"]["bytes_in_use"]
+    return agg
+
+
+def list_nodes() -> List[dict]:
+    core = _core()
+    if _is_cluster(core):
+        return [{"node_id": n["node_id"].hex(),
+                 "address": list(n["address"]), "state": n["state"],
+                 "resources": n["resources"]} for n in core.nodes()]
+    s = core.state_summary()
+    return [{"node_id": s["node_id"], "address": ["local", 0],
+             "state": "ALIVE", "resources": s["resources"]["total"]}]
+
+
+def list_actors() -> List[dict]:
+    core = _core()
+    if _is_cluster(core):
+        table = core.gcs.call(("list_actors",))
+        return [{"actor_id": aid.hex(), **{k: v for k, v in info.items()
+                                           if k != "opts"}}
+                for aid, info in table.items()]
+    return core.state_summary()["actors"]
+
+
+def list_workers() -> List[dict]:
+    core = _core()
+    if _is_cluster(core):
+        return _workers_from(_node_summaries(core))
+    return core.state_summary()["workers"]
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    core = _core()
+    if _is_cluster(core):
+        return _tasks_from(_node_summaries(core))
+    return core.state_summary()["tasks"]
+
+
+def summarize_objects() -> Dict[str, Any]:
+    core = _core()
+    if _is_cluster(core):
+        return _objects_from(_node_summaries(core))
+    s = core.state_summary()
+    return {**s["objects"], "store_bytes_in_use": s["store"]["bytes_in_use"]}
+
+
+def cluster_resources() -> Dict[str, float]:
+    core = _core()
+    if _is_cluster(core):
+        return core.cluster_resources()
+    return core.state_summary()["resources"]["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    core = _core()
+    if _is_cluster(core):
+        total: Dict[str, float] = {}
+        for n in core.nodes():
+            for k, v in n.get("avail", {}).items():
+                total[k] = total.get(k, 0) + v
+        return total
+    return core.state_summary()["resources"]["available"]
+
+
+def state_summary() -> Dict[str, Any]:
+    """One-call overview (the dashboard-lite payload). In cluster mode the
+    per-node fan-out happens exactly once, so the snapshot is internally
+    consistent."""
+    core = _core()
+    if _is_cluster(core):
+        summaries = _node_summaries(core)
+        return {
+            "nodes": list_nodes(),
+            "actors": list_actors(),
+            "tasks": _tasks_from(summaries),
+            "objects": _objects_from(summaries),
+            "cluster_resources": cluster_resources(),
+            "available_resources": available_resources(),
+        }
+    return {
+        "nodes": list_nodes(),
+        "actors": list_actors(),
+        "tasks": summarize_tasks(),
+        "objects": summarize_objects(),
+        "cluster_resources": cluster_resources(),
+        "available_resources": available_resources(),
+    }

@@ -147,6 +147,8 @@ class TPESearcher(Searcher):
             return math.log(v) if log else float(v)
 
         lo, hi = xform(dom.lower), xform(dom.upper)
+        if hi <= lo:  # degenerate domain: only one value exists
+            return dom.sample(self._rng)
         gx = [xform(o[0][path]) for o in good if path in o[0]]
         bx = [xform(o[0][path]) for o in bad if path in o[0]]
         if not gx:
@@ -204,6 +206,11 @@ class TPESearcher(Searcher):
         """Feed an externally-known (config, score) pair — used when an
         interrupted experiment is restored."""
         self._obs.append((_flatten(config), float(score)))
+
+    def register(self, trial_id: str, config: Dict[str, Any]):
+        """Make an externally-created trial's config known so its eventual
+        on_trial_complete lands in the model (restored in-flight trials)."""
+        self._configs[trial_id] = _flatten(config)
 
     def on_restore(self, num_existing: int):
         self._count = max(self._count, num_existing)

@@ -87,6 +87,9 @@ class TuneController:
                 r = Trial.from_snapshot(s)
                 if not r.is_finished:
                     r.status = TrialStatus.PENDING
+                    register = getattr(self._searcher, "register", None)
+                    if register is not None:
+                        register(r.trial_id, r.config)
                 self.trials.append(r)
                 try:
                     idx = int(r.trial_id.rsplit("_", 1)[-1]) + 1

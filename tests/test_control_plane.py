@@ -1,0 +1,318 @@
+"""Control-plane services: state API, jobs, autoscaler, workflows,
+metrics, timeline, CLI.
+
+Reference test model: python/ray/tests/test_state_api.py,
+dashboard/modules/job/tests, autoscaler fake-node tests,
+workflow/tests, test_metrics_agent.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import runtime_context
+from ray_tpu.core.cluster.fixture import Cluster
+
+
+# ------------------------------------------------------------- state (local)
+
+
+def test_state_api_embedded():
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    os.environ["RTPU_TASK_EVENTS_ENABLED"] = "1"
+    from ray_tpu.core.config import config
+    config.reload()
+    try:
+        ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+        from ray_tpu import state
+
+        @ray_tpu.remote
+        class A:
+            def f(self):
+                return 1
+
+        a = A.remote()
+        ray_tpu.get(a.f.remote())
+
+        @ray_tpu.remote
+        def t(x):
+            return x
+
+        ray_tpu.get([t.remote(i) for i in range(5)])
+
+        s = state.state_summary()
+        assert len(s["nodes"]) == 1
+        assert any(x["state"] == "ALIVE" for x in s["actors"])
+        assert s["objects"]["tracked"] > 0
+        assert state.cluster_resources()["CPU"] == 2
+
+        # timeline captured the task events
+        trace = ray_tpu.timeline()
+        assert len(trace) >= 6
+        assert all(ev["ph"] == "X" and ev["dur"] >= 0 for ev in trace)
+    finally:
+        os.environ.pop("RTPU_TASK_EVENTS_ENABLED", None)
+        config.reload()
+        core = runtime_context.get_core_or_none()
+        if core is not None:
+            core.shutdown()
+        runtime_context.set_core(prev)
+
+
+# ------------------------------------------------------ cluster-side services
+
+
+@pytest.fixture()
+def cluster2():
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=2, num_workers_per_node=2)
+    c.wait_for_nodes(2)
+    yield c
+    c.shutdown()
+    runtime_context.set_core(prev)
+
+
+def test_state_api_cluster(cluster2):
+    cluster2.connect()
+    from ray_tpu import state
+
+    @ray_tpu.remote
+    def f():
+        return os.getpid()
+
+    ray_tpu.get([f.remote() for _ in range(4)], timeout=60)
+    nodes = state.list_nodes()
+    assert len(nodes) == 2 and all(n["state"] == "ALIVE" for n in nodes)
+    s = state.state_summary()
+    assert s["cluster_resources"]["CPU"] == 4
+    assert isinstance(state.list_workers(), list)
+
+
+def test_job_submission(cluster2):
+    from ray_tpu.core.cluster.rpc import RpcClient
+    from ray_tpu.job import JobAgent, JobStatus, JobSubmissionClient
+
+    gcs_addr = cluster2.gcs_address
+    os.environ["RTPU_CLUSTER_AUTHKEY"] = cluster2.authkey.hex()
+    try:
+        agent_gcs = RpcClient(gcs_addr, cluster2.authkey)
+        agent = JobAgent(agent_gcs, gcs_addr, "test-agent",
+                         log_dir="/tmp/ray_tpu_test_jobs")
+        client = JobSubmissionClient(f"{gcs_addr[0]}:{gcs_addr[1]}",
+                                     authkey=cluster2.authkey)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+        status = client.wait_until_finished(job_id, timeout=60)
+        assert status == JobStatus.SUCCEEDED
+        assert "hello from job" in client.get_job_logs(job_id)
+
+        # failing job surfaces FAILED
+        bad = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"import sys; sys.exit(3)\"")
+        assert client.wait_until_finished(bad, timeout=60) == JobStatus.FAILED
+        assert client.get_job_info(bad)["returncode"] == 3
+        assert len(client.list_jobs()) == 2
+        client.close()
+        agent.close()
+    finally:
+        os.environ.pop("RTPU_CLUSTER_AUTHKEY", None)
+
+
+def test_autoscaler_scales_up_and_down():
+    from ray_tpu.autoscaler import AutoscalerMonitor, SubprocessNodeProvider
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=1, num_workers_per_node=1)
+    try:
+        c.wait_for_nodes(1)
+        c.connect()
+        os.environ["RTPU_CLUSTER_AUTHKEY"] = c.authkey.hex()
+        provider = SubprocessNodeProvider(c.gcs_address, num_workers=1)
+        monitor = AutoscalerMonitor(
+            c.gcs_address, provider, min_nodes=1, max_nodes=2,
+            scale_up_after_ticks=2, scale_down_after_ticks=6,
+            tick_s=0.25, authkey=c.authkey)
+
+        @ray_tpu.remote
+        def slow():
+            time.sleep(0.6)
+            return os.getpid()
+
+        # flood one 1-worker node: queue builds -> a second node launches
+        refs = [slow.remote() for _ in range(16)]
+        deadline = time.monotonic() + 60
+        from ray_tpu.core.cluster.rpc import RpcClient
+        gcs = RpcClient(c.gcs_address, c.authkey)
+        while time.monotonic() < deadline:
+            view = gcs.call(("list_nodes", True))
+            if len(view["nodes"]) >= 2:
+                break
+            time.sleep(0.25)
+        assert len(gcs.call(("list_nodes", True))["nodes"]) >= 2, \
+            f"no scale-up: {monitor.events}"
+        ray_tpu.get(refs, timeout=120)
+
+        # drain: the extra node idles out and is terminated
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            view = gcs.call(("list_nodes", True))
+            if len(view["nodes"]) == 1:
+                break
+            time.sleep(0.5)
+        assert len(gcs.call(("list_nodes", True))["nodes"]) == 1, \
+            f"no scale-down: {monitor.events}"
+        monitor.stop()
+        gcs.close()
+        for p in provider.procs:
+            if p.poll() is None:
+                p.kill()
+    finally:
+        os.environ.pop("RTPU_CLUSTER_AUTHKEY", None)
+        c.shutdown()
+        runtime_context.set_core(prev)
+
+
+# --------------------------------------------------------------- workflows
+
+
+def test_workflow_run_and_resume(tmp_path, rt):
+    from ray_tpu import workflow
+
+    calls = str(tmp_path / "calls")
+    os.makedirs(calls)
+
+    @workflow.step
+    def double(x):
+        open(os.path.join(calls, f"double_{x}"), "a").write("1")
+        return x * 2
+
+    @workflow.step
+    def add(a, b):
+        open(os.path.join(calls, "add"), "a").write("1")
+        return a + b
+
+    storage = str(tmp_path / "wf")
+    dag = add.bind(double.bind(3), double.bind(4))
+    out = workflow.run(dag, workflow_id="w1", storage=storage)
+    assert out == 14
+    assert workflow.get_status("w1", storage=storage) == "SUCCESSFUL"
+
+    # resume: everything checkpointed, nothing re-executes
+    out2 = workflow.resume("w1", storage=storage)
+    assert out2 == 14
+    assert open(os.path.join(calls, "add")).read() == "1"
+
+    # rebuilding the same graph reuses checkpoints (deterministic ids)
+    dag2 = add.bind(double.bind(3), double.bind(4))
+    assert workflow.run(dag2, workflow_id="w1", storage=storage) == 14
+    assert open(os.path.join(calls, "add")).read() == "1"
+    assert [w["workflow_id"] for w in workflow.list_all(storage=storage)] \
+        == ["w1"]
+
+
+def test_workflow_failure_and_partial_resume(tmp_path, rt):
+    from ray_tpu import workflow
+
+    storage = str(tmp_path / "wf2")
+    marker = str(tmp_path / "ok")
+
+    @workflow.step
+    def stage1():
+        return 10
+
+    @workflow.step
+    def flaky(x):
+        if not os.path.exists(marker):
+            raise RuntimeError("not yet")
+        return x + 1
+
+    dag = flaky.bind(stage1.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2", storage=storage)
+    assert workflow.get_status("w2", storage=storage) == "FAILED"
+
+    open(marker, "w").close()
+    # resume executes only the failed suffix; stage1's checkpoint is reused
+    assert workflow.resume("w2", storage=storage) == 11
+    assert workflow.get_status("w2", storage=storage) == "SUCCESSFUL"
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_metrics_registry_and_http():
+    from ray_tpu import metrics
+
+    c = metrics.Counter("rtpu_test_total", "test counter", ("kind",))
+    c.inc(tags={"kind": "a"})
+    c.inc(2, tags={"kind": "a"})
+    g = metrics.Gauge("rtpu_test_gauge", "test gauge")
+    g.set(7.5)
+    h = metrics.Histogram("rtpu_test_hist", "test hist",
+                          boundaries=(1, 10))
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(50)
+
+    text = metrics.REGISTRY.render()
+    assert 'rtpu_test_total{kind="a"} 3.0' in text
+    assert "rtpu_test_gauge 7.5" in text
+    assert 'rtpu_test_hist_bucket{le="+Inf"} 3' in text
+
+    host, port = metrics.start_metrics_server()
+    try:
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10).read().decode()
+        assert "rtpu_test_gauge 7.5" in body
+    finally:
+        metrics.stop_metrics_server()
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def test_cli_start_status_job_stop(tmp_path):
+    env = dict(os.environ)
+    env["RTPU_CLUSTER_AUTHKEY"] = os.urandom(16).hex()
+    # isolated session file via HOME trick is overkill; just run the flow
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-workers", "1"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "GCS address" in out.stdout
+    try:
+        status = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "status"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert status.returncode == 0, status.stderr
+        assert "nodes: 1" in status.stdout
+
+        job = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "job", "submit", "--wait",
+             "--", sys.executable, "-c", "print(6*7)"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert job.returncode == 0, job.stderr
+        assert "SUCCEEDED" in job.stdout and "42" in job.stdout
+
+        nodes = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "state", "nodes"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert nodes.returncode == 0
+        assert len(json.loads(nodes.stdout)) == 1
+    finally:
+        stop = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "stop"],
+            capture_output=True, text=True, env=env, timeout=60)
+        assert stop.returncode == 0, stop.stderr
