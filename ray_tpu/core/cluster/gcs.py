@@ -71,6 +71,10 @@ class GcsServer:
         self._functions: Dict[bytes, bytes] = {}
         self._deaths: List[Tuple[int, bytes]] = []  # (seq, node_id)
         self._death_seq = 0
+        # pubsub channels: bounded event logs with long-poll subscribers
+        # (reference: src/ray/pubsub/publisher.h:296)
+        self._channels: Dict[str, List[Tuple[int, Any]]] = {}
+        self._channel_seq: Dict[str, int] = {}
         self._view_version = 0
         self._stop = False
         self._server = RpcServer(self._handle, authkey or cluster_authkey(),
@@ -98,6 +102,8 @@ class GcsServer:
         self._death_seq += 1
         info.death_seq = self._death_seq
         self._deaths.append((self._death_seq, info.node_id))
+        self._publish_locked("node_deaths", {
+            "node_id": info.node_id, "address": list(info.address)})
         self._view_version += 1
         # objects whose only location was the dead node are now lost
         dead_addr = info.address
@@ -279,6 +285,45 @@ class GcsServer:
                 if not locs:
                     del self._locations[oid]
         return True
+
+    # -- pubsub
+
+    _CHANNEL_CAP = 10_000
+
+    def _publish_locked(self, channel: str, message):
+        seq = self._channel_seq.get(channel, 0) + 1
+        self._channel_seq[channel] = seq
+        log = self._channels.setdefault(channel, [])
+        log.append((seq, message))
+        if len(log) > self._CHANNEL_CAP:
+            del log[: len(log) - self._CHANNEL_CAP]
+        self._cond.notify_all()
+
+    def _op_publish(self, channel: str, message):
+        with self._lock:
+            self._publish_locked(channel, message)
+            return self._channel_seq[channel]
+
+    def _op_poll(self, channel: str, since_seq: int, timeout: float = 0.0):
+        """Long-poll subscribe: messages with seq > since_seq, blocking up
+        to ``timeout`` for the first one. Returns [(seq, message)].
+
+        Seqs are contiguous per channel, so a slow subscriber can DETECT
+        trimming: if the first returned seq > since_seq + 1, the log was
+        truncated past its cursor and it should resync from a snapshot."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._channel_seq.get(channel, 0) > since_seq:
+                    log = self._channels[channel]
+                    # contiguous seqs: index the tail instead of scanning
+                    first_seq = log[0][0]
+                    start = max(0, since_seq + 1 - first_seq)
+                    return log[start:]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(remaining)
 
     # -- function table
 

@@ -28,7 +28,7 @@ from ray_tpu.core.object_ref import ObjectRef, begin_ref_collection, end_ref_col
 MSG_REGISTER_FN = "reg_fn"         # (MSG_REGISTER_FN, fn_id, pickled_fn)
 MSG_CREATE_ACTOR = "create_actor"  # (.., actor_id_b, cls_fn_id, args_payload, inline_values, opts)
 MSG_ACTOR_CALL = "actor_call"      # (.., task_id_b, actor_id_b, method, args_payload, inline_values, return_id_bytes)
-MSG_TASK_BATCH = "task_batch"      # (MSG_TASK_BATCH, [(task_id_b, fn_id, args_payload, inline_values, return_ids), ...])
+MSG_TASK_BATCH = "task_batch"      # (MSG_TASK_BATCH, [(task_id_b, fn_id, args_payload, inline_values, return_ids, runtime_env|None), ...])
 MSG_SHUTDOWN = "shutdown"
 
 # worker -> driver (task conn)

@@ -959,6 +959,7 @@ class Runtime:
                 entries.append((
                     spec.task_id.binary(), spec.fn_id, spec.args_payload,
                     inline_values, [r.binary() for r in spec.return_ids],
+                    spec.options.get("runtime_env"),
                 ))
             self._send_msg(w, (protocol.MSG_TASK_BATCH, entries))
         except (OSError, EOFError, BrokenPipeError):

@@ -360,7 +360,10 @@ class WorkerCore:
         """
         from ray_tpu.core.config import config
 
-        for task_id_b, fn_id, args_payload, inline_values, return_ids in tasks:
+        for entry in tasks:
+            task_id_b, fn_id, args_payload, inline_values, return_ids = \
+                entry[:5]
+            runtime_env = entry[5] if len(entry) > 5 else None
             if config.testing_kill_worker_prob > 0:
                 # Chaos injection (reference: WorkerKillerActor,
                 # python/ray/_private/test_utils.py:1597).
@@ -369,6 +372,7 @@ class WorkerCore:
                 if random.random() < config.testing_kill_worker_prob:
                     os._exit(1)
             self.current_task_id = TaskID(task_id_b)
+            saved_env = _apply_env(runtime_env)
             try:
                 fn = self._functions[fn_id]
                 args, kwargs = self._decode_args(args_payload, inline_values)
@@ -378,6 +382,7 @@ class WorkerCore:
             except BaseException as e:  # noqa: BLE001
                 self._send_error(task_id_b, e)
             finally:
+                _restore_env(saved_env)
                 self.current_task_id = None
 
     def _send_error(self, task_id_b: bytes, exc: BaseException):
@@ -390,6 +395,9 @@ class WorkerCore:
             cls = self._functions[cls_fn_id]
             args, kwargs = self._decode_args(args_payload, inline_values)
             self.current_actor_id = ActorID(actor_id_b)
+            # actor-scoped runtime_env: applied for the actor's lifetime
+            # (the worker is dedicated to it)
+            _apply_env(opts.get("runtime_env"))
             instance = cls(*args, **kwargs)
             self._actors[actor_id_b] = instance
             if opts.get("has_async_methods"):
@@ -432,6 +440,28 @@ class WorkerCore:
             self._send_error(task_id_b, e)
         finally:
             self.current_task_id = None
+
+
+def _apply_env(runtime_env):
+    """Apply a task's runtime_env env_vars; returns state for restore
+    (reference: python/ray/_private/runtime_env/ — the env-vars plugin;
+    container/conda isolation is out of scope for a shared worker pool)."""
+    env_vars = (runtime_env or {}).get("env_vars")
+    if not env_vars:
+        return None
+    saved = {k: os.environ.get(k) for k in env_vars}
+    os.environ.update({k: str(v) for k, v in env_vars.items()})
+    return saved
+
+
+def _restore_env(saved):
+    if not saved:
+        return
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
 
 
 def _prepare_args_local(core: WorkerCore, args: tuple, kwargs: dict):

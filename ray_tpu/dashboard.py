@@ -1,0 +1,103 @@
+"""Dashboard-lite: a single-page cluster overview over the state API.
+
+Reference: the Ray dashboard (python/ray/dashboard/) — here a stdlib HTTP
+server with two routes: ``/`` renders an auto-refreshing HTML overview and
+``/api/state`` returns the raw state_summary JSON (also the programmatic
+endpoint the CLI's `status` could target remotely).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="2">
+<style>
+ body {{ font-family: monospace; margin: 2em; background: #111;
+        color: #ddd; }}
+ h1 {{ color: #7fd4ff; }} h2 {{ color: #9f9; margin-bottom: 4px; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #444; padding: 3px 10px; text-align: left; }}
+ .dead {{ color: #f77; }}
+</style></head><body>
+<h1>ray_tpu</h1>
+<h2>resources</h2><pre>{resources}</pre>
+<h2>tasks</h2><pre>{tasks}</pre>
+<h2>objects</h2><pre>{objects}</pre>
+<h2>nodes ({n_nodes})</h2><table><tr><th>id</th><th>address</th>
+<th>state</th><th>resources</th></tr>{node_rows}</table>
+<h2>actors ({n_actors})</h2><table><tr><th>id</th><th>name</th>
+<th>state</th></tr>{actor_rows}</table>
+</body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        from ray_tpu import state
+
+        try:
+            s = state.state_summary()
+        except Exception as e:  # noqa: BLE001
+            self._reply(500, f"state unavailable: {e!r}".encode(),
+                        "text/plain")
+            return
+        if self.path.startswith("/api"):
+            self._reply(200, json.dumps(s, default=str).encode(),
+                        "application/json")
+            return
+        node_rows = "".join(
+            f"<tr><td>{n['node_id'][:12]}</td>"
+            f"<td>{html.escape(str(n['address']))}</td>"
+            f"<td class={'dead' if n['state'] != 'ALIVE' else 'ok'}>"
+            f"{n['state']}</td>"
+            f"<td>{html.escape(str(n['resources']))}</td></tr>"
+            for n in s["nodes"])
+        actor_rows = "".join(
+            f"<tr><td>{a.get('actor_id', '')[:12]}</td>"
+            f"<td>{html.escape(str(a.get('name') or ''))}</td>"
+            f"<td>{a.get('state', '')}</td></tr>"
+            for a in s["actors"])
+        page = _PAGE.format(
+            resources=html.escape(
+                f"total: {s['cluster_resources']}\n"
+                f"avail: {s['available_resources']}"),
+            tasks=html.escape(str(s["tasks"])),
+            objects=html.escape(str(s["objects"])),
+            n_nodes=len(s["nodes"]), node_rows=node_rows,
+            n_actors=len(s["actors"]), actor_rows=actor_rows)
+        self._reply(200, page.encode(), "text/html")
+
+    def _reply(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0):
+    global _server
+    if _server is None:
+        _server = ThreadingHTTPServer((host, port), _Handler)
+        threading.Thread(target=_server.serve_forever, daemon=True,
+                         name="dashboard-http").start()
+    return _server.server_address
+
+
+def stop_dashboard():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()  # release the listening socket now
+        _server = None

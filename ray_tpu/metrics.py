@@ -158,4 +158,5 @@ def stop_metrics_server():
     global _server
     if _server is not None:
         _server.shutdown()
+        _server.server_close()  # release the listening socket now
         _server = None

@@ -141,12 +141,11 @@ def _attend(cfg: LlamaConfig, q, k, v, mesh=None):
     return attention_reference(q, k, v, causal=True)
 
 
-def _layer(cfg: LlamaConfig, x, layer_params, cos, sin, mesh=None):
-    """One decoder block. x: [b, s, h]."""
-    p = layer_params
+def attention_block(cfg: LlamaConfig, x, p, cos, sin, mesh=None):
+    """Pre-norm attention sub-block with residual: x + wo(attend(qkv)).
+    Shared by every model in the family (llama dense, mixtral MoE)."""
     b, s, _ = x.shape
     hd = cfg.head_dim_
-
     h1 = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
     q = jnp.dot(h1, p["wq"].astype(cfg.dtype),
                 preferred_element_type=jnp.float32).astype(cfg.dtype)
@@ -163,8 +162,13 @@ def _layer(cfg: LlamaConfig, x, layer_params, cos, sin, mesh=None):
     attn = attn.reshape(b, s, cfg.num_heads * hd)
     attn_out = jnp.dot(attn, p["wo"].astype(cfg.dtype),
                        preferred_element_type=jnp.float32).astype(cfg.dtype)
-    x = x + attn_out
+    return x + attn_out
 
+
+def _layer(cfg: LlamaConfig, x, layer_params, cos, sin, mesh=None):
+    """One decoder block. x: [b, s, h]."""
+    p = layer_params
+    x = attention_block(cfg, x, p, cos, sin, mesh=mesh)
     h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
     mlp = swiglu(h2, p["w_gate"].astype(cfg.dtype),
                  p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype))

@@ -1,0 +1,30 @@
+"""Usage stats: OPT-IN, local-file-only session records.
+
+Reference: python/ray/_private/usage/usage_lib.py (phones home unless
+disabled). This framework inverts the default — nothing is recorded
+unless RTPU_USAGE_STATS_ENABLED=1, and records only ever go to a local
+JSON file (no network reporting exists)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict
+
+USAGE_FILE = "/tmp/ray_tpu_usage.json"
+
+
+def enabled() -> bool:
+    return os.environ.get("RTPU_USAGE_STATS_ENABLED", "0") == "1"
+
+
+def record(event: str, **fields: Any) -> None:
+    if not enabled():
+        return
+    entry: Dict[str, Any] = {"event": event, "ts": time.time(), **fields}
+    try:
+        with open(USAGE_FILE, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass

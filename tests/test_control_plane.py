@@ -316,3 +316,85 @@ def test_cli_start_status_job_stop(tmp_path):
             [sys.executable, "-m", "ray_tpu", "stop"],
             capture_output=True, text=True, env=env, timeout=60)
         assert stop.returncode == 0, stop.stderr
+
+
+# ------------------------------------------------------ pubsub / env / dash
+
+
+def test_gcs_pubsub():
+    from ray_tpu.core.cluster.gcs import GcsServer
+    from ray_tpu.core.cluster.rpc import RpcClient
+
+    gcs = GcsServer(authkey=b"k2")
+    try:
+        c = RpcClient(gcs.address, b"k2")
+        assert c.call(("poll", "chan1", 0, 0.1)) == []
+        seq = c.call(("publish", "chan1", {"x": 1}))
+        assert seq == 1
+        msgs = c.call(("poll", "chan1", 0, 1.0))
+        assert msgs == [(1, {"x": 1})]
+        # long-poll wakes on publish from another connection
+        import threading
+        got = []
+        t = threading.Thread(target=lambda: got.extend(
+            c.call(("poll", "chan1", 1, 10.0))))
+        t.start()
+        time.sleep(0.2)
+        RpcClient(gcs.address, b"k2").call(("publish", "chan1", "late"))
+        t.join(10)
+        assert got == [(2, "late")]
+        c.close()
+    finally:
+        gcs.close()
+
+
+def test_runtime_env_env_vars(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_VAR": "abc"}})
+    def read_env():
+        return os.environ.get("RTPU_TEST_VAR")
+
+    @ray_tpu.remote
+    def read_env_plain():
+        return os.environ.get("RTPU_TEST_VAR")
+
+    assert ray_tpu.get(read_env.remote()) == "abc"
+    # env is restored after the task: cover every pool worker so the one
+    # that ran read_env is definitely observed again
+    vals = ray_tpu.get([read_env_plain.remote() for _ in range(16)])
+    assert all(v is None for v in vals)
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_SCOPE": "yes"}})
+    class EnvActor:
+        def get(self):
+            return os.environ.get("ACTOR_SCOPE")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.get.remote()) == "yes"
+
+
+def test_dashboard_lite(rt):
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    host, port = start_dashboard()
+    try:
+        page = urllib.request.urlopen(
+            f"http://{host}:{port}/", timeout=15).read().decode()
+        assert "ray_tpu" in page and "resources" in page
+        api = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/api/state", timeout=15).read())
+        assert "nodes" in api and "cluster_resources" in api
+    finally:
+        stop_dashboard()
+
+
+def test_usage_stats_opt_in(tmp_path, monkeypatch):
+    from ray_tpu import usage_stats
+
+    monkeypatch.setattr(usage_stats, "USAGE_FILE",
+                        str(tmp_path / "usage.json"))
+    usage_stats.record("init", workers=2)  # disabled: no file
+    assert not os.path.exists(usage_stats.USAGE_FILE)
+    monkeypatch.setenv("RTPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.record("init", workers=2)
+    line = json.loads(open(usage_stats.USAGE_FILE).read())
+    assert line["event"] == "init" and line["workers"] == 2

@@ -151,3 +151,85 @@ def test_gpt2_sharded(gpt2_setup):
     p_sharded = jax.device_put(params, gpt2.param_shardings(cfg, mesh))
     f = jax.jit(lambda p, t: gpt2.loss_fn(cfg, p, {"tokens": t}))
     np.testing.assert_allclose(float(f(p_sharded, tokens)), base, rtol=1e-4)
+
+
+# ------------------------------------------------------------------ mixtral
+
+
+def test_moe_forward_and_aux():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import mixtral
+
+    cfg = mixtral.MixtralConfig.tiny()
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    logits, aux = mixtral.forward(cfg, params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert jnp.isfinite(logits).all() and jnp.isfinite(aux)
+    assert float(aux) >= 0.0
+    loss = mixtral.loss_fn(cfg, params, {"tokens": tokens})
+    # near-uniform at init (plus small aux)
+    import math
+
+    assert abs(float(loss) - math.log(cfg.vocab_size)) < 1.0
+
+
+def test_moe_single_expert_equals_dense_mlp():
+    """With E=1, k=1 and ample capacity the routed layer must reduce to a
+    plain SwiGLU MLP — the numerics oracle for dispatch/combine."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import mixtral
+    from ray_tpu.ops.layers import swiglu
+
+    cfg = mixtral.MixtralConfig.tiny(num_experts=1, top_k=1,
+                                     capacity_factor=2.0)
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    p0 = jax.tree_util.tree_map(lambda a: a[0], params["layers"])
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, cfg.hidden_size),
+                          jnp.float32)
+    out, aux = mixtral.moe_layer(cfg, p0, x)
+    dense = swiglu(x, p0["e_gate"][0], p0["e_up"][0], p0["e_down"][0])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_moe_expert_parallel_train_step():
+    """Full train step with experts sharded over ep on the 8-device mesh
+    (dp=2, ep=4): compiles, runs, loss finite and matches replicated."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import mixtral
+    from ray_tpu.parallel import MeshSpec, build_mesh, named_sharding
+
+    cfg = mixtral.MixtralConfig.tiny()
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                                cfg.vocab_size)
+    base = float(mixtral.loss_fn(cfg, params, {"tokens": tokens}))
+
+    mesh = build_mesh(MeshSpec({"dp": 2, "ep": 4}))
+    p_sh = jax.device_put(params, mixtral.param_shardings(cfg, mesh))
+    t_sh = jax.device_put(tokens, named_sharding(mesh, "batch", None))
+
+    tx = optax.adamw(1e-3)
+    opt = tx.init(p_sh)
+
+    def step(p, o, t):
+        loss, grads = jax.value_and_grad(
+            lambda q: mixtral.loss_fn(cfg, q, {"tokens": t}))(p)
+        upd, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, upd), o, loss
+
+    p2, o2, loss = jax.jit(step)(p_sh, opt, t_sh)
+    assert abs(float(loss) - base) < 1e-2
+    # expert weights are actually partitioned over ep
+    sh = p2["layers"]["e_gate"].sharding.spec
+    assert "ep" in str(sh)
