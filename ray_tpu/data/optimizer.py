@@ -1,0 +1,105 @@
+"""Logical-plan optimizer: a rule framework over the Data logical DAG.
+
+Reference: python/ray/data/_internal/logical/optimizers.py (LogicalOptimizer
+running a Rule list) and rules/ (operator fusion, limit pushdown, ...).
+Physical Read->Map / Map->Map fusion stays in the planner's lowering (it
+needs physical-operator knowledge); the rules here rewrite the LOGICAL
+graph before lowering. Custom rules register via ``register_rule`` (the
+extension point the reference exposes through DataContext).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Type
+
+from ray_tpu.data import logical as L
+
+
+class Rule:
+    """A logical-plan rewrite. apply() returns the (possibly new) root."""
+
+    def apply(self, root: L.LogicalOperator) -> L.LogicalOperator:
+        raise NotImplementedError
+
+    # -- helpers -------------------------------------------------------------
+
+    def _rewrite(self, node: L.LogicalOperator,
+                 fn: Callable[[L.LogicalOperator], L.LogicalOperator]
+                 ) -> L.LogicalOperator:
+        """Bottom-up rewrite over CLONES of the inputs DAG.
+
+        Datasets share logical nodes by reference (every derived Dataset
+        wraps its parent's op), so rules must never mutate the originals —
+        an in-place rewrite would corrupt sibling pipelines and
+        re-executions. Clones are memoized per original so diamond DAGs
+        (zip(ds, ds)) keep their sharing."""
+        import copy
+
+        memo: dict = {}
+
+        def walk(n: L.LogicalOperator) -> L.LogicalOperator:
+            got = memo.get(id(n))
+            if got is not None:
+                return got
+            clone = copy.copy(n)
+            clone.inputs = [walk(c) for c in n.inputs]
+            out = fn(clone)
+            memo[id(n)] = out
+            return out
+
+        return walk(node)
+
+
+class MergeLimits(Rule):
+    """Limit(Limit(x, a), b) -> Limit(x, min(a, b))."""
+
+    def apply(self, root):
+        def fn(node):
+            if (isinstance(node, L.Limit) and node.inputs
+                    and isinstance(node.inputs[0], L.Limit)):
+                inner = node.inputs[0]
+                node.limit = min(node.limit, inner.limit)
+                node.name = f"Limit[{node.limit}]"
+                node.inputs = list(inner.inputs)
+            return node
+        return self._rewrite(root, fn)
+
+
+class LimitPushdown(Rule):
+    """Push Limit beneath row-preserving maps so upstream work stops at
+    the limit (reference: rules/limit_pushdown.py). Only 'map_rows' maps
+    are strictly 1:1; batch maps / flat_map / filter change counts."""
+
+    _PUSHABLE = ("map_rows",)
+
+    def apply(self, root):
+        def fn(node):
+            if (isinstance(node, L.Limit) and node.inputs
+                    and isinstance(node.inputs[0], L.AbstractMap)
+                    and node.inputs[0].kind in self._PUSHABLE):
+                m = node.inputs[0]
+                node.inputs = list(m.inputs)
+                m.inputs = [node]
+                return m
+            return node
+        return self._rewrite(root, fn)
+
+
+_DEFAULT_RULES: List[Type[Rule]] = [MergeLimits, LimitPushdown]
+_EXTRA_RULES: List[Type[Rule]] = []
+
+
+def register_rule(rule_cls: Type[Rule]) -> None:
+    """Add a custom rule (applied after the built-ins)."""
+    _EXTRA_RULES.append(rule_cls)
+
+
+class LogicalOptimizer:
+    def __init__(self, rules: List[Type[Rule]] = None):
+        self._rules = list(rules) if rules is not None else (
+            _DEFAULT_RULES + _EXTRA_RULES)
+
+    def optimize(self, root: L.LogicalOperator) -> L.LogicalOperator:
+        for rule_cls in self._rules:
+            root = rule_cls().apply(root)
+        return root

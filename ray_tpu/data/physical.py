@@ -385,6 +385,7 @@ class ActorPoolMapOperator(PhysicalOperator):
         self._meta_to_actor: Dict[ObjectRef, int] = {}
         self._udf_cls = udf_cls
         self._ctor_args = fn_constructor_args
+        self._idle_since: Dict[int, float] = {}
         self._resources = resources or {}
         self._started = False
 
@@ -405,12 +406,15 @@ class ActorPoolMapOperator(PhysicalOperator):
         cap = self._strategy.max_tasks_in_flight_per_actor
         return any(load < cap for load in self._actor_load.values())
 
+    def _alive_count(self) -> int:
+        return len(self._actor_load)
+
     def launch_one(self):
         self._ensure_pool()
         idx = min(self._actor_load, key=self._actor_load.get)
         # Scale up if every actor is saturated and we're under max_size.
         if (self._actor_load[idx] > 0 and
-                len(self._actors) < self._strategy.max_size):
+                self._alive_count() < self._strategy.max_size):
             a = _MapWorker.options(**self._resources).remote(
                 self._udf_cls, self._ctor_args)
             self._actors.append(a)
@@ -422,15 +426,52 @@ class ActorPoolMapOperator(PhysicalOperator):
         self._track(meta_ref, blocks_ref)
         self._meta_to_actor[meta_ref] = idx
         self._actor_load[idx] += 1
+        self._idle_since.pop(idx, None)
         self.tasks_launched += 1
 
+    # Seconds an actor must stay idle before scale-down reaps it: a
+    # momentary drain of the input queue in a streaming pipeline must not
+    # churn workers whose UDF constructors are expensive (model loads).
+    IDLE_REAP_S = 2.0
+
     def on_task_done(self, meta_ref: ObjectRef):
+        import time as _time
+
         idx = self._meta_to_actor.pop(meta_ref)
         self._actor_load[idx] -= 1
+        if self._actor_load[idx] == 0:
+            self._idle_since[idx] = _time.monotonic()
+        self._maybe_reap()
         super().on_task_done(meta_ref)
+
+    def _maybe_reap(self):
+        """Scale DOWN: release actors idle past the grace period, above the
+        pool floor (reference: the autoscaling actor pool's idle reaping —
+        which is likewise timeout-based)."""
+        import time as _time
+
+        if self.input_queue:
+            return
+        now = _time.monotonic()
+        for idx, since in list(self._idle_since.items()):
+            if idx not in self._actor_load or self._actor_load[idx] != 0:
+                self._idle_since.pop(idx, None)
+                continue
+            if (now - since >= self.IDLE_REAP_S
+                    and self._alive_count() > self._strategy.min_size):
+                actor = self._actors[idx]
+                self._actors[idx] = None  # tombstone keeps indices stable
+                del self._actor_load[idx]
+                self._idle_since.pop(idx, None)
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
 
     def shutdown(self):
         for a in self._actors:
+            if a is None:
+                continue
             try:
                 ray_tpu.kill(a)
             except Exception:

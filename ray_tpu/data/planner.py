@@ -49,6 +49,9 @@ class Planner:
         self._ctx = context or DataContext.get_current()
 
     def plan(self, dag: L.LogicalOperator) -> Topology:
+        from ray_tpu.data.optimizer import LogicalOptimizer
+
+        dag = LogicalOptimizer().optimize(dag)
         ops: List[PhysicalOperator] = []
         edges: Dict[int, List[Tuple[PhysicalOperator, int]]] = {}
 

@@ -140,12 +140,57 @@ class CollectiveGroup:
             f"(rank {self.rank}/{self.world_size})"
         )
 
+    # ---- ring allreduce ------------------------------------------------------
+
+    # Above this size the host backend switches from the star (everything
+    # through the coordinator) to a RING: chunks hop peer-to-peer as
+    # ObjectRefs, the shm store is the data plane, and the coordinator
+    # mailbox only rendezvouses refs — O(N) total movement per member and
+    # O(refs) coordinator memory instead of O(world x N) payloads.
+    RING_THRESHOLD_BYTES = 1 << 20
+
+    def _ring_allreduce(self, arr: np.ndarray, op: str, timeout: float):
+        import ray_tpu
+
+        W, r = self.world_size, self.rank
+        self._round += 1
+        base = self._round * 10_000
+        flat = arr.ravel()
+        bounds = np.linspace(0, flat.size, W + 1).astype(int)
+        own = [flat[bounds[i]: bounds[i + 1]].copy() for i in range(W)]
+
+        def send_chunk(chunk, tag):
+            ref = ray_tpu.put(np.ascontiguousarray(chunk))
+            # nested (listed) refs pass through UNRESOLVED, so the
+            # coordinator mailbox holds the ref, never the payload
+            ray_tpu.get(self._coord.post.remote(r, (r + 1) % W, tag, [ref]))
+
+        def recv_chunk(tag):
+            boxed = self.recv((r - 1) % W, tag=tag, timeout=timeout)
+            return np.asarray(ray_tpu.get(boxed[0]))
+
+        # phase 1: reduce-scatter around the ring
+        for s in range(W - 1):
+            send_chunk(own[(r - s) % W], base + s)
+            idx = (r - s - 1) % W
+            own[idx] = _reduce2(own[idx], recv_chunk(base + s), op)
+        # phase 2: all-gather the reduced chunks
+        for s in range(W - 1):
+            send_chunk(own[(r + 1 - s) % W], base + 5000 + s)
+            idx = (r - s) % W
+            own[idx] = recv_chunk(base + 5000 + s)
+        return np.concatenate(own).reshape(arr.shape)
+
     # ---- API ----------------------------------------------------------------
 
     def allreduce(self, tensor, op: str = "sum", timeout: float = 120.0):
         if self.backend == "xla":
             return _xla_allreduce(self._mesh, tensor, op)
-        return self._sync_op(np.asarray(tensor), op, timeout)
+        arr = np.asarray(tensor)
+        if (self.world_size > 1 and op in REDUCE_OPS
+                and arr.nbytes >= self.RING_THRESHOLD_BYTES):
+            return self._ring_allreduce(arr, op, timeout)
+        return self._sync_op(arr, op, timeout)
 
     def allgather(self, tensor, timeout: float = 120.0) -> List[Any]:
         return self._sync_op(np.asarray(tensor), "gather", timeout)
@@ -180,6 +225,16 @@ class CollectiveGroup:
                 return data
             time.sleep(0.002)
         raise TimeoutError(f"recv from rank {src_rank} timed out")
+
+
+def _reduce2(a: np.ndarray, b: np.ndarray, op: str) -> np.ndarray:
+    if op == "sum":
+        return a + b
+    if op == "prod":
+        return a * b
+    if op == "min":
+        return np.minimum(a, b)
+    return np.maximum(a, b)
 
 
 def _xla_allreduce(mesh, tensor, op: str):

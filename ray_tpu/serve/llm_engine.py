@@ -209,10 +209,12 @@ class LLMEngine:
             toks[s] = self._slot_tokens[s][-1]
             poss[s] = self._slot_pos[s]
             act[s] = True
-        # Chunked decode when no request is waiting to join (admission
-        # happens at chunk boundaries); single step when the queue has
-        # work, to keep TTFT low.
-        k = 1 if not self._in.empty() else self._chunk_steps
+        # Chunked decode by default; drop to single steps only when a
+        # waiting request could actually be admitted (a free slot exists)
+        # so its TTFT isn't held behind a whole chunk. With all slots
+        # busy, chunking through a non-empty queue is pure win.
+        k = (1 if (self._free and not self._in.empty())
+             else self._chunk_steps)
         k = min(k, max(1, self._max_len - 1 - max(
             self._slot_pos[s] for s in active_slots)))
         if k > 1:

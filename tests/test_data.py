@@ -468,3 +468,60 @@ def test_streaming_split_sequential_large():
     a = sum(1 for _ in its[0].iter_rows())
     b = sum(1 for _ in its[1].iter_rows())
     assert a == b == 200
+
+
+# ----------------------------------------------------------- optimizer rules
+
+
+def test_optimizer_merges_and_pushes_limits():
+    from ray_tpu.data import logical as L
+    from ray_tpu.data.optimizer import LogicalOptimizer
+
+    read = L.InputData([])
+    m = L.AbstractMap("Map", read, "map_rows", lambda r: r)
+    lim1 = L.Limit(m, 10)
+    lim2 = L.Limit(lim1, 5)
+    root = LogicalOptimizer().optimize(lim2)
+    # merged to one Limit[5], pushed beneath the 1:1 map
+    assert isinstance(root, L.AbstractMap)
+    assert isinstance(root.inputs[0], L.Limit)
+    assert root.inputs[0].limit == 5
+    # rules rewrite CLONES: the original nodes are never mutated
+    assert isinstance(root.inputs[0].inputs[0], L.InputData)
+    assert lim2.inputs[0] is lim1 and lim1.inputs[0] is m
+
+
+def test_optimizer_limit_pipeline_result(rt):
+    import ray_tpu.data as rd
+
+    out = rd.range(1000, parallelism=8).map(
+        lambda r: {"id": r["id"] * 2}).limit(7).take_all()
+    assert [r["id"] for r in out] == [0, 2, 4, 6, 8, 10, 12]
+
+
+def test_actor_pool_scales_down(rt):
+    import ray_tpu.data as rd
+    from ray_tpu.data.logical import ActorPoolStrategy
+
+    class AddOne:
+        def __call__(self, batch):
+            return {"id": [x + 1 for x in batch["id"]]}
+
+    ds = rd.range(200, parallelism=16).map_batches(
+        AddOne, compute=ActorPoolStrategy(min_size=1, max_size=3))
+    total = sum(r["id"] for r in ds.iter_rows())
+    assert total == sum(range(1, 201))
+
+
+def test_optimizer_does_not_corrupt_shared_plans(rt):
+    """Executing a derived dataset must never rewrite nodes its parent
+    still references (rules rewrite clones, not originals)."""
+    import ray_tpu.data as rd
+
+    base = rd.range(20, parallelism=4).map(lambda r: {"id": r["id"] * 2})
+    lim = base.limit(5)
+    assert [r["id"] for r in lim.take_all()] == [0, 2, 4, 6, 8]
+    # repeat execution: same answer (no in-place plan mutation)
+    assert [r["id"] for r in lim.take_all()] == [0, 2, 4, 6, 8]
+    # the parent pipeline is untouched
+    assert len(base.take_all()) == 20

@@ -200,3 +200,33 @@ def test_host_collective_group_across_actors(rt):
     sr = rt.get([m.do_sendrecv.remote(99) for m in members[:2]], timeout=60)
     assert sr[0] is None
     np.testing.assert_array_equal(sr[1], [99])
+
+
+def test_host_ring_allreduce_matches_star(rt):
+    """Large payloads take the ring path (peer-to-peer chunk refs); the
+    result must match the star path exactly."""
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def member(rank, world, n):
+        import numpy as np
+
+        from ray_tpu.parallel import collective as col
+
+        g = col.init_collective_group(world, rank, group_name=f"ring{world}")
+        arr = np.arange(n, dtype=np.float64) * (rank + 1)
+        out = g.allreduce(arr, op="sum")
+        col.destroy_collective_group(f"ring{world}")
+        return out[:5], float(out.sum())
+
+    world = 3
+    n = 300_000  # 2.4MB > ring threshold
+    refs = [member.remote(r, world, n) for r in range(world)]
+    outs = ray_tpu.get(refs, timeout=120)
+    base = np.arange(n, dtype=np.float64)
+    expect = base * (1 + 2 + 3)
+    for head, total in outs:
+        np.testing.assert_allclose(head, expect[:5])
+        assert abs(total - expect.sum()) < 1e-6
