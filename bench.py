@@ -156,6 +156,44 @@ def bench_flash_numerics():
     return err
 
 
+def bench_moe_train(batch: int = 8, seq: int = 1024, steps: int = 8):
+    """MoE (Mixtral-style) train step on the chip: tokens/sec/chip for the
+    ~620M-param moe_proxy (8 experts, top-2). BASELINE config #3 names
+    expert-parallel MoE; single-chip establishes the per-chip number."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import mixtral
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = (mixtral.MixtralConfig.moe_proxy(param_dtype=jnp.bfloat16)
+           if on_tpu else mixtral.MixtralConfig.tiny())
+    if not on_tpu:
+        batch, seq, steps = 2, 64, 2
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    tx = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = tx.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+
+    def _step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: mixtral.loss_fn(cfg, p, {"tokens": tokens}))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
+    return batch * seq / dt
+
+
 def bench_serve_ttft(n_requests: int = 16):
     """Serve LLM engine on the chip: p50 TTFT + decode throughput.
 
@@ -401,7 +439,16 @@ def main():
             rows.append({"metric": "flash_bwd_grad_max_err_vs_ref",
                          "value": -1, "unit": f"error: {e}"})
 
-    # 2) serve: p50 TTFT + continuous-batched decode throughput on the chip
+    # 2) MoE train step on the chip
+    try:
+        moe_tok_s = bench_moe_train()
+        rows.append(_row("moe_train_tokens_per_sec_per_chip", moe_tok_s,
+                         "tokens/s/chip"))
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "moe_train_tokens_per_sec_per_chip",
+                     "value": -1, "unit": f"error: {e}"})
+
+    # 3) serve: p50 TTFT + continuous-batched decode throughput on the chip
     try:
         ttft_ms, dec_tok_s = bench_serve_ttft()
         rows.append(_row("serve_ttft_p50_ms", ttft_ms, "ms"))

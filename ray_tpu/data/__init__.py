@@ -26,7 +26,9 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_numpy,
     read_parquet,
     read_text,
+    read_sql,
     read_tfrecords,
+    read_webdataset,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask  # noqa: F401
 from ray_tpu.data.grouped import (  # noqa: F401
@@ -52,5 +54,5 @@ __all__ = [
     "range", "range_tensor", "from_items", "from_numpy", "from_arrow",
     "from_pandas", "from_blocks", "read_datasource", "read_parquet",
     "read_csv", "read_json", "read_numpy", "read_text",
-    "read_binary_files", "read_tfrecords",
+    "read_binary_files", "read_tfrecords", "read_webdataset", "read_sql",
 ]

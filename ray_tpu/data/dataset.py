@@ -439,3 +439,18 @@ def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
 def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
     return read_datasource(TFRecordsDatasource(paths),
                            parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import WebDatasetDatasource
+
+    return read_datasource(WebDatasetDatasource(paths),
+                           parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = -1
+             ) -> Dataset:
+    from ray_tpu.data.datasource import SQLDatasource
+
+    return read_datasource(SQLDatasource(sql, connection_factory),
+                           parallelism=parallelism)

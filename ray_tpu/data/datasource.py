@@ -233,6 +233,83 @@ class TFRecordsDatasource(FileDatasource):
         yield pa.table({"bytes": pa.array(records, type=pa.binary())})
 
 
+class WebDatasetDatasource(FileDatasource):
+    """WebDataset-style tar shards (reference:
+    _internal/datasource/webdataset_datasource.py): each sample is the
+    group of tar members sharing a basename; extensions become columns
+    holding raw bytes (decoding is a downstream map)."""
+
+    suffixes = [".tar"]
+
+    def read_file(self, path: str):
+        import tarfile
+
+        samples: dict = {}
+        order: List[str] = []
+        with tarfile.open(path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                # webdataset convention: split at the first dot of the LAST
+                # path component (dotted directories stay in the key)
+                dirname, _, fname = member.name.rpartition("/")
+                stem, _, ext = fname.partition(".")
+                base = f"{dirname}/{stem}" if dirname else stem
+                data = tf.extractfile(member).read()
+                if base not in samples:
+                    samples[base] = {"__key__": base}
+                    order.append(base)
+                samples[base][ext or "bin"] = data
+        if not order:
+            return
+        cols = sorted({k for s in samples.values() for k in s})
+        table = {}
+        for c in cols:
+            vals = [samples[b].get(c) for b in order]
+            if c == "__key__":
+                table[c] = pa.array(vals, type=pa.string())
+            else:
+                table[c] = pa.array(vals, type=pa.binary())
+        yield pa.table(table)
+
+
+class SQLDatasource(Datasource):
+    """Rows from a DBAPI connection factory (reference:
+    _internal/datasource/sql_datasource.py; works out of the box with
+    stdlib sqlite3)."""
+
+    def __init__(self, sql: str, connection_factory):
+        self._sql = sql
+        self._factory = connection_factory
+
+    def estimate_inmemory_data_size(self):
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        sql, factory = self._sql, self._factory
+
+        def read():
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                names = [d[0] for d in cur.description]
+                # page the cursor so huge result sets stream as bounded
+                # blocks instead of one fetchall() materialization
+                while True:
+                    rows = cur.fetchmany(10_000)
+                    if not rows:
+                        break
+                    cols = {n: pa.array([r[i] for r in rows])
+                            for i, n in enumerate(names)}
+                    yield pa.table(cols)
+            finally:
+                conn.close()
+
+        return [ReadTask(read, BlockMetadata(num_rows=0, size_bytes=0,
+                                             input_files=[]))]
+
+
 # ---- writers ---------------------------------------------------------------
 
 def write_block(block: Block, path: str, file_format: str, index: int,

@@ -73,14 +73,20 @@ class LimitPushdown(Rule):
     _PUSHABLE = ("map_rows",)
 
     def apply(self, root):
+        import copy
+
         def fn(node):
             if (isinstance(node, L.Limit) and node.inputs
                     and isinstance(node.inputs[0], L.AbstractMap)
                     and node.inputs[0].kind in self._PUSHABLE):
                 m = node.inputs[0]
+                # m may be a memoized clone SHARED with sibling branches
+                # (diamond plans: base.union(base.limit(k))) — rewire a
+                # fresh copy so the unlimited branches keep plain m
+                m2 = copy.copy(m)
                 node.inputs = list(m.inputs)
-                m.inputs = [node]
-                return m
+                m2.inputs = [node]
+                return m2
             return node
         return self._rewrite(root, fn)
 

@@ -209,12 +209,13 @@ class LLMEngine:
             toks[s] = self._slot_tokens[s][-1]
             poss[s] = self._slot_pos[s]
             act[s] = True
-        # Chunked decode by default; drop to single steps only when a
-        # waiting request could actually be admitted (a free slot exists)
-        # so its TTFT isn't held behind a whole chunk. With all slots
-        # busy, chunking through a non-empty queue is pure win.
-        k = (1 if (self._free and not self._in.empty())
-             else self._chunk_steps)
+        # Chunked decode by default. With requests waiting (the pool is
+        # saturated — _admit just drained the queue into any free slots),
+        # use SHORT chunks so a slot freed by a mid-chunk EOS admits the
+        # next request within a few steps instead of a full chunk; the
+        # roundtrip still amortizes over the batch.
+        k = (self._chunk_steps if self._in.empty()
+             else max(1, min(4, self._chunk_steps)))
         k = min(k, max(1, self._max_len - 1 - max(
             self._slot_pos[s] for s in active_slots)))
         if k > 1:

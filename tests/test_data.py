@@ -525,3 +525,53 @@ def test_optimizer_does_not_corrupt_shared_plans(rt):
     assert [r["id"] for r in lim.take_all()] == [0, 2, 4, 6, 8]
     # the parent pipeline is untouched
     assert len(base.take_all()) == 20
+
+
+def test_read_webdataset(rt, tmp_path):
+    import io
+    import tarfile
+
+    import ray_tpu.data as rd
+
+    tar_path = str(tmp_path / "shard-000.tar")
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(3):
+            for ext, payload in (("txt", f"caption {i}".encode()),
+                                 ("cls", str(i).encode())):
+                info = tarfile.TarInfo(f"sample{i:04d}.{ext}")
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+    ds = rd.read_webdataset(tar_path)
+    rows = ds.take_all()
+    assert len(rows) == 3
+    assert rows[0]["__key__"] == "sample0000"
+    assert rows[2]["txt"] == b"caption 2" and rows[2]["cls"] == b"2"
+
+
+def test_read_sql(rt, tmp_path):
+    import sqlite3
+
+    import ray_tpu.data as rd
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?, ?)",
+                     [(i, f"n{i}") for i in range(10)])
+    conn.commit()
+    conn.close()
+    ds = rd.read_sql("SELECT id, name FROM t WHERE id >= 5",
+                     lambda: sqlite3.connect(db))
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert [r["id"] for r in rows] == [5, 6, 7, 8, 9]
+    assert rows[0]["name"] == "n5"
+
+
+def test_optimizer_diamond_limit_isolated(rt):
+    """A Limit pushed down one branch of a diamond must not leak into the
+    sibling branch sharing the same map node."""
+    import ray_tpu.data as rd
+
+    base = rd.range(100, parallelism=4).map(lambda r: {"id": r["id"]})
+    u = base.union(base.limit(5))
+    assert u.count() == 105

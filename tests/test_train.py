@@ -248,3 +248,56 @@ def test_dataset_ingest_batches_to_jax(rt, run_cfg):
     assert last["rows"] > 0
     # rank-0's shard sums to a strict subset of the full range's sum
     assert 0 < last["total"] < sum(range(64))
+
+
+def test_gpt2_language_model_training_e2e(rt, run_cfg):
+    """BASELINE config #1 analogue: GPT-2 (tiny) language-model training on
+    a Data-ingested synthetic corpus, 1 worker — loss must drop."""
+    import ray_tpu.data as rd
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from ray_tpu.models import gpt2
+
+        cfg = gpt2.GPT2Config.tiny()
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+        tx = optax.adam(1e-3)
+        opt = tx.init(params)
+
+        def step(params, opt, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: gpt2.loss_fn(cfg, p, {"tokens": tokens}))(params)
+            upd, opt = tx.update(grads, opt, params)
+            return optax.apply_updates(params, upd), opt, loss
+
+        jstep = jax.jit(step)
+        shard = train.get_dataset_shard("train")
+        first = last = None
+        for epoch in range(3):
+            for batch in shard.iter_batches(batch_size=8,
+                                            batch_format="numpy"):
+                toks = jnp.asarray(np.stack(batch["tokens"]), jnp.int32)
+                params, opt, loss = jstep(params, opt, toks)
+                if first is None:
+                    first = float(loss)
+                last = float(loss)
+        train.report({"first_loss": first, "last_loss": last})
+
+    import numpy as np
+
+    # learnable corpus: arithmetic token sequences (next token is a
+    # deterministic function of the previous), unlike uniform noise whose
+    # loss floor is log(vocab)
+    corpus = [{"tokens": ((np.arange(33) * 3 + i) % 255).astype(np.int32)}
+              for i in range(64)]
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        datasets={"train": rd.from_items(corpus)},
+        run_config=run_cfg())
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["last_loss"] < result.metrics["first_loss"] * 0.8
