@@ -148,14 +148,16 @@ def decode_step(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
         # inactive slots' cache lines are untouched (no post-pass needed)
         ck = _scatter_step(ck, k[:, 0], positions, active)  # [S, T, KVH, hd]
         cv = _scatter_step(cv, v[:, 0], positions, active)
-        kf = _gqa_repeat(cfg, ck)                # [S, T, H, hd]
-        vf = _gqa_repeat(cfg, cv)
-        scores = jnp.einsum("shd,sthd->sht", q[:, 0], kf,
+        # GQA as a GROUPED einsum — no repeated-KV materialization (the
+        # decode step is HBM-bound; repeating kv doubles cache traffic)
+        rep = cfg.num_heads // cfg.num_kv_heads
+        q2 = q[:, 0].reshape(S, cfg.num_kv_heads, rep, hd)
+        scores = jnp.einsum("skrd,stkd->skrt", q2, ck,
                             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(hd))
-        scores = jnp.where(kv_mask[:, None], scores, -1e30)
+        scores = jnp.where(kv_mask[:, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("sht,sthd->shd", probs, vf)
+        attn = jnp.einsum("skrt,stkd->skrd", probs, cv)
         attn = attn.reshape(S, 1, cfg.num_heads * hd)
         x = x + jnp.dot(attn, p["wo"].astype(cfg.dtype),
                         preferred_element_type=jnp.float32).astype(cfg.dtype)

@@ -174,11 +174,35 @@ class LLMEngine:
             return True
         return False
 
+    def _precompile(self):
+        """Compile every decode program (single-step + each power-of-two
+        chunk bucket) at startup on inactive slots, so no request ever
+        stalls behind a first-occurrence XLA compile."""
+        import numpy as np
+
+        jnp = self._jnp
+        S = self._num_slots
+        toks = jnp.zeros((S,), jnp.int32)
+        poss = jnp.zeros((S,), jnp.int32)
+        act = jnp.zeros((S,), bool)  # inactive: cache unchanged
+        self._cache, logits = self._decode(self._cache, toks, poss, act)
+        np.asarray(logits[0, 0])
+        k = 2
+        while k <= self._chunk_steps:
+            self._cache, out, _ = self._decode_chunk(
+                self._cache, toks, poss, act, k)
+            np.asarray(out[0, 0])
+            k *= 2
+
     def _run(self):
         import numpy as np
 
         jnp = self._jnp
         S = self._num_slots
+        try:
+            self._precompile()
+        except Exception:  # noqa: BLE001 — lazily compile instead
+            pass
         while not self._stop:
             try:
                 self._tick(np, jnp, S)
@@ -211,13 +235,21 @@ class LLMEngine:
             act[s] = True
         # Chunked decode by default. With requests waiting (the pool is
         # saturated — _admit just drained the queue into any free slots),
-        # use SHORT chunks so a slot freed by a mid-chunk EOS admits the
-        # next request within a few steps instead of a full chunk; the
-        # roundtrip still amortizes over the batch.
-        k = (self._chunk_steps if self._in.empty()
-             else max(1, min(4, self._chunk_steps)))
+        # chunk exactly to the earliest KNOWN finish (token budgets are
+        # known up front) so the waiter is admitted the step a slot frees,
+        # at full throughput. Only an unpredictable mid-chunk EOS can
+        # delay admission, bounded by one chunk.
+        k = self._chunk_steps
+        if not self._in.empty():
+            to_finish = min(self._slot_budget[s] - len(self._slot_tokens[s])
+                            for s in active_slots)
+            k = max(1, min(k, to_finish))
         k = min(k, max(1, self._max_len - 1 - max(
             self._slot_pos[s] for s in active_slots)))
+        # num_steps is a STATIC jit arg: round down to a power of two so
+        # only log2(chunk_steps) decode programs ever compile (a fresh
+        # compile per novel k would stall every in-flight request)
+        k = 1 << (k.bit_length() - 1)
         if k > 1:
             self._cache, out, _ = self._decode_chunk(
                 self._cache, jnp.asarray(toks), jnp.asarray(poss),
