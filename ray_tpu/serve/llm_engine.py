@@ -69,7 +69,10 @@ class LLMEngine:
         # Tokens decoded per host sync. Over a high-latency link (the axon
         # tunnel is ~100ms/roundtrip) chunking is the difference between 9
         # and ~200 tok/s; new requests still join every chunk boundary.
-        self._chunk_steps = max(1, int(chunk_steps))
+        # Normalized to a power of two: chunk lengths are compile-time
+        # static and bucketed, so only log2 programs ever exist.
+        chunk_steps = max(1, int(chunk_steps))
+        self._chunk_steps = 1 << (chunk_steps.bit_length() - 1)
 
         # slot bookkeeping (host side)
         self._free = list(range(num_slots))
@@ -175,9 +178,10 @@ class LLMEngine:
         return False
 
     def _precompile(self):
-        """Compile every decode program (single-step + each power-of-two
-        chunk bucket) at startup on inactive slots, so no request ever
-        stalls behind a first-occurrence XLA compile."""
+        """Compile every program this engine can ever run — single-step
+        decode, each power-of-two chunk bucket, and each prefill bucket —
+        at startup, so no request stalls behind a first-occurrence XLA
+        compile mid-serve."""
         import numpy as np
 
         jnp = self._jnp
@@ -193,6 +197,9 @@ class LLMEngine:
                 self._cache, toks, poss, act, k)
             np.asarray(out[0, 0])
             k *= 2
+        for b in self._buckets:
+            lg, _, _ = self._prefill(jnp.zeros((1, b), jnp.int32))
+            np.asarray(lg[0, 0])
 
     def _run(self):
         import numpy as np
@@ -235,10 +242,11 @@ class LLMEngine:
             act[s] = True
         # Chunked decode by default. With requests waiting (the pool is
         # saturated — _admit just drained the queue into any free slots),
-        # chunk exactly to the earliest KNOWN finish (token budgets are
-        # known up front) so the waiter is admitted the step a slot frees,
-        # at full throughput. Only an unpredictable mid-chunk EOS can
-        # delay admission, bounded by one chunk.
+        # chunk toward the earliest KNOWN finish (token budgets are known
+        # up front). Chunk lengths round DOWN to a power of two (static
+        # jit arg; only the precompiled buckets may run), so the waiter is
+        # admitted within at most two ticks of the earliest finish; an
+        # unpredictable mid-chunk EOS delays it by one chunk at most.
         k = self._chunk_steps
         if not self._in.empty():
             to_finish = min(self._slot_budget[s] - len(self._slot_tokens[s])
@@ -246,9 +254,6 @@ class LLMEngine:
             k = max(1, min(k, to_finish))
         k = min(k, max(1, self._max_len - 1 - max(
             self._slot_pos[s] for s in active_slots)))
-        # num_steps is a STATIC jit arg: round down to a power of two so
-        # only log2(chunk_steps) decode programs ever compile (a fresh
-        # compile per novel k would stall every in-flight request)
         k = 1 << (k.bit_length() - 1)
         if k > 1:
             self._cache, out, _ = self._decode_chunk(

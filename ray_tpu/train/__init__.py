@@ -10,6 +10,11 @@ from ray_tpu.train.backend_executor import (  # noqa: F401
     TrainingWorkerError,
 )
 from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.predictor import (  # noqa: F401
+    JaxPredictor,
+    Predictor,
+    predict_batches,
+)
 from ray_tpu.train.checkpoint_manager import CheckpointManager  # noqa: F401
 from ray_tpu.train.config import (  # noqa: F401
     CheckpointConfig,

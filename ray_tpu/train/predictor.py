@@ -1,0 +1,79 @@
+"""Predictors: checkpoint -> batch inference over Data (reference:
+python/ray/train/predictor.py + the batch-inference-on-Data pattern that
+replaced BatchPredictor).
+
+A Predictor wraps a loaded model; ``predict_batches`` maps it over a
+Dataset with an actor pool so the model loads once per worker (the
+TPU-side model stays resident in the actor)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+class Predictor:
+    """Subclass: implement from_checkpoint() and predict(batch)->batch."""
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint, **kwargs) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class JaxPredictor(Predictor):
+    """Predictor over a jitted apply function + params pytree."""
+
+    def __init__(self, params, apply_fn: Callable,
+                 input_column: str = "data",
+                 output_column: str = "predictions"):
+        import jax
+
+        self._params = params
+        self._apply = jax.jit(apply_fn)
+        self._in = input_column
+        self._out = output_column
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint, apply_fn: Callable,
+                        load_params: Optional[Callable] = None,
+                        **kwargs) -> "JaxPredictor":
+        """load_params(dir_path) -> params; defaults to a pickle named
+        params.pkl in the checkpoint directory."""
+        import os
+        import pickle
+
+        path = checkpoint.path if hasattr(checkpoint, "path") else checkpoint
+        if load_params is not None:
+            params = load_params(path)
+        else:
+            with open(os.path.join(path, "params.pkl"), "rb") as f:
+                params = pickle.load(f)
+        return cls(params, apply_fn, **kwargs)
+
+    def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        out = self._apply(self._params, jnp.asarray(batch[self._in]))
+        return {**batch, self._out: np.asarray(out)}
+
+
+def predict_batches(dataset, predictor_cls, *, batch_size: int = 256,
+                    concurrency: int = 1, predictor_kwargs: dict = None):
+    """Map a Predictor over a Dataset with an actor pool (model loads once
+    per pool worker). Returns a new Dataset with predictions."""
+    kwargs = predictor_kwargs or {}
+
+    class _PredictUDF:
+        def __init__(self):
+            self._p = predictor_cls.from_checkpoint(**kwargs) \
+                if "checkpoint" in kwargs else predictor_cls(**kwargs)
+
+        def __call__(self, batch):
+            return self._p.predict(batch)
+
+    return dataset.map_batches(_PredictUDF, batch_size=batch_size,
+                               concurrency=concurrency)

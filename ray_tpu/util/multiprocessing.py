@@ -1,0 +1,158 @@
+"""multiprocessing.Pool shim over the runtime (reference:
+python/ray/util/multiprocessing/pool.py — the drop-in Pool that turns
+``pool.map(f, xs)`` into distributed tasks).
+
+Only the commonly-used surface: map/imap/imap_unordered/starmap/
+apply/apply_async/map_async, with chunking. Initializers run once per
+pool actor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_chunk(self, fn, chunk: List[tuple]) -> List[Any]:
+        return [fn(*args) for args in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], unpack_single: bool):
+        self._refs = refs
+        self._single = unpack_single
+
+    def get(self, timeout: Optional[float] = None):
+        outs = ray_tpu.get(self._refs, timeout=timeout)
+        flat = [x for chunk in outs for x in chunk]
+        return flat[0] if self._single else flat
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+
+class Pool:
+    """Drop-in-ish multiprocessing.Pool running on pool actors."""
+
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs=()):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._n = processes or 4
+        self._actors = [_PoolWorker.remote(initializer, initargs)
+                        for _ in range(self._n)]
+        self._rr = 0
+        self._closed = False
+        self._outstanding: List[Any] = []
+
+    # -- helpers -------------------------------------------------------------
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int],
+                star: bool) -> List[List[tuple]]:
+        items = [tuple(x) if star else (x,) for x in iterable]
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._n * 4) or 1)
+        return [items[i: i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _submit(self, fn, chunks: List[List[tuple]]) -> List[Any]:
+        refs = []
+        for chunk in chunks:
+            actor = self._actors[self._rr % self._n]
+            self._rr += 1
+            refs.append(actor.run_chunk.remote(fn, chunk))
+        self._outstanding.extend(refs)
+        return refs
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    # -- API -----------------------------------------------------------------
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        self._check_open()
+        return AsyncResult(
+            self._submit(fn, self._chunks(iterable, chunksize, star=False)),
+            unpack_single=False)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check_open()
+        refs = self._submit(fn, self._chunks(iterable, chunksize, star=True))
+        return AsyncResult(refs, unpack_single=False).get()
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
+        self._check_open()
+        kwds = kwds or {}
+        actor = self._actors[self._rr % self._n]
+        self._rr += 1
+        wrapped = (lambda *a: fn(*a, **kwds)) if kwds else fn
+        return AsyncResult([actor.run_chunk.remote(wrapped, [tuple(args)])],
+                           unpack_single=True)
+
+    def imap(self, fn, iterable, chunksize: Optional[int] = 1):
+        self._check_open()
+        refs = self._submit(fn, self._chunks(iterable, chunksize,
+                                             star=False))
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn, iterable, chunksize: Optional[int] = 1):
+        self._check_open()
+        refs = self._submit(fn, self._chunks(iterable, chunksize,
+                                             star=False))
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            for r in ready:
+                yield from ray_tpu.get(r)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self.close()
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        self._actors = []
+
+    def join(self):
+        """Drain all in-flight work, then tear down the pool actors
+        (stdlib contract: close()+join() == orderly shutdown)."""
+        if not self._closed:
+            raise ValueError("join() before close()")
+        if self._outstanding:
+            ray_tpu.wait(self._outstanding,
+                         num_returns=len(self._outstanding), timeout=None)
+            self._outstanding = []
+        self.terminate()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

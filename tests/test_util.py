@@ -198,3 +198,45 @@ def test_queue_batch_put(rt):
         q.put_nowait_batch([3, 4])
     q.put_nowait_batch([3])
     assert q.qsize() == 3
+
+
+def test_multiprocessing_pool_shim(rt):
+    import ray_tpu.util.multiprocessing as mp
+
+    def sq(x):
+        return x * x
+
+    with mp.Pool(processes=2) as pool:
+        assert pool.map(sq, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        r = pool.apply_async(sq, (6,))
+        assert r.get(timeout=30) == 36
+        assert sorted(pool.imap_unordered(sq, range(5))) == [0, 1, 4, 9, 16]
+        assert list(pool.imap(sq, range(5))) == [0, 1, 4, 9, 16]
+
+
+def test_jax_predictor_batch_inference(rt, tmp_path):
+    import os
+    import pickle
+
+    import numpy as np
+
+    import ray_tpu.data as rd
+    from ray_tpu.train.predictor import JaxPredictor, predict_batches
+
+    # "checkpoint": a linear model w=3
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt)
+    with open(os.path.join(ckpt, "params.pkl"), "wb") as f:
+        pickle.dump({"w": np.float32(3.0)}, f)
+
+    def apply_fn(params, x):
+        return params["w"] * x
+
+    ds = rd.from_numpy(np.arange(32, dtype=np.float32))
+    out = predict_batches(
+        ds, JaxPredictor, batch_size=8, concurrency=1,
+        predictor_kwargs={"checkpoint": ckpt, "apply_fn": apply_fn})
+    rows = sorted(out.take_all(), key=lambda r: r["data"])
+    assert rows[5]["predictions"] == 15.0
+    assert len(rows) == 32
