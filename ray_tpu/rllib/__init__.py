@@ -6,8 +6,20 @@ rllib/env/env_runner.py:22), re-designed TPU-first: the RLModule is a pure
 function over a jax pytree, the Learner's update is ONE jitted program
 (minibatch loop via lax.scan — no per-minibatch dispatch), and EnvRunners
 are actors collecting vectorized numpy rollouts in parallel.
+
+Algorithm families: PPO (on-policy, clipped), IMPALA (async actor-learner
+with V-trace), DQN (double DQN + optional prioritized replay), SAC
+(continuous control), and offline BC/CQL over ``ray_tpu.data`` Datasets.
 """
 
 from ray_tpu.rllib.algorithm import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.offline import (BCLearner, CQLLearner,  # noqa: F401
+                                   train_offline)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
-from ray_tpu.rllib.rl_module import MLPModule  # noqa: F401
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,  # noqa: F401
+                                         ReplayBuffer)
+from ray_tpu.rllib.rl_module import (MLPModule, QMLPModule,  # noqa: F401
+                                     SquashedGaussianModule, TwinQModule)
+from ray_tpu.rllib.sac import SAC, SACConfig  # noqa: F401

@@ -17,11 +17,31 @@ from ray_tpu.rllib.envs import make_env
 from ray_tpu.rllib.rl_module import MLPModule
 
 
+class _EpisodeTracker:
+    """Shared episode-return bookkeeping across runner kinds."""
+
+    def _init_tracking(self):
+        self._ep_ret = np.zeros(self.env.n, np.float64)
+        self._completed: list = []
+
+    def _track_episodes(self, rew: np.ndarray, done: np.ndarray) -> None:
+        self._ep_ret += rew
+        if done.any():
+            for i in np.nonzero(done)[0]:
+                self._completed.append(self._ep_ret[i])
+                self._ep_ret[i] = 0.0
+
+    def _drain_completed(self) -> np.ndarray:
+        completed, self._completed = self._completed, []
+        return np.asarray(completed, np.float64)
+
+
 @ray_tpu.remote
-class EnvRunner:
+class EnvRunner(_EpisodeTracker):
     def __init__(self, env_name: str, num_envs: int, rollout_len: int,
                  module_spec: dict, gamma: float = 0.99, lam: float = 0.95,
                  seed: int = 0):
+        self.env_name = env_name
         self.env = make_env(env_name, num_envs, seed=seed)
         self.module = MLPModule(**module_spec)
         self.rollout_len = rollout_len
@@ -29,9 +49,7 @@ class EnvRunner:
         self.lam = lam
         self.rng = np.random.default_rng(seed + 1)
         self.obs = self.env.reset()
-        # episode-return tracking (completed episodes since last sample)
-        self._ep_ret = np.zeros(self.env.n, np.float64)
-        self._completed: list = []
+        self._init_tracking()
 
     def sample(self, weights) -> Dict[str, np.ndarray]:
         """Collect rollout_len vectorized steps; returns a flat GAE batch
@@ -57,11 +75,7 @@ class EnvRunner:
             obs_buf[t], act_buf[t] = obs, actions
             logp_buf[t], val_buf[t] = logp_t, value
             rew_buf[t], done_buf[t] = rew, done
-            self._ep_ret += rew
-            if done.any():
-                for i in np.nonzero(done)[0]:
-                    self._completed.append(self._ep_ret[i])
-                    self._ep_ret[i] = 0.0
+            self._track_episodes(rew, done)
             obs = nxt
         self.obs = obs
         _, last_value = self.module.apply_np(weights, obs)
@@ -78,20 +92,54 @@ class EnvRunner:
             adv[t] = gae
         ret = adv + val_buf[:T]
 
-        completed, self._completed = self._completed, []
         return {
             "obs": obs_buf.reshape(T * N, -1),
             "actions": act_buf.reshape(-1).astype(np.int32),
             "logp_old": logp_buf.reshape(-1),
             "advantages": adv.reshape(-1),
             "returns": ret.reshape(-1),
-            "episode_returns": np.asarray(completed, np.float64),
+            "episode_returns": self._drain_completed(),
+        }
+
+    def sample_sequences(self, weights) -> Dict[str, np.ndarray]:
+        """Time-major rollout for off-policy-corrected learners (IMPALA).
+
+        Returns [T, N, ...] arrays with BEHAVIOR logits (the learner
+        recomputes target logits and applies V-trace; reference:
+        rllib/algorithms/impala/impala.py) plus the bootstrap observation.
+        """
+        T, N = self.rollout_len, self.env.n
+        obs_buf = np.empty((T, N, self.env.obs_dim), np.float32)
+        act_buf = np.empty((T, N), np.int32)
+        logits_buf = np.empty((T, N, self.env.num_actions), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        done_buf = np.empty((T, N), bool)
+
+        obs = self.obs
+        for t in range(T):
+            logits, _ = self.module.apply_np(weights, obs)
+            g = self.rng.gumbel(size=logits.shape)
+            actions = np.argmax(logits + g, axis=-1)
+            nxt, rew, done = self.env.step(actions)
+            obs_buf[t], act_buf[t] = obs, actions
+            logits_buf[t], rew_buf[t], done_buf[t] = logits, rew, done
+            self._track_episodes(rew, done)
+            obs = nxt
+        self.obs = obs
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "behavior_logits": logits_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "bootstrap_obs": obs.astype(np.float32),
+            "episode_returns": self._drain_completed(),
         }
 
     def evaluate(self, weights, num_episodes: int = 8) -> float:
         """Mean greedy-policy episode return."""
-        env = make_env(type(self.env).__name__ and "CartPole-v1",
-                       num_episodes, seed=int(self.rng.integers(1 << 30)))
+        env = make_env(self.env_name, num_episodes,
+                       seed=int(self.rng.integers(1 << 30)))
         obs = env.reset()
         total = np.zeros(num_episodes, np.float64)
         finished = np.zeros(num_episodes, bool)
@@ -103,6 +151,98 @@ class EnvRunner:
             if finished.all():
                 break
         return float(total.mean())
+
+
+@ray_tpu.remote
+class OffPolicyRunner(_EpisodeTracker):
+    """Transition-collecting actor for replay-based algorithms (DQN/SAC).
+
+    Reference: rllib/env/single_agent_env_runner.py in the off-policy
+    algorithms' sample loop. Keeps env state across calls; the policy is
+    epsilon-greedy over a Q module (discrete) or a squashed Gaussian
+    (continuous), selected by ``kind``.
+    """
+
+    def __init__(self, env_name: str, num_envs: int, module_spec: dict,
+                 kind: str = "dqn", seed: int = 0):
+        from ray_tpu.rllib.rl_module import (QMLPModule,
+                                             SquashedGaussianModule)
+
+        self.env_name = env_name
+        self.env = make_env(env_name, num_envs, seed=seed)
+        if kind == "dqn":
+            self.module = QMLPModule(**module_spec)
+        elif kind == "sac":
+            self.module = SquashedGaussianModule(**module_spec)
+        else:
+            raise ValueError(f"unknown runner kind {kind!r}")
+        self.kind = kind
+        self.rng = np.random.default_rng(seed + 1)
+        self.obs = self.env.reset()
+        self._init_tracking()
+
+    def _act(self, weights, obs, epsilon: float) -> np.ndarray:
+        if self.kind == "dqn":
+            q = self.module.apply_np(weights, obs)
+            greedy = np.argmax(q, axis=-1)
+            explore = self.rng.random(len(obs)) < epsilon
+            random_a = self.rng.integers(0, self.env.num_actions,
+                                         size=len(obs))
+            return np.where(explore, random_a, greedy).astype(np.int32)
+        return self.module.sample_np(weights, obs, self.rng).astype(
+            np.float32)
+
+    def sample_transitions(self, weights, num_steps: int,
+                           epsilon: float = 0.0) -> Dict[str, np.ndarray]:
+        """Collect num_steps vectorized steps of (s, a, r, s', done)."""
+        N = self.env.n
+        cols = {
+            "obs": np.empty((num_steps, N, self.env.obs_dim), np.float32),
+            "rewards": np.empty((num_steps, N), np.float32),
+            "next_obs": np.empty((num_steps, N, self.env.obs_dim),
+                                 np.float32),
+            "dones": np.empty((num_steps, N), np.float32),
+        }
+        actions = []
+        obs = self.obs
+        for t in range(num_steps):
+            a = self._act(weights, obs, epsilon)
+            nxt, rew, done = self.env.step(a)
+            cols["obs"][t] = obs
+            actions.append(a)
+            cols["rewards"][t] = rew
+            cols["next_obs"][t] = nxt
+            cols["dones"][t] = done.astype(np.float32)
+            self._track_episodes(rew, done)
+            obs = nxt
+        self.obs = obs
+        act = np.stack(actions)
+        out = {k: v.reshape((num_steps * N,) + v.shape[2:])
+               for k, v in cols.items()}
+        out["actions"] = act.reshape((num_steps * N,) + act.shape[2:])
+        out["episode_returns"] = self._drain_completed()
+        return out
+
+    def evaluate(self, weights, num_episodes: int = 8) -> float:
+        """Mean deterministic-policy episode return."""
+        env = make_env(self.env_name, num_episodes,
+                       seed=int(self.rng.integers(1 << 30)))
+        obs = env.reset()
+        total = np.zeros(num_episodes, np.float64)
+        finished = np.zeros(num_episodes, bool)
+        for _ in range(env.max_steps + 1):
+            if self.kind == "dqn":
+                a = np.argmax(self.module.apply_np(weights, obs), axis=-1)
+            else:
+                a = self.module.sample_np(weights, obs, self.rng,
+                                          deterministic=True)
+            obs, rew, done = env.step(a)
+            total += rew * (~finished)
+            finished |= done
+            if finished.all():
+                break
+        return float(total.mean())
+
 
 
 def _logsumexp(x: np.ndarray) -> np.ndarray:

@@ -70,7 +70,65 @@ class CartPoleVec:
         return self.state.astype(np.float32), reward, done
 
 
-ENVS = {"CartPole-v1": CartPoleVec}
+class PendulumVec:
+    """Classic Pendulum-v1 swing-up (continuous torque), vectorized.
+
+    Matches the gymnasium constants: g=10, m=1, l=1, dt=0.05, torque
+    clipped to [-2, 2], theta_dot clipped to [-8, 8], 200-step episodes,
+    reward = -(angle^2 + 0.1*thetadot^2 + 0.001*torque^2). obs is
+    [cos(theta), sin(theta), theta_dot]. Done envs auto-reset.
+    """
+
+    obs_dim = 3
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+    max_steps = 200
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        self.n = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.theta = np.zeros(num_envs)
+        self.theta_dot = np.zeros(num_envs)
+        self.steps = np.zeros(num_envs, np.int64)
+        self.reset()
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self.theta), np.sin(self.theta),
+                         self.theta_dot], axis=1).astype(np.float32)
+
+    def _sample(self, n: int):
+        return (self.rng.uniform(-np.pi, np.pi, size=n),
+                self.rng.uniform(-1.0, 1.0, size=n))
+
+    def reset(self) -> np.ndarray:
+        self.theta, self.theta_dot = self._sample(self.n)
+        self.steps[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        g, m, length, dt = 10.0, 1.0, 1.0, 0.05
+        u = np.clip(np.asarray(actions, np.float64).reshape(self.n, -1)[:, 0],
+                    self.action_low, self.action_high)
+        th = ((self.theta + np.pi) % (2 * np.pi)) - np.pi  # normalize
+        cost = th**2 + 0.1 * self.theta_dot**2 + 0.001 * u**2
+
+        acc = (3 * g / (2 * length) * np.sin(self.theta)
+               + 3.0 / (m * length**2) * u)
+        self.theta_dot = np.clip(self.theta_dot + acc * dt, -8.0, 8.0)
+        self.theta = self.theta + self.theta_dot * dt
+        self.steps += 1
+
+        done = self.steps >= self.max_steps
+        if done.any():
+            idx = np.nonzero(done)[0]
+            th0, thd0 = self._sample(len(idx))
+            self.theta[idx], self.theta_dot[idx] = th0, thd0
+            self.steps[idx] = 0
+        return self._obs(), (-cost).astype(np.float32), done
+
+
+ENVS = {"CartPole-v1": CartPoleVec, "Pendulum-v1": PendulumVec}
 
 
 def make_env(name: str, num_envs: int, seed: int = 0):

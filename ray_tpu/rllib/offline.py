@@ -1,0 +1,147 @@
+"""Offline RL: behavior cloning and conservative Q-learning over Datasets.
+
+Reference: rllib/algorithms/bc/bc.py and rllib/algorithms/cql/cql.py —
+there, offline data flows through offline_data readers into the learner;
+here the input is a ``ray_tpu.data.Dataset`` (any datasource), iterated
+with ``iter_batches`` and fed to a jitted update, so the streaming
+executor (backpressure, prefetch) is the offline-data pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ray_tpu.rllib.rl_module import MLPModule, QMLPModule, to_numpy
+
+
+class BCLearner:
+    """Behavior cloning for discrete actions: maximize logp(a_data | s)."""
+
+    def __init__(self, module: MLPModule, lr: float = 1e-3, seed: int = 0):
+        import jax
+        import optax
+
+        self.module = module
+        self.params = module.init_params(seed)
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1))
+
+    def _loss(self, params, obs, actions):
+        import jax
+        import jax.numpy as jnp
+
+        logits, _ = self.module.apply(params, obs)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+        return nll.mean()
+
+    def _update_impl(self, params, opt_state, obs, actions):
+        import jax
+
+        loss, grads = jax.value_and_grad(self._loss)(params, obs, actions)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    def update(self, batch: Dict[str, np.ndarray]) -> float:
+        import jax.numpy as jnp
+
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state,
+            jnp.asarray(batch["obs"], jnp.float32),
+            jnp.asarray(batch["actions"], jnp.int32))
+        return float(loss)
+
+    def get_weights(self):
+        return to_numpy(self.params)
+
+
+class CQLLearner:
+    """Discrete CQL(H): double-DQN TD loss + conservative regularizer
+    ``alpha_cql * (logsumexp_a Q(s, a) - Q(s, a_data))`` (Kumar et al.
+    2020), which penalizes Q on out-of-distribution actions.
+    """
+
+    def __init__(self, module: QMLPModule, lr: float = 1e-3,
+                 gamma: float = 0.99, tau: float = 0.01,
+                 alpha_cql: float = 1.0, seed: int = 0):
+        import jax
+        import optax
+
+        self.module = module
+        self.params = module.init_params(seed)
+        import jax.numpy as jnp
+
+        self.target_params = jax.tree_util.tree_map(jnp.array, self.params)
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+        self._gamma = gamma
+        self._tau = tau
+        self._alpha = alpha_cql
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1, 2))
+
+    def _loss(self, params, target_params, mb):
+        import jax
+        import jax.numpy as jnp
+
+        q = self.module.apply(params, mb["obs"])
+        q_sa = jnp.take_along_axis(q, mb["actions"][:, None], axis=-1)[:, 0]
+        a_next = jnp.argmax(self.module.apply(params, mb["next_obs"]),
+                            axis=-1)
+        q_next = jnp.take_along_axis(
+            self.module.apply(target_params, mb["next_obs"]),
+            a_next[:, None], axis=-1)[:, 0]
+        target = jax.lax.stop_gradient(
+            mb["rewards"] + self._gamma * (1.0 - mb["dones"]) * q_next)
+        td_loss = jnp.square(q_sa - target).mean()
+        conservative = (jax.nn.logsumexp(q, axis=-1) - q_sa).mean()
+        return td_loss + self._alpha * conservative
+
+    def _update_impl(self, params, target_params, opt_state, mb):
+        import jax
+
+        loss, grads = jax.value_and_grad(self._loss)(params, target_params,
+                                                     mb)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        target_params = jax.tree_util.tree_map(
+            lambda t, p: t + self._tau * (p - t), target_params, params)
+        return params, target_params, opt_state, loss
+
+    def update(self, batch: Dict[str, np.ndarray]) -> float:
+        import jax.numpy as jnp
+
+        mb = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.int32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "next_obs": jnp.asarray(batch["next_obs"], jnp.float32),
+            "dones": jnp.asarray(batch["dones"], jnp.float32),
+        }
+        self.params, self.target_params, self.opt_state, loss = (
+            self._update(self.params, self.target_params, self.opt_state,
+                         mb))
+        return float(loss)
+
+    def get_weights(self):
+        return to_numpy(self.params)
+
+
+def train_offline(learner, dataset, *, num_epochs: int = 1,
+                  batch_size: int = 256, shuffle: bool = True) -> float:
+    """Drive a BC/CQL learner over a Dataset; returns the last loss.
+
+    With ``shuffle``, each epoch re-executes the pipeline with a full
+    ``random_shuffle`` (new permutation per epoch).
+    """
+    loss = float("nan")
+    for _ in range(num_epochs):
+        ds = dataset.random_shuffle() if shuffle else dataset
+        for batch in ds.iter_batches(batch_size=batch_size):
+            if len(next(iter(batch.values()))) < 2:
+                continue
+            loss = learner.update(batch)
+    return loss

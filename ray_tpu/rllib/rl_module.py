@@ -64,6 +64,154 @@ class MLPModule:
         return logits, value
 
 
+def _init_mlp(keys, sizes, out_scale_last: float = 0.01):
+    """He-init dense stack; last layer down-scaled (stable policy heads)."""
+    import jax
+    import jax.numpy as jnp
+
+    layers = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        scale = out_scale_last if i == len(sizes) - 2 else np.sqrt(2.0 / a)
+        layers.append({"w": jax.random.normal(keys[i], (a, b)) * scale,
+                       "b": jnp.zeros((b,))})
+    return layers
+
+
+def _mlp_np(layers, x, act=np.tanh):
+    for layer in layers[:-1]:
+        x = act(x @ layer["w"] + layer["b"])
+    return x @ layers[-1]["w"] + layers[-1]["b"]
+
+
+class QMLPModule:
+    """State-action value MLP for discrete actions (DQN family).
+
+    apply(params, obs) -> Q [B, num_actions]. Reference analogue:
+    rllib/algorithms/dqn/torch/dqn_torch_rl_module.py (the compute_q_values
+    path); here a pure function over a pytree.
+    """
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (128, 128)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        import jax
+
+        sizes = (self.obs_dim,) + self.hidden + (self.num_actions,)
+        keys = jax.random.split(jax.random.PRNGKey(seed), len(sizes) - 1)
+        return {"q": _init_mlp(keys, sizes, out_scale_last=0.01)}
+
+    def apply(self, params, obs):
+        import jax.numpy as jnp
+
+        x = obs
+        for layer in params["q"][:-1]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        last = params["q"][-1]
+        return x @ last["w"] + last["b"]
+
+    def apply_np(self, params_np, obs: np.ndarray) -> np.ndarray:
+        return _mlp_np(params_np["q"], obs)
+
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SquashedGaussianModule:
+    """Tanh-squashed Gaussian policy for continuous actions (SAC actor).
+
+    apply(params, obs) -> (mu [B, D], log_std [B, D]); sampling + the tanh
+    log-prob correction live in the learner (jax) and runner (numpy).
+    """
+
+    def __init__(self, obs_dim: int, action_dim: int,
+                 action_low: float = -1.0, action_high: float = 1.0,
+                 hidden: Sequence[int] = (128, 128)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.action_low = float(action_low)
+        self.action_high = float(action_high)
+        self.hidden = tuple(hidden)
+
+    @property
+    def action_scale(self) -> float:
+        return (self.action_high - self.action_low) / 2.0
+
+    @property
+    def action_center(self) -> float:
+        return (self.action_high + self.action_low) / 2.0
+
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        import jax
+
+        sizes = (self.obs_dim,) + self.hidden + (2 * self.action_dim,)
+        keys = jax.random.split(jax.random.PRNGKey(seed), len(sizes) - 1)
+        return {"pi": _init_mlp(keys, sizes, out_scale_last=0.01)}
+
+    def apply(self, params, obs):
+        import jax.numpy as jnp
+
+        x = obs
+        for layer in params["pi"][:-1]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        last = params["pi"][-1]
+        out = x @ last["w"] + last["b"]
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def apply_np(self, params_np, obs: np.ndarray):
+        out = _mlp_np(params_np["pi"], obs)
+        mu, log_std = np.split(out, 2, axis=-1)
+        return mu, np.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample_np(self, params_np, obs: np.ndarray, rng: np.random.Generator,
+                  deterministic: bool = False) -> np.ndarray:
+        """Environment-frame action (squashed + rescaled), runner-side."""
+        mu, log_std = self.apply_np(params_np, obs)
+        pre = mu if deterministic else (
+            mu + np.exp(log_std) * rng.standard_normal(mu.shape))
+        return np.tanh(pre) * self.action_scale + self.action_center
+
+
+class TwinQModule:
+    """Two independent Q(s, a) critics (SAC / TD3 style)."""
+
+    def __init__(self, obs_dim: int, action_dim: int,
+                 hidden: Sequence[int] = (128, 128)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.hidden = tuple(hidden)
+
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        import jax
+
+        sizes = (self.obs_dim + self.action_dim,) + self.hidden + (1,)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        keys1 = jax.random.split(k1, len(sizes) - 1)
+        keys2 = jax.random.split(k2, len(sizes) - 1)
+        return {"q1": _init_mlp(keys1, sizes, out_scale_last=1.0),
+                "q2": _init_mlp(keys2, sizes, out_scale_last=1.0)}
+
+    def apply(self, params, obs, action):
+        import jax
+        import jax.numpy as jnp
+
+        x0 = jnp.concatenate([obs, action], axis=-1)
+        outs = []
+        for name in ("q1", "q2"):
+            x = x0
+            # relu (not tanh): Q targets can be large-magnitude (e.g.
+            # Pendulum returns ~-1500) and tanh hidden layers saturate
+            for layer in params[name][:-1]:
+                x = jax.nn.relu(x @ layer["w"] + layer["b"])
+            last = params[name][-1]
+            outs.append((x @ last["w"] + last["b"])[..., 0])
+        return outs[0], outs[1]
+
+
 def to_numpy(params) -> Any:
     import jax
 

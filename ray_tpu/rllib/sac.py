@@ -1,0 +1,247 @@
+"""SAC: soft actor-critic for continuous control, JAX Learner path.
+
+Reference: rllib/algorithms/sac/sac.py. Twin Q critics with polyak
+targets, tanh-squashed Gaussian actor with the reparameterization trick,
+and automatic temperature tuning toward target entropy -|A| (Haarnoja et
+al. 2018). As in dqn.py, a train iteration runs all U minibatch updates
+(critic + actor + alpha + polyak) inside ONE jitted ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import (SquashedGaussianModule, TwinQModule,
+                                     to_numpy)
+
+
+class SACLearner:
+    def __init__(self, actor: SquashedGaussianModule, critic: TwinQModule,
+                 lr: float = 3e-4, gamma: float = 0.99, tau: float = 0.005,
+                 init_alpha: float = 0.1, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.actor = actor
+        self.critic = critic
+        self.pi_params = actor.init_params(seed)
+        self.q_params = critic.init_params(seed + 1)
+        self.q_target = jax.tree_util.tree_map(jnp.array, self.q_params)
+        self.log_alpha = jnp.log(jnp.asarray(init_alpha))
+        self.pi_tx = optax.adam(lr)
+        self.q_tx = optax.adam(lr)
+        self.a_tx = optax.adam(lr)
+        self.pi_opt = self.pi_tx.init(self.pi_params)
+        self.q_opt = self.q_tx.init(self.q_params)
+        self.a_opt = self.a_tx.init(self.log_alpha)
+        self._gamma = gamma
+        self._tau = tau
+        self._target_entropy = -float(actor.action_dim)
+        self._rng = jax.random.PRNGKey(seed + 2)
+        self._update = jax.jit(self._update_impl,
+                               donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+
+    # ---- squashed-Gaussian sample + logp (jax) -------------------------------
+
+    def _pi_sample(self, pi_params, obs, key):
+        import jax
+        import jax.numpy as jnp
+
+        mu, log_std = self.actor.apply(pi_params, obs)
+        std = jnp.exp(log_std)
+        pre = mu + std * jax.random.normal(key, mu.shape)
+        a_tanh = jnp.tanh(pre)
+        # diag-Gaussian logp + tanh change-of-variables correction
+        logp = (-0.5 * (((pre - mu) / std) ** 2 + 2 * log_std
+                        + jnp.log(2 * jnp.pi))).sum(-1)
+        logp -= (2 * (jnp.log(2.0) - pre
+                      - jax.nn.softplus(-2 * pre))).sum(-1)
+        # change-of-variables for the affine rescale to the env's bounds
+        logp -= jnp.log(self.actor.action_scale) * self.actor.action_dim
+        action = a_tanh * self.actor.action_scale + self.actor.action_center
+        return action, logp
+
+    def _update_impl(self, pi_params, q_params, q_target, log_alpha,
+                     pi_opt, q_opt, a_opt, batches, rng):
+        import jax
+        import jax.numpy as jnp
+
+        def q_loss(q_params, pi_params, q_target, alpha, mb, key):
+            a_next, logp_next = self._pi_sample(pi_params, mb["next_obs"],
+                                                key)
+            tq1, tq2 = self.critic.apply(q_target, mb["next_obs"], a_next)
+            target = jax.lax.stop_gradient(
+                mb["rewards"] + self._gamma * (1.0 - mb["dones"])
+                * (jnp.minimum(tq1, tq2) - alpha * logp_next))
+            q1, q2 = self.critic.apply(q_params, mb["obs"], mb["actions"])
+            return (jnp.square(q1 - target).mean()
+                    + jnp.square(q2 - target).mean())
+
+        def pi_loss(pi_params, q_params, alpha, mb, key):
+            a, logp = self._pi_sample(pi_params, mb["obs"], key)
+            q1, q2 = self.critic.apply(q_params, mb["obs"], a)
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+        def alpha_loss(log_alpha, logp):
+            return -(jnp.exp(log_alpha)
+                     * jax.lax.stop_gradient(
+                         logp + self._target_entropy)).mean()
+
+        def step(carry, xs):
+            (pi_params, q_params, q_target, log_alpha, pi_opt, q_opt,
+             a_opt) = carry
+            mb, key = xs
+            kq, kp = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            ql, qg = jax.value_and_grad(q_loss)(
+                q_params, pi_params, q_target, alpha, mb, kq)
+            qu, q_opt = self.q_tx.update(qg, q_opt, q_params)
+            q_params = jax.tree_util.tree_map(lambda p, u: p + u,
+                                              q_params, qu)
+
+            (pl, logp), pg = jax.value_and_grad(pi_loss, has_aux=True)(
+                pi_params, q_params, alpha, mb, kp)
+            pu, pi_opt = self.pi_tx.update(pg, pi_opt, pi_params)
+            pi_params = jax.tree_util.tree_map(lambda p, u: p + u,
+                                               pi_params, pu)
+
+            al, ag = jax.value_and_grad(alpha_loss)(log_alpha, logp)
+            au, a_opt = self.a_tx.update(ag, a_opt, log_alpha)
+            log_alpha = log_alpha + au
+
+            q_target = jax.tree_util.tree_map(
+                lambda t, p: t + self._tau * (p - t), q_target, q_params)
+            metrics = {"q_loss": ql, "pi_loss": pl, "alpha": alpha,
+                       "entropy": -logp.mean()}
+            return (pi_params, q_params, q_target, log_alpha, pi_opt,
+                    q_opt, a_opt), metrics
+
+        U = batches["rewards"].shape[0]
+        keys = jax.random.split(rng, U)
+        carry = (pi_params, q_params, q_target, log_alpha, pi_opt, q_opt,
+                 a_opt)
+        carry, metrics = jax.lax.scan(step, carry, (batches, keys))
+        metrics = jax.tree_util.tree_map(lambda a: a[-1], metrics)
+        return carry + (metrics,)
+
+    def update_many(self, batches: Dict[str, np.ndarray]
+                    ) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        self._rng, key = jax.random.split(self._rng)
+        jb = {k: jnp.asarray(v) for k, v in batches.items()}
+        if jb["actions"].ndim == 2:   # [U, B] -> [U, B, 1]
+            jb["actions"] = jb["actions"][..., None]
+        (self.pi_params, self.q_params, self.q_target, self.log_alpha,
+         self.pi_opt, self.q_opt, self.a_opt, metrics) = self._update(
+            self.pi_params, self.q_params, self.q_target, self.log_alpha,
+            self.pi_opt, self.q_opt, self.a_opt, jb, key)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return to_numpy(self.pi_params)
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env_name = "Pendulum-v1"
+        self.lr = 3e-4
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 8
+        self.rollout_len = 16
+        self.module_hidden = (128, 128)
+        self.train_kwargs = {
+            "buffer_size": 100_000,
+            "learning_starts": 1_000,
+            "batch_size": 128,
+            "updates_per_iter": 16,
+            "tau": 0.005,
+            "init_alpha": 0.1,
+        }
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        from ray_tpu.rllib.env_runner import OffPolicyRunner
+        from ray_tpu.rllib.envs import make_env
+
+        self.config = config
+        kw = config.train_kwargs
+        probe = make_env(config.env_name, 1)
+        self.module_spec = {
+            "obs_dim": probe.obs_dim, "action_dim": probe.action_dim,
+            "action_low": probe.action_low, "action_high": probe.action_high,
+            "hidden": config.module_hidden,
+        }
+        actor = SquashedGaussianModule(**self.module_spec)
+        critic = TwinQModule(probe.obs_dim, probe.action_dim,
+                             hidden=config.module_hidden)
+        self.learner = SACLearner(actor, critic, lr=config.lr,
+                                  gamma=config.gamma, tau=kw["tau"],
+                                  init_alpha=kw["init_alpha"],
+                                  seed=config.seed)
+        self.buffer = ReplayBuffer(kw["buffer_size"], seed=config.seed)
+        self.runners = [
+            OffPolicyRunner.remote(config.env_name,
+                                   config.num_envs_per_runner,
+                                   self.module_spec, kind="sac",
+                                   seed=config.seed + 1000 * i)
+            for i in range(config.num_env_runners)
+        ]
+        self.iteration = 0
+        self.env_steps = 0
+        self._recent_returns: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        kw = self.config.train_kwargs
+        w_ref = ray_tpu.put(self.learner.get_weights())
+        batches = ray_tpu.get(
+            [r.sample_transitions.remote(w_ref, self.config.rollout_len)
+             for r in self.runners], timeout=300)
+        for b in batches:
+            self._recent_returns.extend(b.pop("episode_returns").tolist())
+            self.env_steps += len(b["rewards"])
+            self.buffer.add_batch(b)
+        self._recent_returns = self._recent_returns[-100:]
+
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= kw["learning_starts"]:
+            stacked = self.buffer.sample_many(kw["updates_per_iter"],
+                                              kw["batch_size"])
+            metrics = self.learner.update_many(stacked)
+        self.iteration += 1
+        mean_ret = (float(np.mean(self._recent_returns))
+                    if self._recent_returns else 0.0)
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled": self.env_steps,
+            "time_this_iter_s": time.perf_counter() - t0,
+            **metrics,
+        }
+
+    def evaluate(self, num_episodes: int = 8) -> float:
+        return float(ray_tpu.get(
+            self.runners[0].evaluate.remote(self.learner.get_weights(),
+                                            num_episodes), timeout=120))
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
