@@ -19,9 +19,14 @@ from ray_tpu.data.dataset import (  # noqa: F401
     from_pandas,
     range,
     range_tensor,
+    from_huggingface,
+    from_torch,
+    read_avro,
+    read_bigquery,
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
@@ -30,7 +35,18 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_tfrecords,
     read_webdataset,
 )
-from ray_tpu.data.datasource import Datasource, ReadTask  # noqa: F401
+from ray_tpu.data.datasource import (  # noqa: F401
+    _CLOUD_SOURCES,
+    Datasource,
+    ReadTask,
+    make_gated_reader,
+)
+
+# cloud-warehouse readers whose client libraries aren't in this image:
+# importable API surface that raises an actionable error at call time
+for _name, _mod in _CLOUD_SOURCES.items():
+    globals()[_name] = make_gated_reader(_name, _mod)
+del _name, _mod
 from ray_tpu.data.grouped import (  # noqa: F401
     AggregateFn,
     Count,
@@ -52,7 +68,9 @@ __all__ = [
     "AggregateFn", "Sum", "Min", "Max", "Mean", "Count", "Std",
     "GroupedData",
     "range", "range_tensor", "from_items", "from_numpy", "from_arrow",
-    "from_pandas", "from_blocks", "read_datasource", "read_parquet",
+    "from_pandas", "from_blocks", "from_torch", "from_huggingface",
+    "read_datasource", "read_parquet",
     "read_csv", "read_json", "read_numpy", "read_text",
     "read_binary_files", "read_tfrecords", "read_webdataset", "read_sql",
-]
+    "read_images", "read_avro", "read_bigquery",
+] + list(_CLOUD_SOURCES)

@@ -165,16 +165,13 @@ class BlockAccessor:
         raise ValueError(f"Unknown batch_format {batch_format!r}")
 
     def to_numpy(self) -> Dict[str, np.ndarray]:
-        meta = self._table.schema.metadata or {}
+        shapes = _tensor_shapes(self._table)
         out = {}
         for name in self._table.column_names:
             col = self._table.column(name)
             arr = _arrow_to_numpy(col)
-            shape_key = f"tensor_shape:{name}".encode()
-            if shape_key in meta and arr.ndim == 2:
-                import ast
-                inner = ast.literal_eval(meta[shape_key].decode())
-                arr = arr.reshape((arr.shape[0],) + inner)
+            if name in shapes and arr.ndim == 2:
+                arr = arr.reshape((arr.shape[0],) + shapes[name])
             out[name] = arr
         return out
 
@@ -184,11 +181,15 @@ class BlockAccessor:
     def iter_rows(self) -> Iterator[Any]:
         cols = self._table.column_names
         simple = cols == [ITEM_COL]
+        # per-column inner tensor shape from schema metadata, so rows see
+        # (d0, d1, ...) cells, not the flattened storage layout
+        shapes = _tensor_shapes(self._table)
         for i in range(self._table.num_rows):
             if simple:
                 yield self._table.column(0)[i].as_py()
             else:
-                yield {c: _cell(self._table.column(c), i) for c in cols}
+                yield {c: _cell(self._table.column(c), i, shapes.get(c))
+                       for c in cols}
 
     # ---- ops ----
 
@@ -281,8 +282,27 @@ def _arrow_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
         return np.asarray(col.to_pylist(), dtype=object)
 
 
-def _cell(col: pa.ChunkedArray, i: int):
+def _tensor_shapes(table: pa.Table) -> dict:
+    """Inner tensor shape per column, from ``tensor_shape:<col>`` schema
+    metadata (written by batch_to_block for ndim>1 columns)."""
+    import ast
+
+    meta = table.schema.metadata or {}
+    shapes = {}
+    for c in table.column_names:
+        key = f"tensor_shape:{c}".encode()
+        if key in meta:
+            shapes[c] = tuple(ast.literal_eval(meta[key].decode()))
+    return shapes
+
+
+def _cell(col: pa.ChunkedArray, i: int, inner_shape=None):
     v = col[i]
     if pa.types.is_fixed_size_list(col.type):
-        return np.asarray(v.as_py())
+        # .values keeps the arrow value dtype (as_py would widen to
+        # int64); copy so row cells stay writable (arrow views are not)
+        arr = v.values.to_numpy(zero_copy_only=False).copy()
+        if inner_shape is not None:
+            arr = arr.reshape(inner_shape)
+        return arr
     return v.as_py()

@@ -257,6 +257,81 @@ class Dataset:
                 return sum(rows)
         return sum(bundle.num_rows for bundle in self._execute_bundles())
 
+    # ---- global aggregates (reference: dataset.py sum/min/max/mean/std):
+    # a streaming fold over batches on the driver — bounded memory, one
+    # pass, no shuffle needed for whole-dataset scalars.
+
+    def aggregate(self, *aggs) -> dict:
+        states: dict = {a.name: None for a in aggs}
+        for batch in self.iter_batches(batch_format="numpy"):
+            for a in aggs:
+                col = np.asarray(batch[a.on])
+                s = states[a.name]
+                if a.arrow_name == "sum":
+                    states[a.name] = (0 if s is None else s) + col.sum()
+                elif a.arrow_name == "min":
+                    m = col.min()
+                    states[a.name] = m if s is None else min(s, m)
+                elif a.arrow_name == "max":
+                    m = col.max()
+                    states[a.name] = m if s is None else max(s, m)
+                elif a.arrow_name == "count":
+                    states[a.name] = (0 if s is None else s) + len(col)
+                elif a.arrow_name in ("mean", "stddev"):
+                    # Chan et al. parallel Welford merge of (n, mean, M2):
+                    # numerically stable for large-mean data (the naive
+                    # sumsq formula cancels catastrophically there)
+                    col = col.astype(np.float64)
+                    nb, mb = len(col), col.mean()
+                    m2b = ((col - mb) ** 2).sum()
+                    if s is None:
+                        states[a.name] = [nb, mb, m2b]
+                    else:
+                        na, ma, m2a = s
+                        n = na + nb
+                        d = mb - ma
+                        states[a.name] = [
+                            n, ma + d * nb / n,
+                            m2a + m2b + d * d * na * nb / n]
+                else:
+                    raise ValueError(
+                        f"unknown aggregate {a.arrow_name!r}")
+        out = {}
+        for a in aggs:
+            s = states[a.name]
+            if a.arrow_name == "mean":
+                out[a.name] = None if s is None or s[0] == 0 else s[1]
+            elif a.arrow_name == "stddev":
+                if s is None or s[0] < 2:
+                    out[a.name] = None
+                else:
+                    n, _, m2 = s
+                    out[a.name] = float(np.sqrt(m2 / (n - 1)))
+            else:
+                out[a.name] = s
+        return out
+
+    def _scalar_agg(self, arrow_name: str, on: str):
+        from ray_tpu.data.grouped import AggregateFn
+
+        agg = AggregateFn(on, arrow_name)
+        return self.aggregate(agg)[agg.name]
+
+    def sum(self, on: str):
+        return self._scalar_agg("sum", on)
+
+    def min(self, on: str):
+        return self._scalar_agg("min", on)
+
+    def max(self, on: str):
+        return self._scalar_agg("max", on)
+
+    def mean(self, on: str):
+        return self._scalar_agg("mean", on)
+
+    def std(self, on: str):
+        return self._scalar_agg("stddev", on)
+
     def schema(self):
         for bundle in self.limit(1)._execute_bundles():
             if bundle.metas and bundle.metas[0].schema is not None:
@@ -320,6 +395,20 @@ class Dataset:
 
     def write_parquet(self, path: str, **kw):
         return self._write(path, "parquet", **kw)
+
+    def write_bigquery(self, project_id: str, dataset: str) -> int:
+        """Append to a BigQuery table via parallel load jobs; returns the
+        row count written (reference: Dataset.write_bigquery)."""
+        from ray_tpu.data.datasource import write_bigquery_block
+
+        @ray_tpu.remote
+        def _write_one(blocks, project_id=project_id, dataset=dataset):
+            return sum(write_bigquery_block(b, project_id, dataset)
+                       for b in blocks)
+
+        refs = [_write_one.remote(bundle.blocks_ref)
+                for bundle in self._execute_bundles()]
+        return sum(ray_tpu.get(refs))
 
     def write_csv(self, path: str, **kw):
         return self._write(path, "csv", **kw)
@@ -454,3 +543,39 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = -1
 
     return read_datasource(SQLDatasource(sql, connection_factory),
                            parallelism=parallelism)
+
+
+def read_images(paths, *, size=None, mode=None,
+                parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import ImageDatasource
+
+    return read_datasource(ImageDatasource(paths, size=size, mode=mode),
+                           parallelism=parallelism)
+
+
+def read_avro(paths, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import AvroDatasource
+
+    return read_datasource(AvroDatasource(paths), parallelism=parallelism)
+
+
+def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import TorchDatasource
+
+    return read_datasource(TorchDatasource(torch_dataset),
+                           parallelism=parallelism)
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import huggingface_to_blocks
+
+    return from_blocks(huggingface_to_blocks(hf_dataset, parallelism))
+
+
+def read_bigquery(project_id: str, dataset: str = None, query: str = None,
+                  *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import BigQueryDatasource
+
+    return read_datasource(
+        BigQueryDatasource(project_id, dataset=dataset, query=query),
+        parallelism=parallelism)

@@ -60,7 +60,44 @@ class AlgorithmConfig:
         return self
 
 
-class Algorithm:
+class RunnerDriver:
+    """Shared driver plumbing: a learner + a runner gang + episode-return
+    bookkeeping. All algorithm drivers (PPO/IMPALA/DQN/SAC) extend this."""
+
+    learner = None
+    runners: List[Any] = []
+
+    def _init_driver(self):
+        self.iteration = 0
+        self.env_steps = 0
+        self._recent_returns: List[float] = []
+
+    def _record_returns(self, batch: Dict[str, np.ndarray]) -> None:
+        """Consume the episode_returns column of a runner batch."""
+        self._recent_returns.extend(batch.pop("episode_returns").tolist())
+
+    def _mean_return(self) -> float:
+        self._recent_returns = self._recent_returns[-100:]
+        return (float(np.mean(self._recent_returns))
+                if self._recent_returns else 0.0)
+
+    def evaluate(self, num_episodes: int = 8) -> float:
+        return float(ray_tpu.get(
+            self._eval_runner().evaluate.remote(
+                self.learner.get_weights(), num_episodes), timeout=120))
+
+    def _eval_runner(self):
+        return self.runners[0]
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class Algorithm(RunnerDriver):
     """Drives a learner + an EnvRunner gang. Subclasses build the learner."""
 
     def __init__(self, config: AlgorithmConfig):
@@ -81,8 +118,7 @@ class Algorithm:
                              seed=config.seed + 1000 * i)
             for i in range(config.num_env_runners)
         ]
-        self.iteration = 0
-        self._recent_returns: List[float] = []
+        self._init_driver()
 
     def _build_learner(self):
         raise NotImplementedError
@@ -94,38 +130,22 @@ class Algorithm:
         w_ref = ray_tpu.put(weights)
         batches = ray_tpu.get(
             [r.sample.remote(w_ref) for r in self.runners], timeout=300)
-        batch = {
-            k: np.concatenate([b[k] for b in batches])
-            for k in batches[0] if k != "episode_returns"
-        }
         for b in batches:
-            self._recent_returns.extend(b["episode_returns"].tolist())
-        self._recent_returns = self._recent_returns[-100:]
+            self._record_returns(b)
+        batch = {
+            k: np.concatenate([b[k] for b in batches]) for k in batches[0]
+        }
         # advantage normalization (standard PPO practice)
         adv = batch["advantages"]
         batch["advantages"] = ((adv - adv.mean())
                                / (adv.std() + 1e-8)).astype(np.float32)
         metrics = self.learner.update(batch)
         self.iteration += 1
-        mean_ret = (float(np.mean(self._recent_returns))
-                    if self._recent_returns else 0.0)
+        self.env_steps += batch["obs"].shape[0]
         return {
             "training_iteration": self.iteration,
-            "episode_return_mean": mean_ret,
-            "num_env_steps_sampled": batch["obs"].shape[0],
+            "episode_return_mean": self._mean_return(),
+            "num_env_steps_sampled": self.env_steps,
             "time_this_iter_s": time.perf_counter() - t0,
             **metrics,
         }
-
-    def evaluate(self, num_episodes: int = 8) -> float:
-        weights = self.learner.get_weights()
-        return float(ray_tpu.get(
-            self.runners[0].evaluate.remote(weights, num_episodes),
-            timeout=120))
-
-    def stop(self):
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:  # noqa: BLE001
-                pass

@@ -15,7 +15,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.algorithm import AlgorithmConfig, RunnerDriver
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.rl_module import QMLPModule, to_numpy
 
@@ -127,7 +127,7 @@ class DQNConfig(AlgorithmConfig):
         return DQN(self)
 
 
-class DQN:
+class DQN(RunnerDriver):
     def __init__(self, config: DQNConfig):
         from ray_tpu.rllib.env_runner import OffPolicyRunner
         from ray_tpu.rllib.envs import make_env
@@ -155,9 +155,7 @@ class DQN:
                                    seed=config.seed + 1000 * i)
             for i in range(config.num_env_runners)
         ]
-        self.iteration = 0
-        self.env_steps = 0
-        self._recent_returns: List[float] = []
+        self._init_driver()
 
     def _epsilon(self) -> float:
         kw = self.config.train_kwargs
@@ -176,10 +174,9 @@ class DQN:
                                          epsilon=eps)
              for r in self.runners], timeout=300)
         for b in batches:
-            self._recent_returns.extend(b.pop("episode_returns").tolist())
+            self._record_returns(b)
             self.env_steps += len(b["rewards"])
             self.buffer.add_batch(b)
-        self._recent_returns = self._recent_returns[-100:]
 
         loss = float("nan")
         if len(self.buffer) >= kw["learning_starts"]:
@@ -190,25 +187,11 @@ class DQN:
             if indices is not None:
                 self.buffer.update_priorities(indices, tds)
         self.iteration += 1
-        mean_ret = (float(np.mean(self._recent_returns))
-                    if self._recent_returns else 0.0)
         return {
             "training_iteration": self.iteration,
-            "episode_return_mean": mean_ret,
+            "episode_return_mean": self._mean_return(),
             "num_env_steps_sampled": self.env_steps,
             "epsilon": eps,
             "loss": loss,
             "time_this_iter_s": time.perf_counter() - t0,
         }
-
-    def evaluate(self, num_episodes: int = 8) -> float:
-        return float(ray_tpu.get(
-            self.runners[0].evaluate.remote(self.learner.get_weights(),
-                                            num_episodes), timeout=120))
-
-    def stop(self):
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:  # noqa: BLE001
-                pass

@@ -4,6 +4,13 @@ Reference: rllib/env/env_runner.py:22 / single_agent_env_runner. The gang
 of runners samples in parallel (one actor each); weights are broadcast as
 numpy pytrees each round. GAE is computed runner-side so the learner batch
 arrives ready.
+
+Termination vs truncation: envs report both (gymnasium split). Collected
+batches carry ``next_obs`` holding the TRUE successor state (the env's
+``final_obs`` at episode boundaries, never the auto-reset obs) plus a
+``terminateds`` mask, so targets bootstrap through time-limit truncations
+(r + gamma*V(s')) instead of treating them as value-0 terminals; GAE /
+V-trace propagation still stops at every episode boundary.
 """
 
 from __future__ import annotations
@@ -36,6 +43,16 @@ class _EpisodeTracker:
         return np.asarray(completed, np.float64)
 
 
+def _true_next_obs(env, nxt: np.ndarray, done: np.ndarray) -> np.ndarray:
+    """The successor obs for targets: final_obs where the episode ended
+    (auto-reset replaced it in ``nxt``), nxt elsewhere."""
+    if not done.any():
+        return nxt
+    out = nxt.copy()
+    out[done] = env.final_obs[done]
+    return out
+
+
 @ray_tpu.remote
 class EnvRunner(_EpisodeTracker):
     def __init__(self, env_name: str, num_envs: int, rollout_len: int,
@@ -56,10 +73,12 @@ class EnvRunner(_EpisodeTracker):
         plus episode stats."""
         T, N = self.rollout_len, self.env.n
         obs_buf = np.empty((T, N, self.env.obs_dim), np.float32)
+        next_buf = np.empty((T, N, self.env.obs_dim), np.float32)
         act_buf = np.empty((T, N), np.int32)
         logp_buf = np.empty((T, N), np.float32)
-        val_buf = np.empty((T + 1, N), np.float32)
+        val_buf = np.empty((T, N), np.float32)
         rew_buf = np.empty((T, N), np.float32)
+        term_buf = np.empty((T, N), bool)
         done_buf = np.empty((T, N), bool)
 
         obs = self.obs
@@ -71,26 +90,41 @@ class EnvRunner(_EpisodeTracker):
             logp = logits - _logsumexp(logits)
             logp_t = np.take_along_axis(
                 logp, actions[:, None], axis=-1)[:, 0]
-            nxt, rew, done = self.env.step(actions)
+            nxt, rew, term, trunc = self.env.step(actions)
+            done = term | trunc
             obs_buf[t], act_buf[t] = obs, actions
+            next_buf[t] = _true_next_obs(self.env, nxt, done)
             logp_buf[t], val_buf[t] = logp_t, value
-            rew_buf[t], done_buf[t] = rew, done
+            rew_buf[t], term_buf[t], done_buf[t] = rew, term, done
             self._track_episodes(rew, done)
             obs = nxt
         self.obs = obs
-        _, last_value = self.module.apply_np(weights, obs)
-        val_buf[T] = last_value
+        # V(s'_true) per step: for non-boundary steps that's val_buf[t+1]
+        # (same weights, same state — no recompute); fresh evaluation only
+        # for boundary columns (final_obs) and the last row
+        next_val = np.empty((T, N), np.float32)
+        next_val[:-1] = val_buf[1:]
+        fresh_t, fresh_i = np.nonzero(done_buf[:-1])
+        fresh_obs = [next_buf[fresh_t, fresh_i]] if len(fresh_t) else []
+        fresh_obs.append(next_buf[T - 1])
+        _, fresh_vals = self.module.apply_np(
+            weights, np.concatenate(fresh_obs, axis=0))
+        if len(fresh_t):
+            next_val[fresh_t, fresh_i] = fresh_vals[:len(fresh_t)]
+        next_val[T - 1] = fresh_vals[len(fresh_t):]
 
-        # GAE(lambda)
+        # GAE(lambda): bootstrap masked only by TERMINATION; the gae
+        # accumulation stops at any episode boundary
         adv = np.zeros((T, N), np.float32)
         gae = np.zeros(N, np.float32)
         for t in reversed(range(T)):
-            nonterminal = 1.0 - done_buf[t].astype(np.float32)
-            delta = (rew_buf[t] + self.gamma * val_buf[t + 1] * nonterminal
+            not_term = 1.0 - term_buf[t].astype(np.float32)
+            not_done = 1.0 - done_buf[t].astype(np.float32)
+            delta = (rew_buf[t] + self.gamma * next_val[t] * not_term
                      - val_buf[t])
-            gae = delta + self.gamma * self.lam * nonterminal * gae
+            gae = delta + self.gamma * self.lam * not_done * gae
             adv[t] = gae
-        ret = adv + val_buf[:T]
+        ret = adv + val_buf
 
         return {
             "obs": obs_buf.reshape(T * N, -1),
@@ -106,13 +140,17 @@ class EnvRunner(_EpisodeTracker):
 
         Returns [T, N, ...] arrays with BEHAVIOR logits (the learner
         recomputes target logits and applies V-trace; reference:
-        rllib/algorithms/impala/impala.py) plus the bootstrap observation.
+        rllib/algorithms/impala/impala.py). ``next_obs`` carries true
+        successors so the learner can bootstrap every step, including
+        through truncations.
         """
         T, N = self.rollout_len, self.env.n
         obs_buf = np.empty((T, N, self.env.obs_dim), np.float32)
+        next_buf = np.empty((T, N, self.env.obs_dim), np.float32)
         act_buf = np.empty((T, N), np.int32)
         logits_buf = np.empty((T, N, self.env.num_actions), np.float32)
         rew_buf = np.empty((T, N), np.float32)
+        term_buf = np.empty((T, N), bool)
         done_buf = np.empty((T, N), bool)
 
         obs = self.obs
@@ -120,19 +158,23 @@ class EnvRunner(_EpisodeTracker):
             logits, _ = self.module.apply_np(weights, obs)
             g = self.rng.gumbel(size=logits.shape)
             actions = np.argmax(logits + g, axis=-1)
-            nxt, rew, done = self.env.step(actions)
+            nxt, rew, term, trunc = self.env.step(actions)
+            done = term | trunc
             obs_buf[t], act_buf[t] = obs, actions
-            logits_buf[t], rew_buf[t], done_buf[t] = logits, rew, done
+            next_buf[t] = _true_next_obs(self.env, nxt, done)
+            logits_buf[t], rew_buf[t] = logits, rew
+            term_buf[t], done_buf[t] = term, done
             self._track_episodes(rew, done)
             obs = nxt
         self.obs = obs
         return {
             "obs": obs_buf,
+            "next_obs": next_buf,
             "actions": act_buf,
             "behavior_logits": logits_buf,
             "rewards": rew_buf,
+            "terminateds": term_buf,
             "dones": done_buf,
-            "bootstrap_obs": obs.astype(np.float32),
             "episode_returns": self._drain_completed(),
         }
 
@@ -145,9 +187,9 @@ class EnvRunner(_EpisodeTracker):
         finished = np.zeros(num_episodes, bool)
         for _ in range(env.max_steps + 1):
             logits, _ = self.module.apply_np(weights, obs)
-            obs, rew, done = env.step(np.argmax(logits, axis=-1))
+            obs, rew, term, trunc = env.step(np.argmax(logits, axis=-1))
             total += rew * (~finished)
-            finished |= done
+            finished |= term | trunc
             if finished.all():
                 break
         return float(total.mean())
@@ -160,7 +202,9 @@ class OffPolicyRunner(_EpisodeTracker):
     Reference: rllib/env/single_agent_env_runner.py in the off-policy
     algorithms' sample loop. Keeps env state across calls; the policy is
     epsilon-greedy over a Q module (discrete) or a squashed Gaussian
-    (continuous), selected by ``kind``.
+    (continuous), selected by ``kind``. Stored transitions are
+    (s, a, r, s'_true, terminated): time-limit truncations keep their
+    bootstrap.
     """
 
     def __init__(self, env_name: str, num_envs: int, module_spec: dict,
@@ -194,7 +238,7 @@ class OffPolicyRunner(_EpisodeTracker):
 
     def sample_transitions(self, weights, num_steps: int,
                            epsilon: float = 0.0) -> Dict[str, np.ndarray]:
-        """Collect num_steps vectorized steps of (s, a, r, s', done)."""
+        """Collect num_steps vectorized steps of (s, a, r, s', term)."""
         N = self.env.n
         cols = {
             "obs": np.empty((num_steps, N, self.env.obs_dim), np.float32),
@@ -207,12 +251,14 @@ class OffPolicyRunner(_EpisodeTracker):
         obs = self.obs
         for t in range(num_steps):
             a = self._act(weights, obs, epsilon)
-            nxt, rew, done = self.env.step(a)
+            nxt, rew, term, trunc = self.env.step(a)
+            done = term | trunc
             cols["obs"][t] = obs
             actions.append(a)
             cols["rewards"][t] = rew
-            cols["next_obs"][t] = nxt
-            cols["dones"][t] = done.astype(np.float32)
+            cols["next_obs"][t] = _true_next_obs(self.env, nxt, done)
+            # the replay "done" masks the bootstrap => termination only
+            cols["dones"][t] = term.astype(np.float32)
             self._track_episodes(rew, done)
             obs = nxt
         self.obs = obs
@@ -236,13 +282,12 @@ class OffPolicyRunner(_EpisodeTracker):
             else:
                 a = self.module.sample_np(weights, obs, self.rng,
                                           deterministic=True)
-            obs, rew, done = env.step(a)
+            obs, rew, term, trunc = env.step(a)
             total += rew * (~finished)
-            finished |= done
+            finished |= term | trunc
             if finished.all():
                 break
         return float(total.mean())
-
 
 
 def _logsumexp(x: np.ndarray) -> np.ndarray:

@@ -2,8 +2,12 @@
 
 The reference wraps gymnasium; this image ships no gym, so the standard
 benchmark env is implemented directly. The interface is the vectorized
-subset RLlib's EnvRunner needs: reset() -> obs [N, obs_dim];
-step(actions [N]) -> (obs, reward [N], done [N]).
+subset RLlib's EnvRunner needs, with the gymnasium termination split:
+reset() -> obs [N, obs_dim]; step(actions [N]) -> (obs, reward [N],
+terminated [N], truncated [N]). Done envs auto-reset; the TRUE final
+observation of a finished episode is stashed in ``final_obs`` (the
+post-reset obs goes into the returned batch), so learners can bootstrap
+through time-limit truncations instead of treating them as terminal.
 """
 
 from __future__ import annotations
@@ -60,14 +64,16 @@ class CartPoleVec:
         self.state = np.stack([x, x_dot, theta, theta_dot], axis=1)
         self.steps += 1
 
-        done = (np.abs(x) > 2.4) | (np.abs(theta) > 12 * np.pi / 180) | (
-            self.steps >= self.max_steps)
+        terminated = (np.abs(x) > 2.4) | (np.abs(theta) > 12 * np.pi / 180)
+        truncated = ~terminated & (self.steps >= self.max_steps)
+        done = terminated | truncated
         reward = np.ones(self.n, np.float32)
+        self.final_obs = self.state.astype(np.float32)
         if done.any():
             idx = np.nonzero(done)[0]
             self.state[idx] = self._sample_state(len(idx))
             self.steps[idx] = 0
-        return self.state.astype(np.float32), reward, done
+        return self.state.astype(np.float32), reward, terminated, truncated
 
 
 class PendulumVec:
@@ -119,13 +125,15 @@ class PendulumVec:
         self.theta = self.theta + self.theta_dot * dt
         self.steps += 1
 
-        done = self.steps >= self.max_steps
-        if done.any():
-            idx = np.nonzero(done)[0]
+        truncated = self.steps >= self.max_steps  # never terminates
+        terminated = np.zeros(self.n, bool)
+        self.final_obs = self._obs()
+        if truncated.any():
+            idx = np.nonzero(truncated)[0]
             th0, thd0 = self._sample(len(idx))
             self.theta[idx], self.theta_dot[idx] = th0, thd0
             self.steps[idx] = 0
-        return self._obs(), (-cost).astype(np.float32), done
+        return self._obs(), (-cost).astype(np.float32), terminated, truncated
 
 
 ENVS = {"CartPole-v1": CartPoleVec, "Pendulum-v1": PendulumVec}

@@ -17,7 +17,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.algorithm import AlgorithmConfig, RunnerDriver
 from ray_tpu.rllib.rl_module import MLPModule, to_numpy
 
 
@@ -44,30 +44,38 @@ class ImpalaLearner:
 
     # ---- V-trace target computation (inside jit) ----------------------------
 
-    def _vtrace(self, target_logp, behavior_logp, values, bootstrap_value,
-                rewards, discounts):
-        """v_s and clipped rho for [T, N] time-major inputs."""
+    def _vtrace(self, target_logp, behavior_logp, values, next_values,
+                rewards, disc_boot, cont):
+        """v_s and the pg advantage for [T, N] time-major inputs.
+
+        ``next_values`` are V(s'_true) per step (the env's final obs at
+        episode boundaries), ``disc_boot = gamma*(1-terminated)`` masks
+        the bootstrap only at real terminations, and ``cont = 1-done``
+        stops the v_s recursion at every episode boundary (so time-limit
+        truncations bootstrap but don't leak across episodes).
+        """
         import jax
         import jax.numpy as jnp
 
         rho = jnp.exp(target_logp - behavior_logp)
         rho_c = jnp.minimum(self._rho_bar, rho)
         c = jnp.minimum(self._c_bar, rho)
-        values_next = jnp.concatenate(
-            [values[1:], bootstrap_value[None]], axis=0)
-        deltas = rho_c * (rewards + discounts * values_next - values)
+        deltas = rho_c * (rewards + disc_boot * next_values - values)
 
         def back(acc, xs):
-            delta_t, disc_t, c_t = xs
-            acc = delta_t + disc_t * c_t * acc
+            delta_t, cont_t, c_t = xs
+            acc = delta_t + self._gamma * cont_t * c_t * acc
             return acc, acc
 
         _, vs_minus_v = jax.lax.scan(
-            back, jnp.zeros_like(bootstrap_value),
-            (deltas, discounts, c), reverse=True)
+            back, jnp.zeros_like(values[0]),
+            (deltas, cont, c), reverse=True)
         vs = vs_minus_v + values
-        vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
-        pg_adv = rho_c * (rewards + discounts * vs_next - values)
+        # within a trajectory the next target is vs[t+1]; at a boundary it
+        # is the (terminal-masked) bootstrap value itself
+        vs_shift = jnp.concatenate([vs[1:], next_values[-1:]], axis=0)
+        vs_next = cont * vs_shift + (1.0 - cont) * next_values
+        pg_adv = rho_c * (rewards + disc_boot * vs_next - values)
         return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
     def _loss(self, params, batch):
@@ -79,18 +87,20 @@ class ImpalaLearner:
         logits, values = self.module.apply(params, obs_flat)
         logits = logits.reshape(T, N, -1)
         values = values.reshape(T, N)
-        _, bootstrap_value = self.module.apply(params,
-                                               batch["bootstrap_obs"])
+        _, next_values = self.module.apply(
+            params, batch["next_obs"].reshape(T * N, -1))
+        next_values = jax.lax.stop_gradient(next_values.reshape(T, N))
         logp_all = jax.nn.log_softmax(logits)
         b_logp_all = jax.nn.log_softmax(batch["behavior_logits"])
         a = batch["actions"][..., None]
         target_logp = jnp.take_along_axis(logp_all, a, axis=-1)[..., 0]
         behavior_logp = jnp.take_along_axis(b_logp_all, a, axis=-1)[..., 0]
-        discounts = self._gamma * (1.0 - batch["dones"])
+        disc_boot = self._gamma * (1.0 - batch["terminateds"])
+        cont = 1.0 - batch["dones"]
 
         vs, pg_adv = self._vtrace(target_logp, behavior_logp, values,
-                                  bootstrap_value, batch["rewards"],
-                                  discounts)
+                                  next_values, batch["rewards"],
+                                  disc_boot, cont)
         pg_loss = -(target_logp * pg_adv).mean()
         vf_loss = 0.5 * jnp.square(vs - values).mean()
         ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
@@ -111,6 +121,7 @@ class ImpalaLearner:
 
         jb = {k: jnp.asarray(v) for k, v in batch.items()}
         jb["dones"] = jb["dones"].astype(jnp.float32)
+        jb["terminateds"] = jb["terminateds"].astype(jnp.float32)
         self.params, self.opt_state, aux = self._update(
             self.params, self.opt_state, jb)
         return {k: float(v) for k, v in aux.items()}
@@ -136,7 +147,7 @@ class IMPALAConfig(AlgorithmConfig):
         return IMPALA(self)
 
 
-class IMPALA:
+class IMPALA(RunnerDriver):
     """Async driver: one in-flight rollout per runner, learner consumes
     batches in completion order (the IMPALA architecture)."""
 
@@ -161,9 +172,7 @@ class IMPALA:
             for i in range(config.num_env_runners)
         ]
         self._inflight: Dict[Any, Any] = {}   # ref -> runner
-        self.iteration = 0
-        self.env_steps = 0
-        self._recent_returns: List[float] = []
+        self._init_driver()
 
     def _submit(self, runner) -> None:
         w_ref = ray_tpu.put(self.learner.get_weights())
@@ -175,7 +184,7 @@ class IMPALA:
         for r in self.runners:
             if r not in self._inflight.values():
                 self._submit(r)
-        metrics: Dict[str, float] = {}
+        accum: Dict[str, List[float]] = {}
         for _ in range(self._batches_per_iter):
             ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
                                     timeout=300)
@@ -187,34 +196,22 @@ class IMPALA:
             runner = self._inflight.pop(ref)
             batch = ray_tpu.get(ref)
             self._submit(runner)   # immediately refill with fresh weights
-            self._recent_returns.extend(
-                batch.pop("episode_returns").tolist())
+            self._record_returns(batch)
             self.env_steps += batch["rewards"].size
-            metrics = self.learner.update(batch)
-        self._recent_returns = self._recent_returns[-100:]
+            for k, v in self.learner.update(batch).items():
+                accum.setdefault(k, []).append(v)
+        metrics = {k: float(np.mean(v)) for k, v in accum.items()}
         self.iteration += 1
-        mean_ret = (float(np.mean(self._recent_returns))
-                    if self._recent_returns else 0.0)
         return {
             "training_iteration": self.iteration,
-            "episode_return_mean": mean_ret,
+            "episode_return_mean": self._mean_return(),
             "num_env_steps_sampled": self.env_steps,
             "time_this_iter_s": time.perf_counter() - t0,
             **metrics,
         }
 
-    def evaluate(self, num_episodes: int = 8) -> float:
-        # use a runner with no sample in flight if possible
+    def _eval_runner(self):
+        # prefer a runner with no sample in flight
         busy = set(self._inflight.values())
-        runner = next((r for r in self.runners if r not in busy),
-                      self.runners[0])
-        return float(ray_tpu.get(
-            runner.evaluate.remote(self.learner.get_weights(),
-                                   num_episodes), timeout=120))
-
-    def stop(self):
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:  # noqa: BLE001
-                pass
+        return next((r for r in self.runners if r not in busy),
+                    self.runners[0])

@@ -83,6 +83,16 @@ def _mlp_np(layers, x, act=np.tanh):
     return x @ layers[-1]["w"] + layers[-1]["b"]
 
 
+def _mlp_jax(layers, x, act="tanh"):
+    """jax mirror of _mlp_np (act on hidden layers, linear last)."""
+    import jax
+
+    act_fn = {"tanh": jax.numpy.tanh, "relu": jax.nn.relu}[act]
+    for layer in layers[:-1]:
+        x = act_fn(x @ layer["w"] + layer["b"])
+    return x @ layers[-1]["w"] + layers[-1]["b"]
+
+
 class QMLPModule:
     """State-action value MLP for discrete actions (DQN family).
 
@@ -105,13 +115,7 @@ class QMLPModule:
         return {"q": _init_mlp(keys, sizes, out_scale_last=0.01)}
 
     def apply(self, params, obs):
-        import jax.numpy as jnp
-
-        x = obs
-        for layer in params["q"][:-1]:
-            x = jnp.tanh(x @ layer["w"] + layer["b"])
-        last = params["q"][-1]
-        return x @ last["w"] + last["b"]
+        return _mlp_jax(params["q"], obs)
 
     def apply_np(self, params_np, obs: np.ndarray) -> np.ndarray:
         return _mlp_np(params_np["q"], obs)
@@ -154,11 +158,7 @@ class SquashedGaussianModule:
     def apply(self, params, obs):
         import jax.numpy as jnp
 
-        x = obs
-        for layer in params["pi"][:-1]:
-            x = jnp.tanh(x @ layer["w"] + layer["b"])
-        last = params["pi"][-1]
-        out = x @ last["w"] + last["b"]
+        out = _mlp_jax(params["pi"], obs)
         mu, log_std = jnp.split(out, 2, axis=-1)
         return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
 
@@ -196,20 +196,13 @@ class TwinQModule:
                 "q2": _init_mlp(keys2, sizes, out_scale_last=1.0)}
 
     def apply(self, params, obs, action):
-        import jax
         import jax.numpy as jnp
 
         x0 = jnp.concatenate([obs, action], axis=-1)
-        outs = []
-        for name in ("q1", "q2"):
-            x = x0
-            # relu (not tanh): Q targets can be large-magnitude (e.g.
-            # Pendulum returns ~-1500) and tanh hidden layers saturate
-            for layer in params[name][:-1]:
-                x = jax.nn.relu(x @ layer["w"] + layer["b"])
-            last = params[name][-1]
-            outs.append((x @ last["w"] + last["b"])[..., 0])
-        return outs[0], outs[1]
+        # relu (not tanh): Q targets can be large-magnitude (e.g.
+        # Pendulum returns ~-1500) and tanh hidden layers saturate
+        return tuple(_mlp_jax(params[name], x0, act="relu")[..., 0]
+                     for name in ("q1", "q2"))
 
 
 def to_numpy(params) -> Any:

@@ -15,7 +15,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.algorithm import AlgorithmConfig, RunnerDriver
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rl_module import (SquashedGaussianModule, TwinQModule,
                                      to_numpy)
@@ -173,7 +173,7 @@ class SACConfig(AlgorithmConfig):
         return SAC(self)
 
 
-class SAC:
+class SAC(RunnerDriver):
     def __init__(self, config: SACConfig):
         from ray_tpu.rllib.env_runner import OffPolicyRunner
         from ray_tpu.rllib.envs import make_env
@@ -201,9 +201,7 @@ class SAC:
                                    seed=config.seed + 1000 * i)
             for i in range(config.num_env_runners)
         ]
-        self.iteration = 0
-        self.env_steps = 0
-        self._recent_returns: List[float] = []
+        self._init_driver()
 
     def train(self) -> Dict[str, Any]:
         t0 = time.perf_counter()
@@ -213,10 +211,9 @@ class SAC:
             [r.sample_transitions.remote(w_ref, self.config.rollout_len)
              for r in self.runners], timeout=300)
         for b in batches:
-            self._recent_returns.extend(b.pop("episode_returns").tolist())
+            self._record_returns(b)
             self.env_steps += len(b["rewards"])
             self.buffer.add_batch(b)
-        self._recent_returns = self._recent_returns[-100:]
 
         metrics: Dict[str, float] = {}
         if len(self.buffer) >= kw["learning_starts"]:
@@ -224,24 +221,10 @@ class SAC:
                                               kw["batch_size"])
             metrics = self.learner.update_many(stacked)
         self.iteration += 1
-        mean_ret = (float(np.mean(self._recent_returns))
-                    if self._recent_returns else 0.0)
         return {
             "training_iteration": self.iteration,
-            "episode_return_mean": mean_ret,
+            "episode_return_mean": self._mean_return(),
             "num_env_steps_sampled": self.env_steps,
             "time_this_iter_s": time.perf_counter() - t0,
             **metrics,
         }
-
-    def evaluate(self, num_episodes: int = 8) -> float:
-        return float(ray_tpu.get(
-            self.runners[0].evaluate.remote(self.learner.get_weights(),
-                                            num_episodes), timeout=120))
-
-    def stop(self):
-        for r in self.runners:
-            try:
-                ray_tpu.kill(r)
-            except Exception:  # noqa: BLE001
-                pass

@@ -575,3 +575,141 @@ def test_optimizer_diamond_limit_isolated(rt):
     base = rd.range(100, parallelism=4).map(lambda r: {"id": r["id"]})
     u = base.union(base.limit(5))
     assert u.count() == 105
+
+
+# ---------------------------------------------------------------------------
+# round 2: long-tail datasources (images, avro, torch/HF converters, gates)
+# ---------------------------------------------------------------------------
+
+
+def test_read_images_roundtrip(tmp_path):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        arr = rng.integers(0, 255, size=(10 + i, 12, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+    ds = rd.read_images(str(tmp_path), size=(8, 8), mode="RGB",
+                        parallelism=2)
+    rows = ds.take_all()
+    assert len(rows) == 4
+    for r in rows:
+        assert r["image"].shape == (8, 8, 3)
+        assert r["image"].dtype == np.uint8
+        assert r["path"].endswith(".png")
+
+
+def _zigzag(n: int) -> bytes:
+    u = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _avro_write(path, rows, codec=b"null"):
+    """Hand-rolled Avro OCF writer (test oracle for the pure-py reader).
+    Schema: record{id: long, name: string, score: double,
+    tag: union[null, string]}."""
+    import json
+    import struct
+    import zlib
+
+    schema = {
+        "type": "record", "name": "Row", "fields": [
+            {"name": "id", "type": "long"},
+            {"name": "name", "type": "string"},
+            {"name": "score", "type": "double"},
+            {"name": "tag", "type": ["null", "string"]},
+        ],
+    }
+    payload = bytearray()
+    for r in rows:
+        payload += _zigzag(r["id"])
+        nb = r["name"].encode()
+        payload += _zigzag(len(nb)) + nb
+        payload += struct.pack("<d", r["score"])
+        if r["tag"] is None:
+            payload += _zigzag(0)
+        else:
+            tb = r["tag"].encode()
+            payload += _zigzag(1) + _zigzag(len(tb)) + tb
+    payload = bytes(payload)
+    if codec == b"deflate":
+        comp = zlib.compressobj(wbits=-15)
+        payload = comp.compress(payload) + comp.flush()
+
+    sync = bytes(range(16))
+    out = bytearray(b"Obj\x01")
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec}
+    out += _zigzag(len(meta))
+    for k, v in meta.items():
+        kb = k.encode()
+        out += _zigzag(len(kb)) + kb + _zigzag(len(v)) + v
+    out += _zigzag(0)       # end of metadata map
+    out += sync
+    out += _zigzag(len(rows)) + _zigzag(len(payload)) + payload + sync
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+
+@pytest.mark.parametrize("codec", [b"null", b"deflate"])
+def test_read_avro(tmp_path, codec):
+    rows = [
+        {"id": 1, "name": "a", "score": 0.5, "tag": "x"},
+        {"id": -3, "name": "bb", "score": -2.25, "tag": None},
+        {"id": 1 << 40, "name": "", "score": 0.0, "tag": "yy"},
+    ]
+    _avro_write(tmp_path / "t.avro", rows, codec=codec)
+    got = rd.read_avro(str(tmp_path / "t.avro")).take_all()
+    assert len(got) == 3
+    by_id = {r["id"]: r for r in got}
+    assert by_id[-3]["name"] == "bb" and by_id[-3]["score"] == -2.25
+    assert by_id[1]["tag"] == "x"
+    assert by_id[1 << 40]["tag"] == "yy"
+    # None survives the nullable union
+    assert by_id[-3]["tag"] is None
+
+
+def test_from_torch():
+    import torch
+    from torch.utils.data import TensorDataset
+
+    xs = torch.arange(20, dtype=torch.float32).reshape(10, 2)
+    ys = torch.arange(10)
+    ds = rd.from_torch(TensorDataset(xs, ys), parallelism=3)
+    rows = ds.take_all()
+    assert len(rows) == 10
+    # tuple items expand to one column per element: item_0 = x, item_1 = y
+    ys_got = sorted(int(r["item_1"]) for r in rows)
+    assert ys_got == list(range(10))
+    assert np.asarray(rows[0]["item_0"]).shape == (2,)
+
+
+def test_from_huggingface():
+    import datasets as hfd
+
+    hf = hfd.Dataset.from_dict(
+        {"text": [f"t{i}" for i in range(12)],
+         "label": list(range(12))})
+    ds = rd.from_huggingface(hf, parallelism=4)
+    assert ds.count() == 12
+    got = sorted(r["label"] for r in ds.take_all())
+    assert got == list(range(12))
+    # arrow-native ops still work downstream
+    assert ds.map_batches(
+        lambda b: {"label2": b["label"] * 2}).sum("label2") == 2 * sum(
+            range(12))
+
+
+def test_cloud_readers_are_gated():
+    with pytest.raises(ImportError, match="read_lance requires"):
+        rd.read_lance("s3://bucket/path")
+    with pytest.raises(ImportError, match="read_delta requires"):
+        rd.read_delta("s3://bucket/table")

@@ -33,10 +33,11 @@ def test_cartpole_dynamics():
     assert obs.shape == (4, 4) and np.abs(obs).max() <= 0.05
     total_done = 0
     for _ in range(400):
-        obs, rew, done = env.step(np.zeros(4, np.int64))  # constant force
+        obs, rew, term, trunc = env.step(np.zeros(4, np.int64))
         assert rew.shape == (4,) and (rew == 1.0).all()
-        total_done += int(done.sum())
-    # pushing left forever must topple the pole repeatedly
+        total_done += int((term | trunc).sum())
+    # pushing left forever must topple the pole repeatedly (termination,
+    # not time-limit truncation)
     assert total_done >= 4
 
 
@@ -111,10 +112,10 @@ def test_pendulum_dynamics():
     assert np.allclose(obs[:, 0] ** 2 + obs[:, 1] ** 2, 1.0, atol=1e-5)
     total = np.zeros(4)
     for _ in range(200):
-        obs, rew, done = env.step(np.zeros((4, 1), np.float32))
-        assert (rew <= 0).all()
+        obs, rew, term, trunc = env.step(np.zeros((4, 1), np.float32))
+        assert (rew <= 0).all() and not term.any()
         total += rew
-    assert done.all()  # fixed 200-step episodes
+    assert trunc.all()  # fixed 200-step episodes (truncation, no terminal)
     # hanging uncontrolled can't be near-optimal
     assert total.mean() < -500
 
@@ -149,43 +150,86 @@ def test_prioritized_replay_prefers_high_td():
 
 
 def test_vtrace_matches_numpy_reference():
-    """Learner's scan-based V-trace vs a direct numpy recursion."""
+    """Learner's scan-based V-trace vs a direct numpy recursion, on a
+    boundary-free trajectory with a single bootstrap (the textbook
+    Espeholt et al. 2018 setting)."""
     from ray_tpu.rllib.impala import ImpalaLearner
     from ray_tpu.rllib.rl_module import MLPModule
 
     rng = np.random.default_rng(0)
     T, N = 7, 3
+    gamma = 0.99
     target_logp = rng.normal(size=(T, N)).astype(np.float32) * 0.3
     behavior_logp = rng.normal(size=(T, N)).astype(np.float32) * 0.3
     values = rng.normal(size=(T, N)).astype(np.float32)
     bootstrap = rng.normal(size=N).astype(np.float32)
     rewards = rng.normal(size=(T, N)).astype(np.float32)
-    discounts = (0.9 * rng.integers(0, 2, size=(T, N))).astype(np.float32)
+    # no episode boundaries: next value IS values[t+1], bootstrap at T
+    next_values = np.concatenate([values[1:], bootstrap[None]], axis=0)
+    disc_boot = np.full((T, N), gamma, np.float32)
+    cont = np.ones((T, N), np.float32)
 
-    learner = ImpalaLearner(MLPModule(4, 2), rho_bar=1.0, c_bar=1.0)
+    learner = ImpalaLearner(MLPModule(4, 2), gamma=gamma,
+                            rho_bar=1.0, c_bar=1.0)
     import jax.numpy as jnp
 
     vs, pg_adv = learner._vtrace(
         jnp.asarray(target_logp), jnp.asarray(behavior_logp),
-        jnp.asarray(values), jnp.asarray(bootstrap),
-        jnp.asarray(rewards), jnp.asarray(discounts))
+        jnp.asarray(values), jnp.asarray(next_values),
+        jnp.asarray(rewards), jnp.asarray(disc_boot), jnp.asarray(cont))
     vs, pg_adv = np.asarray(vs), np.asarray(pg_adv)
 
     # numpy recursion (Espeholt et al. 2018, eq. 1)
     rho = np.minimum(1.0, np.exp(target_logp - behavior_logp))
     c = np.minimum(1.0, np.exp(target_logp - behavior_logp))
-    v_next = np.concatenate([values[1:], bootstrap[None]], axis=0)
-    deltas = rho * (rewards + discounts * v_next - values)
+    deltas = rho * (rewards + gamma * next_values - values)
     vs_ref = np.zeros((T + 1, N), np.float32)
     vs_ref[T] = bootstrap
     acc = np.zeros(N, np.float32)
     for t in reversed(range(T)):
-        acc = deltas[t] + discounts[t] * c[t] * acc
+        acc = deltas[t] + gamma * c[t] * acc
         vs_ref[t] = values[t] + acc
-    adv_ref = rho * (rewards + discounts * vs_ref[1:] - values)
+    adv_ref = rho * (rewards + gamma * vs_ref[1:] - values)
 
     assert np.allclose(vs, vs_ref[:T], atol=1e-4)
     assert np.allclose(pg_adv, adv_ref, atol=1e-4)
+
+
+def test_vtrace_truncation_bootstraps():
+    """At a time-limit truncation the v_s target must bootstrap from
+    V(final_obs), not treat the state as terminal."""
+    from ray_tpu.rllib.impala import ImpalaLearner
+    from ray_tpu.rllib.rl_module import MLPModule
+    import jax.numpy as jnp
+
+    T, N = 3, 1
+    gamma = 0.9
+    # on-policy (rho = c = 1), constant reward 1, truncation at t=1
+    zeros = np.zeros((T, N), np.float32)
+    values = np.asarray([[1.0], [2.0], [3.0]], np.float32)
+    next_values = np.asarray([[2.0], [10.0], [4.0]], np.float32)
+    rewards = np.ones((T, N), np.float32)
+    terminated = zeros.copy()
+    dones = zeros.copy()
+    dones[1] = 1.0   # truncated (not terminated) at t=1
+    disc_boot = gamma * (1.0 - terminated)
+    cont = 1.0 - dones
+
+    learner = ImpalaLearner(MLPModule(4, 2), gamma=gamma)
+    vs, _ = learner._vtrace(
+        jnp.asarray(zeros), jnp.asarray(zeros), jnp.asarray(values),
+        jnp.asarray(next_values), jnp.asarray(rewards),
+        jnp.asarray(disc_boot), jnp.asarray(cont))
+    vs = np.asarray(vs)
+    # t=2: vs = r + gamma * V(next) = 1 + 0.9*4 = 4.6
+    assert np.isclose(vs[2, 0], 4.6, atol=1e-5)
+    # t=1 (truncated): bootstraps from V(final_obs)=10 -> 1 + 9 = 10,
+    # and the recursion does NOT leak t=2's delta across the boundary
+    assert np.isclose(vs[1, 0], 1 + gamma * 10.0, atol=1e-5)
+    # t=0: continues into t=1: delta0 + gamma*(vs1 - v1) + v0
+    delta0 = 1 + gamma * 2.0 - 1.0
+    assert np.isclose(vs[0, 0], 1.0 + delta0 + gamma * (10.0 - 2.0),
+                      atol=1e-4)
 
 
 def test_dqn_cartpole_learns(rl_ray):
@@ -289,12 +333,12 @@ def _expert_cartpole_data(num_steps: int = 1500, n_envs: int = 8):
             "dones": []}
     for _ in range(num_steps):
         a = (obs[:, 2] + obs[:, 3] > 0).astype(np.int32)
-        nxt, rew, done = env.step(a)
+        nxt, rew, term, trunc = env.step(a)
         rows["obs"].append(obs.copy())
         rows["actions"].append(a)
         rows["rewards"].append(rew)
         rows["next_obs"].append(nxt.copy())
-        rows["dones"].append(done.astype(np.float32))
+        rows["dones"].append(term.astype(np.float32))
         obs = nxt
     return {k: np.concatenate(v) if v[0].ndim > 1 else np.stack(v).reshape(-1)
             for k, v in ((k, vs) for k, vs in rows.items())}
@@ -310,9 +354,9 @@ def _greedy_cartpole_return(module, weights, episodes: int = 8) -> float:
     for _ in range(501):
         out = module.apply_np(weights, obs)
         logits = out[0] if isinstance(out, tuple) else out
-        obs, rew, done = env.step(np.argmax(logits, axis=-1))
+        obs, rew, term, trunc = env.step(np.argmax(logits, axis=-1))
         total += rew * (~finished)
-        finished |= done
+        finished |= term | trunc
         if finished.all():
             break
     return float(total.mean())
