@@ -418,6 +418,15 @@ def bench_core(rows: list):
 def main():
     rows: list = []
 
+    # 0) ray_perf-style core microbenchmarks FIRST, before jax loads: the
+    # TPU sections leave tunnel/client threads behind that steal CPU from
+    # the single-core host path and depress memcpy/dispatch rates by 2-3x
+    try:
+        bench_core(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "core_microbench", "value": -1,
+                     "unit": f"error: {e}"})
+
     # 1) headline: flagship train step on the chip
     import jax
 
@@ -456,13 +465,6 @@ def main():
                          "tokens/s"))
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "serve_ttft_p50_ms", "value": -1,
-                     "unit": f"error: {e}"})
-
-    # 3) core microbenchmarks
-    try:
-        bench_core(rows)
-    except Exception as e:  # pragma: no cover
-        rows.append({"metric": "core_microbench", "value": -1,
                      "unit": f"error: {e}"})
 
     # BASELINE.json.published was empty until this repo established it

@@ -23,6 +23,7 @@ def init(num_workers: Optional[int] = None,
          object_store_memory: Optional[int] = None,
          ignore_reinit_error: bool = True,
          address: Optional[str] = None,
+         log_to_driver: Optional[bool] = None,
          **kwargs):
     """Start the local runtime (worker pool + shm object store), or connect
     to a running cluster when ``address="host:port"`` names its GCS
@@ -48,7 +49,8 @@ def init(num_workers: Optional[int] = None,
         from ray_tpu.core.runtime import Runtime
 
         _runtime = Runtime(num_workers=num_workers,
-                           object_store_memory=object_store_memory)
+                           object_store_memory=object_store_memory,
+                           log_to_driver=log_to_driver)
     runtime_context.set_core(_runtime)
     atexit.register(shutdown)
     return runtime_context.get_runtime_context()

@@ -200,9 +200,11 @@ class NodeServer:
         self._peers = ClientCache(self._authkey)
         self._stop = False
 
+        # node workers log to the session files (served via the get_log
+        # op); no local monitor thread — the driver pulls, it isn't pushed
         self.runtime = NodeRuntime(
             self, num_workers=num_workers,
-            object_store_memory=object_store_memory)
+            object_store_memory=object_store_memory, log_to_driver=False)
         self.node_id = self.runtime.node_id
         if resources:
             # extend the node's resource pool with custom resources
@@ -498,6 +500,16 @@ class NodeServer:
 
     def _op_state(self):
         return self.runtime.state_summary()
+
+    def _op_list_logs(self):
+        from ray_tpu.core.log_monitor import list_log_files
+
+        return list_log_files(self.runtime.log_dir)
+
+    def _op_get_log(self, name: str, tail_lines: int = 1000):
+        from ray_tpu.core.log_monitor import read_log_file
+
+        return read_log_file(self.runtime.log_dir, name, tail_lines)
 
     def _op_register_fn(self, fn_id: bytes, pickled: bytes):
         rt = self.runtime

@@ -65,6 +65,16 @@ _FLAGS: List[Flag] = [
     Flag("worker_shutdown_grace_s", float, 2.0,
          "Grace period for workers to exit at shutdown before SIGKILL."),
     # ---- observability ---------------------------------------------------
+    Flag("log_to_driver", bool, True,
+         "Stream worker stdout/stderr lines to the driver's stderr with "
+         "(worker=<id> out|err) prefixes (reference: ray.init "
+         "log_to_driver + log_monitor.py)."),
+    Flag("log_monitor_interval_s", float, 0.2,
+         "Poll interval of the driver/node log monitor thread."),
+    Flag("worker_log_redirect", bool, True,
+         "Redirect each worker's stdout/stderr to per-worker files under "
+         "the session log dir (worker-<id8>.out|err). Disabling inherits "
+         "the parent's terminal (debug)."),
     Flag("task_events_enabled", bool, False,
          "Record task lifecycle events (submit/dispatch/done per task) "
          "for ray_tpu.timeline() chrome-trace export (reference: "

@@ -162,7 +162,8 @@ class Runtime:
     def __init__(self, num_workers: Optional[int] = None,
                  object_store_memory: Optional[int] = None,
                  session_name: Optional[str] = None,
-                 topology: Optional[TpuSliceTopology] = None):
+                 topology: Optional[TpuSliceTopology] = None,
+                 log_to_driver: Optional[bool] = None):
         self.node_id = NodeID.from_random()
         self.worker_id = WorkerID.from_random()
         self.job_id = JobID.from_random()
@@ -218,6 +219,18 @@ class Runtime:
         self._pending_actors: List[_ActorState] = []
         self._pg_ready_waiters: Dict[PlacementGroupID, List[ObjectID]] = {}
 
+        # per-session worker log capture + driver streaming (reference:
+        # session/logs + log_monitor.py)
+        self.log_dir = os.path.join("/tmp", self._session, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._log_monitor = None
+        if log_to_driver if log_to_driver is not None else config.log_to_driver:
+            from ray_tpu.core.log_monitor import LogMonitor
+
+            self._log_monitor = LogMonitor(
+                self.log_dir,
+                interval_s=config.log_monitor_interval_s).start()
+
         self._listener = Listener(self._sock_path, family="AF_UNIX",
                                   authkey=self._authkey)
         self._accept_thread = threading.Thread(
@@ -251,10 +264,25 @@ class Runtime:
             env.setdefault("JAX_PLATFORMS", "cpu")
             if env.get("JAX_PLATFORMS") == "axon":
                 env["JAX_PLATFORMS"] = "cpu"
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env, stdin=subprocess.DEVNULL,
-        )
+        out = err = None
+        if config.worker_log_redirect:
+            from ray_tpu.core.log_monitor import worker_log_paths
+
+            out_path, err_path = worker_log_paths(self.log_dir,
+                                                  worker_id.hex())
+            out = open(out_path, "ab", buffering=0)
+            err = open(err_path, "ab", buffering=0)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env, stdin=subprocess.DEVNULL, stdout=out, stderr=err,
+            )
+        finally:
+            # the child holds its own descriptors after fork/exec
+            if out is not None:
+                out.close()
+            if err is not None:
+                err.close()
         w = _Worker(worker_id, proc)
         with self._lock:
             self._workers[worker_id] = w
@@ -1922,6 +1950,8 @@ class Runtime:
         except OSError:
             pass
         self.store.close()
+        if self._log_monitor is not None:
+            self._log_monitor.stop(flush=True)  # drain final worker output
         import shutil
 
         shutil.rmtree(self._spill_dir, ignore_errors=True)

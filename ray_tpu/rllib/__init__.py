@@ -15,6 +15,8 @@ with V-trace), DQN (double DQN + optional prioritized replay), SAC
 from ray_tpu.rllib.algorithm import AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.multi_agent import (MultiAgentPPO,  # noqa: F401
+                                       MultiAgentPPOConfig)
 from ray_tpu.rllib.offline import (BCLearner, CQLLearner,  # noqa: F401
                                    train_offline)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401

@@ -142,6 +142,17 @@ def cmd_state(args):
     print(json.dumps(fn(), indent=2, default=str))
 
 
+def cmd_logs(args):
+    _connect()
+    from ray_tpu import state
+
+    if args.file:
+        print(state.get_log(args.file, node_id=args.node, tail=args.tail),
+              end="")
+    else:
+        print(json.dumps(state.list_logs(node_id=args.node), indent=2))
+
+
 def cmd_job(args):
     from ray_tpu.job import JobSubmissionClient
 
@@ -193,6 +204,13 @@ def main(argv=None):
     sp.add_argument("what", choices=["nodes", "actors", "workers", "tasks",
                                      "objects", "summary"])
     sp.set_defaults(fn=cmd_state)
+
+    sp = sub.add_parser("logs", help="list/tail session worker logs")
+    sp.add_argument("file", nargs="?", default=None,
+                    help="log filename (omit to list)")
+    sp.add_argument("--node", default=None, help="node id filter")
+    sp.add_argument("--tail", type=int, default=1000)
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("job", help="job submission")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

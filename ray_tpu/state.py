@@ -111,6 +111,55 @@ def summarize_objects() -> Dict[str, Any]:
     return {**s["objects"], "store_bytes_in_use": s["store"]["bytes_in_use"]}
 
 
+def list_logs(node_id: str = None) -> List[dict]:
+    """Session log files (name + size), per node in cluster mode
+    (reference: ray.util.state.list_logs)."""
+    from ray_tpu.core.cluster.rpc import RpcError
+
+    core = _core()
+    if _is_cluster(core):
+        out = []
+        for n in core.nodes():
+            if node_id and n["node_id"].hex() != node_id:
+                continue
+            try:
+                files = core._nodes.get(tuple(n["address"])).call(
+                    ("list_logs",))
+            except RpcError:  # unreachable node
+                files = []
+            out.extend({**f, "node_id": n["node_id"].hex()} for f in files)
+        return out
+    from ray_tpu.core.log_monitor import list_log_files
+
+    return list_log_files(core.log_dir)
+
+
+def get_log(filename: str, node_id: str = None,
+            tail: int = 1000) -> str:
+    """Tail of one session log file (reference: ray.util.state.get_log)."""
+    from ray_tpu.core.cluster.rpc import RpcError
+
+    core = _core()
+    if _is_cluster(core):
+        for n in core.nodes():
+            if node_id and n["node_id"].hex() != node_id:
+                continue
+            try:
+                return core._nodes.get(tuple(n["address"])).call(
+                    ("get_log", filename, tail))
+            except (RpcError, FileNotFoundError):
+                # transport failure or absent on this node: try the next
+                # one; bad requests (ValueError) propagate untouched
+                if node_id:
+                    raise
+                continue
+        raise FileNotFoundError(
+            f"log {filename!r} not found on any reachable node")
+    from ray_tpu.core.log_monitor import read_log_file
+
+    return read_log_file(core.log_dir, filename, tail)
+
+
 def cluster_resources() -> Dict[str, float]:
     core = _core()
     if _is_cluster(core):

@@ -398,3 +398,53 @@ def test_usage_stats_opt_in(tmp_path, monkeypatch):
     usage_stats.record("init", workers=2)
     line = json.loads(open(usage_stats.USAGE_FILE).read())
     assert line["event"] == "init" and line["workers"] == 2
+
+
+# ---------------------------------------------------------------------------
+# worker log capture + streaming (reference: log_monitor.py, log_to_driver)
+# ---------------------------------------------------------------------------
+
+
+def test_worker_logs_captured_and_streamed(rt):
+    import io
+    import time
+
+    from ray_tpu import state
+    from ray_tpu.core import runtime_context
+    from ray_tpu.core.log_monitor import LogMonitor
+
+    @rt.remote
+    def shout(x):
+        print(f"log-line-{x}")
+        return x
+
+    assert rt.get(shout.remote(7)) == 7
+    core = runtime_context.get_core()
+
+    # the line landed in some worker-*.out file
+    deadline = time.time() + 5
+    found = False
+    while time.time() < deadline and not found:
+        for f in state.list_logs():
+            if f["name"].endswith(".out") and f["size"] > 0:
+                if "log-line-7" in state.get_log(f["name"]):
+                    found = True
+                    break
+        time.sleep(0.05)
+    assert found, state.list_logs()
+
+    # a monitor over the same dir streams it with the worker prefix
+    sink = io.StringIO()
+    mon = LogMonitor(core.log_dir, sink=sink, interval_s=0.05)
+    mon.poll_once()
+    out = sink.getvalue()
+    assert "log-line-7" in out
+    assert "(worker=" in out and " out) " in out
+
+
+def test_get_log_rejects_path_escape(rt):
+    from ray_tpu import state
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        state.get_log("../../etc/passwd")
