@@ -210,7 +210,10 @@ def bench_serve_ttft(n_requests: int = 16):
         model_config=({"preset": "llama3_1b_proxy",
                        "param_dtype": "bfloat16"} if on_tpu
                       else {"preset": "tiny"}),
-        num_slots=8, max_len=512 if on_tpu else 64,
+        # 16 slots so the 16-request burst admits without queueing for a
+        # slot (KV for 16x512 at 1B scale is a few hundred MB of HBM);
+        # batched prefill admits the burst in 2 program calls
+        num_slots=16, max_len=512 if on_tpu else 64,
         prefill_buckets=[128] if on_tpu else [16],
         max_new_tokens=64 if on_tpu else 8,
         chunk_steps=16)

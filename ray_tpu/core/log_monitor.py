@@ -52,6 +52,10 @@ class LogMonitor:
             self._thread.join(timeout=2.0)
         if flush:
             self.poll_once()
+            # a crashed worker's final write may lack the newline — force
+            # the stashed partials out so nothing is silently dropped
+            for name in list(self._partial):
+                self._emit(name, b"\n")
 
     # -- tailing -----------------------------------------------------------
 

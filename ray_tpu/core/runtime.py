@@ -1955,5 +1955,7 @@ class Runtime:
         import shutil
 
         shutil.rmtree(self._spill_dir, ignore_errors=True)
+        shutil.rmtree(os.path.join("/tmp", self._session),
+                      ignore_errors=True)
         if runtime_context.get_core_or_none() is self:
             runtime_context.set_core(None)

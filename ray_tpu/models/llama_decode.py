@@ -107,6 +107,83 @@ def prefill(cfg: LlamaConfig, params, tokens: jax.Array
     return logits, {"k": kv[0], "v": kv[1]}, x
 
 
+def prefill_batch(cfg: LlamaConfig, params, tokens: jax.Array,
+                  last_idx: jax.Array
+                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Batched prompt prefill: B prompts in one program.
+
+    tokens: [B, P] (rows padded to the bucket length), last_idx: [B] (index
+    of each row's true last prompt token). Returns (logits_last [B, vocab],
+    kv {"k","v": [L, B, P, KVH, hd]}). One batched call replaces B
+    sequential prefills — under burst admission this divides the
+    prefill-phase host↔device round-trips by B (the tunnel RT dominates
+    TTFT otherwise).
+    """
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    P = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg.head_dim_, P, cfg.rope_theta,
+                                dtype=cfg.dtype)
+
+    def layer(x, p):
+        b, s, _ = x.shape
+        q, k, v, _ = _project_qkv(cfg, p, x)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kf = _gqa_repeat(cfg, k)
+        vf = _gqa_repeat(cfg, v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim_))
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+        attn = attn.reshape(b, s, cfg.num_heads * cfg.head_dim_)
+        x = x + jnp.dot(attn, p["wo"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32).astype(cfg.dtype)
+        x = x + _mlp(cfg, p, x)
+        return x, (k, v)  # [B, P, KVH, hd]
+
+    x, kv = jax.lax.scan(lambda x_, p_: layer(x_, p_), x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # gather each row's last true prompt position, then ONE [B, vocab]
+    # head matmul (a full [B, P, vocab] logits tensor would be ~P times
+    # the transfer and FLOPs for the same information)
+    B = tokens.shape[0]
+    x_last = x[jnp.arange(B), last_idx]  # [B, h]
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.dot(x_last, head.astype(cfg.dtype),
+                     preferred_element_type=jnp.float32)  # [B, vocab]
+    return logits, {"k": kv[0], "v": kv[1]}
+
+
+def insert_many(cache: Dict[str, jax.Array], kv: Dict[str, jax.Array],
+                slots: jax.Array, valid: jax.Array
+                ) -> Dict[str, jax.Array]:
+    """Write B prefilled sequences into their cache slots in one program.
+
+    kv: [L, B, P, KVH, hd]; slots [B] int32; valid [B] bool (padding rows
+    of a partially-filled admission batch leave the cache untouched).
+    """
+    def body(cache, xs):
+        k_row, v_row, slot, ok = xs   # k/v row: [L, P, KVH, hd]
+
+        def write(c):
+            k = jax.lax.dynamic_update_slice(
+                c["k"], k_row[:, None], (0, slot, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(
+                c["v"], v_row[:, None], (0, slot, 0, 0, 0))
+            return {"k": k, "v": v}
+
+        return jax.lax.cond(ok, write, lambda c: c, cache), None
+
+    cache, _ = jax.lax.scan(
+        body, cache,
+        (jnp.moveaxis(kv["k"], 1, 0), jnp.moveaxis(kv["v"], 1, 0),
+         slots, valid))
+    return cache
+
+
 def insert_sequence(cache: Dict[str, jax.Array], kv: Dict[str, jax.Array],
                     slot: jax.Array) -> Dict[str, jax.Array]:
     """Write a prefilled sequence's K/V into cache slot ``slot``.
@@ -213,15 +290,15 @@ def make_engine_fns(cfg: LlamaConfig, params, num_slots: int, max_len: int):
     bake the full weight tensors into the HLO as literal constants and
     compilation explodes (GBs of literals). cfg is static (frozen
     dataclass)."""
-    prefill_j = jax.jit(prefill, static_argnums=(0,))
-    insert_j = jax.jit(insert_sequence, donate_argnums=(0,))
+    prefill_b_j = jax.jit(prefill_batch, static_argnums=(0,))
+    insert_many_j = jax.jit(insert_many, donate_argnums=(0,))
     decode_j = jax.jit(decode_step, static_argnums=(0,),
                        donate_argnums=(2,))
     chunk_j = jax.jit(decode_chunk, static_argnums=(0, 6),
                       donate_argnums=(2,))
 
-    def pre(tokens):
-        return prefill_j(cfg, params, tokens)
+    def pre_batch(tokens, last_idx):
+        return prefill_b_j(cfg, params, tokens, last_idx)
 
     def dec(cache, tokens, positions, active):
         return decode_j(cfg, params, cache, tokens, positions, active)
@@ -230,4 +307,4 @@ def make_engine_fns(cfg: LlamaConfig, params, num_slots: int, max_len: int):
         return chunk_j(cfg, params, cache, tokens, positions, active,
                        num_steps)
 
-    return pre, insert_j, dec, dec_chunk
+    return pre_batch, insert_many_j, dec, dec_chunk

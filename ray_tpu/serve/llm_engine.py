@@ -63,8 +63,12 @@ class LLMEngine:
         self._greedy = greedy
         self._jnp = jnp
 
-        self._prefill, self._insert, self._decode, self._decode_chunk = \
+        (self._prefill_batch, self._insert_many, self._decode,
+         self._decode_chunk) = \
             llama_decode.make_engine_fns(cfg, self._params, num_slots, max_len)
+        # burst admission: up to this many prompts prefill in ONE batched
+        # program call (2 compiled batch sizes: 1 and this max)
+        self._admit_batch = max(1, min(8, num_slots))
         self._cache = llama_decode.init_cache(cfg, num_slots, max_len)
         # Tokens decoded per host sync. Over a high-latency link (the axon
         # tunnel is ~100ms/roundtrip) chunking is the difference between 9
@@ -122,42 +126,85 @@ class LLMEngine:
     # ---- engine loop -------------------------------------------------------
 
     def _admit(self) -> bool:
-        """Prefill waiting requests into free slots; returns True if any."""
+        """Prefill waiting requests into free slots; returns True if any.
+
+        Requests are admitted in batches: up to ``_admit_batch`` waiting
+        prompts run through ONE batched prefill + insert program, so a
+        burst pays one host↔device round-trip instead of one per prompt
+        (the round-trip dominates TTFT over a high-latency link).
+        """
+        import numpy as np
+
         jnp = self._jnp
         admitted = False
         while self._free and not self._in.empty():
-            try:
-                req_id, toks, max_new, t0 = self._in.get_nowait()
-            except queue.Empty:
+            # pull up to min(free slots, admit batch) waiting requests
+            pending = []
+            while (len(pending) < min(len(self._free), self._admit_batch)
+                   and not self._in.empty()):
+                try:
+                    pending.append(self._in.get_nowait())
+                except queue.Empty:
+                    break
+            if not pending:
                 break
-            slot = None
-            try:
-                toks = [int(t) for t in toks]
-                if not toks:
-                    raise ValueError("empty prompt")
+            batch = []   # (req_id, toks, max_new, t0, slot)
+            for req_id, toks, max_new, t0 in pending:
+                try:
+                    toks = [int(t) for t in toks]
+                    if not toks:
+                        raise ValueError("empty prompt")
+                except Exception as e:  # noqa: BLE001
+                    with self._done_lock:
+                        self._done[req_id] = ValueError(
+                            f"request rejected: {e!r}")
+                    continue
                 if len(toks) >= self._max_len:
                     toks = toks[: self._max_len - 1]
-                slot = self._free.pop()
-                P = _bucket(len(toks), self._buckets)
-                padded = jnp.array([toks + [0] * (P - len(toks))], jnp.int32)
-                logits, kv, _ = self._prefill(padded)
-                self._cache = self._insert(self._cache, kv, jnp.int32(slot))
-                first = int(jnp.argmax(logits[len(toks) - 1]))
-            except Exception as e:  # noqa: BLE001 — fail THIS request only
-                if slot is not None:
-                    self._free.append(slot)
-                with self._done_lock:
-                    self._done[req_id] = ValueError(
-                        f"request rejected: {e!r}")
+                batch.append((req_id, toks, max_new, t0, self._free.pop()))
+            if not batch:
                 continue
-            self._slot_req[slot] = req_id
-            self._slot_tokens[slot] = [first]
-            self._slot_budget[slot] = max_new
-            self._slot_pos[slot] = len(toks)
-            self._slot_start[slot] = t0
-            self._slot_ttft[slot] = time.monotonic() - t0
-            admitted = True
-            self._maybe_finish(slot, first)
+            try:
+                # one code path for both sizes: the batched prefill takes
+                # the last-token index as a TRACED argument, so prompt
+                # length never mints a new program (a python-int slice
+                # like logits[len-1] would compile per distinct length —
+                # ~1s each over the tunnel, paid inside TTFT)
+                B = 1 if len(batch) == 1 else self._admit_batch
+                P = _bucket(max(len(t) for _, t, _, _, _ in batch),
+                            self._buckets)
+                rows = np.zeros((B, P), np.int32)
+                last = np.zeros((B,), np.int32)
+                slots = np.zeros((B,), np.int32)
+                valid = np.zeros((B,), bool)
+                for i, (_, toks, _, _, slot) in enumerate(batch):
+                    rows[i, :len(toks)] = toks
+                    last[i] = len(toks) - 1
+                    slots[i], valid[i] = slot, True
+                logits, kv = self._prefill_batch(jnp.asarray(rows),
+                                                 jnp.asarray(last))
+                self._cache = self._insert_many(
+                    self._cache, kv, jnp.asarray(slots),
+                    jnp.asarray(valid))
+                firsts = np.asarray(jnp.argmax(logits, axis=-1))
+            except Exception as e:  # noqa: BLE001 — fail THESE requests
+                for req_id, _, _, _, slot in batch:
+                    self._free.append(slot)
+                    with self._done_lock:
+                        self._done[req_id] = ValueError(
+                            f"request rejected: {e!r}")
+                continue
+            now = time.monotonic()
+            for i, (req_id, toks, max_new, t0, slot) in enumerate(batch):
+                first = int(firsts[i])
+                self._slot_req[slot] = req_id
+                self._slot_tokens[slot] = [first]
+                self._slot_budget[slot] = max_new
+                self._slot_pos[slot] = len(toks)
+                self._slot_start[slot] = t0
+                self._slot_ttft[slot] = now - t0
+                admitted = True
+                self._maybe_finish(slot, first)
         return admitted
 
     def _maybe_finish(self, slot: int, last_token: int) -> bool:
@@ -190,16 +237,28 @@ class LLMEngine:
         poss = jnp.zeros((S,), jnp.int32)
         act = jnp.zeros((S,), bool)  # inactive: cache unchanged
         self._cache, logits = self._decode(self._cache, toks, poss, act)
-        np.asarray(logits[0, 0])
+        # warm the EAGER argmax op the k==1 decode path uses (eager ops
+        # compile like jit programs on first use)
+        np.asarray(jnp.argmax(logits, axis=-1))
         k = 2
         while k <= self._chunk_steps:
             self._cache, out, _ = self._decode_chunk(
                 self._cache, toks, poss, act, k)
             np.asarray(out[0, 0])
             k *= 2
+        sizes = sorted({1, self._admit_batch})
         for b in self._buckets:
-            lg, _, _ = self._prefill(jnp.zeros((1, b), jnp.int32))
-            np.asarray(lg[0, 0])
+            for B in sizes:
+                # admission path per (batch-size, bucket): prefill_batch +
+                # insert_many + the eager argmax — ALL compile per shape,
+                # and any one left cold lands its compile inside a TTFT
+                lg, kvb = self._prefill_batch(
+                    jnp.zeros((B, b), jnp.int32), jnp.zeros((B,), jnp.int32))
+                np.asarray(jnp.argmax(lg, axis=-1))
+                self._cache = self._insert_many(
+                    self._cache, kvb, jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B,), bool))
+        np.asarray(self._cache["k"][0, 0, 0, 0, 0])
 
     def _run(self):
         import numpy as np

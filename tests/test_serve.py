@@ -180,3 +180,40 @@ def test_llm_engine_e2e(serve_ray):
     # engine stats row visible
     stats = handle.stats.remote().result(timeout=30)
     assert stats == {} or stats.get("slots", 4) == 4
+
+
+def test_batched_admission_matches_single(rt):
+    """A burst admitted through the batched prefill path must generate
+    exactly the tokens the single-prompt path generates (greedy)."""
+    import time as _time
+
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    prompts = [[7, 3, 9, 1], [5, 5, 2], [11, 4, 6, 8, 2], [1, 2]]
+
+    def run(engine, stagger):
+        for i, p in enumerate(prompts):
+            engine.submit(f"r{i}", p, 6)
+            if stagger:
+                # let each request admit alone (single-prefill path)
+                deadline = _time.time() + 30
+                while f"r{i}" not in engine._done and _time.time() < deadline:
+                    _time.sleep(0.01)
+        out = {}
+        deadline = _time.time() + 60
+        while len(out) < len(prompts) and _time.time() < deadline:
+            out.update(engine.collect())
+            _time.sleep(0.01)
+        engine.shutdown()
+        return {k: v["tokens"] for k, v in out.items()}
+
+    eng1 = LLMEngine(model_config={"preset": "tiny"}, num_slots=4,
+                     max_len=32, prefill_buckets=[8], max_new_tokens=6,
+                     chunk_steps=1)
+    singles = run(eng1, stagger=True)
+    eng2 = LLMEngine(model_config={"preset": "tiny"}, num_slots=4,
+                     max_len=32, prefill_buckets=[8], max_new_tokens=6,
+                     chunk_steps=1)
+    burst = run(eng2, stagger=False)
+    assert singles == burst, (singles, burst)
+    assert all(len(t) == 6 for t in burst.values())
