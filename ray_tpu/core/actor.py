@@ -103,6 +103,10 @@ class ActorClass:
             if getattr(m, "__rtpu_method_opts__", None)
         }
         opts["method_opts"] = method_opts
+        if opts.get("runtime_env") and hasattr(core, "prepare_runtime_env"):
+            # package working_dir/py_modules paths into hash references
+            opts["runtime_env"] = core.prepare_runtime_env(
+                opts["runtime_env"])
         if hasattr(core, "register_function"):
             cls_fn_id = core.register_function(self._cls)
             actor_id = core.create_actor(cls_fn_id, args, kwargs, opts)

@@ -828,6 +828,27 @@ class ClusterCore:
     def kv_op(self, op: str, key: str, value=None):
         return self.gcs.call(("kv", op, key, value))
 
+    # ---- runtime_env packages: content-addressed blobs in the GCS KV,
+    # pulled lazily by each node (reference: GCS package store + per-node
+    # runtime-env agent download)
+
+    def register_package(self, pkg_hash: str, data: bytes) -> None:
+        registered = getattr(self, "_registered_pkgs", None)
+        if registered is None:
+            registered = self._registered_pkgs = set()
+        if pkg_hash in registered:
+            return
+        key = f"pkg:{pkg_hash}"
+        # exists-check: never pull the blob back just to test presence
+        if not self.kv_op("exists", key):
+            self.kv_op("put", key, data)
+        registered.add(pkg_hash)
+
+    def prepare_runtime_env(self, runtime_env):
+        from ray_tpu.core import runtime_env as _re
+
+        return _re.prepare(self, runtime_env)
+
     def cluster_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}
         for n in self._cluster_view(force=True)["nodes"]:

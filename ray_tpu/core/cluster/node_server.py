@@ -76,6 +76,18 @@ class NodeRuntime(Runtime):
         self._server_ref = server
         super().__init__(**kw)
 
+    def _get_package(self, pkg_hash: str):
+        """Runtime_env package lookup: local table first, then the GCS
+        KV blob the submitting driver registered; cache locally."""
+        data = super()._get_package(pkg_hash)
+        if data is None:
+            srv = self._server_ref
+            if srv is not None:
+                # no RAM cache: workers extract once into the shared
+                # on-disk session cache and never re-fetch this hash
+                data = srv.gcs.call(("kv", "get", f"pkg:{pkg_hash}", None))
+        return data
+
     # locations: publish every stored object id to the GCS directory
     def _store_payload(self, oid, payload):
         super()._store_payload(oid, payload)

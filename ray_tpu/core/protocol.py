@@ -49,6 +49,8 @@ REQ_CREATE_ACTOR = "create_actor_req"  # (.., fn_id, pickled_cls_or_none, args_p
 REQ_PG = "pg"                      # (REQ_PG, op, *args) -> ("ok", result); op in create/remove/ready_ref/wait/chips/table
 REQ_GET_ACTOR = "get_actor"        # (REQ_GET_ACTOR, name) -> ("ok", handle_payload)
 REQ_CANCEL = "cancel"              # (REQ_CANCEL, oid_bytes, force) -> ("ok",)
+REQ_PKG = "pkg"                    # (REQ_PKG, hash_str) -> ("ok", bytes_or_none)
+REQ_PKG_PUT = "pkg_put"            # (REQ_PKG_PUT, hash_str, bytes) -> ("ok", None)
 REQ_NEED_SPACE = "need_space"      # (REQ_NEED_SPACE, nbytes) -> ("ok", freed_bool)
 
 class ErrorValue:

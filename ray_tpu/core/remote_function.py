@@ -42,6 +42,10 @@ class RemoteFunction:
         core = runtime_context.get_core()
         num_returns = options.get("num_returns", 1)
         opts = {k: v for k, v in options.items() if k != "num_returns"}
+        if opts.get("runtime_env") and hasattr(core, "prepare_runtime_env"):
+            # package working_dir/py_modules paths into hash references
+            opts["runtime_env"] = core.prepare_runtime_env(
+                opts["runtime_env"])
         if hasattr(core, "submit_task") and hasattr(core, "register_function"):
             # driver path
             if self._fn_id is None or self._fn_id_core is not core:

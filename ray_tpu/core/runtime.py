@@ -201,6 +201,7 @@ class Runtime:
         self._actors: Dict[ActorID, _ActorState] = {}
         self._named_actors: Dict[str, ActorID] = {}
         self._kv: Dict[str, Any] = {}
+        self._packages: Dict[str, bytes] = {}  # runtime_env package store
         # First-return-id -> spec, for ray.cancel lookup; entries drop when
         # the task finishes (done/error/cancel paths).
         self._cancellable: Dict[bytes, _TaskSpec] = {}
@@ -250,6 +251,7 @@ class Runtime:
             RTPU_ADDRESS=self._sock_path,
             RTPU_AUTH=self._authkey.hex(),
             RTPU_STORE="/" + self._session,
+            RTPU_PKG_DIR=os.path.join("/tmp", self._session, "packages"),
             RTPU_NODE_ID=self.node_id.hex(),
             RTPU_WORKER_ID=worker_id.hex(),
         )
@@ -1720,6 +1722,18 @@ class Runtime:
         except (EOFError, OSError):
             pass
 
+    def register_package(self, pkg_hash: str, data: bytes) -> None:
+        """Store a runtime_env package (driver-side prepare)."""
+        self._packages[pkg_hash] = data
+
+    def _get_package(self, pkg_hash: str):
+        return self._packages.get(pkg_hash)
+
+    def prepare_runtime_env(self, runtime_env):
+        from ray_tpu.core import runtime_env as _re
+
+        return _re.prepare(self, runtime_env)
+
     def _handle_data_request(self, w: _Worker, msg):
         tag = msg[0]
         if tag == protocol.REQ_GET:
@@ -1796,6 +1810,11 @@ class Runtime:
             finally:
                 self._unmark_worker_blocked(w, cur_task)
             return ("ok", [x.binary() for x in ready], [x.binary() for x in rest])
+        if tag == protocol.REQ_PKG:
+            return ("ok", self._get_package(msg[1]))
+        if tag == protocol.REQ_PKG_PUT:
+            self.register_package(msg[1], msg[2])
+            return ("ok", None)
         if tag == protocol.REQ_KV:
             _, op, key, value = msg
             if op == "get":

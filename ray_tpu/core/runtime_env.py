@@ -1,0 +1,223 @@
+"""Runtime environments: ship code to workers (working_dir / py_modules).
+
+Reference: python/ray/_private/runtime_env/ — there, working_dir and
+py_modules are zipped, uploaded to the GCS package store, downloaded and
+extracted by each node's runtime-env agent, then applied per worker
+(chdir + sys.path). Here the same shape without a separate agent:
+
+- driver: `prepare(core, runtime_env)` zips each local path into a
+  content-addressed package and registers the bytes with the core
+  (local runtime: in-process table; cluster: GCS KV `pkg:<hash>`),
+  rewriting the env to hash references — the env dict that travels with
+  the task/actor is small and serializable.
+- worker: `apply(runtime_env, core)` fetches packages it doesn't have
+  (REQ_PKG to its core, answered from the table / GCS KV), extracts them
+  once into the session package cache, then chdirs into the working_dir
+  and prepends py_modules to sys.path. Per-task application is restored
+  after the task; actor-scoped application persists for the actor's
+  lifetime (the worker is dedicated to it).
+
+pip/conda/container isolation is intentionally out of scope: workers
+share one pool and one interpreter (and this image installs nothing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Callable, Dict, Optional, Tuple
+
+_EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules", ".eggs"}
+_EXCLUDE_SUFFIXES = (".pyc", ".pyo")
+_MAX_PACKAGE_BYTES = 512 << 20
+
+
+def _iter_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _EXCLUDE_DIRS)
+        for f in sorted(filenames):
+            if f.endswith(_EXCLUDE_SUFFIXES):
+                continue
+            full = os.path.join(dirpath, f)
+            yield os.path.relpath(full, root), full
+
+
+def package_path(path: str, *, prefix: str = "") -> Tuple[str, bytes]:
+    """Zip a directory (or single .py file) deterministically.
+
+    Returns (content_hash, zip_bytes). The hash covers names + contents
+    (not zip metadata), so identical trees share a package.
+    """
+    path = os.path.abspath(path)
+    h = hashlib.sha256()
+    entries = []
+    if os.path.isfile(path):
+        entries = [(os.path.basename(path), path)]
+    elif os.path.isdir(path):
+        entries = [(os.path.join(prefix, rel) if prefix else rel, full)
+                   for rel, full in _iter_files(path)]
+    else:
+        raise FileNotFoundError(f"runtime_env path {path!r} does not exist")
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for arcname, full in entries:
+            with open(full, "rb") as f:
+                data = f.read()
+            total += len(data)
+            if total > _MAX_PACKAGE_BYTES:
+                raise ValueError(
+                    f"runtime_env package {path!r} exceeds "
+                    f"{_MAX_PACKAGE_BYTES >> 20} MiB")
+            h.update(arcname.encode())
+            h.update(b"\0")
+            h.update(data)
+            info = zipfile.ZipInfo(arcname, date_time=(1980, 1, 1, 0, 0, 0))
+            zf.writestr(info, data)
+    return h.hexdigest()[:32], buf.getvalue()
+
+
+def _tree_signature(path: str):
+    """Cheap change detector: (file count, max mtime_ns, total bytes).
+    Walking stats is ~100x cheaper than re-reading + zipping the tree on
+    every .remote() call."""
+    if os.path.isfile(path):
+        st = os.stat(path)
+        return (1, st.st_mtime_ns, st.st_size)
+    n = mt = size = 0
+    for _, full in _iter_files(path):
+        st = os.stat(full)
+        n += 1
+        mt = max(mt, st.st_mtime_ns)
+        size += st.st_size
+    return (n, mt, size)
+
+
+def _package_cached(core, path: str, *, prefix: str = "") -> str:
+    """Package + register once per unchanged tree; returns the hash."""
+    cache = getattr(core, "_renv_cache", None)
+    if cache is None:
+        cache = core._renv_cache = {}
+    key = (os.path.abspath(path), prefix)
+    sig = _tree_signature(os.path.abspath(path))
+    hit = cache.get(key)
+    if hit and hit[0] == sig:
+        return hit[1]
+    h, data = package_path(path, prefix=prefix)
+    core.register_package(h, data)
+    cache[key] = (sig, h)
+    return h
+
+
+def prepare(core, runtime_env: Optional[dict]) -> Optional[dict]:
+    """Driver-side: package local paths, register bytes with the core,
+    rewrite the env to content-hash references."""
+    if not runtime_env:
+        return runtime_env
+    if "working_dir_pkg" in runtime_env or "py_modules_pkgs" in runtime_env:
+        return runtime_env  # already prepared (e.g. re-submission)
+    out = dict(runtime_env)
+    wd = out.pop("working_dir", None)
+    if wd is not None:
+        out["working_dir_pkg"] = _package_cached(core, wd)
+    mods = out.pop("py_modules", None)
+    if mods:
+        hashes = []
+        for m in mods:
+            m = os.path.abspath(m)
+            # a module DIRECTORY must stay importable after extraction:
+            # nest it under its own name so sys.path points at the parent
+            prefix = os.path.basename(m.rstrip(os.sep)) \
+                if os.path.isdir(m) else ""
+            hashes.append(_package_cached(core, m, prefix=prefix))
+        out["py_modules_pkgs"] = hashes
+    return out
+
+
+def ensure_extracted(cache_root: str, pkg_hash: str,
+                     fetch: Callable[[str], bytes]) -> str:
+    """Extract package ``pkg_hash`` under the cache once; returns its dir.
+    Atomic against concurrent workers (extract to temp + rename)."""
+    dest = os.path.join(cache_root, pkg_hash)
+    if os.path.isdir(dest):
+        return dest
+    data = fetch(pkg_hash)
+    if data is None:
+        raise FileNotFoundError(
+            f"runtime_env package {pkg_hash} not found in the package "
+            "store (was it registered by the submitting driver?)")
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, dest)
+    except OSError:
+        # another worker won the race; ours is redundant
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def apply(runtime_env: Optional[dict], fetch: Callable[[str], bytes],
+          cache_root: Optional[str] = None):
+    """Worker-side: apply env_vars, working_dir, py_modules.
+
+    Returns opaque state for ``restore`` (None when nothing applied).
+    """
+    if not runtime_env:
+        return None
+    if "working_dir" in runtime_env or "py_modules" in runtime_env:
+        # raw paths mean prepare() never ran (e.g. a core without
+        # prepare_runtime_env support): fail loudly, not silently
+        raise ValueError(
+            "runtime_env working_dir/py_modules were not prepared by the "
+            "submitting process — submit from a driver or a worker core "
+            "with prepare_runtime_env support")
+    cache_root = cache_root or os.environ.get(
+        "RTPU_PKG_DIR", "/tmp/ray_tpu_pkgs")
+    os.makedirs(cache_root, exist_ok=True)
+    saved_env = None
+    env_vars = runtime_env.get("env_vars")
+    if env_vars:
+        saved_env = {k: os.environ.get(k) for k in env_vars}
+        os.environ.update({k: str(v) for k, v in env_vars.items()})
+    saved_cwd = None
+    saved_path: Optional[list] = None
+    wd_hash = runtime_env.get("working_dir_pkg")
+    mod_hashes = runtime_env.get("py_modules_pkgs") or []
+    if wd_hash or mod_hashes:
+        saved_path = list(sys.path)
+    if wd_hash:
+        wd = ensure_extracted(cache_root, wd_hash, fetch)
+        saved_cwd = os.getcwd()
+        os.chdir(wd)
+        sys.path.insert(0, wd)
+    for h in mod_hashes:
+        sys.path.insert(0, ensure_extracted(cache_root, h, fetch))
+    if saved_env is None and saved_cwd is None and saved_path is None:
+        return None
+    return (saved_env, saved_cwd, saved_path)
+
+
+def restore(state) -> None:
+    if state is None:
+        return
+    saved_env, saved_cwd, saved_path = state
+    if saved_env:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if saved_cwd is not None:
+        try:
+            os.chdir(saved_cwd)
+        except OSError:
+            pass
+    if saved_path is not None:
+        sys.path[:] = saved_path

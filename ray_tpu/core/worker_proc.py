@@ -372,7 +372,7 @@ class WorkerCore:
                 if random.random() < config.testing_kill_worker_prob:
                     os._exit(1)
             self.current_task_id = TaskID(task_id_b)
-            saved_env = _apply_env(runtime_env)
+            saved_env = self._apply_runtime_env(runtime_env)
             try:
                 fn = self._functions[fn_id]
                 args, kwargs = self._decode_args(args_payload, inline_values)
@@ -382,8 +382,30 @@ class WorkerCore:
             except BaseException as e:  # noqa: BLE001
                 self._send_error(task_id_b, e)
             finally:
-                _restore_env(saved_env)
+                _re_restore(saved_env)
                 self.current_task_id = None
+
+    def _apply_runtime_env(self, runtime_env):
+        """env_vars + working_dir + py_modules; packages fetched from the
+        core over REQ_PKG and cached under RTPU_PKG_DIR."""
+        from ray_tpu.core import runtime_env as _re
+
+        if not runtime_env:
+            return None
+        return _re.apply(runtime_env, fetch=self._fetch_package)
+
+    def _fetch_package(self, pkg_hash: str):
+        _, data = self._request(protocol.REQ_PKG, pkg_hash)
+        return data
+
+    def register_package(self, pkg_hash: str, data: bytes) -> None:
+        """Upload a package to the core (nested submissions from tasks)."""
+        self._request(protocol.REQ_PKG_PUT, pkg_hash, data)
+
+    def prepare_runtime_env(self, runtime_env):
+        from ray_tpu.core import runtime_env as _re
+
+        return _re.prepare(self, runtime_env)
 
     def _send_error(self, task_id_b: bytes, exc: BaseException):
         self.task_conn.send(
@@ -397,7 +419,7 @@ class WorkerCore:
             self.current_actor_id = ActorID(actor_id_b)
             # actor-scoped runtime_env: applied for the actor's lifetime
             # (the worker is dedicated to it)
-            _apply_env(opts.get("runtime_env"))
+            self._apply_runtime_env(opts.get("runtime_env"))
             instance = cls(*args, **kwargs)
             self._actors[actor_id_b] = instance
             if opts.get("has_async_methods"):
@@ -442,26 +464,10 @@ class WorkerCore:
             self.current_task_id = None
 
 
-def _apply_env(runtime_env):
-    """Apply a task's runtime_env env_vars; returns state for restore
-    (reference: python/ray/_private/runtime_env/ — the env-vars plugin;
-    container/conda isolation is out of scope for a shared worker pool)."""
-    env_vars = (runtime_env or {}).get("env_vars")
-    if not env_vars:
-        return None
-    saved = {k: os.environ.get(k) for k in env_vars}
-    os.environ.update({k: str(v) for k, v in env_vars.items()})
-    return saved
+def _re_restore(saved):
+    from ray_tpu.core import runtime_env as _re
 
-
-def _restore_env(saved):
-    if not saved:
-        return
-    for k, v in saved.items():
-        if v is None:
-            os.environ.pop(k, None)
-        else:
-            os.environ[k] = v
+    _re.restore(saved)
 
 
 def _prepare_args_local(core: WorkerCore, args: tuple, kwargs: dict):

@@ -279,3 +279,22 @@ def test_cluster_remove_node_survival():
     finally:
         c.shutdown()
         runtime_context.set_core(prev_core)
+
+
+def test_runtime_env_working_dir_across_nodes(cluster, tmp_path):
+    """Packages registered by the driver reach workers on every node via
+    the GCS KV package store."""
+    proj = tmp_path / "clusterproj"
+    proj.mkdir()
+    (proj / "marker.txt").write_text("cluster-pkg")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def read_marker():
+        with open("marker.txt") as f:
+            return f.read(), os.getppid()
+
+    # spread over enough tasks to hit more than one node's workers
+    results = ray_tpu.get([read_marker.remote() for _ in range(8)],
+                          timeout=120)
+    assert all(content == "cluster-pkg" for content, _ in results)
+    assert len({node for _, node in results}) >= 2

@@ -448,3 +448,109 @@ def test_get_log_rejects_path_escape(rt):
     import pytest as _pytest
     with _pytest.raises(ValueError):
         state.get_log("../../etc/passwd")
+
+
+# ---------------------------------------------------------------------------
+# runtime_env: working_dir / py_modules code shipping
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_env_working_dir(rt, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "data.txt").write_text("payload-42")
+    (proj / "helper.py").write_text("VALUE = 1234\n")
+
+    @rt.remote(runtime_env={"working_dir": str(proj)})
+    def read_rel():
+        import os
+        import helper  # importable: working_dir is on sys.path
+
+        with open("data.txt") as f:
+            return f.read(), helper.VALUE, os.getcwd()
+
+    content, val, cwd = rt.get(read_rel.remote())
+    assert content == "payload-42" and val == 1234
+    assert "/packages/" in cwd  # extracted into the session package cache
+
+    # per-task scope: a plain task afterwards is back in the original cwd
+    @rt.remote
+    def plain_cwd():
+        import os
+        return os.getcwd()
+
+    assert "/packages/" not in rt.get(plain_cwd.remote())
+
+
+def test_runtime_env_py_modules(rt, tmp_path):
+    mod = tmp_path / "shippedmod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("def f():\n    return 'shipped'\n")
+
+    @rt.remote(runtime_env={"py_modules": [str(mod)]})
+    def use_mod():
+        import shippedmod
+        return shippedmod.f()
+
+    assert rt.get(use_mod.remote()) == "shipped"
+
+    # module is NOT importable without the runtime_env
+    @rt.remote
+    def no_mod():
+        try:
+            import shippedmod  # noqa: F401
+            return True
+        except ImportError:
+            return False
+
+    assert rt.get(no_mod.remote()) is False
+
+
+def test_runtime_env_actor_scoped_working_dir(rt, tmp_path):
+    proj = tmp_path / "aproj"
+    proj.mkdir()
+    (proj / "cfg.txt").write_text("actor-cfg")
+
+    @rt.remote(runtime_env={"working_dir": str(proj)})
+    class Reader:
+        def read(self):
+            with open("cfg.txt") as f:
+                return f.read()
+
+    r = Reader.remote()
+    assert rt.get(r.read.remote()) == "actor-cfg"
+    assert rt.get(r.read.remote()) == "actor-cfg"  # persists across calls
+    rt.kill(r)
+
+
+def test_runtime_env_package_determinism(tmp_path):
+    from ray_tpu.core.runtime_env import package_path
+
+    d = tmp_path / "pkg"
+    d.mkdir()
+    (d / "a.py").write_text("x = 1\n")
+    h1, z1 = package_path(str(d))
+    h2, z2 = package_path(str(d))
+    assert h1 == h2 and z1 == z2
+    (d / "a.py").write_text("x = 2\n")
+    h3, _ = package_path(str(d))
+    assert h3 != h1
+
+
+def test_runtime_env_nested_submission(rt, tmp_path):
+    """A task can itself submit a runtime_env task: the worker packages
+    the path and uploads it to the core's package store."""
+    proj = tmp_path / "nested"
+    proj.mkdir()
+    (proj / "n.txt").write_text("nested-ok")
+
+    @rt.remote
+    def outer(path):
+        @rt.remote(runtime_env={"working_dir": path})
+        def inner():
+            with open("n.txt") as f:
+                return f.read()
+
+        return rt.get(inner.remote())
+
+    assert rt.get(outer.remote(str(proj))) == "nested-ok"
