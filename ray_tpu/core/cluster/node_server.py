@@ -76,6 +76,17 @@ class NodeRuntime(Runtime):
         self._server_ref = server
         super().__init__(**kw)
 
+    def register_package(self, pkg_hash: str, data: bytes) -> None:
+        """Nested submissions from this node's workers: publish to the
+        GCS KV so spillback peers (and later tasks on any node) can pull
+        the package — the local table alone would strand spilled tasks."""
+        super().register_package(pkg_hash, data)
+        srv = self._server_ref
+        if srv is not None:
+            key = f"pkg:{pkg_hash}"
+            if not srv.gcs.call(("kv", "exists", key, None)):
+                srv.gcs.call(("kv", "put", key, data))
+
     def _get_package(self, pkg_hash: str):
         """Runtime_env package lookup: local table first, then the GCS
         KV blob the submitting driver registered; cache locally."""
@@ -344,6 +355,63 @@ class NodeServer:
                              daemon=True, name="node-fetch")
         t.start()
 
+    def _fetch_from(self, addr, oid_bytes: bytes) -> Optional[bytes]:
+        """Pull one object from a peer. Large payloads transfer as ranged
+        chunks over ``fetch_parallelism`` dedicated connections — the DCN
+        bulk path (reference: object_manager chunked pushes over multiple
+        gRPC streams); small ones take the single-call fast path."""
+        from ray_tpu.core.config import config as cfg
+
+        threshold = cfg.fetch_parallel_threshold_bytes
+        data = self._peers.get(addr).call(
+            ("fetch", oid_bytes, threshold if threshold > 0 else None))
+        if data is None:
+            return None
+        if data[0] != "size":
+            return data[1]
+        size = data[1]
+
+        chunk = max(1 << 20, cfg.fetch_chunk_bytes)
+        nstreams = max(1, min(cfg.fetch_parallelism,
+                              (size + chunk - 1) // chunk))
+        offsets = list(range(0, size, chunk))
+        out = bytearray(size)
+        failed: List[str] = []
+        idx_lock = threading.Lock()
+        next_idx = [0]
+
+        client = self._peers.get(addr)  # pooled: N concurrent calls use
+        # N connections, kept for future transfers to the same peer
+
+        def puller():
+            try:
+                while not failed:
+                    with idx_lock:
+                        if next_idx[0] >= len(offsets):
+                            return
+                        off = offsets[next_idx[0]]
+                        next_idx[0] += 1
+                    n = min(chunk, size - off)
+                    part = client.call(("fetch_range", oid_bytes, off, n))
+                    if part is None or len(part) != n:
+                        failed.append(f"range {off}+{n} unavailable")
+                        return
+                    out[off:off + n] = part
+            except Exception as e:  # noqa: BLE001
+                failed.append(repr(e))
+
+        threads = [threading.Thread(target=puller, daemon=True,
+                                    name="node-fetch-range")
+                   for _ in range(nstreams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if failed:
+            raise RpcError(f"chunked fetch of {len(out)} bytes from "
+                           f"{addr} failed: {failed[0]}")
+        return bytes(out)
+
     def _fetch_object(self, oid_bytes: bytes, hint):
         rt = self.runtime
         oid = ObjectID(oid_bytes)
@@ -363,12 +431,12 @@ class NodeServer:
                     if addr == self.address:
                         continue
                     try:
-                        data = self._peers.get(addr).call(("fetch", oid_bytes))
+                        data = self._fetch_from(addr, oid_bytes)
                     except (RpcError, Exception):  # noqa: BLE001
                         self.gcs.try_call(("loc_drop", oid_bytes, addr))
                         continue
                     if data is not None:
-                        store_incoming(rt, oid, data[1])
+                        store_incoming(rt, oid, data)
                         return
                 if time.monotonic() > deadline:
                     # Surface ObjectLostError to local waiters (queued
@@ -579,9 +647,11 @@ class NodeServer:
                 out[b] = materialize(rt, e.payload)
         return out
 
-    def _op_fetch(self, oid_bytes):
-        """Peer pull: return materialized payload bytes, or None if this
-        node does not hold the object (no recursive fetch)."""
+    def _op_fetch(self, oid_bytes, max_bytes=None):
+        """Peer pull: ("inline", payload_bytes), or ("size", n) when the
+        payload exceeds ``max_bytes`` (caller switches to ranged pulls),
+        or None if this node does not hold the object (no recursive
+        fetch)."""
         rt = self.runtime
         oid = ObjectID(oid_bytes)
         with rt._lock:
@@ -589,7 +659,63 @@ class NodeServer:
             if e is None or not e.event.is_set():
                 return None
             payload = e.payload
+        if max_bytes is not None:
+            size = self._op_fetch_size(oid_bytes)
+            if size is not None and size >= max_bytes:
+                return ("size", size)
         return materialize(rt, payload)
+
+    def _op_fetch_size(self, oid_bytes):
+        """Payload byte count for range-based transfer, or None."""
+        rt = self.runtime
+        oid = ObjectID(oid_bytes)
+        with rt._lock:
+            e = rt._objects.get(oid)
+            if e is None or not e.event.is_set():
+                return None
+            kind, data = e.payload
+        if kind == "inline":
+            return len(data)
+        if kind == "spilled":
+            path = data[0] if isinstance(data, tuple) else data
+            try:
+                return os.path.getsize(path)
+            except OSError:
+                return None
+        view = rt.store.get(oid, timeout_ms=0)
+        try:
+            return view.nbytes
+        finally:
+            del view
+            rt.store.release(oid)
+
+    def _op_fetch_range(self, oid_bytes, offset: int, length: int):
+        """One chunk of a payload (the DCN bulk path: a puller runs many
+        of these concurrently on separate connections). Serves shm-backed
+        objects without materializing the whole payload."""
+        rt = self.runtime
+        oid = ObjectID(oid_bytes)
+        with rt._lock:
+            e = rt._objects.get(oid)
+            if e is None or not e.event.is_set():
+                return None
+            kind, data = e.payload
+        if kind == "inline":
+            return bytes(data[offset:offset + length])
+        if kind == "spilled":
+            path = data[0] if isinstance(data, tuple) else data
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(length)
+            except OSError:
+                return None
+        view = rt.store.get(oid, timeout_ms=0)
+        try:
+            return bytes(view[offset:offset + length])
+        finally:
+            del view
+            rt.store.release(oid)
 
     def _op_has(self, oid_bytes):
         rt = self.runtime

@@ -98,6 +98,15 @@ _FLAGS: List[Flag] = [
          "descriptions kept for object reconstruction); oldest entries "
          "are evicted past it (reference: max_lineage_bytes)."),
     # ---- cluster plane ---------------------------------------------------
+    Flag("fetch_chunk_bytes", int, 16 << 20,
+         "Chunk size for ranged node-to-node object transfer "
+         "(reference: object manager 64MB chunked pushes)."),
+    Flag("fetch_parallel_threshold_bytes", int, 64 << 20,
+         "Objects at or above this size transfer as parallel ranged "
+         "chunks over multiple connections (the DCN bulk path); smaller "
+         "ones use a single fetch call. 0 disables ranged transfer."),
+    Flag("fetch_parallelism", int, 4,
+         "Concurrent connections per large-object fetch."),
     Flag("gcs_heartbeat_interval_s", float, 0.2,
          "Node -> GCS heartbeat period (reference: "
          "raylet_report_resources_period_milliseconds)."),

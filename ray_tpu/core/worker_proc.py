@@ -372,8 +372,12 @@ class WorkerCore:
                 if random.random() < config.testing_kill_worker_prob:
                     os._exit(1)
             self.current_task_id = TaskID(task_id_b)
-            saved_env = self._apply_runtime_env(runtime_env)
+            saved_env = None
             try:
+                # inside the try: a failed package fetch/extract must fail
+                # THIS task (and restore any partial state), not kill the
+                # worker and drop the rest of the batch
+                saved_env = self._apply_runtime_env(runtime_env)
                 fn = self._functions[fn_id]
                 args, kwargs = self._decode_args(args_payload, inline_values)
                 result = fn(*args, **kwargs)

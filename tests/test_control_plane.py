@@ -554,3 +554,21 @@ def test_runtime_env_nested_submission(rt, tmp_path):
         return rt.get(inner.remote())
 
     assert rt.get(outer.remote(str(proj))) == "nested-ok"
+
+
+def test_runtime_env_missing_package_fails_task_not_worker(rt):
+    """A task whose runtime_env names an unknown package must fail with a
+    clean error while the worker (and the rest of the pool) lives on."""
+
+    @rt.remote(runtime_env={"working_dir_pkg": "deadbeef" * 4})
+    def doomed():
+        return 1
+
+    @rt.remote
+    def fine():
+        return 2
+
+    with pytest.raises(Exception, match="not found in the package"):
+        rt.get(doomed.remote(), timeout=60)
+    # pool is still healthy
+    assert rt.get(fine.remote(), timeout=60) == 2
