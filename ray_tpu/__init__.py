@@ -26,6 +26,7 @@ layers live under ray_tpu.parallel / ops / models / train and import lazily.
 from ray_tpu._version import __version__  # noqa: F401
 from ray_tpu.api import (  # noqa: F401
     cancel,
+    free,
     get,
     get_actor,
     init,

@@ -134,6 +134,21 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     core.cancel_task(ref, force=force)
 
 
+def free(refs, *, local_only: bool = False) -> int:
+    """Eagerly delete objects from the store (reference:
+    ray._private.internal_api.free). Complements the pin+spill lifetime
+    model when the caller knows an object is dead: storage (shm or spill
+    file) is reclaimed immediately and subsequent ``get``s raise
+    ObjectLostError — freed objects are never lineage-reconstructed.
+    Returns the number of objects actually freed. ``local_only`` is
+    accepted for API parity (deletion always covers the owning core)."""
+    del local_only
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    core = runtime_context.get_core()
+    return core.free_objects([r.binary() for r in refs])
+
+
 def timeline(filename: Optional[str] = None):
     """Export recorded task events as a chrome://tracing trace (reference:
     ray.timeline, python/ray/_private/worker.py). Requires the

@@ -828,6 +828,28 @@ class ClusterCore:
     def kv_op(self, op: str, key: str, value=None):
         return self.gcs.call(("kv", op, key, value))
 
+    def free_objects(self, oid_bytes_list: List[bytes]) -> int:
+        """Fan eager deletion out to every node holding a copy; returns
+        the count of UNIQUE objects freed anywhere."""
+        freed: set = set()
+        addrs = {tuple(n["address"])
+                 for n in self._cluster_view(force=True)["nodes"]}
+        for addr in addrs:
+            try:
+                freed.update(self._nodes.get(addr).call(
+                    ("free", oid_bytes_list)) or [])
+            except RpcError:
+                continue
+        # also clear lineage: free means dead, never reconstructed
+        # (symmetric byte accounting with the insertion/eviction paths)
+        with self._lock:
+            for b in oid_bytes_list:
+                old = self._lineage.pop(b, None)
+                if old is not None:
+                    self._lineage_bytes -= (len(old[1][1])
+                                            if old[1][0] == "inline" else 64)
+        return len(freed)
+
     # ---- runtime_env packages: content-addressed blobs in the GCS KV,
     # pulled lazily by each node (reference: GCS package store + per-node
     # runtime-env agent download)

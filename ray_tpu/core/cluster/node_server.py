@@ -717,6 +717,23 @@ class NodeServer:
             del view
             rt.store.release(oid)
 
+    def _op_free(self, oid_bytes_list):
+        """Eager deletion (driver free fan-out). Returns the ids actually
+        freed here (the driver unions across nodes — a replicated object
+        must count once). The freed-error marker is local — never
+        republish these ids as locations."""
+        for b in oid_bytes_list:
+            self._unpublished.add(b)
+        try:
+            freed = self.runtime.free_objects(oid_bytes_list,
+                                              return_ids=True)
+        finally:
+            for b in oid_bytes_list:
+                self._unpublished.discard(b)
+        for b in oid_bytes_list:
+            self.gcs.try_call(("loc_drop", b, self.address))
+        return freed
+
     def _op_has(self, oid_bytes):
         rt = self.runtime
         with rt._lock:

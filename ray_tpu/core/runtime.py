@@ -202,6 +202,7 @@ class Runtime:
         self._named_actors: Dict[str, ActorID] = {}
         self._kv: Dict[str, Any] = {}
         self._packages: Dict[str, bytes] = {}  # runtime_env package store
+        self._freed: set = set()               # eagerly-freed object ids
         # First-return-id -> spec, for ray.cancel lookup; entries drop when
         # the task finishes (done/error/cancel paths).
         self._cancellable: Dict[bytes, _TaskSpec] = {}
@@ -543,6 +544,50 @@ class Runtime:
         if spec.args_pinned and p is not None and p[0] == "shm":
             spec.args_pinned = False
             self._unpin_args(p[1])
+
+    def free_objects(self, oid_bytes_list: List[bytes],
+                     return_ids: bool = False):
+        """Eagerly delete objects (reference: internal_api.free) —
+        complements the pin+spill lifetime model for workloads that know
+        an object is dead. Unresolved ids are skipped; subsequent gets of
+        a freed id surface ObjectLostError (lineage reconstruction is
+        deliberately not attempted: free means dead). Returns the count
+        actually freed."""
+        from ray_tpu.exceptions import ObjectLostError
+
+        freed_ids: List[bytes] = []
+        for oid_b in oid_bytes_list:
+            oid = ObjectID(oid_b)
+            with self._lock:
+                e = self._objects.get(oid)
+                if (e is None or not e.event.is_set()
+                        or oid_b in self._freed):
+                    continue
+                self._freed.add(oid_b)
+                payload = e.payload
+            kind, data = payload
+            if kind == "shm":
+                with self._spill_lock:
+                    pinned = self._pinned.pop(oid_b, None) is not None
+                if pinned:
+                    try:
+                        self.store.release(oid)
+                        self.store.delete(oid)
+                    except Exception:  # noqa: BLE001
+                        pass
+            elif kind == "spilled":
+                path = data[0] if isinstance(data, tuple) else data
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                if isinstance(data, tuple):
+                    with self._spill_lock:
+                        self._spilled_bytes -= data[1]
+            self._store_error(
+                [oid], ObjectLostError(f"object {oid} was freed"))
+            freed_ids.append(oid_b)
+        return freed_ids if return_ids else len(freed_ids)
 
     def _try_free_space(self, nbytes: int) -> bool:
         """Spill cold tracked containers to disk until ``nbytes`` are freed.

@@ -62,6 +62,11 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return DeploymentResponse(self._get_router().request(args, kwargs))
 
+    def stream(self, *args, **kwargs):
+        """Token streaming against an engine deployment: a generator of
+        new-token lists (reference: handle streaming + serve.llm)."""
+        return self._get_router().stream_request(args, kwargs)
+
     def __getattr__(self, method: str) -> _MethodCaller:
         if method.startswith("_"):
             raise AttributeError(method)

@@ -33,6 +33,8 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.loads(self.rfile.read(length) or b"{}")
             args = tuple(body.get("args", ()))
             kwargs = dict(body.get("kwargs", {}))
+            if body.get("stream"):
+                return self._stream(handle, args, kwargs)
             result = handle.remote(*args, **kwargs).result(self.timeout_s)
             payload = json.dumps({"result": result}).encode()
             self.send_response(200)
@@ -43,6 +45,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
+
+    def _stream(self, handle, args, kwargs):
+        """Server-sent events: one ``data:`` line per new-token chunk,
+        then ``data: [DONE]`` (the OpenAI-compatible shape)."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        try:
+            for chunk in handle.stream(*args, **kwargs):
+                self.wfile.write(
+                    b"data: " + json.dumps({"tokens": chunk}).encode()
+                    + b"\n\n")
+                self.wfile.flush()
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except Exception as e:  # noqa: BLE001 — mid-stream: emit an error
+            try:
+                self.wfile.write(
+                    b"data: " + json.dumps({"error": repr(e)}).encode()
+                    + b"\n\n")
+                self.wfile.flush()
+            except OSError:
+                pass
 
 
 class HttpProxy:

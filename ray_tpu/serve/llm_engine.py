@@ -88,6 +88,7 @@ class LLMEngine:
         self._slot_ttft: Dict[int, float] = {}
 
         self._in: "queue.Queue[tuple]" = queue.Queue()
+        self._cancelled: set = set()
         self._done: Dict[str, Any] = {}
         self._done_lock = threading.Lock()
         self._steps = 0
@@ -114,6 +115,53 @@ class LLMEngine:
                 out = {r: self._done.pop(r) for r in req_ids
                        if r in self._done}
         return out
+
+    def peek(self, req_ids: Optional[List[str]] = None,
+             since: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
+        """Non-destructive progress snapshot for streaming consumers:
+        {req_id: {"tokens": [...], "offset": k, "done": bool}} where
+        ``tokens`` are those from each request's ``since[req_id]`` offset
+        on (a poller then transfers O(new), not O(all-so-far) per poll).
+        Finished requests stay in the mailbox until ``collect``."""
+        since = since or {}
+
+        def view(rid, toks, done):
+            off = since.get(rid, 0)
+            return {"tokens": list(toks[off:]), "offset": off,
+                    "done": done}
+
+        out: Dict[str, Any] = {}
+        # in-flight slots (list() copies under the GIL; the engine thread
+        # only appends)
+        for slot, rid in list(self._slot_req.items()):
+            if req_ids is not None and rid not in req_ids:
+                continue
+            toks = self._slot_tokens.get(slot)
+            if toks is not None:
+                out[rid] = view(rid, toks, False)
+        with self._done_lock:
+            for rid, res in self._done.items():
+                if req_ids is not None and rid not in req_ids:
+                    continue
+                if isinstance(res, Exception):
+                    out[rid] = {"error": repr(res), "done": True}
+                else:
+                    out[rid] = view(rid, res["tokens"], True)
+        return out
+
+    def cancel(self, req_id: str) -> None:
+        """Abort a request: a generating slot stops at the next step
+        boundary and its result is discarded (not delivered); a
+        finished-but-uncollected result is dropped."""
+        self._cancelled.add(req_id)
+        for slot, rid in list(self._slot_req.items()):
+            if rid == req_id:
+                # clamp the budget; _maybe_finish frees the slot on the
+                # next emitted token (engine-thread-safe: ints only)
+                self._slot_budget[slot] = 0
+                break
+        with self._done_lock:
+            self._done.pop(req_id, None)
 
     def stats(self) -> dict:
         return {"active": self._num_slots - len(self._free),
@@ -211,12 +259,16 @@ class LLMEngine:
         toks = self._slot_tokens[slot]
         if last_token == self._eos or len(toks) >= self._slot_budget[slot]:
             req_id = self._slot_req.pop(slot)
-            with self._done_lock:
-                self._done[req_id] = {
-                    "tokens": list(toks),
-                    "ttft_s": self._slot_ttft[slot],
-                    "latency_s": time.monotonic() - self._slot_start[slot],
-                }
+            if req_id in self._cancelled:
+                self._cancelled.discard(req_id)  # aborted: drop silently
+            else:
+                with self._done_lock:
+                    self._done[req_id] = {
+                        "tokens": list(toks),
+                        "ttft_s": self._slot_ttft[slot],
+                        "latency_s": (time.monotonic()
+                                      - self._slot_start[slot]),
+                    }
             for d in (self._slot_tokens, self._slot_budget, self._slot_pos,
                       self._slot_start, self._slot_ttft):
                 d.pop(slot, None)

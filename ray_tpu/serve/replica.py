@@ -76,6 +76,20 @@ class ReplicaActor:
             return self._instance.collect(req_ids)
         return self._instance.collect()
 
+    def peek(self, req_ids=None, since=None) -> Dict[str, Any]:
+        """Streaming progress snapshot (engines that support it); None
+        signals the engine has no streaming surface."""
+        if hasattr(self._instance, "peek"):
+            try:
+                return self._instance.peek(req_ids, since)
+            except TypeError:
+                return self._instance.peek(req_ids)
+        return None
+
+    def cancel(self, req_id: str) -> None:
+        if hasattr(self._instance, "cancel"):
+            self._instance.cancel(req_id)
+
     def engine_stats(self) -> dict:
         if hasattr(self._instance, "stats"):
             return self._instance.stats()

@@ -213,6 +213,66 @@ class Router:
 
     # ---------------------------------------------------------------- engine
 
+    def stream_request(self, args, kwargs, timeout_s: float = 600.0):
+        """Generator over an engine request's progress: yields lists of
+        NEW tokens as they are generated, ending after the final chunk
+        (reference: serve streaming responses / vLLM token streaming).
+        Requires an engine with ``peek`` (the LLM engine); bounded by
+        ``timeout_s`` overall."""
+        with self._lock:
+            self._req_seq += 1
+            req_id = f"s{id(self)}-{self._req_seq}"
+        rid, handle = self._pick()
+        with self._lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        deadline = time.monotonic() + timeout_s
+        collected = False
+        try:
+            ray_tpu.get(handle.submit.remote(req_id, *args, **kwargs))
+            sent = 0
+            while True:
+                snap = ray_tpu.get(
+                    handle.peek.remote([req_id], {req_id: sent}),
+                    timeout=60)
+                if snap is None:
+                    raise TypeError(
+                        "deployment's engine has no peek(): token "
+                        "streaming needs the LLM engine surface; use "
+                        ".remote() for request/response")
+                snap = snap.get(req_id)
+                if snap is not None:
+                    if "error" in snap:
+                        collected = True  # collect below drains the error
+                        ray_tpu.get(handle.collect.remote([req_id]),
+                                    timeout=60)
+                        raise RuntimeError(snap["error"])
+                    new = snap["tokens"]
+                    if new:
+                        yield new
+                        sent = snap["offset"] + len(new)
+                    if snap["done"]:
+                        collected = True
+                        ray_tpu.get(handle.collect.remote([req_id]),
+                                    timeout=60)
+                        return
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"stream {req_id} exceeded {timeout_s}s")
+                time.sleep(0.005)
+        except ActorDiedError:
+            self._drop_replica(rid)
+            raise
+        finally:
+            if not collected:
+                # abandoned/errored mid-stream: abort generation and
+                # drop any finished result so nothing leaks replica-side
+                try:
+                    handle.cancel.remote(req_id)
+                except Exception:  # noqa: BLE001
+                    pass
+            with self._lock:
+                self._inflight[rid] = max(0, self._inflight.get(rid, 1) - 1)
+
     def _engine_request(self, args, kwargs, fut: Future):
         """Submit to an engine replica's mailbox and poll its collect()."""
         with self._lock:

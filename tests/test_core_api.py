@@ -187,3 +187,25 @@ def test_zero_copy_get_is_view(rt):
     # large objects come back as zero-copy views over the shm mapping
     assert out.base is not None
     assert np.array_equal(out, arr)
+
+
+def test_free_reclaims_store_and_errors_gets(rt):
+    """ray_tpu.free: storage reclaimed now; later gets raise, never
+    reconstruct (reference: internal_api.free semantics)."""
+    import numpy as np
+
+    from ray_tpu import exceptions
+    from ray_tpu.core import runtime_context
+
+    core = runtime_context.get_core()
+    before = core.store.stats()["bytes_in_use"]
+    ref = rt.put(np.zeros(4 << 20, np.uint8))
+    mid = core.store.stats()["bytes_in_use"]
+    assert mid >= before + (4 << 20)
+    assert rt.free(ref) == 1
+    after = core.store.stats()["bytes_in_use"]
+    assert after <= mid - (4 << 20)
+    with pytest.raises(exceptions.ObjectLostError, match="freed"):
+        rt.get(ref, timeout=5)
+    # freeing twice (or freeing an unresolved id) is a no-op
+    assert rt.free(ref) == 0
