@@ -840,10 +840,12 @@ class ClusterCore:
                     ("free", oid_bytes_list)) or [])
             except RpcError:
                 continue
-        # also clear lineage: free means dead, never reconstructed
-        # (symmetric byte accounting with the insertion/eviction paths)
+        # clear lineage ONLY for ids actually freed: free of an
+        # unresolved/unknown id is a no-op and must not destroy a live
+        # object's reconstructability (symmetric byte accounting with the
+        # insertion/eviction paths)
         with self._lock:
-            for b in oid_bytes_list:
+            for b in freed:
                 old = self._lineage.pop(b, None)
                 if old is not None:
                     self._lineage_bytes -= (len(old[1][1])

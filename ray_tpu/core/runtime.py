@@ -575,7 +575,15 @@ class Runtime:
                         self.store.delete(oid)
                     except Exception:  # noqa: BLE001
                         pass
-            elif kind == "spilled":
+                else:
+                    # the pressure-spill thread won the pin: the payload
+                    # may have flipped shm->spilled after our read —
+                    # re-read so the spill file is reclaimed, not leaked
+                    with self._lock:
+                        e2 = self._objects.get(oid)
+                        payload = e2.payload if e2 is not None else payload
+                    kind, data = payload
+            if kind == "spilled":
                 path = data[0] if isinstance(data, tuple) else data
                 try:
                     os.remove(path)

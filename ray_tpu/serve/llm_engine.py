@@ -150,18 +150,17 @@ class LLMEngine:
         return out
 
     def cancel(self, req_id: str) -> None:
-        """Abort a request: a generating slot stops at the next step
-        boundary and its result is discarded (not delivered); a
-        finished-but-uncollected result is dropped."""
+        """Abort a request: the ENGINE THREAD notices the cancel mark at
+        its next tick — a generating slot stops at the next step boundary
+        with its result discarded, a queued request is dropped at
+        admission, and a finished-but-uncollected result is removed.
+        (Only marking here avoids racing slot reuse: clamping a slot's
+        budget from this thread could hit a slot already recycled to a
+        different request.)"""
         self._cancelled.add(req_id)
-        for slot, rid in list(self._slot_req.items()):
-            if rid == req_id:
-                # clamp the budget; _maybe_finish frees the slot on the
-                # next emitted token (engine-thread-safe: ints only)
-                self._slot_budget[slot] = 0
-                break
         with self._done_lock:
-            self._done.pop(req_id, None)
+            if self._done.pop(req_id, None) is not None:
+                self._cancelled.discard(req_id)  # already finished
 
     def stats(self) -> dict:
         return {"active": self._num_slots - len(self._free),
@@ -198,6 +197,9 @@ class LLMEngine:
                 break
             batch = []   # (req_id, toks, max_new, t0, slot)
             for req_id, toks, max_new, t0 in pending:
+                if req_id in self._cancelled:
+                    self._cancelled.discard(req_id)  # dropped pre-admission
+                    continue
                 try:
                     toks = [int(t) for t in toks]
                     if not toks:
@@ -339,6 +341,13 @@ class LLMEngine:
                     self._free.append(slot)
 
     def _tick(self, np, jnp, S):
+        # engine-thread cancel handling: clamp budgets here, where slot
+        # bookkeeping is single-threaded, so a cancel can never clamp a
+        # recycled slot belonging to another request
+        if self._cancelled:
+            for slot, rid in list(self._slot_req.items()):
+                if rid in self._cancelled:
+                    self._slot_budget[slot] = 0
         self._admit()
         active_slots = sorted(self._slot_req)
         if not active_slots:

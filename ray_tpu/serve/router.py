@@ -271,7 +271,8 @@ class Router:
                 except Exception:  # noqa: BLE001
                     pass
             with self._lock:
-                self._inflight[rid] = max(0, self._inflight.get(rid, 1) - 1)
+                if rid in self._inflight:  # dropped replicas stay dropped
+                    self._inflight[rid] = max(0, self._inflight[rid] - 1)
 
     def _engine_request(self, args, kwargs, fut: Future):
         """Submit to an engine replica's mailbox and poll its collect()."""

@@ -299,3 +299,32 @@ def test_stream_abandonment_releases_engine_slot(serve_ray):
     assert stats["active"] == 0, stats
     # mailbox is empty: a fresh peek shows nothing pending
     assert handle.peek.remote().result(30) == {}
+
+
+def test_model_composition_handle_in_deployment(serve_ray):
+    """Deployments can hold handles to other deployments and fan calls
+    through them (reference: serve model composition / deployment graph)."""
+
+    @serve.deployment(name="embedder", num_replicas=1)
+    def embedder(x):
+        return [v * 2 for v in x]
+
+    @serve.deployment(name="scorer", num_replicas=1)
+    def scorer(x):
+        return sum(x)
+
+    emb_handle = serve.run(embedder)
+    score_handle = serve.run(scorer)
+
+    @serve.deployment(name="pipeline", num_replicas=1)
+    class Pipeline:
+        def __init__(self, emb, score):
+            self.emb = emb          # DeploymentHandle reconstructed
+            self.score = score      # inside the replica worker
+
+        def __call__(self, x):
+            e = self.emb.remote(x).result(60)
+            return self.score.remote(e).result(60)
+
+    pipe = serve.run(Pipeline.bind(emb_handle, score_handle), timeout=120)
+    assert pipe.remote([1, 2, 3]).result(120) == 12  # sum([2,4,6])
