@@ -634,8 +634,19 @@ class Runtime:
                 pass
         with self._lock:
             e = self._objects.get(oid)
-            if e is not None and e.payload == ("shm", oid_b):
+            swapped = (e is not None and e.payload == ("shm", oid_b)
+                       and oid_b not in self._freed)
+            if swapped:
                 e.payload = ("spilled", (path, size))
+        if not swapped:
+            # a concurrent free() won (payload is now a freed-error marker
+            # or gone): discard the file we just wrote — accounting it
+            # would leak disk and inflate _spilled_bytes forever
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return 0
         with self._spill_lock:
             self._pinned.pop(oid_b, None)
             self._spilled_bytes += size

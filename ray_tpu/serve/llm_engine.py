@@ -88,7 +88,7 @@ class LLMEngine:
         self._slot_ttft: Dict[int, float] = {}
 
         self._in: "queue.Queue[tuple]" = queue.Queue()
-        self._cancelled: set = set()
+        self._cancelled: Dict[str, float] = {}  # req_id -> cancel time
         self._done: Dict[str, Any] = {}
         self._done_lock = threading.Lock()
         self._steps = 0
@@ -156,11 +156,12 @@ class LLMEngine:
         admission, and a finished-but-uncollected result is removed.
         (Only marking here avoids racing slot reuse: clamping a slot's
         budget from this thread could hit a slot already recycled to a
-        different request.)"""
-        self._cancelled.add(req_id)
+        different request.) Mark-and-pop happen under one lock with the
+        finish path's check-and-insert, so a result can never slip into
+        the mailbox after its cancel."""
         with self._done_lock:
-            if self._done.pop(req_id, None) is not None:
-                self._cancelled.discard(req_id)  # already finished
+            if self._done.pop(req_id, None) is None:
+                self._cancelled[req_id] = time.monotonic()
 
     def stats(self) -> dict:
         return {"active": self._num_slots - len(self._free),
@@ -197,9 +198,11 @@ class LLMEngine:
                 break
             batch = []   # (req_id, toks, max_new, t0, slot)
             for req_id, toks, max_new, t0 in pending:
-                if req_id in self._cancelled:
-                    self._cancelled.discard(req_id)  # dropped pre-admission
-                    continue
+                with self._done_lock:
+                    was_cancelled = (
+                        self._cancelled.pop(req_id, None) is not None)
+                if was_cancelled:
+                    continue  # dropped pre-admission
                 try:
                     toks = [int(t) for t in toks]
                     if not toks:
@@ -261,10 +264,10 @@ class LLMEngine:
         toks = self._slot_tokens[slot]
         if last_token == self._eos or len(toks) >= self._slot_budget[slot]:
             req_id = self._slot_req.pop(slot)
-            if req_id in self._cancelled:
-                self._cancelled.discard(req_id)  # aborted: drop silently
-            else:
-                with self._done_lock:
+            with self._done_lock:
+                if self._cancelled.pop(req_id, None) is not None:
+                    pass  # aborted: drop silently
+                else:
                     self._done[req_id] = {
                         "tokens": list(toks),
                         "ttft_s": self._slot_ttft[slot],
@@ -348,6 +351,13 @@ class LLMEngine:
             for slot, rid in list(self._slot_req.items()):
                 if rid in self._cancelled:
                     self._slot_budget[slot] = 0
+            # prune marks for ids this engine never saw (e.g. a failed
+            # submit still cancels in the router's cleanup path)
+            cutoff = time.monotonic() - 600.0
+            with self._done_lock:
+                for rid, t in list(self._cancelled.items()):
+                    if t < cutoff:
+                        del self._cancelled[rid]
         self._admit()
         active_slots = sorted(self._slot_req)
         if not active_slots:

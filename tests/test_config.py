@@ -33,3 +33,15 @@ def test_describe_roundtrip():
     names = {r["name"] for r in rows}
     assert "max_dispatch_batch" in names
     assert all("doc" in r for r in rows)
+
+
+def test_protocol_schema_introspection():
+    """python -m ray_tpu.core.protocol prints the full wire schema (the
+    single-language analogue of .proto files)."""
+    from ray_tpu.core import protocol
+
+    text = protocol.schema()
+    for needle in ("MSG_TASK_BATCH", "REQ_GET", "fetch_range",
+                   "node server RPC ops", "GCS server RPC ops", "kv"):
+        assert needle in text, needle
+    assert len(text.splitlines()) > 50
