@@ -469,6 +469,16 @@ class Runtime:
             e = self._objects.get(oid)
             if e is None:
                 e = _ObjectEntry()
+                if oid.binary() in self._freed:
+                    # freed ids keep only a 20-byte tombstone; a get
+                    # resurrects this transient error entry instead of
+                    # hanging on a value that will never arrive
+                    from ray_tpu.exceptions import ObjectLostError
+
+                    e.payload = protocol.serialize_value(
+                        protocol.ErrorValue(ObjectLostError(
+                            f"object {oid} was freed")), store=None)
+                    e.event.set()
                 self._objects[oid] = e
             return e
 
@@ -564,6 +574,14 @@ class Runtime:
                         or oid_b in self._freed):
                     continue
                 self._freed.add(oid_b)
+                if len(self._freed) > 1_000_000:
+                    # tombstones are 20B ids kept only so get-after-free
+                    # errors instead of hanging; under periodic-free use
+                    # (load reports) bound the set — dropping old ones
+                    # degrades a late get to a hang-with-timeout, which
+                    # is acceptable for year-old freed ids
+                    for _ in range(len(self._freed) // 2):
+                        self._freed.pop()
                 payload = e.payload
             kind, data = payload
             if kind == "shm":
@@ -592,8 +610,18 @@ class Runtime:
                 if isinstance(data, tuple):
                     with self._spill_lock:
                         self._spilled_bytes -= data[1]
-            self._store_error(
-                [oid], ObjectLostError(f"object {oid} was freed"))
+            # drop the table entry entirely: periodic fire-and-forget
+            # callers (e.g. load reports) can then free their refs
+            # without the object table growing; the _freed tombstone
+            # keeps later gets erroring instead of hanging
+            with self._lock:
+                e = self._objects.pop(oid, None)
+            if e is not None and not e.event.is_set():
+                # concurrent waiters on a just-freed id: resolve them
+                self._objects[oid] = e
+                self._store_error(
+                    [oid], ObjectLostError(f"object {oid} was freed"))
+            self._cancellable.pop(oid_b, None)
             freed_ids.append(oid_b)
         return freed_ids if return_ids else len(freed_ids)
 

@@ -111,6 +111,11 @@ def deployment(_target=None, *, name: Optional[str] = None,
                engine: bool = False, **extra):
     """Decorator: wrap a class or function as a Deployment."""
     def wrap(target):
+        if extra.get("autoscaling_config") and num_replicas != 1:
+            raise ValueError(
+                "num_replicas and autoscaling_config are mutually "
+                "exclusive (the autoscaler owns the replica count; "
+                "set min_replicas/max_replicas instead)")
         cfg = {"num_replicas": num_replicas, "num_cpus": num_cpus,
                "max_batch_size": max_batch_size,
                "batch_wait_timeout_s": batch_wait_timeout_s,

@@ -36,11 +36,21 @@ class _DeploymentInfo:
         self.name = name
         self.pickled_def = pickled_def
         self.config = dict(config)
-        self.target = int(config.get("num_replicas", 1))
+        self.target = self._initial_target(config)
         self.replicas: Dict[str, _ReplicaInfo] = {}
         self.version = 0
         self.next_id = 0
         self.deleting = False
+        # autoscaling state: router load reports + pending decision
+        self.loads: Dict[str, tuple] = {}   # router_id -> (load, ts)
+        self.desired_since: Optional[tuple] = None  # (desired, since_ts)
+
+    @staticmethod
+    def _initial_target(config: dict) -> int:
+        au = config.get("autoscaling_config")
+        if au:
+            return int(au.get("min_replicas", 1))
+        return int(config.get("num_replicas", 1))
 
 
 class ServeController:
@@ -66,7 +76,7 @@ class ServeController:
                 # redeploy: new code/config, replicas are rolled
                 info.pickled_def = pickled_def
                 info.config = dict(config)
-                info.target = int(config.get("num_replicas", 1))
+                info.target = _DeploymentInfo._initial_target(config)
                 info.version += 1
                 info.deleting = False
                 for r in list(info.replicas.values()):
@@ -84,8 +94,23 @@ class ServeController:
             info = self._deployments.get(name)
             if info is None:
                 raise KeyError(f"no deployment {name!r}")
+            if info.config.get("autoscaling_config"):
+                raise ValueError(
+                    f"deployment {name!r} has autoscaling_config; a "
+                    "manual scale would be silently reverted by the "
+                    "autoscaler — redeploy without autoscaling_config "
+                    "to pin the replica count")
             info.target = int(num_replicas)
             info.config["num_replicas"] = int(num_replicas)
+
+    def report_load(self, name: str, router_id: str, load: int) -> None:
+        """Routers push their in-flight count per deployment (reference:
+        handles push autoscaling metrics to the controller); reports
+        expire so a vanished router stops counting."""
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is not None:
+                info.loads[router_id] = (int(load), time.monotonic())
 
     def get_replicas(self, name: str):
         """(version, [(replica_id, actor_name)]) for router refresh."""
@@ -150,10 +175,48 @@ class ServeController:
                 pass
             time.sleep(0.1)
 
+    def _autoscale(self, info: "_DeploymentInfo") -> None:
+        """Load-based target adjustment (reference:
+        serve/_private/autoscaling_policy.py): desired =
+        ceil(total_ongoing / target_ongoing_requests), clamped to
+        [min_replicas, max_replicas]; a change must persist for
+        upscale_delay_s / downscale_delay_s before it is applied."""
+        au = info.config.get("autoscaling_config")
+        if not au or info.deleting:
+            return
+        import math
+
+        now = time.monotonic()
+        with self._lock:
+            # prune vanished routers (short-lived drivers would otherwise
+            # grow this dict forever)
+            for rid, (_, ts) in list(info.loads.items()):
+                if now - ts >= 3.0:
+                    del info.loads[rid]
+            total = sum(load for load, _ in info.loads.values())
+            lo = int(au.get("min_replicas", 1))
+            hi = int(au.get("max_replicas", max(lo, 1)))
+            per = max(1e-9, float(au.get("target_ongoing_requests", 2)))
+            desired = min(hi, max(lo, math.ceil(total / per)))
+            if desired == info.target:
+                info.desired_since = None
+                return
+            if (info.desired_since is None
+                    or info.desired_since[0] != desired):
+                info.desired_since = (desired, now)
+                return
+            delay = (float(au.get("upscale_delay_s", 1.0))
+                     if desired > info.target
+                     else float(au.get("downscale_delay_s", 5.0)))
+            if now - info.desired_since[1] >= delay:
+                info.target = desired
+                info.desired_since = None
+
     def _reconcile(self):
         with self._lock:
             deployments = list(self._deployments.values())
         for info in deployments:
+            self._autoscale(info)
             with self._lock:
                 n = len(info.replicas)
                 deficit = info.target - n

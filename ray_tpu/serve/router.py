@@ -39,6 +39,36 @@ class Router:
         self._batch_thread: Optional[threading.Thread] = None
         self._engine_state: Dict[str, Any] = {}
         self._req_seq = 0
+        # load reporting feeds controller autoscaling (reference: handles
+        # push autoscaling metrics); only started when the deployment has
+        # an autoscaling_config
+        if cfg.get("autoscaling_config"):
+            import os as _os
+            import uuid as _uuid
+
+            # pid+uuid: id(self) alone collides across processes and
+            # would overwrite another router's load report
+            self._router_id = f"router-{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
+            threading.Thread(target=self._report_load_loop, daemon=True,
+                             name="serve-load-report").start()
+
+    def _report_load_loop(self):
+        prev_ref = None
+        while True:
+            try:
+                with self._lock:
+                    load = sum(self._inflight.values())
+                ref = self._controller.report_load.remote(
+                    self._name, self._router_id, load)
+                if prev_ref is not None:
+                    # free the previous report's return entry — a
+                    # periodic fire-and-forget would otherwise grow the
+                    # object table forever
+                    ray_tpu.free(prev_ref)
+                prev_ref = ref
+            except Exception:  # noqa: BLE001 — controller restart etc.
+                pass
+            time.sleep(0.5)
 
     # ------------------------------------------------------------- replicas
 

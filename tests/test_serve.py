@@ -328,3 +328,62 @@ def test_model_composition_handle_in_deployment(serve_ray):
 
     pipe = serve.run(Pipeline.bind(emb_handle, score_handle), timeout=120)
     assert pipe.remote([1, 2, 3]).result(120) == 12  # sum([2,4,6])
+
+
+def test_autoscaling_scales_up_and_down(serve_ray):
+    """Replicas scale with router-reported load within [min, max], and
+    shrink back once the load drains (reference: autoscaling_policy)."""
+    import threading as _th
+    import time as _time
+
+    @serve.deployment(name="autoscaled", num_cpus=0.05,
+                      autoscaling_config={
+                          "min_replicas": 1, "max_replicas": 3,
+                          "target_ongoing_requests": 1,
+                          "upscale_delay_s": 0.2,
+                          "downscale_delay_s": 1.0,
+                      })
+    def slow(x):
+        _time.sleep(0.4)
+        return x
+
+    handle = serve.run(slow, timeout=120)
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+
+    # sustained burst: 9 concurrent requests, target 1 ongoing/replica
+    stop = _time.time() + 12
+    results = []
+
+    def fire():
+        while _time.time() < stop:
+            try:
+                results.append(handle.remote(1).result(60))
+            except Exception:  # noqa: BLE001 — rolling replicas
+                pass
+
+    threads = [_th.Thread(target=fire) for _ in range(9)]
+    for t in threads:
+        t.start()
+    peak = 0
+    deadline = _time.time() + 25
+    while _time.time() < deadline:
+        st = ray_tpu.get(controller.status.remote(), timeout=30)
+        peak = max(peak, st["autoscaled"]["running"])
+        if peak >= 3:
+            break
+        _time.sleep(0.3)
+    for t in threads:
+        t.join()
+    assert peak >= 2, f"never scaled up (peak={peak})"
+
+    # drain: scale back down to min_replicas
+    deadline = _time.time() + 30
+    down = 99
+    while _time.time() < deadline:
+        st = ray_tpu.get(controller.status.remote(), timeout=30)
+        down = st["autoscaled"]["target"]
+        if down == 1:
+            break
+        _time.sleep(0.3)
+    assert down == 1, f"never scaled back down (target={down})"
+    assert len(results) > 0
