@@ -47,13 +47,32 @@ class WorkerCore:
                 lambda n: self._request(protocol.REQ_NEED_SPACE, n)[1])
         self.node_id = node_id
         self.worker_id = worker_id
-        self.current_task_id: Optional[TaskID] = None
-        self.current_actor_id: Optional[ActorID] = None
+        # task/actor context is thread-local: concurrent actor threads
+        # (max_concurrency > 1) must not clobber each other's attribution
+        self._ctx_tls = threading.local()
         self._data_lock = threading.Lock()
+        self._send_lock = threading.Lock()
         self._functions: Dict[bytes, Any] = {}
         self._driver_known_fns: set = set()
         self._actors: Dict[bytes, Any] = {}
         self._actor_loops: Dict[bytes, Any] = {}  # actor_id -> asyncio loop
+        self._actor_pools: Dict[bytes, Any] = {}  # actor_id -> executor
+
+    @property
+    def current_task_id(self) -> Optional[TaskID]:
+        return getattr(self._ctx_tls, "task_id", None)
+
+    @current_task_id.setter
+    def current_task_id(self, v) -> None:
+        self._ctx_tls.task_id = v
+
+    @property
+    def current_actor_id(self) -> Optional[ActorID]:
+        return getattr(self._ctx_tls, "actor_id", None)
+
+    @current_actor_id.setter
+    def current_actor_id(self, v) -> None:
+        self._ctx_tls.actor_id = v
 
     # ---- data-conn RPC ------------------------------------------------------
 
@@ -223,6 +242,8 @@ class WorkerCore:
                 break
             tag = msg[0]
             if tag == protocol.MSG_SHUTDOWN:
+                for pool in self._actor_pools.values():
+                    pool.shutdown(wait=False, cancel_futures=True)
                 break
             elif tag == protocol.MSG_REGISTER_FN:
                 _, fn_id, pickled_fn = msg
@@ -232,7 +253,14 @@ class WorkerCore:
             elif tag == protocol.MSG_CREATE_ACTOR:
                 self._create_actor(msg)
             elif tag == protocol.MSG_ACTOR_CALL:
-                self._execute_actor_call(msg)
+                pool = self._actor_pools.get(msg[2])
+                if pool is not None:
+                    # max_concurrency > 1: calls overlap on pool threads
+                    # (FIFO submission; completion may reorder — the
+                    # reference's threaded-actor semantics)
+                    pool.submit(self._execute_actor_call, msg)
+                else:
+                    self._execute_actor_call(msg)
             else:  # pragma: no cover
                 sys.stderr.write(f"worker: unknown message {tag!r}\n")
 
@@ -412,8 +440,9 @@ class WorkerCore:
         return _re.prepare(self, runtime_env)
 
     def _send_error(self, task_id_b: bytes, exc: BaseException):
-        self.task_conn.send(
-            (protocol.MSG_ERROR, task_id_b, self._error_payload(exc)))
+        with self._send_lock:
+            self.task_conn.send(
+                (protocol.MSG_ERROR, task_id_b, self._error_payload(exc)))
 
     def _create_actor(self, msg):
         _, actor_id_b, cls_fn_id, args_payload, inline_values, opts = msg
@@ -426,6 +455,12 @@ class WorkerCore:
             self._apply_runtime_env(opts.get("runtime_env"))
             instance = cls(*args, **kwargs)
             self._actors[actor_id_b] = instance
+            mc = int(opts.get("max_concurrency") or 1)
+            if mc > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._actor_pools[actor_id_b] = ThreadPoolExecutor(
+                    max_workers=mc, thread_name_prefix="actor-conc")
             if opts.get("has_async_methods"):
                 import asyncio
 
@@ -456,10 +491,15 @@ class WorkerCore:
             if hasattr(result, "__await__"):
                 import asyncio
 
-                loop = self._actor_loops.get(actor_id_b)
-                if loop is None:
-                    loop = asyncio.new_event_loop()
-                    self._actor_loops[actor_id_b] = loop
+                if actor_id_b in self._actor_pools:
+                    loop = getattr(self._ctx_tls, "loop", None)
+                    if loop is None:
+                        loop = self._ctx_tls.loop = asyncio.new_event_loop()
+                else:
+                    loop = self._actor_loops.get(actor_id_b)
+                    if loop is None:
+                        loop = asyncio.new_event_loop()
+                        self._actor_loops[actor_id_b] = loop
                 result = loop.run_until_complete(result)
             self._send_results(task_id_b, result, len(return_ids), return_ids)
         except BaseException as e:  # noqa: BLE001
