@@ -70,6 +70,11 @@ class ClusterCore:
         self._fn_cache: Dict[int, Tuple[bytes, Any]] = {}
         self._shipped: Dict[Tuple[str, int], set] = {}
         self._ref_node: Dict[bytes, Tuple[str, int]] = {}
+        # driver-side tombstones for eagerly freed ids: a get after free
+        # must fail fast with the documented freed message, not spend the
+        # fetch deadline discovering no copy exists (mirrors Runtime._freed;
+        # insertion-ordered so note_freed evicts oldest-first)
+        self._freed: Dict[bytes, None] = {}
         # lineage: first-return-id -> resubmittable task description, for
         # reconstructing objects lost to node death (reference:
         # object_recovery_manager.h:41). Keyed per return id.
@@ -382,6 +387,10 @@ class ClusterCore:
         groups: Dict[Tuple[str, int], List[bytes]] = {}
         for ref in refs:
             b = ref.binary()
+            if b in self._freed:
+                raise ObjectLostError(
+                    f"object {b.hex()} was freed by ray_tpu.free() and is "
+                    f"not reconstructable")
             if b in self._local:
                 ev, cell = self._local[b]
                 if not ev.wait(timeout):
@@ -454,6 +463,19 @@ class ClusterCore:
                 with self._lock:
                     self._ref_node[oid_b] = tuple(addr)
                 return self._decode(data)
+        # a worker-freed object must stay dead: check the published
+        # tombstone before resurrecting through lineage (the driver-side
+        # _freed set only covers driver-initiated frees)
+        try:
+            if self.gcs.call(("kv", "get", "freed:" + oid_b.hex())):
+                with self._lock:
+                    from ray_tpu.core.runtime import note_freed
+                    note_freed(self._freed, (oid_b,))
+                raise ObjectLostError(
+                    f"object {oid_b.hex()} was freed by ray_tpu.free() "
+                    f"and is not reconstructable")
+        except RpcError:
+            pass
         # no surviving copy: reconstruct through lineage by resubmitting the
         # creating task (recursively reconstructing lost deps first)
         if self._reconstruct(oid_b):
@@ -844,7 +866,14 @@ class ClusterCore:
         # unresolved/unknown id is a no-op and must not destroy a live
         # object's reconstructability (symmetric byte accounting with the
         # insertion/eviction paths)
+        from ray_tpu.core.runtime import note_freed
+
         with self._lock:
+            note_freed(self._freed, freed)
+            for b in freed:
+                # drop the location hint too — the periodic-free pattern
+                # (router load reports) must not grow _ref_node unboundedly
+                self._ref_node.pop(b, None)
             for b in freed:
                 old = self._lineage.pop(b, None)
                 if old is not None:

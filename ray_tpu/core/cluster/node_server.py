@@ -118,6 +118,9 @@ class NodeRuntime(Runtime):
             elif tag == protocol.REQ_KV:
                 _, op, key, value = msg
                 return ("ok", srv.gcs.call(("kv", op, key, value)))
+            elif tag == protocol.REQ_FREE:
+                # worker-originated free: the object may live on any node
+                return ("ok", len(srv.free_cluster_wide(msg[1])))
             elif tag == protocol.REQ_ACTOR_CALL:
                 _, actor_id_b, method, args_payload, extra, n_returns = msg
                 if ActorID(actor_id_b) not in self._actors:
@@ -732,6 +735,31 @@ class NodeServer:
                 self._unpublished.discard(b)
         for b in oid_bytes_list:
             self.gcs.try_call(("loc_drop", b, self.address))
+        return freed
+
+    def free_cluster_wide(self, oid_bytes_list) -> set:
+        """Worker-originated free: the copy may live on ANY node (a
+        worker on node A freeing an object produced on node B), so free
+        locally, then fan out to every node the GCS directory lists as a
+        holder. Returns the union of ids freed anywhere."""
+        freed = set(self._op_free(oid_bytes_list) or [])
+        by_addr: Dict[Tuple[str, int], List[bytes]] = {}
+        for b in oid_bytes_list:
+            locs = self.gcs.try_call(("loc_get", b, 0.2), default=[]) or []
+            for addr in locs:
+                addr = tuple(addr)
+                if addr != self.address:
+                    by_addr.setdefault(addr, []).append(b)
+        for addr, ids in by_addr.items():
+            try:
+                freed.update(self._peers.get(addr).call(("free", ids)) or [])
+            except RpcError:
+                continue
+        for b in freed:
+            # publish the tombstone: the driver's lineage must not
+            # resurrect a worker-freed object after a node death ("free
+            # means dead"); drivers check this flag before reconstructing
+            self.gcs.try_call(("kv", "put", "freed:" + b.hex(), 1))
         return freed
 
     def _op_has(self, oid_bytes):

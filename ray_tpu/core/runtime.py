@@ -48,6 +48,21 @@ from ray_tpu.exceptions import (
 )
 
 
+def note_freed(freed: Dict[bytes, None], ids, cap: int = 1_000_000) -> None:
+    """Record eager-free tombstones (20B ids kept only so get-after-free
+    errors fast instead of hanging). Past ``cap``, evict oldest-first —
+    the dict is insertion-ordered — degrading a year-late get of an
+    ancient freed id to a hang-with-timeout, which is acceptable. Shared
+    by Runtime and ClusterCore (call under the owner's lock)."""
+    for b in ids:
+        freed[b] = None
+    if len(freed) > cap:
+        from itertools import islice
+
+        for b in list(islice(iter(freed), len(freed) - cap // 2)):
+            del freed[b]
+
+
 class _ObjectEntry:
     __slots__ = ("event", "payload", "callbacks")
 
@@ -202,7 +217,9 @@ class Runtime:
         self._named_actors: Dict[str, ActorID] = {}
         self._kv: Dict[str, Any] = {}
         self._packages: Dict[str, bytes] = {}  # runtime_env package store
-        self._freed: set = set()               # eagerly-freed object ids
+        # eagerly-freed object ids: insertion-ordered so the tombstone cap
+        # evicts oldest-first (dict preserves insertion order)
+        self._freed: Dict[bytes, None] = {}
         # First-return-id -> spec, for ray.cancel lookup; entries drop when
         # the task finishes (done/error/cancel paths).
         self._cancellable: Dict[bytes, _TaskSpec] = {}
@@ -573,15 +590,7 @@ class Runtime:
                 if (e is None or not e.event.is_set()
                         or oid_b in self._freed):
                     continue
-                self._freed.add(oid_b)
-                if len(self._freed) > 1_000_000:
-                    # tombstones are 20B ids kept only so get-after-free
-                    # errors instead of hanging; under periodic-free use
-                    # (load reports) bound the set — dropping old ones
-                    # degrades a late get to a hang-with-timeout, which
-                    # is acceptable for year-old freed ids
-                    for _ in range(len(self._freed) // 2):
-                        self._freed.pop()
+                note_freed(self._freed, (oid_b,))
                 payload = e.payload
             kind, data = payload
             if kind == "shm":
@@ -1848,6 +1857,8 @@ class Runtime:
             return ("ok", payloads)
         if tag == protocol.REQ_NEED_SPACE:
             return ("ok", self._try_free_space(msg[1]))
+        if tag == protocol.REQ_FREE:
+            return ("ok", self.free_objects(msg[1]))
         if tag == protocol.REQ_PUT_META:
             _, oid_bytes, payload = msg
             oid = ObjectID(oid_bytes)

@@ -358,7 +358,11 @@ class WorkerCore:
         payloads = []
         for value, rid in zip(values, return_id_bytes):
             payloads.append(self._serialize_result(value, ObjectID(rid)))
-        self.task_conn.send((protocol.MSG_DONE, task_id_b, payloads))
+        # _send_lock: actor thread pools (max_concurrency > 1) complete
+        # calls concurrently; unsynchronized sends would interleave
+        # Connection frames and corrupt the worker->driver protocol.
+        with self._send_lock:
+            self.task_conn.send((protocol.MSG_DONE, task_id_b, payloads))
 
     def _serialize_result(self, value, rid: ObjectID):
         pickled, views, total = serialization.serialize(value)
@@ -433,6 +437,13 @@ class WorkerCore:
     def register_package(self, pkg_hash: str, data: bytes) -> None:
         """Upload a package to the core (nested submissions from tasks)."""
         self._request(protocol.REQ_PKG_PUT, pkg_hash, data)
+
+    def free_objects(self, oid_bytes_list) -> int:
+        """Eager deletion from inside a task/actor — forwarded to the
+        owning core over the data conn (reference: internal_api.free is
+        routed through the core worker to the owning raylet)."""
+        _, n = self._request(protocol.REQ_FREE, list(oid_bytes_list))
+        return n
 
     def prepare_runtime_env(self, runtime_env):
         from ray_tpu.core import runtime_env as _re

@@ -556,6 +556,18 @@ def huggingface_to_blocks(hf_dataset, parallelism: int) -> List[Block]:
             for i in range(k) if n * (i + 1) // k > n * i // k]
 
 
+def _require_bigquery():
+    """Actionable gated-import error, consistent with make_gated_reader."""
+    try:
+        from google.cloud import bigquery  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "read_bigquery/write_bigquery require the optional dependency "
+            "'google-cloud-bigquery', which is not installed in this "
+            "environment. Install it, or export the table to parquet/csv "
+            "and use read_parquet/read_csv.") from None
+
+
 class BigQueryDatasource(Datasource):
     """BigQuery tables/queries via the google-cloud-bigquery client
     (reference: _internal/datasource/bigquery_datasource.py). A table
@@ -573,6 +585,7 @@ class BigQueryDatasource(Datasource):
         self._query = query
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        _require_bigquery()
         project, dataset, query = self._project, self._dataset, self._query
 
         if query is not None:
@@ -617,6 +630,7 @@ def write_bigquery_block(block: Block, project_id: str, dataset: str
     import io
 
     import pyarrow.parquet as pq
+    _require_bigquery()
     from google.cloud import bigquery
 
     client = bigquery.Client(project=project_id)

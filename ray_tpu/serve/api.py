@@ -75,6 +75,14 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle, (self._name,))
 
+    def __del__(self):
+        r = getattr(self, "_router", None)
+        if r is not None:
+            try:
+                r.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
 
 class Deployment:
     """A deployable callable + its config (reference: serve/deployment.py)."""
@@ -188,11 +196,17 @@ def status() -> Dict[str, Any]:
 
 
 def delete(name: str):
+    from ray_tpu.serve.router import stop_routers
+
     controller = ray_tpu.get_actor(CONTROLLER_NAME)
     ray_tpu.get(controller.delete_deployment.remote(name), timeout=30)
+    stop_routers(name)
 
 
 def shutdown():
+    from ray_tpu.serve.router import stop_routers
+
+    stop_routers()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:  # noqa: BLE001

@@ -15,8 +15,21 @@ import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Tuple
 
+import weakref
+
 import ray_tpu
 from ray_tpu.exceptions import ActorDiedError
+
+# process-local registry so serve.delete/shutdown can stop the reporting
+# threads of routers whose handles are still alive in this process
+_ROUTERS: "weakref.WeakSet[Router]" = weakref.WeakSet()
+
+
+def stop_routers(name: Optional[str] = None):
+    """Stop load-report loops for one deployment (or all, name=None)."""
+    for r in list(_ROUTERS):
+        if name is None or r._name == name:
+            r.stop()
 
 
 class Router:
@@ -25,6 +38,8 @@ class Router:
     def __init__(self, controller, name: str):
         self._controller = controller
         self._name = name
+        self._stop_reporting = False
+        _ROUTERS.add(self)
         self._lock = threading.Lock()
         self._replicas: List[Tuple[str, Any]] = []
         self._inflight: Dict[str, int] = {}
@@ -42,33 +57,84 @@ class Router:
         # load reporting feeds controller autoscaling (reference: handles
         # push autoscaling metrics); only started when the deployment has
         # an autoscaling_config
-        if cfg.get("autoscaling_config"):
+        self._autoscaling = bool(cfg.get("autoscaling_config"))
+        self._report_thread: Optional[threading.Thread] = None
+        if self._autoscaling:
             import os as _os
             import uuid as _uuid
 
             # pid+uuid: id(self) alone collides across processes and
             # would overwrite another router's load report
             self._router_id = f"router-{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
-            threading.Thread(target=self._report_load_loop, daemon=True,
-                             name="serve-load-report").start()
+            self._ensure_report_thread()
+
+    def _ensure_report_thread(self):
+        """(Re)start load reporting. A router whose loop exited — deleted
+        deployment, controller outage, stop() — but that then routes NEW
+        traffic must become visible to the autoscaler again, or its
+        in-flight load is invisible and replicas scale to min under load."""
+        if not self._autoscaling:
+            return
+        t = self._report_thread
+        if t is not None and t.is_alive():
+            return
+        self._stop_reporting = False
+        self._report_thread = threading.Thread(
+            target=self._report_load_loop, daemon=True,
+            name="serve-load-report")
+        self._report_thread.start()
 
     def _report_load_loop(self):
         prev_ref = None
-        while True:
-            try:
-                with self._lock:
-                    load = sum(self._inflight.values())
-                ref = self._controller.report_load.remote(
-                    self._name, self._router_id, load)
-                if prev_ref is not None:
-                    # free the previous report's return entry — a
-                    # periodic fire-and-forget would otherwise grow the
-                    # object table forever
+        last_exist_check = time.monotonic()
+        consecutive_failures = 0
+        try:
+            while not self._stop_reporting:
+                try:
+                    with self._lock:
+                        load = sum(self._inflight.values())
+                    ref = self._controller.report_load.remote(
+                        self._name, self._router_id, load)
+                    if prev_ref is not None:
+                        # free the previous report's return entry — a
+                        # periodic fire-and-forget would otherwise grow
+                        # the object table forever
+                        ray_tpu.free(prev_ref)
+                    prev_ref = ref
+                    consecutive_failures = 0
+                except Exception:  # noqa: BLE001 — controller restart
+                    # a dead controller must also end the loop, not just a
+                    # deleted deployment: ~30s of straight failures means
+                    # serve was torn down (a restart would have recovered)
+                    consecutive_failures += 1
+                    if consecutive_failures >= 60:
+                        return
+                # a router for a deleted/redeployed deployment must not
+                # fire RPCs forever: poll existence at low frequency and
+                # exit when the controller no longer knows the deployment
+                if time.monotonic() - last_exist_check > 10.0:
+                    last_exist_check = time.monotonic()
+                    try:
+                        cfg_ref = (self._controller
+                                   .get_deployment_config.remote(self._name))
+                        cfg = ray_tpu.get(cfg_ref, timeout=30)
+                        ray_tpu.free(cfg_ref)
+                        if cfg is None:
+                            return
+                    except Exception:  # noqa: BLE001
+                        pass
+                time.sleep(0.5)
+        finally:
+            if prev_ref is not None:
+                try:
                     ray_tpu.free(prev_ref)
-                prev_ref = ref
-            except Exception:  # noqa: BLE001 — controller restart etc.
-                pass
-            time.sleep(0.5)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def stop(self):
+        """Stop background reporting (called by DeploymentHandle teardown
+        and serve.delete/shutdown via the process-local registry)."""
+        self._stop_reporting = True
 
     # ------------------------------------------------------------- replicas
 
@@ -113,6 +179,7 @@ class Router:
     # --------------------------------------------------------------- routing
 
     def request(self, args: tuple, kwargs: dict) -> Future:
+        self._ensure_report_thread()
         fut: Future = Future()
         if self._engine:
             threading.Thread(target=self._engine_request,
@@ -130,6 +197,7 @@ class Router:
         return fut
 
     def call_method(self, method: str, args: tuple, kwargs: dict) -> Future:
+        self._ensure_report_thread()
         fut: Future = Future()
 
         def run():
@@ -249,6 +317,7 @@ class Router:
         (reference: serve streaming responses / vLLM token streaming).
         Requires an engine with ``peek`` (the LLM engine); bounded by
         ``timeout_s`` overall."""
+        self._ensure_report_thread()
         with self._lock:
             self._req_seq += 1
             req_id = f"s{id(self)}-{self._req_seq}"

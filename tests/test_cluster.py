@@ -121,6 +121,29 @@ def test_cluster_cross_node_object_transfer(cluster):
     assert total == int(np.arange(200_000, dtype=np.int64).sum())
 
 
+def test_cluster_free_fails_fast_and_worker_free(cluster):
+    """Cluster-mode eager free: a later driver get fails immediately with
+    the documented freed message (driver tombstone — not the 600s fetch
+    deadline), and ray_tpu.free works from INSIDE a task (REQ_FREE path
+    through the node server)."""
+    import numpy as np
+
+    ref = ray_tpu.put(np.zeros(1 << 20, np.uint8))
+    assert ray_tpu.free(ref) == 1
+    t0 = time.monotonic()
+    with pytest.raises(ObjectLostError, match="freed"):
+        ray_tpu.get(ref, timeout=60)
+    assert time.monotonic() - t0 < 5.0  # fail-fast, not fetch-deadline
+
+    @ray_tpu.remote
+    def free_inside():
+        r = ray_tpu.put(b"x" * (1 << 20))
+        n = ray_tpu.free(r)
+        return n
+
+    assert ray_tpu.get(free_inside.remote(), timeout=60) == 1
+
+
 def test_cluster_put_get_and_wait(cluster):
     refs = [ray_tpu.put(i * 11) for i in range(5)]
     assert ray_tpu.get(refs) == [0, 11, 22, 33, 44]
