@@ -467,15 +467,16 @@ class ClusterCore:
         # tombstone before resurrecting through lineage (the driver-side
         # _freed set only covers driver-initiated frees)
         try:
-            if self.gcs.call(("kv", "get", "freed:" + oid_b.hex())):
-                with self._lock:
-                    from ray_tpu.core.runtime import note_freed
-                    note_freed(self._freed, (oid_b,))
-                raise ObjectLostError(
-                    f"object {oid_b.hex()} was freed by ray_tpu.free() "
-                    f"and is not reconstructable")
+            freed = self.gcs.call(("freed_check", oid_b))
         except RpcError:
-            pass
+            freed = False
+        if freed:
+            with self._lock:
+                from ray_tpu.core.runtime import note_freed
+                note_freed(self._freed, (oid_b,))
+            raise ObjectLostError(
+                f"object {oid_b.hex()} was freed by ray_tpu.free() "
+                f"and is not reconstructable")
         # no surviving copy: reconstruct through lineage by resubmitting the
         # creating task (recursively reconstructing lost deps first)
         if self._reconstruct(oid_b):
@@ -492,6 +493,16 @@ class ClusterCore:
         node). Bounded per object by max_reconstructions."""
         if depth > 10:
             return False
+        # "free means dead": an eagerly-freed object (driver- OR
+        # worker-originated) must never be resurrected, directly or as a
+        # recursively-reconstructed dependency
+        if oid_b in self._freed:
+            return False
+        try:
+            if self.gcs.call(("freed_check", oid_b)):
+                return False
+        except RpcError:
+            pass
         lineage = self._lineage.get(oid_b)
         if lineage is None:
             return False
@@ -868,6 +879,13 @@ class ClusterCore:
         # insertion/eviction paths)
         from ray_tpu.core.runtime import note_freed
 
+        if freed:
+            # publish tombstones so node fetch loops and reconstruction
+            # refuse these ids even when the freeing driver exits
+            try:
+                self.gcs.call(("freed_add", list(freed)))
+            except RpcError:
+                pass
         with self._lock:
             note_freed(self._freed, freed)
             for b in freed:

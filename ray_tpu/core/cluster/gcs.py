@@ -75,6 +75,10 @@ class GcsServer:
         # (reference: src/ray/pubsub/publisher.h:296)
         self._channels: Dict[str, List[Tuple[int, Any]]] = {}
         self._channel_seq: Dict[str, int] = {}
+        # eager-free tombstones (worker-originated frees): bounded,
+        # insertion-ordered — consulted before any fetch-retry spin or
+        # lineage reconstruction so "free means dead" holds cluster-wide
+        self._freed: Dict[bytes, None] = {}
         self._view_version = 0
         self._stop = False
         self._server = RpcServer(self._handle, authkey or cluster_authkey(),
@@ -177,6 +181,19 @@ class GcsServer:
     def _op_deaths_since(self, seq: int):
         with self._lock:
             return [(s, nid) for s, nid in self._deaths if s > seq]
+
+    # -- eager-free tombstones
+
+    def _op_freed_add(self, oid_bytes_list):
+        from ray_tpu.core.runtime import note_freed
+
+        with self._lock:
+            note_freed(self._freed, oid_bytes_list, cap=1_000_000)
+        return True
+
+    def _op_freed_check(self, oid_bytes: bytes) -> bool:
+        with self._lock:
+            return oid_bytes in self._freed
 
     # -- kv
 

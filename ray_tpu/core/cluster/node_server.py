@@ -57,6 +57,8 @@ def materialize(runtime: Runtime, payload) -> Tuple[str, bytes]:
 
 def store_incoming(runtime: Runtime, oid: ObjectID, data: bytes):
     """Store wire bytes locally: shm when large, inline entry otherwise."""
+    if oid.binary() in runtime._freed:
+        return  # eagerly freed while this transfer was in flight
     if len(data) > serialization.inline_threshold() and not runtime.store.contains(oid):
         try:
             # retain: _store_payload adopts the ref as the tracking pin
@@ -441,6 +443,20 @@ class NodeServer:
                     if data is not None:
                         store_incoming(rt, oid, data)
                         return
+                # no copy anywhere: an eagerly-freed object must fail NOW
+                # with the documented message, not spin out the deadline
+                if self.gcs.try_call(("freed_check", oid_bytes),
+                                     default=False):
+                    self._unpublished.add(oid_bytes)
+                    self._lost_marked.add(oid_bytes)
+                    try:
+                        rt._store_payload(oid, protocol.serialize_value(
+                            protocol.ErrorValue(ObjectLostError(
+                                f"object {oid} was freed by ray_tpu.free() "
+                                f"and is not reconstructable")), store=None))
+                    finally:
+                        self._unpublished.discard(oid_bytes)
+                    return
                 if time.monotonic() > deadline:
                     # Surface ObjectLostError to local waiters (queued
                     # tasks would otherwise hang forever on the dep) but
@@ -634,6 +650,10 @@ class NodeServer:
         rt = self.runtime
         deadline = None if timeout is None else time.monotonic() + timeout
         for b in oid_bytes_list:
+            if b in rt._freed:
+                raise ObjectLostError(
+                    f"object {b.hex()} was freed by ray_tpu.free() and is "
+                    f"not reconstructable")
             self.ensure_available(b)
         out = {}
         for b in oid_bytes_list:
@@ -659,7 +679,11 @@ class NodeServer:
         oid = ObjectID(oid_bytes)
         with rt._lock:
             e = rt._objects.get(oid)
-            if e is None or not e.event.is_set():
+            # a freed id must not be served: the entry keeps its payload
+            # as a tombstone, but the storage is reclaimed ("free means
+            # dead" — peers see "not held", then the GCS tombstone)
+            if (e is None or not e.event.is_set()
+                    or oid_bytes in rt._freed):
                 return None
             payload = e.payload
         if max_bytes is not None:
@@ -674,7 +698,8 @@ class NodeServer:
         oid = ObjectID(oid_bytes)
         with rt._lock:
             e = rt._objects.get(oid)
-            if e is None or not e.event.is_set():
+            if (e is None or not e.event.is_set()
+                    or oid_bytes in rt._freed):
                 return None
             kind, data = e.payload
         if kind == "inline":
@@ -700,7 +725,8 @@ class NodeServer:
         oid = ObjectID(oid_bytes)
         with rt._lock:
             e = rt._objects.get(oid)
-            if e is None or not e.event.is_set():
+            if (e is None or not e.event.is_set()
+                    or oid_bytes in rt._freed):
                 return None
             kind, data = e.payload
         if kind == "inline":
@@ -740,26 +766,38 @@ class NodeServer:
     def free_cluster_wide(self, oid_bytes_list) -> set:
         """Worker-originated free: the copy may live on ANY node (a
         worker on node A freeing an object produced on node B), so free
-        locally, then fan out to every node the GCS directory lists as a
-        holder. Returns the union of ids freed anywhere."""
+        locally, then fan out to EVERY alive peer — the location
+        directory only covers transferred copies, not a producer's
+        original, so loc_get alone would miss the primary copy (the
+        driver-side free fans out the same way). Returns the union of
+        ids freed anywhere."""
         freed = set(self._op_free(oid_bytes_list) or [])
-        by_addr: Dict[Tuple[str, int], List[bytes]] = {}
-        for b in oid_bytes_list:
-            locs = self.gcs.try_call(("loc_get", b, 0.2), default=[]) or []
-            for addr in locs:
-                addr = tuple(addr)
-                if addr != self.address:
-                    by_addr.setdefault(addr, []).append(b)
-        for addr, ids in by_addr.items():
+        for info in self._alive_peers():
+            addr = tuple(info["address"])
             try:
-                freed.update(self._peers.get(addr).call(("free", ids)) or [])
+                freed.update(self._peers.get(addr).call(
+                    ("free", list(oid_bytes_list))) or [])
             except RpcError:
                 continue
-        for b in freed:
-            # publish the tombstone: the driver's lineage must not
-            # resurrect a worker-freed object after a node death ("free
-            # means dead"); drivers check this flag before reconstructing
-            self.gcs.try_call(("kv", "put", "freed:" + b.hex(), 1))
+        if freed:
+            # publish tombstones (bounded GCS table): fetch loops and the
+            # driver's lineage reconstruction consult them, so a
+            # worker-freed object dies fast everywhere instead of being
+            # spun on or resurrected ("free means dead")
+            self.gcs.try_call(("freed_add", list(freed)))
+            # close the prefetch race: a transfer of one of these ids that
+            # started before the free can land locally AFTER the local
+            # _op_free above ran (this very node prefetches nested task
+            # deps). Re-free anything that landed meanwhile, THEN
+            # tombstone locally so later arrivals are never stored or
+            # served (free_objects skips already-tombstoned ids, so the
+            # order matters).
+            self._op_free(list(freed))
+            from ray_tpu.core.runtime import note_freed
+
+            rt = self.runtime
+            with rt._lock:
+                note_freed(rt._freed, freed)
         return freed
 
     def _op_has(self, oid_bytes):

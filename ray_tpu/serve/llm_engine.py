@@ -37,7 +37,8 @@ class LLMEngine:
                  num_slots: int = 8, max_len: int = 256,
                  prefill_buckets: Optional[List[int]] = None,
                  max_new_tokens: int = 32, eos_id: int = -1,
-                 greedy: bool = True, chunk_steps: int = 8):
+                 greedy: bool = True, chunk_steps: int = 8,
+                 tp: int = 1, mesh=None):
         import jax
         import jax.numpy as jnp
 
@@ -50,7 +51,24 @@ class LLMEngine:
                 cfg_kw[key] = getattr(jnp, cfg_kw[key])
         cfg = getattr(llama.LlamaConfig, preset)(**cfg_kw)
         self._cfg = cfg
+        # tensor-parallel serving (BASELINE config #5 is v5e-4): weights
+        # and KV cache shard over a tp mesh; XLA emits the per-layer
+        # all-reduces over ICI. tp=1 keeps the single-chip path unchanged.
+        if mesh is None and tp > 1:
+            from ray_tpu.parallel import MeshSpec, build_mesh
+
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(
+                    f"tp={tp} needs {tp} devices, found {len(devs)}")
+            mesh = build_mesh(MeshSpec({"tp": tp}), devices=devs[:tp])
+        self._mesh = mesh
         self._params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        if mesh is not None:
+            # shard NOW and drop the unsharded copy — keeping both would
+            # hold 1x + 1/tp weights on chip 0, defeating TP's HBM saving
+            self._params = jax.device_put(
+                self._params, llama.param_shardings(cfg, mesh))
         self._num_slots = num_slots
         self._max_len = max_len
         # max_len-1 terminates the bucket list so over-length (truncated)
@@ -65,11 +83,13 @@ class LLMEngine:
 
         (self._prefill_batch, self._insert_many, self._decode,
          self._decode_chunk) = \
-            llama_decode.make_engine_fns(cfg, self._params, num_slots, max_len)
+            llama_decode.make_engine_fns(cfg, self._params, num_slots,
+                                         max_len, mesh=mesh)
         # burst admission: up to this many prompts prefill in ONE batched
         # program call (2 compiled batch sizes: 1 and this max)
         self._admit_batch = max(1, min(8, num_slots))
-        self._cache = llama_decode.init_cache(cfg, num_slots, max_len)
+        self._cache = llama_decode.init_cache(cfg, num_slots, max_len,
+                                              mesh=mesh)
         # Tokens decoded per host sync. Over a high-latency link (the axon
         # tunnel is ~100ms/roundtrip) chunking is the difference between 9
         # and ~200 tok/s; new requests still join every chunk boundary.

@@ -75,14 +75,15 @@ class Router:
         in-flight load is invisible and replicas scale to min under load."""
         if not self._autoscaling:
             return
-        t = self._report_thread
-        if t is not None and t.is_alive():
-            return
-        self._stop_reporting = False
-        self._report_thread = threading.Thread(
-            target=self._report_load_loop, daemon=True,
-            name="serve-load-report")
-        self._report_thread.start()
+        with self._lock:  # check-then-start must not race concurrent calls
+            t = self._report_thread
+            if t is not None and t.is_alive():
+                return
+            self._stop_reporting = False
+            self._report_thread = threading.Thread(
+                target=self._report_load_loop, daemon=True,
+                name="serve-load-report")
+            self._report_thread.start()
 
     def _report_load_loop(self):
         prev_ref = None
@@ -114,15 +115,24 @@ class Router:
                 # exit when the controller no longer knows the deployment
                 if time.monotonic() - last_exist_check > 10.0:
                     last_exist_check = time.monotonic()
+                    cfg_ref = None
                     try:
                         cfg_ref = (self._controller
                                    .get_deployment_config.remote(self._name))
-                        cfg = ray_tpu.get(cfg_ref, timeout=30)
-                        ray_tpu.free(cfg_ref)
+                        # short timeout: the controller prunes load
+                        # reports after 3s of silence — a long block here
+                        # would blind the autoscaler mid-poll
+                        cfg = ray_tpu.get(cfg_ref, timeout=2.0)
                         if cfg is None:
                             return
                     except Exception:  # noqa: BLE001
                         pass
+                    finally:
+                        if cfg_ref is not None:
+                            try:
+                                ray_tpu.free(cfg_ref)
+                            except Exception:  # noqa: BLE001
+                                pass
                 time.sleep(0.5)
         finally:
             if prev_ref is not None:

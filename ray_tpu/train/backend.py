@@ -53,7 +53,7 @@ class JaxConfig(BackendConfig):
     platform: Optional[str] = None
     cpu_devices_per_worker: int = 1
     distributed: bool = False
-    coordinator_port: int = 37737
+    coordinator_port: int = 0  # 0 = pick a free port on rank 0's host
     host_collectives: bool = True
 
     def backend_cls(self):
@@ -62,11 +62,16 @@ class JaxConfig(BackendConfig):
 
 def _setup_jax_platform(platform: Optional[str], n_cpu_devices: int):
     if platform == "cpu":
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n_cpu_devices}"
-            ).strip()
+        import re
+
+        # REPLACE any inherited device-count flag (the pytest conftest
+        # exports one for the whole session; each gang worker must get its
+        # own local count, not the driver's)
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_cpu_devices}"
+        ).strip()
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
@@ -75,12 +80,32 @@ def _setup_jax_platform(platform: Optional[str], n_cpu_devices: int):
         os.environ.setdefault("JAX_PLATFORMS", "tpu")
 
 
+def _pick_coordinator(port: int) -> str:
+    """Runs on rank 0: its host + a concrete port (a free one when the
+    config leaves port=0, so repeated gangs never collide)."""
+    import socket
+
+    from ray_tpu.core.cluster.rpc import pick_port
+
+    host = socket.gethostname()
+    return f"{host}:{port or pick_port()}"
+
+
 def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int):
     import jax
 
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        # a reused worker process from an earlier gang: reset and rejoin
+        if "already" not in str(e).lower():
+            raise
+        jax.distributed.shutdown()
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
 
 
 def _join_host_collective_group(world_size: int, rank: int, group_name: str):
@@ -95,8 +120,8 @@ class _JaxBackend(Backend):
         worker_group.execute(_setup_jax_platform, cfg.platform,
                              cfg.cpu_devices_per_worker)
         if cfg.distributed and len(worker_group) > 1:
-            infos = worker_group.execute(lambda: __import__("socket").gethostname())
-            coordinator = f"{infos[0]}:{cfg.coordinator_port}"
+            coordinator = worker_group.execute_single(
+                0, _pick_coordinator, cfg.coordinator_port)
             import ray_tpu
 
             refs = [

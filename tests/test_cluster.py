@@ -143,6 +143,35 @@ def test_cluster_free_fails_fast_and_worker_free(cluster):
 
     assert ray_tpu.get(free_inside.remote(), timeout=60) == 1
 
+    # worker on node 1 frees an object produced on node 0 (cross-node
+    # fan-out + GCS tombstone); a dependent task on node 2 must then fail
+    # fast via the fetch-loop tombstone check, not spin out the deadline
+    @ray_tpu.remote
+    def produce():
+        import numpy as np
+        return np.zeros(1 << 20, np.uint8)
+
+    @ray_tpu.remote
+    def free_refs(refs):
+        return ray_tpu.free(refs)
+
+    @ray_tpu.remote
+    def consume(arr):
+        return int(arr.sum())
+
+    ref2 = produce.options(resources={"res0": 1}).remote()
+    ray_tpu.get(ref2, timeout=60)
+    assert ray_tpu.get(free_refs.options(resources={"res1": 1})
+                       .remote([ref2]), timeout=60) == 1
+    t0 = time.monotonic()
+    # the dependent task fails fast with the freed error propagated
+    # through its dep resolution (TaskError wrapping ObjectLostError)
+    from ray_tpu.exceptions import TaskError
+    with pytest.raises((ObjectLostError, TaskError), match="freed"):
+        ray_tpu.get(consume.options(resources={"res2": 1}).remote(ref2),
+                    timeout=90)
+    assert time.monotonic() - t0 < 30.0
+
 
 def test_cluster_put_get_and_wait(cluster):
     refs = [ray_tpu.put(i * 11) for i in range(5)]
