@@ -70,6 +70,9 @@ class ClusterCore:
         self._fn_cache: Dict[int, Tuple[bytes, Any]] = {}
         self._shipped: Dict[Tuple[str, int], set] = {}
         self._ref_node: Dict[bytes, Tuple[str, int]] = {}
+        # actors whose restart FSM the GCS accepted (register_actor_spec
+        # succeeded); the driver restarts only the others
+        self._gcs_owned: set = set()
         # driver-side tombstones for eagerly freed ids: a get after free
         # must fail fast with the documented freed message, not spend the
         # fetch deadline discovering no copy exists (mirrors Runtime._freed;
@@ -159,13 +162,20 @@ class ClusterCore:
         addr = tuple(dead[0]["address"])
         self._nodes.drop(addr)
         self._shipped.pop(addr, None)
-        # restart restartable actors elsewhere
+        # The GCS owns restarts for plain restartable/detached actors
+        # (it got their spec at creation); the driver restarts ONLY
+        # PG-scheduled ones, whose placement table is driver state. Stale
+        # driver-side routing drops so calls re-resolve via the GCS actor
+        # table once the restart lands.
         with self._lock:
             lost = [aid for aid, a in self._actor_node.items() if a == addr]
         for aid in lost:
             spec = self._actor_spec.get(aid)
             opts = (spec[3] if spec else {}) or {}
-            if spec is not None and opts.get("max_restarts", 0) != 0:
+            restartable = (opts.get("max_restarts", 0) != 0
+                           or opts.get("lifetime") == "detached")
+            if (spec is not None and restartable
+                    and aid not in self._gcs_owned):
                 threading.Thread(target=self._restart_actor_with_retry,
                                  args=(aid, spec), daemon=True,
                                  name="actor-restart").start()
@@ -643,6 +653,32 @@ class ClusterCore:
             # keep the ORIGINAL opts (cluster-level PG strategy): restart
             # re-localizes against whichever node it lands on
             self._actor_spec[actor_id] = (cls_fn_id, payload, dep_b, opts)
+        # restartable/detached actors hand their restart FSM to the GCS
+        # (reference: gcs_actor_manager.h:278) so they outlive this
+        # driver. PG-scheduled actors stay driver-restarted: the PG
+        # placement table is driver state.
+        restartable = (opts.get("max_restarts", 0) != 0
+                       or opts.get("lifetime") == "detached")
+        if restartable and not opts.get("scheduling_strategy"):
+            try:
+                with self._lock:
+                    pickled_full = self._functions.get(cls_fn_id)
+                if pickled_full is not None:
+                    self.gcs.call(("register_fn", cls_fn_id, pickled_full))
+                self.gcs.call(("register_actor_spec", actor_id_b, {
+                    "cls_fn_id": cls_fn_id, "payload": payload,
+                    "deps": dep_b,
+                    "opts": {k: v for k, v in opts.items()
+                             if k != "method_opts"},
+                    "name": opts.get("name"),
+                }))
+                with self._lock:
+                    self._gcs_owned.add(actor_id)
+            except (RpcError, Exception):  # noqa: BLE001
+                # registration failed (GCS outage window): the driver
+                # keeps restart authority — never leave the actor with
+                # NO restart owner
+                pass
         return actor_id
 
     def _actor_addr(self, actor_id: ActorID) -> Tuple[str, int]:
@@ -691,6 +727,8 @@ class ClusterCore:
         if no_restart:
             with self._lock:
                 self._actor_spec.pop(actor_id, None)
+            # the GCS must not resurrect an explicitly killed actor
+            self.gcs.try_call(("drop_actor_spec", actor_id.binary()))
         try:
             self._actor_call_with_retry(
                 actor_id,

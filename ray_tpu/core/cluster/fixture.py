@@ -70,7 +70,8 @@ class Cluster:
     def __init__(self, num_nodes: int = 1, num_workers_per_node: int = 2,
                  object_store_memory: int = 128 << 20,
                  node_resources: Optional[List[dict]] = None,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 gcs_persist_dir: Optional[str] = None):
         self.authkey = os.urandom(16)
         self._env = dict(os.environ)
         self._env["RTPU_CLUSTER_AUTHKEY"] = self.authkey.hex()
@@ -80,22 +81,42 @@ class Cluster:
         self.nodes: List[NodeProc] = []
         self._store_mem = object_store_memory
         self._nw = num_workers_per_node
+        self._gcs_persist_dir = gcs_persist_dir
 
-        gcs_port = pick_port()
-        self._gcs_proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.cluster.gcs",
-             "--port", str(gcs_port)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            env=self._env)
-        self.procs.append(self._gcs_proc)
-        self.gcs_address = _parse_addr(
-            _read_tagged_line(self._gcs_proc, "GCS_ADDRESS "))
+        self._gcs_port = pick_port()
+        self._start_gcs()
 
         for i in range(num_nodes):
             res = None
             if node_resources and i < len(node_resources):
                 res = node_resources[i]
             self.add_node(resources=res)
+
+    def _start_gcs(self):
+        cmd = [sys.executable, "-m", "ray_tpu.core.cluster.gcs",
+               "--port", str(self._gcs_port)]
+        if self._gcs_persist_dir:
+            cmd += ["--persist-dir", self._gcs_persist_dir]
+        self._gcs_proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=self._env)
+        self.procs.append(self._gcs_proc)
+        self.gcs_address = _parse_addr(
+            _read_tagged_line(self._gcs_proc, "GCS_ADDRESS "))
+
+    def kill_gcs(self):
+        """Hard-kill the GCS process (chaos: control-plane failure)."""
+        if self._gcs_proc.poll() is None:
+            self._gcs_proc.kill()
+            self._gcs_proc.wait()
+        if self._gcs_proc in self.procs:
+            self.procs.remove(self._gcs_proc)
+
+    def restart_gcs(self):
+        """Restart the GCS on the SAME port (requires gcs_persist_dir for
+        state to survive); nodes re-register on their next heartbeat."""
+        self.kill_gcs()
+        self._start_gcs()
 
     def add_node(self, num_workers: Optional[int] = None,
                  resources: Optional[dict] = None) -> NodeProc:

@@ -20,12 +20,26 @@ Run as ``python -m ray_tpu.core.cluster.gcs --port N``.
 
 from __future__ import annotations
 
+import os
+import pickle
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.cluster.rpc import RpcServer, cluster_authkey
 from ray_tpu.core.config import config
+
+# ops whose effects must survive a GCS restart (heartbeats and reads are
+# deliberately not logged: transient / no effect). kv is logged only for
+# its mutating sub-ops — see _WAL_KV_MUTATORS.
+_WAL_OPS = frozenset({
+    "register_node", "unregister_node", "kv", "name_actor",
+    "drop_actor_name", "register_actor", "register_actor_spec",
+    "drop_actor_spec", "loc_add", "loc_add_batch",
+    "loc_drop", "freed_add", "publish", "register_fn",
+})
+_WAL_KV_MUTATORS = frozenset({"put", "del", "merge", "cas_merge"})
+_WAL_SNAPSHOT_EVERY = 50_000  # records between compactions
 
 
 class _NodeInfo:
@@ -58,9 +72,25 @@ class _NodeInfo:
 
 
 class GcsServer:
-    """In-process GCS server (embed in a dedicated process via main())."""
+    """In-process GCS server (embed in a dedicated process via main()).
 
-    def __init__(self, port: int = 0, authkey: Optional[bytes] = None):
+    With ``persistence_path`` set, every state-mutating op is written to a
+    write-ahead log before the reply, compacted into a snapshot
+    periodically; a restarted GCS on the same path rehydrates
+    nodes/actors/KV/locations/functions/tombstones and resumes pubsub seq
+    counters, so subscribers resync through the normal seq-gap path and
+    nodes re-register on their next rejected heartbeat (reference:
+    src/ray/gcs/store_client/redis_store_client.h:33 — the role of the
+    Redis-backed table storage, done as a single-writer WAL instead of an
+    external store)."""
+
+    def __init__(self, port: int = 0, authkey: Optional[bytes] = None,
+                 persistence_path: Optional[str] = None):
+        self._authkey = authkey or cluster_authkey()
+        self._peers = None  # lazy ClientCache for actor-restart RPCs
+        # restartable/detached actor specs: the GCS owns the restart FSM
+        # (reference: gcs_actor_manager.h:278) so actors outlive drivers
+        self._actor_specs: Dict[bytes, dict] = {}
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._nodes: Dict[bytes, _NodeInfo] = {}
@@ -81,12 +111,142 @@ class GcsServer:
         self._freed: Dict[bytes, None] = {}
         self._view_version = 0
         self._stop = False
-        self._server = RpcServer(self._handle, authkey or cluster_authkey(),
-                                 port=port)
+        # persistence: rehydrate BEFORE serving so no request sees
+        # pre-recovery state. LOCK ORDER: _wal_lock, then self._lock —
+        # mutating ops apply-and-log atomically under _wal_lock (the op
+        # body takes self._lock inside), and compaction snapshots the same
+        # way, so WAL order always matches apply order and no inversion
+        # exists. Code holding self._lock must never take _wal_lock
+        # (deaths buffer into _wal_pending instead).
+        self._wal = None
+        self._wal_lock = threading.Lock()
+        self._wal_pending: List[tuple] = []  # guarded by self._lock
+        self._wal_count = 0
+        self._replaying = False
+        self._pdir = persistence_path
+        if persistence_path:
+            os.makedirs(persistence_path, exist_ok=True)
+            self._replaying = True
+            self._load_persisted()
+            self._replaying = False
+            self._wal = open(os.path.join(persistence_path, "wal.pkl"), "ab")
+        self._server = RpcServer(self._handle, self._authkey, port=port)
         self.address = self._server.address
         self._monitor = threading.Thread(target=self._health_loop,
                                          daemon=True, name="gcs-health")
         self._monitor.start()
+
+    # ------------------------------------------------------- persistence
+
+    def _snapshot_state(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": [(i.node_id, i.address, i.resources, i.topology,
+                           i.labels, i.state) for i in self._nodes.values()],
+                "kv": dict(self._kv),
+                "named_actors": dict(self._named_actors),
+                "actor_table": {k: dict(v)
+                                for k, v in self._actor_table.items()},
+                "locations": {k: list(v)
+                              for k, v in self._locations.items()},
+                "functions": dict(self._functions),
+                "actor_specs": {k: dict(v)
+                                for k, v in self._actor_specs.items()},
+                "freed": dict(self._freed),
+                "deaths": list(self._deaths),
+                "death_seq": self._death_seq,
+                "channel_seq": dict(self._channel_seq),
+                "channels": {k: list(v) for k, v in self._channels.items()},
+                "view_version": self._view_version,
+            }
+
+    def _restore_state(self, s: dict):
+        for node_id, address, resources, topology, labels, state in \
+                s.get("nodes", []):
+            info = _NodeInfo(node_id, address, resources, topology, labels)
+            info.state = state
+            # ALIVE nodes get a fresh grace period: the health monitor
+            # re-marks truly-dead ones after the heartbeat timeout, live
+            # ones heartbeat in (and re-register if they were marked DEAD
+            # during the outage)
+            self._nodes[node_id] = info
+        self._kv = dict(s.get("kv", {}))
+        self._named_actors = dict(s.get("named_actors", {}))
+        self._actor_table = {k: dict(v)
+                             for k, v in s.get("actor_table", {}).items()}
+        self._locations = {k: list(map(tuple, v))
+                           for k, v in s.get("locations", {}).items()}
+        self._functions = dict(s.get("functions", {}))
+        self._actor_specs = {k: dict(v)
+                             for k, v in s.get("actor_specs", {}).items()}
+        self._freed = dict(s.get("freed", {}))
+        self._deaths = [tuple(d) for d in s.get("deaths", [])]
+        self._death_seq = s.get("death_seq", 0)
+        self._channel_seq = dict(s.get("channel_seq", {}))
+        self._channels = {k: [tuple(e) for e in v]
+                          for k, v in s.get("channels", {}).items()}
+        self._view_version = s.get("view_version", 0) + 1
+
+    def _load_persisted(self):
+        snap_path = os.path.join(self._pdir, "snapshot.pkl")
+        wal_path = os.path.join(self._pdir, "wal.pkl")
+        if os.path.exists(snap_path):
+            with open(snap_path, "rb") as f:
+                self._restore_state(pickle.load(f))
+        if os.path.exists(wal_path):
+            with open(wal_path, "rb") as f:
+                while True:
+                    try:
+                        op, args = pickle.load(f)
+                    except (EOFError, pickle.UnpicklingError):
+                        break  # torn tail record from a crash: stop here
+                    try:
+                        if op == "__death__":
+                            info = self._nodes.get(args[0])
+                            if info is not None and info.state == "ALIVE":
+                                with self._lock:
+                                    self._mark_dead_locked(info)
+                        else:
+                            getattr(self, "_op_" + op)(*args)
+                    except Exception:  # noqa: BLE001 — replay best-effort
+                        continue
+
+    def _wal_write_locked(self, op: str, args: tuple):
+        """Append one record (+ any buffered death records); _wal_lock
+        held by the caller."""
+        with self._lock:
+            pending, self._wal_pending = self._wal_pending, []
+        for rec in pending:
+            pickle.dump(rec, self._wal)
+            self._wal_count += 1
+        if op is not None:
+            pickle.dump((op, args), self._wal)
+            self._wal_count += 1
+        self._wal.flush()
+        if self._wal_count >= _WAL_SNAPSHOT_EVERY:
+            self._compact_locked()
+
+    def _flush_pending_deaths(self):
+        """Health-loop hook: persist buffered __death__ records. Runs
+        WITHOUT self._lock so the _wal_lock -> self._lock order holds."""
+        if self._wal is None or not self._wal_pending:
+            return
+        with self._wal_lock:
+            self._wal_write_locked(None, ())
+
+    def _compact_locked(self):
+        """Snapshot current state, truncate the WAL (wal lock held; the
+        snapshot takes self._lock inside — consistent lock order)."""
+        snap_path = os.path.join(self._pdir, "snapshot.pkl")
+        tmp = snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._snapshot_state(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, snap_path)
+        self._wal.close()
+        self._wal = open(os.path.join(self._pdir, "wal.pkl"), "wb")
+        self._wal_count = 0
 
     # ------------------------------------------------------------ health
 
@@ -100,8 +260,15 @@ class GcsServer:
                     if (info.state == "ALIVE"
                             and now - info.last_heartbeat > timeout):
                         self._mark_dead_locked(info)
+            self._flush_pending_deaths()
 
     def _mark_dead_locked(self, info: _NodeInfo):
+        # timeout-detected deaths are state too (explicit unregisters are
+        # WAL'd as their own op). self._lock is held: BUFFER the record —
+        # the health loop flushes it after releasing the lock (lock order
+        # forbids taking _wal_lock here).
+        if self._wal is not None:
+            self._wal_pending.append(("__death__", (info.node_id,)))
         info.state = "DEAD"
         self._death_seq += 1
         info.death_seq = self._death_seq
@@ -117,7 +284,94 @@ class GcsServer:
                 self._locations[oid] = locs
             else:
                 del self._locations[oid]
+        # GCS-owned actor restart (reference: gcs_actor_manager.h:278 —
+        # the FSM lives HERE so named/detached actors survive driver exit
+        # and node death alike)
+        # NOT during WAL replay: a replayed death is history — if the
+        # actor was since restarted, later WAL records already say where
+        # it lives; if its host truly died during the outage, the health
+        # monitor re-detects that death after the grace period and this
+        # path fires then, on live state.
+        lost = [aid for aid, spec in self._actor_specs.items()
+                if tuple((self._actor_table.get(aid) or {})
+                         .get("node", ())) == dead_addr]
+        if lost and not self._stop and not self._replaying:
+            threading.Thread(target=self._restart_actors, args=(lost,),
+                             daemon=True, name="gcs-actor-restart").start()
         self._cond.notify_all()
+
+    # ----------------------------------------------- actor restart FSM
+
+    def _restart_actors(self, actor_ids: List[bytes],
+                        timeout: float = 300.0):
+        from ray_tpu.core.cluster.rpc import ClientCache, RpcError
+
+        if self._peers is None:
+            self._peers = ClientCache(self._authkey)
+        for aid in actor_ids:
+            with self._lock:
+                spec = self._actor_specs.get(aid)
+            if spec is None:
+                continue
+            opts = dict(spec.get("opts") or {})
+            restarts = int(opts.get("max_restarts", 0))
+            detached = opts.get("lifetime") == "detached"
+            if restarts == 0 and not detached:
+                continue
+            if restarts > 0:
+                opts["max_restarts"] = restarts - 1
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline and not self._stop:
+                addr = self._pick_restart_node(opts)
+                if addr is None:
+                    time.sleep(0.5)  # pend until a fitting node joins
+                    continue
+                with self._lock:
+                    pickled = self._functions.get(spec["cls_fn_id"])
+                try:
+                    self._peers.get(addr).call(
+                        ("create_actor", spec["cls_fn_id"], pickled,
+                         spec["payload"], list(spec.get("deps") or []),
+                         opts, None, aid))
+                except RpcError:
+                    time.sleep(0.5)
+                    continue
+                with self._lock:
+                    self._actor_specs[aid] = dict(spec, opts=opts)
+                    self._actor_table.setdefault(aid, {}).update(
+                        {"node": addr, "state": "RESTARTED"})
+                    name = spec.get("name")
+                    if name and self._named_actors.get(name, (None,))[0] \
+                            == aid:
+                        self._named_actors[name] = (aid, addr)
+                if self._wal is not None:
+                    with self._wal_lock:
+                        self._wal_write_locked(
+                            "register_actor",
+                            (aid, {"node": addr, "state": "RESTARTED"}))
+                        self._wal_write_locked(
+                            "register_actor_spec",
+                            (aid, dict(spec, opts=opts)))
+                break
+
+    def _pick_restart_node(self, opts: dict):
+        """An ALIVE node whose TOTAL resources cover the request (the
+        node's own queue pends the creation if currently busy)."""
+        req: Dict[str, float] = {}
+        if opts.get("num_cpus"):
+            req["CPU"] = float(opts["num_cpus"])
+        if opts.get("num_tpus"):
+            req["TPU"] = float(opts["num_tpus"])
+        for k, v in (opts.get("resources") or {}).items():
+            req[k] = req.get(k, 0) + float(v)
+        with self._lock:
+            fit = [i for i in self._nodes.values() if i.state == "ALIVE"
+                   and all(i.resources.get(k, 0) >= v
+                           for k, v in req.items())]
+        if not fit:
+            return None
+        fit.sort(key=lambda i: i.load)
+        return fit[0].address
 
     # ------------------------------------------------------------ handler
 
@@ -126,6 +380,14 @@ class GcsServer:
         fn = getattr(self, "_op_" + op, None)
         if fn is None:
             raise ValueError(f"unknown GCS op {op!r}")
+        if (self._wal is not None and op in _WAL_OPS
+                and (op != "kv" or msg[1] in _WAL_KV_MUTATORS)):
+            # apply + log atomically: concurrent mutators serialize here,
+            # so replay order always equals apply order
+            with self._wal_lock:
+                result = fn(*msg[1:])
+                self._wal_write_locked(op, tuple(msg[1:]))
+            return result
         return fn(*msg[1:])
 
     # -- nodes
@@ -256,6 +518,19 @@ class GcsServer:
             self._actor_table.setdefault(actor_id, {}).update(info)
         return True
 
+    def _op_register_actor_spec(self, actor_id: bytes, spec: dict):
+        """Hand the GCS restart authority for this actor: spec carries
+        {cls_fn_id, payload, deps, opts, name}; the class pickle must be
+        in the GCS function table (register_fn) so a restart can ship it."""
+        with self._lock:
+            self._actor_specs[actor_id] = dict(spec)
+        return True
+
+    def _op_drop_actor_spec(self, actor_id: bytes):
+        with self._lock:
+            self._actor_specs.pop(actor_id, None)
+        return True
+
     def _op_list_actors(self):
         with self._lock:
             return dict(self._actor_table)
@@ -364,6 +639,14 @@ class GcsServer:
 
     def close(self):
         self._stop = True
+        if self._wal is not None:
+            with self._wal_lock:
+                try:
+                    self._compact_locked()
+                except Exception:  # noqa: BLE001 — disk full etc.
+                    pass
+                self._wal.close()
+                self._wal = None
         self._server.close()
 
 
@@ -374,8 +657,11 @@ def main(argv=None):
 
     p = argparse.ArgumentParser(description="ray_tpu GCS server")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--persist-dir", default=None,
+                   help="directory for the WAL + snapshots; a restarted "
+                        "GCS on the same dir rehydrates cluster state")
     args = p.parse_args(argv)
-    gcs = GcsServer(port=args.port)
+    gcs = GcsServer(port=args.port, persistence_path=args.persist_dir)
     # Parent reads the bound address from stdout.
     print(f"GCS_ADDRESS {gcs.address[0]}:{gcs.address[1]}", flush=True)
     stop = threading.Event()

@@ -146,6 +146,7 @@ class RpcClient:
             raise RpcError("client closed")
         with self._lock:
             conn = self._pool.pop() if self._pool else None
+        pooled = conn is not None
         if conn is None:
             conn = self._connect()
         try:
@@ -156,7 +157,33 @@ class RpcClient:
                 conn.close()
             except Exception:  # noqa: BLE001
                 pass
-            raise RpcError(f"rpc to {self.address} failed: {e}") from e
+            if pooled:
+                # keepalive-retry heuristic: an idle pooled connection
+                # that fails immediately almost certainly died while
+                # parked (server restart) — drop the whole pool (parked
+                # siblings share its fate) and retry ONCE on a fresh
+                # connection so a restarted GCS/node is transparent to
+                # callers (reference: GCS client reconnect)
+                with self._lock:
+                    stale, self._pool = self._pool, []
+                for c in stale:
+                    try:
+                        c.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                conn = self._connect()
+                try:
+                    conn.send(msg)
+                    tag, value = conn.recv()
+                except (EOFError, OSError, BrokenPipeError) as e2:
+                    try:
+                        conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise RpcError(
+                        f"rpc to {self.address} failed: {e2}") from e2
+            else:
+                raise RpcError(f"rpc to {self.address} failed: {e}") from e
         with self._lock:
             if self._closed:
                 conn.close()

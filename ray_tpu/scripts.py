@@ -47,9 +47,12 @@ def cmd_start(args):
     if args.head:
         key = _ensure_authkey()
         env["RTPU_CLUSTER_AUTHKEY"] = key
+        gcs_cmd = [sys.executable, "-m", "ray_tpu.core.cluster.gcs",
+                   "--port", str(args.port)]
+        if getattr(args, "gcs_persist_dir", None):
+            gcs_cmd += ["--persist-dir", args.gcs_persist_dir]
         gcs = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.cluster.gcs",
-             "--port", str(args.port)],
+            gcs_cmd,
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
             start_new_session=True)
         line = gcs.stdout.readline().decode()
@@ -192,6 +195,9 @@ def main(argv=None):
     sp.add_argument("--address", default=None, help="GCS host:port to join")
     sp.add_argument("--port", type=int, default=0, help="GCS port (head)")
     sp.add_argument("--num-workers", type=int, default=2)
+    sp.add_argument("--gcs-persist-dir", default=None,
+                    help="persist GCS state here; a restarted head on the "
+                         "same dir + port rehydrates the cluster")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the local session")

@@ -220,6 +220,62 @@ def test_cluster_actor_cross_node_calls(cluster):
     assert ray_tpu.get(h.incr.remote(), timeout=30) == 3
 
 
+def test_detached_actor_survives_driver_and_node_death():
+    """Detached named actors: the restart FSM lives in the GCS
+    (reference: gcs_actor_manager.h:278), so the actor (a) outlives the
+    creating driver, and (b) is restarted on a surviving node after its
+    host dies — with no driver involved."""
+    from ray_tpu.core.cluster.fixture import Cluster
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=2, num_workers_per_node=2,
+                node_resources=[{"stay": 4}, {"doomed": 4}])
+    try:
+        c.wait_for_nodes(2)
+        c.connect()
+
+        @ray_tpu.remote
+        class Svc:
+            def __init__(self):
+                self.calls = 0
+
+            def ping(self):
+                self.calls += 1
+                return self.calls
+
+        svc = Svc.options(name="svc", lifetime="detached",
+                          resources={"doomed": 1}).remote()
+        assert ray_tpu.get(svc.ping.remote(), timeout=60) == 1
+
+        # driver 1 exits; the actor must keep running
+        c.disconnect()
+        c.connect()  # a brand-new driver
+        again = ray_tpu.get_actor("svc")
+        assert ray_tpu.get(again.ping.remote(), timeout=60) == 2
+
+        # the hosting node dies; a replacement provides the resources;
+        # the GCS (not any driver) restarts the actor under its id
+        doomed = c.nodes[1]
+        c.remove_node(doomed, graceful=False)
+        c.add_node(resources={"doomed": 4})
+        c.wait_for_nodes(2)
+        deadline = time.time() + 60
+        last = None
+        while time.time() < deadline:
+            try:
+                h = ray_tpu.get_actor("svc")
+                last = ray_tpu.get(h.ping.remote(), timeout=30)
+                break
+            except Exception as e:  # noqa: BLE001 — restart in flight
+                last = e
+                time.sleep(0.5)
+        assert last == 1, f"restarted actor should answer fresh: {last!r}"
+    finally:
+        c.shutdown()
+        runtime_context.set_core(prev)
+
+
 def test_cluster_placement_group_spread(cluster):
     from ray_tpu.util import placement_group, remove_placement_group
 
