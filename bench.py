@@ -27,11 +27,23 @@ BASE = {
     "single_client_tasks_sync": 986.6,
     "1_1_actor_calls_sync": 2055.7,
     "1_1_actor_calls_async": 9060.7,
+    "1_1_actor_calls_concurrent": 5168.0,
     "1_n_actor_calls_async": 8786.2,
     "n_n_actor_calls_async": 26545.9,
+    "n_n_actor_calls_with_arg_async": 2699.1,
+    "1_1_async_actor_calls_sync": 1486.2,
+    "1_1_async_actor_calls_async": 4456.6,
+    "1_1_async_actor_calls_with_args_async": 3038.9,
+    "1_n_async_actor_calls_async": 7805.0,
+    "n_n_async_actor_calls_async": 22710.0,
     "single_client_put_calls": 5241.2,
     "single_client_get_calls": 10303.5,
     "single_client_put_gigabytes": 20.18,
+    "multi_client_put_calls": 12455.5,
+    "multi_client_tasks_async": 23311.9,
+    "multi_client_put_gigabytes": 38.47,
+    "single_client_tasks_and_get_batch": 7.90,
+    "single_client_get_object_containing_10k_refs": 13.68,
     "single_client_wait_1k_refs": 5.49,
     "placement_group_create_removal": 824.4,
 }
@@ -126,6 +138,41 @@ def bench_train_step(attn_impl: str, batch: int = 8, seq: int = 2048,
     tok_s = batch * seq / dt
     mfu = _train_flops_per_step(cfg, n_params, batch, seq) / dt / _chip_peak_flops()
     return tok_s, mfu, loss, n_params, dt
+
+
+def bench_layer_8b(seq: int, batch: int = 4, steps: int = 10):
+    """One Llama-3-8B-DIM transformer layer, fwd+bwd on the chip.
+
+    A single v5e chip (16 GiB) cannot hold the full 8B model, so the
+    8B-shaped claim is validated where it can be: the per-layer compute
+    (h=4096, ffn=14336, 32 heads / 8 KV heads — exactly the 8B block) at
+    real sequence lengths. vocab is shrunk to 256 so the embed/head cost
+    is negligible and the measurement is the LAYER. Returns (ms, mfu)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig.llama3_8b(
+        num_layers=1, vocab_size=256, param_dtype=jnp.bfloat16,
+        attn_impl="flash")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    n_params = llama.num_params(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+
+    grad_fn = jax.jit(jax.value_and_grad(
+        lambda p: llama.loss_fn(cfg, p, {"tokens": tokens})))
+    loss, grads = grad_fn(params)
+    float(loss)  # compile barrier
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, grads = grad_fn(params)
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
+    # fwd+bwd only: 6N per token matmul + causal attention term
+    flops = _train_flops_per_step(cfg, n_params, batch, seq)
+    return dt * 1e3, flops / dt / _chip_peak_flops()
 
 
 def bench_flash_numerics():
@@ -236,7 +283,13 @@ def bench_serve_ttft(n_requests: int = 16):
         done.update(engine.collect())
         time.sleep(0.005)
     wall = time.perf_counter() - t0
-    engine.shutdown()
+    try:
+        return _serve_rows_from(engine, prompts, done, n_requests, wall)
+    finally:
+        engine.shutdown()
+
+
+def _serve_rows_from(engine, prompts, done, n_requests, wall):
     if len(done) < n_requests:
         raise RuntimeError(f"engine finished {len(done)}/{n_requests}")
     ttfts = sorted(r["ttft_s"] for r in done.values())
@@ -244,7 +297,32 @@ def bench_serve_ttft(n_requests: int = 16):
     # median TTFT over ALL requests under load (jit compilation was paid by
     # the warmup request, outside the timed window)
     p50 = ttfts[len(ttfts) // 2]
-    return p50 * 1e3, total_tokens / wall
+    # per-stream view: inter-token latency and tokens/s within ONE request
+    # under full load (weak point of aggregate-only numbers: they hide a
+    # thin per-stream experience)
+    itls = sorted((r["latency_s"] - r["ttft_s"]) / max(1, len(r["tokens"]) - 1)
+                  for r in done.values())
+    itl_p50_ms = itls[len(itls) // 2] * 1e3
+    per_stream = sorted(
+        len(r["tokens"]) / max(1e-9, r["latency_s"] - r["ttft_s"])
+        for r in done.values())
+    per_stream_p50 = per_stream[len(per_stream) // 2]
+    # unbatched upper bound: ONE request alone on the engine — the gap to
+    # per_stream_p50 is the price each stream pays for batching. Failure
+    # here must not void the measurements above.
+    solo_tok_s = -1.0
+    engine.submit("solo", prompts[0])
+    solo = {}
+    deadline = time.monotonic() + 600
+    while "solo" not in solo and time.monotonic() < deadline:
+        solo.update(engine.collect())
+        time.sleep(0.005)
+    r = solo.get("solo")
+    if isinstance(r, dict):
+        solo_tok_s = (len(r["tokens"])
+                      / max(1e-9, r["latency_s"] - r["ttft_s"]))
+    return (p50 * 1e3, total_tokens / wall, itl_p50_ms, per_stream_p50,
+            solo_tok_s)
 
 
 # --- ray_perf-style microbenchmarks ------------------------------------------
@@ -278,6 +356,9 @@ def bench_core(rows: list):
     @ray_tpu.remote
     class A:
         def f(self):
+            return None
+
+        def f_arg(self, x):
             return None
 
     # tasks async: submit batch, then resolve
@@ -316,21 +397,99 @@ def bench_core(rows: list):
     rows.append(_row("1_n_actor_calls_async", rate, "calls/s",
                      BASE["1_n_actor_calls_async"]))
 
-    # n:n — the runtime is single-driver (embedded), so "n clients" are n
-    # submitter threads in this process, each driving its own actor.
-    import threading
+    # n:n — ray_perf methodology (ray_perf.py:225-232): the n "clients"
+    # are m REMOTE TASKS, each driving every actor round-robin, so the
+    # whole exchange crosses real process boundaries on both sides.
+    # NOTE the hardware asymmetry: the reference number aggregates across
+    # 64 vCPUs; this VM has ONE core, so the aggregate can never exceed
+    # the single-pair rate — see the aggregate_msgs_per_core row.
+    @ray_tpu.remote
+    def drive_actors(acts, per):
+        ray_tpu.get([acts[i % len(acts)].f.remote() for i in range(per)])
+        return 0
 
-    def n_n(per=1000):
-        def drive(a):
-            ray_tpu.get([a.f.remote() for _ in range(per)])
-        ts = [threading.Thread(target=drive, args=(a_,)) for a_ in actors]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-    rate = _timeit(lambda: n_n(), 1000 * nw, warm=1)
+    m = 4
+    def n_n(per=500):
+        ray_tpu.get([drive_actors.remote(actors, per) for _ in range(m)])
+    rate = _timeit(lambda: n_n(), 500 * m, warm=1)
     rows.append(_row("n_n_actor_calls_async", rate, "calls/s",
                      BASE["n_n_actor_calls_async"]))
+
+    # n:n with arg (ray_perf.py:235-243): m client actors, each driving
+    # its own server actor with a put-ref argument per call
+    @ray_tpu.remote
+    class ArgClient:
+        def __init__(self, server):
+            self.server = server
+
+        def batch(self, n):
+            x = ray_tpu.put(0)
+            ray_tpu.get([self.server.f_arg.remote(x) for _ in range(n)])
+            return 0
+
+    clients = [ArgClient.remote(a_) for a_ in actors]
+    def n_n_arg(per=250):
+        ray_tpu.get([c.batch.remote(per) for c in clients])
+    rate = _timeit(lambda: n_n_arg(), 250 * nw, warm=1)
+    rows.append(_row("n_n_actor_calls_with_arg_async", rate, "calls/s",
+                     BASE["n_n_actor_calls_with_arg_async"]))
+
+    # 1:1 concurrent (thread-pooled actor, ray_perf.py:205-210)
+    conc = A.options(max_concurrency=16).remote()
+    ray_tpu.get(conc.f.remote())
+    def actor_concurrent(n=2000):
+        ray_tpu.get([conc.f.remote() for _ in range(n)])
+    rate = _timeit(lambda: actor_concurrent(), 2000, warm=1)
+    rows.append(_row("1_1_actor_calls_concurrent", rate, "calls/s",
+                     BASE["1_1_actor_calls_concurrent"]))
+
+    # async actors (asyncio event-loop per actor, ray_perf.py:26-35)
+    @ray_tpu.remote
+    class AsyncA:
+        async def f(self):
+            return b"ok"
+
+        async def f_arg(self, x):
+            return b"ok"
+
+    aa = AsyncA.remote()
+    ray_tpu.get(aa.f.remote())
+    def async_sync(n=300):
+        for _ in range(n):
+            ray_tpu.get(aa.f.remote())
+    rate = _timeit(lambda: async_sync(), 300, warm=1)
+    rows.append(_row("1_1_async_actor_calls_sync", rate, "calls/s",
+                     BASE["1_1_async_actor_calls_sync"]))
+
+    def async_async(n=2000):
+        ray_tpu.get([aa.f.remote() for _ in range(n)])
+    rate = _timeit(lambda: async_async(), 2000, warm=1)
+    rows.append(_row("1_1_async_actor_calls_async", rate, "calls/s",
+                     BASE["1_1_async_actor_calls_async"]))
+
+    ref_arg = ray_tpu.put(0)
+    def async_args(n=2000):
+        ray_tpu.get([aa.f_arg.remote(ref_arg) for _ in range(n)])
+    rate = _timeit(lambda: async_args(), 2000, warm=1)
+    rows.append(_row("1_1_async_actor_calls_with_args_async", rate,
+                     "calls/s",
+                     BASE["1_1_async_actor_calls_with_args_async"]))
+
+    async_actors = [AsyncA.remote() for _ in range(nw)]
+    for x in async_actors:
+        ray_tpu.get(x.f.remote())
+    def one_n_async(n=2000):
+        ray_tpu.get([async_actors[i % nw].f.remote() for i in range(n)])
+    rate = _timeit(lambda: one_n_async(), 2000, warm=1)
+    rows.append(_row("1_n_async_actor_calls_async", rate, "calls/s",
+                     BASE["1_n_async_actor_calls_async"]))
+
+    def n_n_async(per=500):
+        ray_tpu.get([drive_actors.remote(async_actors, per)
+                     for _ in range(m)])
+    rate = _timeit(lambda: n_n_async(), 500 * m, warm=1)
+    rows.append(_row("n_n_async_actor_calls_async", rate, "calls/s",
+                     BASE["n_n_async_actor_calls_async"]))
 
     # put/get small objects
     def puts(n=3000):
@@ -339,6 +498,45 @@ def bench_core(rows: list):
     rate = _timeit(lambda: puts(), 3000, warm=1)
     rows.append(_row("single_client_put_calls", rate, "puts/s",
                      BASE["single_client_put_calls"]))
+
+    # multi-client puts: m remote tasks each putting small objects
+    @ray_tpu.remote
+    def put_batch(n):
+        for _ in range(n):
+            ray_tpu.put(b"x" * 100)
+        return 0
+
+    def multi_puts(per=750):
+        ray_tpu.get([put_batch.remote(per) for _ in range(m)])
+    rate = _timeit(lambda: multi_puts(), 750 * m, warm=1)
+    rows.append(_row("multi_client_put_calls", rate, "puts/s",
+                     BASE["multi_client_put_calls"]))
+
+    # multi-client task submission: m remote tasks each submitting nested
+    # noop tasks (ray_perf.py:65-67 small_value_batch)
+    @ray_tpu.remote
+    def submit_batch(n):
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        return 0
+
+    def multi_tasks(per=1000):
+        ray_tpu.get([submit_batch.remote(per) for _ in range(m)])
+    rate = _timeit(lambda: multi_tasks(), 1000 * m, warm=1)
+    rows.append(_row("multi_client_tasks_async", rate, "tasks/s",
+                     BASE["multi_client_tasks_async"]))
+
+    # tasks-and-get batch: 1k-task submit+get cycles per second
+    def tasks_and_get(n=1000):
+        ray_tpu.get([noop.remote() for _ in range(n)])
+    tasks_and_get()
+    t0 = time.perf_counter()
+    reps = 6
+    for _ in range(reps):
+        tasks_and_get()
+    rate = reps / (time.perf_counter() - t0)
+    rows.append(_row("single_client_tasks_and_get_batch", rate,
+                     "1k-batches/s",
+                     BASE["single_client_tasks_and_get_batch"]))
 
     small = ray_tpu.put(b"y" * 100)
     def gets(n=6000):
@@ -360,6 +558,64 @@ def bench_core(rows: list):
     gibs = (8 * arr.nbytes / (1 << 30)) / (time.perf_counter() - t0)
     rows.append(_row("single_client_put_gigabytes", gibs, "GiB/s",
                      BASE["single_client_put_gigabytes"]))
+
+    # Hardware ceiling for the row above: raw streaming memcpy into a
+    # ring of distinct 64 MiB destinations (exactly what put does). The
+    # reference's 20.18 GiB/s runs on a 64-vCPU m5.16xlarge with far more
+    # memory bandwidth; on THIS machine put is at ~the memcpy ceiling, so
+    # the remaining vs_baseline gap is hardware, not the store.
+    ring = [np.empty_like(arr) for _ in range(8)]
+    for d in ring:
+        np.copyto(d, arr)
+    t0 = time.perf_counter()
+    for i in range(16):
+        np.copyto(ring[i % 8], arr)
+    ceiling = (16 * arr.nbytes / (1 << 30)) / (time.perf_counter() - t0)
+    del ring
+    rows.append(_row("host_memcpy_gigabytes", ceiling, "GiB/s"))
+    rows.append(_row("put_bandwidth_vs_host_memcpy", gibs / ceiling, "x"))
+
+    # multi-client put GiB/s: m worker processes copying into the SAME
+    # shm arena concurrently
+    @ray_tpu.remote
+    def put_gb_worker(nbytes, reps):
+        import numpy as _np
+
+        from ray_tpu.core import runtime_context
+
+        # warm-store methodology, same as the single-client row (plasma
+        # baselines also run warm): first-touch faults on the worker's
+        # own arena mapping otherwise dominate (1.5 vs 5.3 GiB/s)
+        core = runtime_context.get_core()
+        if getattr(core, "store", None) is not None:
+            core.store.prefault()
+        a = _np.ones(nbytes // 8)
+        for _ in range(reps):
+            ray_tpu.put(a)
+        return 0
+
+    mb32 = 32 << 20
+    ray_tpu.get([put_gb_worker.remote(mb32, 1) for _ in range(m)])  # warm
+    t0 = time.perf_counter()
+    ray_tpu.get([put_gb_worker.remote(mb32, 4) for _ in range(m)])
+    gibs_m = (m * 4 * mb32 / (1 << 30)) / (time.perf_counter() - t0)
+    rows.append(_row("multi_client_put_gigabytes", gibs_m, "GiB/s",
+                     BASE["multi_client_put_gigabytes"]))
+
+    # get of one object containing 10k refs
+    refs_10k = [noop.remote() for _ in range(10_000)]
+    ray_tpu.get(refs_10k)
+    big_ref = ray_tpu.put(refs_10k)
+    ray_tpu.get(big_ref)
+    t0 = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        ray_tpu.get(big_ref)
+    rate = reps / (time.perf_counter() - t0)
+    rows.append(_row("single_client_get_object_containing_10k_refs", rate,
+                     "gets/s",
+                     BASE["single_client_get_object_containing_10k_refs"]))
+    del refs_10k, big_ref
 
     # wait over 1k already-resolved refs (ray_perf pre-resolves before the
     # timed region, so this measures wait() cost, not task completion)
@@ -418,6 +674,60 @@ def bench_core(rows: list):
     ray_tpu.shutdown()
 
 
+def bench_many_nodes(rows: list):
+    """Scale rows on a 16-node local cluster of REAL node-server
+    processes: scheduling throughput for a 10k-task wave, actor-fleet
+    creation, and PG churn (reference: release/benchmarks many_nodes
+    342.8 tasks/s on 64 real nodes / many_actors 627/s — those aggregate
+    64x64 cores; this VM has one)."""
+    import ray_tpu
+    from ray_tpu.core import runtime_context
+    from ray_tpu.core.cluster.fixture import Cluster
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=16, num_workers_per_node=1,
+                object_store_memory=64 << 20)
+    try:
+        assert c.wait_for_nodes(16, timeout=180)
+        c.connect()
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        ray_tpu.get([f.remote(i) for i in range(200)], timeout=120)  # warm
+        t0 = time.perf_counter()
+        ray_tpu.get([f.remote(i) for i in range(10_000)], timeout=600)
+        rows.append(_row("many_nodes_tasks_per_sec",
+                         10_000 / (time.perf_counter() - t0), "tasks/s",
+                         342.8))
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        t0 = time.perf_counter()
+        actors = [A.remote() for _ in range(100)]
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+        rows.append(_row("many_nodes_actors_per_sec",
+                         100 / (time.perf_counter() - t0), "actors/s",
+                         627.3))
+
+        from ray_tpu.util import placement_group, remove_placement_group
+        t0 = time.perf_counter()
+        for _ in range(50):
+            pg = placement_group([{"CPU": 0.01}] * 2, strategy="SPREAD")
+            pg.wait(timeout_seconds=60)
+            remove_placement_group(pg)
+        rows.append(_row("many_nodes_pgs_per_sec",
+                         50 / (time.perf_counter() - t0), "PG/s", 22.2))
+    finally:
+        c.shutdown()
+        runtime_context.set_core(prev)
+
+
 def main():
     rows: list = []
 
@@ -428,6 +738,12 @@ def main():
         bench_core(rows)
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "core_microbench", "value": -1,
+                     "unit": f"error: {e}"})
+
+    try:
+        bench_many_nodes(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "many_nodes_tasks_per_sec", "value": -1,
                      "unit": f"error: {e}"})
 
     # 1) headline: flagship train step on the chip
@@ -446,10 +762,24 @@ def main():
                          tok_s / max(tok_ref, 1e-9), "x"))
         try:
             err = bench_flash_numerics()
-            rows.append(_row("flash_bwd_grad_max_err_vs_ref", err, "abs"))
+            # bf16 tolerance bound asserted ON-CHIP (CI asserts 2e-5 in
+            # fp32 interpret mode; this is the hardware-kernel check)
+            assert err < 0.1, f"flash bwd grads diverged on-chip: {err}"
+            rows.append(_row("flash_bwd_grad_max_err_vs_ref", err,
+                             "abs (bound 0.1)"))
         except Exception as e:  # pragma: no cover
             rows.append({"metric": "flash_bwd_grad_max_err_vs_ref",
                          "value": -1, "unit": f"error: {e}"})
+        # 8B-dim per-layer rows: the "Llama-3-8B" shape measured for real
+        for seq_len in (2048, 4096):
+            try:
+                ms, mfu8 = bench_layer_8b(seq_len)
+                rows.append(_row(f"layer8b_step_ms_seq{seq_len}", ms, "ms"))
+                rows.append(_row(f"layer8b_mfu_seq{seq_len}", mfu8,
+                                 "fraction"))
+            except Exception as e:  # pragma: no cover
+                rows.append({"metric": f"layer8b_step_ms_seq{seq_len}",
+                             "value": -1, "unit": f"error: {e}"})
 
     # 2) MoE train step on the chip
     try:
@@ -462,10 +792,18 @@ def main():
 
     # 3) serve: p50 TTFT + continuous-batched decode throughput on the chip
     try:
-        ttft_ms, dec_tok_s = bench_serve_ttft()
+        (ttft_ms, dec_tok_s, itl_ms, stream_tok_s,
+         solo_tok_s) = bench_serve_ttft()
         rows.append(_row("serve_ttft_p50_ms", ttft_ms, "ms"))
         rows.append(_row("serve_decode_tokens_per_sec", dec_tok_s,
                          "tokens/s"))
+        rows.append(_row("serve_itl_p50_ms", itl_ms, "ms"))
+        rows.append(_row("serve_tokens_per_sec_per_stream_p50",
+                         stream_tok_s, "tokens/s"))
+        rows.append(_row("serve_tokens_per_sec_single_stream_unbatched",
+                         solo_tok_s, "tokens/s"))
+        rows.append(_row("serve_batching_per_stream_retention",
+                         stream_tok_s / max(solo_tok_s, 1e-9), "x"))
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "serve_ttft_p50_ms", "value": -1,
                      "unit": f"error: {e}"})

@@ -28,8 +28,13 @@ from ray_tpu.serve.api import (  # noqa: F401
     start,
     status,
 )
+from ray_tpu.serve.multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)
 
 __all__ = [
     "Deployment", "DeploymentHandle", "batch", "delete", "deployment",
-    "get_deployment_handle", "run", "shutdown", "start", "status",
+    "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
+    "run", "shutdown", "start", "status",
 ]

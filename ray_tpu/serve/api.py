@@ -62,6 +62,14 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return DeploymentResponse(self._get_router().request(args, kwargs))
 
+    def options(self, *, multiplexed_model_id: Optional[str] = None
+                ) -> "_OptionedHandle":
+        """Per-request routing options (reference: handle.options):
+        ``multiplexed_model_id`` routes to a replica that already holds
+        that model variant and exposes the id to the deployment via
+        serve.get_multiplexed_model_id()."""
+        return _OptionedHandle(self, multiplexed_model_id)
+
     def stream(self, *args, **kwargs):
         """Token streaming against an engine deployment: a generator of
         new-token lists (reference: handle streaming + serve.llm)."""
@@ -82,6 +90,40 @@ class DeploymentHandle:
                 r.stop()
             except Exception:  # noqa: BLE001
                 pass
+
+
+class _OptionedHandle:
+    """Handle view carrying per-request options (multiplexed model id).
+    Supports the full handle surface: remote/stream/options chaining."""
+
+    def __init__(self, handle: DeploymentHandle,
+                 multiplexed_model_id: Optional[str]):
+        self._handle = handle
+        self._model_id = multiplexed_model_id
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return DeploymentResponse(self._handle._get_router().request(
+            args, kwargs, model_id=self._model_id))
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None
+                ) -> "_OptionedHandle":
+        return _OptionedHandle(self._handle, multiplexed_model_id)
+
+    def stream(self, *args, **kwargs):
+        if self._model_id is not None:
+            raise ValueError(
+                "multiplexed_model_id is not supported for engine "
+                "streaming deployments")
+        return self._handle.stream(*args, **kwargs)
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        if self._model_id is not None:
+            raise ValueError(
+                "multiplexed_model_id applies to __call__ requests "
+                "(handle.remote); method calls are not mux-routed")
+        return getattr(self._handle, method)
 
 
 class Deployment:

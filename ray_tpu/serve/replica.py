@@ -48,6 +48,15 @@ class ReplicaActor:
         return self._is_engine
 
     def handle(self, args: tuple, kwargs: dict) -> Any:
+        from ray_tpu.serve.multiplex import _MUX_KWARG, _current_model_id
+
+        mid = kwargs.pop(_MUX_KWARG, None)
+        if mid is not None:
+            token = _current_model_id.set(mid)
+            try:
+                return self._call(*args, **kwargs)
+            finally:
+                _current_model_id.reset(token)
         return self._call(*args, **kwargs)
 
     def handle_batch(self, requests: List[tuple]) -> List[Any]:

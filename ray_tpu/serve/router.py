@@ -43,6 +43,8 @@ class Router:
         self._lock = threading.Lock()
         self._replicas: List[Tuple[str, Any]] = []
         self._inflight: Dict[str, int] = {}
+        # multiplexing: model id -> replica id that last loaded it
+        self._mux_affinity: Dict[str, str] = {}
         self._version = -1
         self._last_refresh = 0.0
         cfg = ray_tpu.get(controller.get_deployment_config.remote(name),
@@ -161,8 +163,11 @@ class Router:
             for rid, _ in replicas:
                 self._inflight.setdefault(rid, 0)
 
-    def _pick(self) -> Tuple[str, Any]:
-        """Power-of-two-choices on local in-flight counts."""
+    def _pick(self, model_id: Optional[str] = None) -> Tuple[str, Any]:
+        """Power-of-two-choices on local in-flight counts; with a
+        multiplexed ``model_id``, prefer the replica that already loaded
+        that variant (reference: multiplex-aware replica scheduler) unless
+        it is clearly overloaded vs the pow-2 alternative."""
         deadline = time.monotonic() + 30
         while True:
             self._refresh()
@@ -174,12 +179,31 @@ class Router:
                 raise RuntimeError(
                     f"no running replicas for deployment {self._name!r}")
             time.sleep(0.05)
+        if model_id is not None:
+            with self._lock:
+                rid = self._mux_affinity.get(model_id)
+                hot = next((r for r in replicas if r[0] == rid), None)
+                if hot is not None:
+                    # cache hit beats a cold load unless the hot replica
+                    # is badly backed up relative to the least-loaded one
+                    least = min(self._inflight.get(r[0], 0)
+                                for r in replicas)
+                    if self._inflight.get(rid, 0) <= least + 4:
+                        return hot
+        choice = None
         if len(replicas) == 1:
-            return replicas[0]
-        a, b = random.sample(replicas, 2)
-        with self._lock:
-            return a if (self._inflight.get(a[0], 0)
-                         <= self._inflight.get(b[0], 0)) else b
+            choice = replicas[0]
+        else:
+            a, b = random.sample(replicas, 2)
+            with self._lock:
+                choice = a if (self._inflight.get(a[0], 0)
+                               <= self._inflight.get(b[0], 0)) else b
+        if model_id is not None:
+            with self._lock:
+                self._mux_affinity[model_id] = choice[0]
+                if len(self._mux_affinity) > 10_000:
+                    self._mux_affinity.clear()  # bounded, rebuilt on use
+        return choice
 
     def _drop_replica(self, rid: str):
         with self._lock:
@@ -188,8 +212,16 @@ class Router:
 
     # --------------------------------------------------------------- routing
 
-    def request(self, args: tuple, kwargs: dict) -> Future:
+    def request(self, args: tuple, kwargs: dict,
+                model_id: Optional[str] = None) -> Future:
         self._ensure_report_thread()
+        if model_id is not None and (self._engine or self._max_batch > 1):
+            # engine mailboxes and dynamic batches mix requests across
+            # model ids — silently dropping the id would serve the wrong
+            # variant, so refuse loudly until those paths are mux-aware
+            raise ValueError(
+                "multiplexed_model_id is not supported for engine or "
+                "batched deployments")
         fut: Future = Future()
         if self._engine:
             threading.Thread(target=self._engine_request,
@@ -203,7 +235,8 @@ class Router:
                     self._batch_thread.start()
         else:
             threading.Thread(target=self._unary_request,
-                             args=(args, kwargs, fut), daemon=True).start()
+                             args=(args, kwargs, fut, model_id),
+                             daemon=True).start()
         return fut
 
     def call_method(self, method: str, args: tuple, kwargs: dict) -> Future:
@@ -240,11 +273,15 @@ class Router:
         threading.Thread(target=run, daemon=True).start()
         return fut
 
-    def _unary_request(self, args, kwargs, fut: Future):
+    def _unary_request(self, args, kwargs, fut: Future, model_id=None):
+        from ray_tpu.serve.multiplex import _MUX_KWARG
+
+        if model_id is not None:
+            kwargs = dict(kwargs, **{_MUX_KWARG: model_id})
         err: Optional[BaseException] = None
         for _ in range(3):  # retry across replicas on replica death
             try:
-                rid, handle = self._pick()
+                rid, handle = self._pick(model_id)
             except RuntimeError as e:
                 fut.set_exception(e)
                 return

@@ -312,6 +312,52 @@ def test_cluster_spillback_from_worker_submission(cluster):
     assert pid == cluster.nodes[2].proc.pid
 
 
+def test_many_nodes_scale_stress():
+    """Scale smoke: 16 real node-server processes, a task wave, an actor
+    fleet, and placement groups — exposes O(N) control-plane paths before
+    they matter (reference envelope: release/benchmarks/README.md, 64
+    nodes; 16 here is bounded by this 1-core CI box, not the design)."""
+    from ray_tpu.core.cluster.fixture import Cluster
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=16, num_workers_per_node=1,
+                object_store_memory=64 << 20)
+    try:
+        assert c.wait_for_nodes(16, timeout=120)
+        c.connect()
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        t0 = time.monotonic()
+        out = ray_tpu.get([f.remote(i) for i in range(2000)], timeout=300)
+        rate = 2000 / (time.monotonic() - t0)
+        assert out[:5] == [1, 2, 3, 4, 5] and len(out) == 2000
+        assert rate > 100, f"scheduling collapsed at 16 nodes: {rate:.0f}/s"
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        actors = [A.remote() for _ in range(30)]
+        assert ray_tpu.get([a.ping.remote() for a in actors],
+                           timeout=300) == [1] * 30
+
+        from ray_tpu.util import placement_group, remove_placement_group
+        pgs = [placement_group([{"CPU": 0.01}] * 2, strategy="SPREAD")
+               for _ in range(10)]
+        for pg in pgs:
+            assert pg.wait(timeout_seconds=60)
+        for pg in pgs:
+            remove_placement_group(pg)
+    finally:
+        c.shutdown()
+        runtime_context.set_core(prev)
+
+
 def test_cluster_kv(cluster):
     core = runtime_context.get_core()
     core.kv_op("put", "shared", {"x": 1})
