@@ -123,6 +123,23 @@ class NodeRuntime(Runtime):
             elif tag == protocol.REQ_FREE:
                 # worker-originated free: the object may live on any node
                 return ("ok", len(srv.free_cluster_wide(msg[1])))
+            elif tag == protocol.REQ_KILL_ACTOR:
+                aid = ActorID(msg[1])
+                if msg[2]:
+                    srv.gcs.try_call(("drop_actor_spec", msg[1]))
+                if aid in self._actors:
+                    self.kill_actor(aid, no_restart=msg[2])
+                    return ("ok",)
+                # actor lives elsewhere: route via the GCS actor table
+                info = srv.gcs.try_call(("list_actors",), default={}) or {}
+                entry = info.get(msg[1])
+                if entry and "node" in entry:
+                    try:
+                        srv._peers.get(tuple(entry["node"])).call(
+                            ("kill_actor", msg[1], msg[2]))
+                    except RpcError:
+                        pass
+                return ("ok",)
             elif tag == protocol.REQ_ACTOR_CALL:
                 _, actor_id_b, method, args_payload, extra, n_returns = msg
                 if ActorID(actor_id_b) not in self._actors:

@@ -53,6 +53,7 @@ REQ_PKG = "pkg"                    # (REQ_PKG, hash_str) -> ("ok", bytes_or_none
 REQ_PKG_PUT = "pkg_put"            # (REQ_PKG_PUT, hash_str, bytes) -> ("ok", None)
 REQ_NEED_SPACE = "need_space"      # (REQ_NEED_SPACE, nbytes) -> ("ok", freed_bool)
 REQ_FREE = "free_objs"             # (REQ_FREE, [oid_bytes]) -> ("ok", count_freed)
+REQ_KILL_ACTOR = "kill_actor_req"  # (REQ_KILL_ACTOR, actor_id_bytes, no_restart) -> ("ok",)
 
 class ErrorValue:
     """Marker wrapping an exception stored as an object's value.

@@ -1859,6 +1859,9 @@ class Runtime:
             return ("ok", self._try_free_space(msg[1]))
         if tag == protocol.REQ_FREE:
             return ("ok", self.free_objects(msg[1]))
+        if tag == protocol.REQ_KILL_ACTOR:
+            self.kill_actor(ActorID(msg[1]), no_restart=msg[2])
+            return ("ok",)
         if tag == protocol.REQ_PUT_META:
             _, oid_bytes, payload = msg
             oid = ObjectID(oid_bytes)

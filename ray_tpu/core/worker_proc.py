@@ -311,42 +311,88 @@ class WorkerCore:
                     RuntimeError(repr(exc)), traceback.format_exc())),
                 store=None)
 
-    def _dag_start(self, instance, in_desc, out_desc, method: str) -> str:
-        """Start a compiled-DAG resident loop: read input channel, invoke
-        the bound method, write output channel. Errors are forwarded as
-        ('e', exc) markers so downstream stages pass them through and the
-        driver re-raises (reference: compiled DAG error propagation)."""
+    def _dag_start(self, instance, in_descs, out_descs, method: str) -> str:
+        """Start a compiled-DAG resident loop: read ALL input channels (in
+        edge order), invoke the bound method with those values, write the
+        result to EVERY output channel. Errors are forwarded as ('e', exc)
+        markers so downstream stages pass them through and the driver
+        re-raises (reference: compiled DAG error propagation). Channels
+        may be shm (same-node) or socket (cross-node) per edge."""
         import threading
 
-        from ray_tpu.dag.channel import Channel, ChannelClosed
+        from ray_tpu.dag.channel import ChannelClosed, open_endpoint
 
-        if self.store is None:
-            raise RuntimeError("compiled DAGs require a shm store")
-        inch = Channel.open(self.store, in_desc)
-        outch = Channel.open(self.store, out_desc)
+        # accept the legacy single-descriptor form
+        if in_descs and isinstance(in_descs, tuple) \
+                and not isinstance(in_descs[0], (tuple, list)):
+            in_descs, out_descs = [in_descs], [out_descs]
         fn = getattr(instance, method)
 
         def loop():
+            import sys
+            import traceback as tb
+
+            # open INSIDE the loop thread: socket readers bind+publish
+            # here, writers block until their peer publishes — neither
+            # may stall the __rtpu_dag_start__ ack
+            ins: list = []
+            outs: list = []
+            try:
+                ins = [open_endpoint(d, store=self.store, kv=self.kv_op,
+                                     role="reader") for d in in_descs]
+                outs = [open_endpoint(d, store=self.store, kv=self.kv_op,
+                                      role="writer") for d in out_descs]
+            except Exception as e:  # noqa: BLE001
+                # a real setup failure must not present as a silent hang:
+                # log it, and try to push the error downstream so the
+                # driver's first execute raises instead of timing out
+                tb.print_exc(file=sys.stderr)
+                err = RuntimeError(
+                    f"DAG stage {method!r} failed to open its channels: "
+                    f"{e!r}")
+                for d in out_descs:
+                    try:
+                        outch = open_endpoint(d, store=self.store,
+                                              kv=self.kv_op, role="writer",
+                                              timeout_ms=5000)
+                        outch.write(("e", err), timeout_ms=5000)
+                        outs.append(outch)
+                    except Exception:  # noqa: BLE001 — peer gone too
+                        pass
+                for ch in ins + outs:
+                    ch.release()
+                return
             try:
                 while True:
+                    vals = []
+                    err = None
                     try:
-                        tag, value = inch.read(timeout_ms=-1)
+                        for inch in ins:
+                            tag, value = inch.read(timeout_ms=-1)
+                            if tag == "e" and err is None:
+                                err = value
+                            vals.append(value)
                     except ChannelClosed:
-                        outch.close()
+                        for outch in outs:
+                            outch.close()
                         return
                     except Exception:  # noqa: BLE001 — store torn down
                         return
-                    if tag == "e":
-                        outch.write(("e", value))
-                        continue
-                    try:
-                        out = ("v", fn(value))
-                    except BaseException as e:  # noqa: BLE001
-                        out = ("e", e)
-                    outch.write(out)
+                    if err is not None:
+                        out = ("e", err)
+                    else:
+                        try:
+                            out = ("v", fn(*vals))
+                        except BaseException as e:  # noqa: BLE001
+                            out = ("e", e)
+                    # infinite timeout to MATCH the infinite reads: with a
+                    # pipelined call in flight, a slow downstream stage
+                    # (LLM decode) can legitimately hold the ack >10s
+                    for outch in outs:
+                        outch.write(out, timeout_ms=-1)
             finally:
-                inch.release()
-                outch.release()
+                for ch in ins + outs:
+                    ch.release()
 
         threading.Thread(target=loop, daemon=True,
                          name=f"dag-{method}").start()
@@ -437,6 +483,12 @@ class WorkerCore:
     def register_package(self, pkg_hash: str, data: bytes) -> None:
         """Upload a package to the core (nested submissions from tasks)."""
         self._request(protocol.REQ_PKG_PUT, pkg_hash, data)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        """Kill an actor from inside a task/actor (nested lifecycles:
+        DAG-mode pipelines own their stage actors)."""
+        self._request(protocol.REQ_KILL_ACTOR, actor_id.binary(),
+                      no_restart)
 
     def free_objects(self, oid_bytes_list) -> int:
         """Eager deletion from inside a task/actor — forwarded to the

@@ -9,5 +9,7 @@ invocation costs microseconds of shm handoff instead of a scheduler round
 trip per stage. This is the substrate Serve's TP/PP inference path uses.
 """
 
-from ray_tpu.dag.api import InputNode, bind, compile_pipeline  # noqa: F401
-from ray_tpu.dag.channel import Channel  # noqa: F401
+from ray_tpu.dag.api import (CompiledDag, InputNode,  # noqa: F401
+                             MultiOutputNode, bind, compile_dag,
+                             compile_pipeline)
+from ray_tpu.dag.channel import Channel, SocketChannel  # noqa: F401

@@ -1,31 +1,41 @@
-"""Compiled-DAG API: static actor pipelines with resident loops.
+"""Compiled-DAG API: static actor graphs with resident loops.
 
-Usage::
+Linear pipeline::
 
     dag = compile_pipeline([(actor1, "preprocess"), (actor2, "infer")])
     out = dag.execute(x)     # microsecond-scale dispatch per call
     dag.teardown()
 
-Each stage's actor starts a resident thread (reference: the compiled DAG's
-per-actor executable loop, python/ray/dag/compiled_dag_node.py:92) reading
-its input channel, invoking the bound method, and writing the output
-channel. Execution never touches the scheduler: values hop through
-seqno-gated shm channels. Stages run in PIPELINE: call N+1 may enter stage
-1 while call N is in stage 2.
+General graphs (fan-out / fan-in, reference:
+python/ray/dag/compiled_dag_node.py:482 + dag_node_operation.py)::
 
-Current scope: all actors on the driver's node (channels live in the
-node's shm store); the driver core must own a store (embedded runtime or
-same-host cluster driver).
+    with InputNode() as inp:
+        a = bind(actor_a, "left", inp)
+        b = bind(actor_b, "right", inp)
+        c = bind(actor_c, "join", a, b)      # diamond
+    dag = compile_dag(c)
+    out = dag.execute(x)
+
+Each stage's actor runs a resident loop (reference: the compiled DAG's
+per-actor executable loop, compiled_dag_node.py:92) reading ALL its input
+channels in a fixed order, invoking the bound method with those values,
+and writing the result to every consumer's channel. Execution never
+touches the scheduler. Same-node edges ride seqno-gated shm channels
+(microseconds); CROSS-NODE edges ride framed TCP channels with the same
+rendezvous semantics (dag/channel.py:SocketChannel), so a graph may span
+the cluster. Stages run in PIPELINE: call N+1 may enter stage 1 while
+call N is downstream.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import ray_tpu
 from ray_tpu.core import runtime_context
-from ray_tpu.dag.channel import Channel, ChannelClosed
+from ray_tpu.dag.channel import (Channel, ChannelClosed, SocketChannel,
+                                 open_endpoint)
 
 
 class InputNode:
@@ -39,47 +49,131 @@ class InputNode:
         return False
 
 
-class _BoundStage:
-    __slots__ = ("actor", "method", "upstream")
+class MultiOutputNode:
+    """Declare several stages as the DAG's outputs; execute() returns a
+    list in this order (reference: ray.dag.MultiOutputNode)."""
 
-    def __init__(self, actor, method: str, upstream):
+    def __init__(self, nodes: Sequence["_BoundStage"]):
+        self.nodes = list(nodes)
+
+
+class _BoundStage:
+    __slots__ = ("actor", "method", "upstreams")
+
+    def __init__(self, actor, method: str, upstreams):
         self.actor = actor
         self.method = method
-        self.upstream = upstream
+        self.upstreams = list(upstreams)
 
-    def experimental_compile(self, capacity: int = 1 << 20
-                             ) -> "CompiledPipeline":
-        """Walk the bind chain back to the InputNode and compile."""
-        stages: List[Tuple[Any, str]] = []
-        node: Any = self
-        while isinstance(node, _BoundStage):
-            stages.append((node.actor, node.method))
-            node = node.upstream
-        if not isinstance(node, InputNode):
-            raise ValueError("pipeline must terminate at an InputNode")
-        stages.reverse()
-        return compile_pipeline(stages, capacity=capacity)
+    def experimental_compile(self, capacity: int = 1 << 20) -> "CompiledDag":
+        return compile_dag(self, capacity=capacity)
 
 
-def bind(actor, method: str, upstream) -> _BoundStage:
-    """actor.method(upstream) as a DAG node; chain from an InputNode."""
-    return _BoundStage(actor, method, upstream)
+def bind(actor, method: str, *upstreams) -> _BoundStage:
+    """actor.method(*upstreams) as a DAG node; leaves are InputNodes."""
+    if not upstreams:
+        raise ValueError("bind needs at least one upstream")
+    return _BoundStage(actor, method, upstreams)
 
 
-class CompiledPipeline:
-    def __init__(self, stages: Sequence[Tuple[Any, str]],
-                 capacity: int = 1 << 20):
-        if not stages:
-            raise ValueError("empty pipeline")
+def _actor_id_of(actor):
+    return actor._actor_id if hasattr(actor, "_actor_id") else actor
+
+
+class CompiledDag:
+    """A compiled static graph. One channel per EDGE; the driver owns the
+    input-edge writers and output-edge readers."""
+
+    def __init__(self, output, capacity: int = 1 << 20):
+        outputs = (output.nodes if isinstance(output, MultiOutputNode)
+                   else [output])
+        if not outputs or not all(isinstance(o, _BoundStage)
+                                  for o in outputs):
+            raise ValueError("compile_dag needs _BoundStage output(s)")
         core = runtime_context.get_core()
-        store = getattr(core, "store", None)
-        if store is None:
-            raise RuntimeError(
-                "compiled DAGs need a driver-side shm store (embedded "
-                "runtime or same-host cluster driver)")
-        self._store = store
-        self._chans = [Channel.create(store, capacity)
-                       for _ in range(len(stages) + 1)]
+        self._core = core
+        self._store = getattr(core, "store", None) \
+            or getattr(core, "_home_store", None)
+        self._kv = core.kv_op
+
+        # ---- collect stages in topological order (DFS postorder) ----
+        stages: List[_BoundStage] = []
+        seen: Dict[int, bool] = {}
+
+        def visit(node):
+            if isinstance(node, InputNode):
+                return
+            if id(node) in seen:
+                if not seen[id(node)]:
+                    raise ValueError("DAG has a cycle")
+                return
+            seen[id(node)] = False
+            for up in node.upstreams:
+                visit(up)
+            seen[id(node)] = True
+            stages.append(node)
+
+        for o in outputs:
+            visit(o)
+        self._stages = stages
+
+        # ---- placement: which node hosts each endpoint ----
+        def node_of(actor) -> Any:
+            aid = _actor_id_of(actor)
+            fn = getattr(core, "_actor_addr", None)
+            if fn is None:
+                return "local"  # embedded runtime: everything same-node
+            try:
+                return tuple(fn(aid))
+            except Exception:  # noqa: BLE001
+                return "remote"
+        driver_node = getattr(core, "_home", "local")
+        if driver_node != "local":
+            driver_node = tuple(driver_node)
+
+        # ---- one channel per edge ----
+        # edge key: (producer id | "input", consumer id); descriptor dicts
+        # are shipped to the stage loops. Driver-attached edges to SAME
+        # node use shm; everything else (incl. actor<->actor off the
+        # driver's node) uses socket channels — shm needs both ends
+        # mapped into the driver's arena.
+        self._in_edges: List[Any] = []      # driver-side writer endpoints
+        self._out_edges: List[Any] = []     # driver-side reader endpoints
+        stage_in: Dict[int, List] = {id(s): [] for s in stages}
+        stage_out: Dict[int, List] = {id(s): [] for s in stages}
+
+        def make_edge(prod_node, cons_node):
+            same = (prod_node == cons_node == driver_node
+                    or prod_node == cons_node == "local")
+            if same and self._store is not None:
+                ch = Channel.create(self._store, capacity)
+                return ch.descriptor(), ch
+            # descriptor carries the READER's (consumer's) node host: the
+            # reader publishes only its port to the KV
+            host = (cons_node[0] if isinstance(cons_node, tuple)
+                    else "127.0.0.1")
+            cid = SocketChannel.create_id()
+            return ("sock", cid, host), None
+
+        self._shm_chans: List[Channel] = []
+        for s in stages:
+            s_node = node_of(s.actor)
+            for up in s.upstreams:
+                if isinstance(up, InputNode):
+                    desc, ch = make_edge(driver_node, s_node)
+                    stage_in[id(s)].append(desc)
+                    self._in_edges.append((desc, ch))
+                else:
+                    desc, ch = make_edge(node_of(up.actor), s_node)
+                    stage_in[id(s)].append(desc)
+                    stage_out[id(up)].append(desc)
+                    if ch is not None:
+                        self._shm_chans.append(ch)
+        for o in outputs:
+            desc, ch = make_edge(node_of(o.actor), driver_node)
+            stage_out[id(o)].append(desc)
+            self._out_edges.append((desc, ch))
+
         # Separate writer/reader locks: a write blocked on the input
         # channel's ack gate (pipeline at capacity) must not stop a reader
         # from draining the output channel — that drain is what unblocks it.
@@ -87,60 +181,83 @@ class CompiledPipeline:
         self._rlock = threading.Lock()
         self._down = False
         self._broken = False
-        # start each stage's resident loop
+        self._n_out = len(outputs)
+        self._single = not isinstance(output, MultiOutputNode)
+
+        # ---- start the resident loops ----
         acks = []
-        for i, (actor, method) in enumerate(stages):
+        for s in stages:
             acks.append(core.submit_actor_task(
-                actor._actor_id if hasattr(actor, "_actor_id") else actor,
-                "__rtpu_dag_start__",
-                (self._chans[i].descriptor(),
-                 self._chans[i + 1].descriptor(), method), {}, 1)[0])
+                _actor_id_of(s.actor), "__rtpu_dag_start__",
+                (stage_in[id(s)], stage_out[id(s)], s.method), {}, 1)[0])
         for ref in acks:
             assert ray_tpu.get(ref, timeout=60) == "ok"
 
+        # driver endpoints (socket endpoints rendezvous lazily; stage
+        # loops are already up, so their reader sides publish)
+        self._inputs = [ch if ch is not None else
+                        open_endpoint(desc, kv=self._kv, role="writer")
+                        for desc, ch in self._in_edges]
+        self._outputs = [ch if ch is not None else
+                         open_endpoint(desc, kv=self._kv, role="reader")
+                         for desc, ch in self._out_edges]
+
+    # ------------------------------------------------------------- calls
+
     def _check_usable(self):
         if self._down:
-            raise RuntimeError("pipeline was torn down")
+            raise RuntimeError("DAG was torn down")
         if self._broken:
             raise RuntimeError(
-                "pipeline is broken (a previous call timed out, so the "
+                "DAG is broken (a previous call timed out, so the "
                 "request/response pairing is no longer trustworthy); "
                 "teardown and recompile")
 
-    def _read_out(self, timeout_ms: int):
-        """FIFO-ordered output read; a timeout poisons the pipeline — the
+    def _read_outs(self, timeout_ms: int):
+        """FIFO-ordered output read; a timeout poisons the DAG — the
         unconsumed in-flight result would otherwise be returned to the
         NEXT caller (off-by-one forever)."""
+        vals = []
         try:
-            return self._chans[-1].read(timeout_ms=timeout_ms)
+            for ch in self._outputs:
+                vals.append(ch.read(timeout_ms=timeout_ms))
         except TimeoutError:
             self._broken = True
             raise
+        return vals
 
     def execute(self, value: Any, timeout_ms: int = 60_000) -> Any:
-        """Synchronous call through the pipeline."""
+        """Synchronous call through the graph."""
         with self._wlock:
             self._check_usable()
-            self._chans[0].write(("v", value), timeout_ms=timeout_ms)
+            for ch in self._inputs:
+                ch.write(("v", value), timeout_ms=timeout_ms)
         with self._rlock:
-            tag, out = self._read_out(timeout_ms)
-        if tag == "e":
-            raise out
-        return out
+            outs = self._read_outs(timeout_ms)
+        vals = []
+        for tag, out in outs:
+            if tag == "e":
+                raise out
+            vals.append(out)
+        return vals[0] if self._single else vals
 
     def execute_async(self, value: Any, timeout_ms: int = 60_000):
         """Returns a 0-arg callable resolving the result (the next read).
         Calls resolve in FIFO order; useful to overlap pipeline stages."""
         with self._wlock:
             self._check_usable()
-            self._chans[0].write(("v", value), timeout_ms=timeout_ms)
+            for ch in self._inputs:
+                ch.write(("v", value), timeout_ms=timeout_ms)
 
         def resolve():
             with self._rlock:
-                tag, out = self._read_out(timeout_ms)
-            if tag == "e":
-                raise out
-            return out
+                outs = self._read_outs(timeout_ms)
+            vals = []
+            for tag, out in outs:
+                if tag == "e":
+                    raise out
+                vals.append(out)
+            return vals[0] if self._single else vals
         return resolve
 
     def teardown(self):
@@ -149,18 +266,29 @@ class CompiledPipeline:
                 return
             self._down = True
         try:
-            self._chans[0].close()
-            # the close sentinel cascades through every stage loop
+            for ch in self._inputs:
+                ch.close()
+            # close sentinels cascade through every stage loop
             with self._rlock:
-                try:
-                    self._chans[-1].read(timeout_ms=5000)
-                except (ChannelClosed, TimeoutError):
-                    pass
+                for ch in self._outputs:
+                    try:
+                        ch.read(timeout_ms=5000)
+                    except Exception:  # noqa: BLE001 — draining best-effort
+                        pass
         finally:
-            for ch in self._chans:
+            for ch in self._inputs + self._outputs + self._shm_chans:
                 ch.release()
 
 
+def compile_dag(output, capacity: int = 1 << 20) -> CompiledDag:
+    """Compile a bound graph (single output node or MultiOutputNode)."""
+    return CompiledDag(output, capacity=capacity)
+
+
 def compile_pipeline(stages: Sequence[Tuple[Any, str]],
-                     capacity: int = 1 << 20) -> CompiledPipeline:
-    return CompiledPipeline(stages, capacity=capacity)
+                     capacity: int = 1 << 20) -> CompiledDag:
+    """Linear chain convenience over compile_dag."""
+    node: Any = InputNode()
+    for actor, method in stages:
+        node = _BoundStage(actor, method, [node])
+    return compile_dag(node, capacity=capacity)

@@ -16,11 +16,20 @@ waits so a dead peer surfaces as a timeout rather than a deadlock.
 
 from __future__ import annotations
 
+import pickle
 import struct
 from typing import Any, Tuple
 
-from ray_tpu.core import serialization
 from ray_tpu.core.ids import ObjectID
+
+
+def _chan_dumps(value: Any) -> bytes:
+    try:
+        return pickle.dumps(value, protocol=5)
+    except Exception:  # noqa: BLE001 — closures etc.: cloudpickle path
+        import cloudpickle
+
+        return cloudpickle.dumps(value, protocol=5)
 
 _SEQ = 0  # counter index: writer publishes
 _ACK = 1  # counter index: reader consumed
@@ -57,14 +66,16 @@ class Channel:
         store.chan_init(ch._offset)
         return ch
 
-    def descriptor(self) -> Tuple[bytes, int]:
+    def descriptor(self) -> Tuple[str, bytes, int]:
         """Picklable descriptor; open with Channel.open on any process
         attached to the same store."""
-        return (self._oid.binary(), self._capacity)
+        return ("shm", self._oid.binary(), self._capacity)
 
     @classmethod
-    def open(cls, store, desc: Tuple[bytes, int]) -> "Channel":
-        return cls(store, ObjectID(desc[0]), desc[1])
+    def open(cls, store, desc) -> "Channel":
+        if desc[0] == "shm":
+            return cls(store, ObjectID(desc[1]), desc[2])
+        return cls(store, ObjectID(desc[0]), desc[1])  # legacy 2-tuple
 
     # -- data plane ----------------------------------------------------------
 
@@ -78,11 +89,14 @@ class Channel:
 
     def write(self, value: Any, timeout_ms: int = 10_000):
         """Serialize + publish; blocks until the reader acked the previous
-        message."""
-        pickled, views, total = serialization.serialize(value)
-        if total > self._capacity:
+        message. The data plane is the C pickler writing straight into the
+        shm slot (a channel hop is latency-critical; the container format
+        with OOB buffers buys nothing at message sizes a slot can hold),
+        with cloudpickle as the fallback for closures/lambdas."""
+        data = _chan_dumps(value)
+        if len(data) > self._capacity:
             raise ValueError(
-                f"channel message ({total}B) exceeds capacity "
+                f"channel message ({len(data)}B) exceeds capacity "
                 f"({self._capacity}B)")
         # overwrite gate: previous message must be consumed
         if self._seq:
@@ -90,9 +104,9 @@ class Channel:
                 self._offset, _ACK, self._seq - 1, timeout_ms)
             if acked == 0:
                 raise TimeoutError("channel reader did not ack in time")
-        body = self._store.view(self._offset + self._hdr, total)
-        serialization.write_container(body, pickled, views)
-        self._set_len(total)
+        body = self._store.view(self._offset + self._hdr, len(data))
+        body[:len(data)] = data
+        self._set_len(len(data))
         self._seq += 1
         self._store.chan_post(self._offset, _SEQ, self._seq)
 
@@ -107,8 +121,8 @@ class Channel:
         length = self._get_len()
         if length == _CLOSE_LEN:
             raise ChannelClosed
-        data = bytes(self._store.view(self._offset + self._hdr, length))
-        value = serialization.unpack(data)
+        value = pickle.loads(
+            self._store.view(self._offset + self._hdr, length))
         # ack: the writer may overwrite now
         self._store.chan_post(self._offset, _ACK, seq)
         return value
@@ -129,3 +143,144 @@ class Channel:
             self._store.release(self._oid)
         except Exception:  # noqa: BLE001
             pass
+
+
+class SocketChannel:
+    """SPSC channel over TCP for CROSS-NODE DAG edges (reference role:
+    the multi-node channels of python/ray/experimental/channel/ — there
+    NCCL/gRPC-backed, here a framed socket riding DCN).
+
+    Rendezvous through the cluster KV: the READER binds an ephemeral port
+    and publishes ``dagchan:<id> -> (host, port)``; the WRITER polls the
+    key and connects. Same rendezvous semantics as the shm channel: the
+    writer blocks until the reader acked the previous message, so at most
+    one message is in flight per edge and FIFO pairing is exact."""
+
+    def __init__(self, chan_id: str, kv, role: str,
+                 timeout_ms: int = 30_000, host: str = "127.0.0.1"):
+        import socket as _socket
+
+        assert role in ("reader", "writer")
+        self._id = chan_id
+        self._kv = kv          # kv(op, key, value=None) -> value
+        self._role = role
+        self._host = host      # reader's node host, set at COMPILE time
+        self._conn = None
+        self._await_ack = False
+        self._sock = None
+        if role == "reader":
+            s = _socket.socket()
+            s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            s.listen(1)
+            self._sock = s
+            # publish only the PORT: the HOST comes from the descriptor,
+            # where the compiler wrote the node's advertised address
+            # (gethostname() resolves to loopback on stock images and
+            # would point cross-node writers at themselves)
+            self._kv("put", f"dagchan:{chan_id}", s.getsockname()[1])
+
+    @classmethod
+    def create_id(cls) -> str:
+        import os as _os
+
+        return _os.urandom(8).hex()
+
+    def descriptor(self) -> Tuple[str, str, str]:
+        return ("sock", self._id, self._host)
+
+    def _ensure_conn(self, timeout_ms: int):
+        import socket as _socket
+        import time as _time
+
+        if self._conn is not None:
+            return
+        if self._role == "reader":
+            self._sock.settimeout(None if timeout_ms < 0
+                                  else max(0.001, timeout_ms / 1000))
+            conn, _ = self._sock.accept()
+        else:
+            wait_s = 30.0 if timeout_ms < 0 else timeout_ms / 1000
+            deadline = _time.monotonic() + wait_s
+            port = None
+            while _time.monotonic() < deadline:
+                port = self._kv("get", f"dagchan:{self._id}")
+                if port:
+                    break
+                _time.sleep(0.01)
+            if not port:
+                raise TimeoutError(
+                    f"socket channel {self._id}: reader never published")
+            conn = _socket.create_connection(
+                (self._host, int(port)),
+                timeout=None if timeout_ms < 0 else timeout_ms / 1000)
+        conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self._conn = conn
+
+    def _recv_exact(self, n: int, timeout_ms: int) -> bytes:
+        self._conn.settimeout(None if timeout_ms < 0
+                              else max(0.001, timeout_ms / 1000))
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._conn.recv(n - len(buf))
+            if not chunk:
+                raise ChannelClosed
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def write(self, value: Any, timeout_ms: int = 10_000):
+        self._ensure_conn(timeout_ms)
+        if self._await_ack:
+            if self._recv_exact(1, timeout_ms) != b"A":
+                raise ChannelClosed
+            self._await_ack = False
+        data = _chan_dumps(value)
+        self._conn.sendall(struct.pack("<Q", len(data)) + data)
+        self._await_ack = True
+
+    def read(self, timeout_ms: int = 10_000) -> Any:
+        self._ensure_conn(timeout_ms)
+        try:
+            (length,) = struct.unpack("<Q", self._recv_exact(8, timeout_ms))
+        except OSError as e:
+            raise TimeoutError(f"socket channel read: {e}") from e
+        if length == _CLOSE_LEN:
+            raise ChannelClosed
+        data = self._recv_exact(length, timeout_ms)
+        value = pickle.loads(data)
+        self._conn.sendall(b"A")
+        return value
+
+    def close(self, timeout_ms: int = 5000):
+        try:
+            self._ensure_conn(timeout_ms)
+            if self._await_ack:
+                self._recv_exact(1, timeout_ms)
+                self._await_ack = False
+            self._conn.sendall(struct.pack("<Q", _CLOSE_LEN))
+        except Exception:  # noqa: BLE001 — dead peer: nothing to close
+            pass
+
+    def release(self):
+        for s in (self._conn, self._sock):
+            if s is not None:
+                try:
+                    s.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        if self._role == "reader":
+            try:
+                self._kv("del", f"dagchan:{self._id}")
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def open_endpoint(desc, store=None, kv=None, role: str = "reader",
+                  timeout_ms: int = 30_000):
+    """Open either channel kind from its descriptor."""
+    if desc[0] == "sock":
+        host = desc[2] if len(desc) > 2 else "127.0.0.1"
+        return SocketChannel(desc[1], kv, role, timeout_ms, host=host)
+    if store is None:
+        raise RuntimeError("shm channel endpoint needs a store")
+    return Channel.open(store, desc)

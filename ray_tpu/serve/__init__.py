@@ -28,13 +28,18 @@ from ray_tpu.serve.api import (  # noqa: F401
     start,
     status,
 )
+from ray_tpu.serve.dag_mode import (  # noqa: F401
+    LLMPipeline,
+    PipelineDeployment,
+)
 from ray_tpu.serve.multiplex import (  # noqa: F401
     get_multiplexed_model_id,
     multiplexed,
 )
 
 __all__ = [
-    "Deployment", "DeploymentHandle", "batch", "delete", "deployment",
-    "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
-    "run", "shutdown", "start", "status",
+    "Deployment", "DeploymentHandle", "LLMPipeline", "PipelineDeployment",
+    "batch", "delete", "deployment", "get_deployment_handle",
+    "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
+    "status",
 ]

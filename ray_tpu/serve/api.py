@@ -120,9 +120,12 @@ class _OptionedHandle:
         if method.startswith("_"):
             raise AttributeError(method)
         if self._model_id is not None:
-            raise ValueError(
-                "multiplexed_model_id applies to __call__ requests "
-                "(handle.remote); method calls are not mux-routed")
+            # AttributeError keeps the attribute protocol intact
+            # (hasattr/getattr-with-default must not explode)
+            raise AttributeError(
+                f"{method}: multiplexed_model_id applies to __call__ "
+                f"requests (handle.remote); method calls are not "
+                f"mux-routed")
         return getattr(self._handle, method)
 
 

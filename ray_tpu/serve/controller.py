@@ -275,6 +275,12 @@ class ServeController:
     def _stop_replica(self, info: _DeploymentInfo, r: _ReplicaInfo):
         info.replicas.pop(r.replica_id, None)
         try:
+            # graceful first: lets DAG-mode replicas tear down their
+            # stage-actor pipelines (they outlive their creator otherwise)
+            ray_tpu.get(r.handle.graceful_shutdown.remote(), timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
             ray_tpu.kill(r.handle)
         except Exception:  # noqa: BLE001
             pass

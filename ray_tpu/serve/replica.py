@@ -44,6 +44,17 @@ class ReplicaActor:
     def ping(self) -> str:
         return "ok"
 
+    def graceful_shutdown(self) -> None:
+        """Pre-kill hook: deployments holding external resources (DAG-mode
+        pipelines with stage actors, engines with device state) clean up
+        here — a bare kill would leak actors that outlive this replica."""
+        inst = self._instance
+        if inst is not None and hasattr(inst, "shutdown"):
+            try:
+                inst.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+
     def is_engine(self) -> bool:
         return self._is_engine
 

@@ -165,3 +165,129 @@ def test_dag_dispatch_latency_vs_actor_calls(dag_ray):
     assert speedup > 1.5, (
         f"dag {dag_lat*1e6:.0f}us vs actors {actor_lat*1e6:.0f}us "
         f"(speedup {speedup:.1f}x)")
+
+
+def test_diamond_dag_fan_out_fan_in(dag_ray):
+    """Branching graph: input fans out to two parallel stages whose
+    outputs join at a combiner (reference: compiled diamond DAGs,
+    python/ray/dag/dag_node_operation.py)."""
+    from ray_tpu.dag import MultiOutputNode, compile_dag
+
+    @ray_tpu.remote
+    class Math:
+        def double(self, x):
+            return x * 2
+
+        def square(self, x):
+            return x * x
+
+        def join(self, a, b):
+            return a + b
+
+    a, b, c = Math.remote(), Math.remote(), Math.remote()
+    with InputNode() as inp:
+        left = bind(a, "double", inp)
+        right = bind(b, "square", inp)
+        out = bind(c, "join", left, right)
+    dag = compile_dag(out)
+    try:
+        for x in range(5):
+            assert dag.execute(x) == 2 * x + x * x
+    finally:
+        dag.teardown()
+
+    # multi-output: both branches surface to the driver
+    with InputNode() as inp:
+        left = bind(a, "double", inp)
+        right = bind(b, "square", inp)
+        multi = MultiOutputNode([left, right])
+    dag = compile_dag(multi)
+    try:
+        assert dag.execute(7) == [14, 49]
+    finally:
+        dag.teardown()
+
+
+def test_diamond_dag_error_propagation(dag_ray):
+    from ray_tpu.dag import compile_dag
+
+    @ray_tpu.remote
+    class M:
+        def ok(self, x):
+            return x
+
+        def boom(self, x):
+            raise ValueError("branch exploded")
+
+        def join(self, a, b):
+            return (a, b)
+
+    a, b, c = M.remote(), M.remote(), M.remote()
+    with InputNode() as inp:
+        out = bind(c, "join", bind(a, "ok", inp), bind(b, "boom", inp))
+    dag = compile_dag(out)
+    try:
+        with pytest.raises(ValueError, match="branch exploded"):
+            dag.execute(1)
+        # pairing intact: the next call still works
+        with pytest.raises(ValueError, match="branch exploded"):
+            dag.execute(2)
+    finally:
+        dag.teardown()
+
+
+def test_cross_node_dag():
+    """A DAG whose stages live on DIFFERENT nodes: edges ride socket
+    channels with KV rendezvous; the diamond joins across the cluster
+    (reference: multi-node compiled DAGs over the channel abstraction,
+    python/ray/experimental/channel/)."""
+    from ray_tpu.core.cluster.fixture import Cluster
+    from ray_tpu.dag import compile_dag, compile_pipeline
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=3, num_workers_per_node=1,
+                node_resources=[{"n0": 4}, {"n1": 4}, {"n2": 4}])
+    try:
+        c.wait_for_nodes(3)
+        c.connect()
+
+        @ray_tpu.remote
+        class Stage:
+            def __init__(self, tag):
+                self.tag = tag
+
+            def step(self, x):
+                return x + [self.tag]
+
+            def join(self, a, b):
+                return (a, b)
+
+        s0 = Stage.options(resources={"n0": 1}).remote("n0")
+        s1 = Stage.options(resources={"n1": 1}).remote("n1")
+        s2 = Stage.options(resources={"n2": 1}).remote("n2")
+        for s in (s0, s1, s2):
+            ray_tpu.get(s.step.remote([]), timeout=60)
+
+        # linear chain spanning three nodes
+        dag = compile_pipeline([(s0, "step"), (s1, "step"), (s2, "step")])
+        try:
+            assert dag.execute([], timeout_ms=120_000) == \
+                ["n0", "n1", "n2"]
+            assert dag.execute(["x"], timeout_ms=120_000) == \
+                ["x", "n0", "n1", "n2"]
+        finally:
+            dag.teardown()
+
+        # diamond across nodes
+        with InputNode() as inp:
+            out = bind(s2, "join", bind(s0, "step", inp),
+                       bind(s1, "step", inp))
+        dag = compile_dag(out)
+        try:
+            assert dag.execute([], timeout_ms=120_000) == (["n0"], ["n1"])
+        finally:
+            dag.teardown()
+    finally:
+        c.shutdown()
+        runtime_context.set_core(prev)

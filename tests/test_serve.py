@@ -219,6 +219,22 @@ def test_batched_admission_matches_single(rt):
     assert all(len(t) == 6 for t in burst.values())
 
 
+def test_serve_dag_mode_llm_pipeline(serve_ray):
+    """Serve DAG mode: a deployment whose replica drives a compiled
+    tokenize -> generate -> detokenize pipeline over channels, requests
+    flowing through it instead of per-stage actor calls (reference role:
+    accelerated-DAG serving, compiled_dag_node.py:482)."""
+
+    h = serve.run(
+        serve.deployment(serve.LLMPipeline).options(name="llm-dag"),
+        name="llm-dag")
+    out = h.remote("hello tpu").result(timeout=180)
+    assert isinstance(out, str) and len(out.split()) >= 2
+    out2 = h.remote("hello tpu").result(timeout=180)
+    assert out2 == out  # greedy decode is deterministic
+    serve.delete("llm-dag")
+
+
 def test_model_multiplexing(serve_ray):
     """@serve.multiplexed: per-replica LRU of model variants, request
     routing by model id, and serve.get_multiplexed_model_id() visibility
