@@ -131,14 +131,24 @@ class NodeRuntime(Runtime):
                     self.kill_actor(aid, no_restart=msg[2])
                     return ("ok",)
                 # actor lives elsewhere: route via the GCS actor table
-                info = srv.gcs.try_call(("list_actors",), default={}) or {}
-                entry = info.get(msg[1])
-                if entry and "node" in entry:
-                    try:
-                        srv._peers.get(tuple(entry["node"])).call(
-                            ("kill_actor", msg[1], msg[2]))
-                    except RpcError:
-                        pass
+                # (brief retry — creation registration may be racing)
+                import sys as _sys
+
+                for _ in range(5):
+                    info = (srv.gcs.try_call(("list_actors",), default={})
+                            or {})
+                    entry = info.get(msg[1])
+                    if entry and "node" in entry:
+                        try:
+                            srv._peers.get(tuple(entry["node"])).call(
+                                ("kill_actor", msg[1], msg[2]))
+                            return ("ok",)
+                        except RpcError:
+                            pass
+                    time.sleep(0.1)
+                print(f"kill_actor: could not route kill for {aid} "
+                      f"(no table entry / peer unreachable) — the actor "
+                      f"may leak", file=_sys.stderr)
                 return ("ok",)
             elif tag == protocol.REQ_ACTOR_CALL:
                 _, actor_id_b, method, args_payload, extra, n_returns = msg

@@ -338,10 +338,16 @@ class WorkerCore:
             ins: list = []
             outs: list = []
             try:
-                ins = [open_endpoint(d, store=self.store, kv=self.kv_op,
-                                     role="reader") for d in in_descs]
-                outs = [open_endpoint(d, store=self.store, kv=self.kv_op,
-                                      role="writer") for d in out_descs]
+                # append one by one: a failure partway must not orphan
+                # the endpoints already opened (a bound socket reader has
+                # published its rendezvous key by now)
+                for d in in_descs:
+                    ins.append(open_endpoint(d, store=self.store,
+                                             kv=self.kv_op, role="reader"))
+                for d in out_descs:
+                    outs.append(open_endpoint(d, store=self.store,
+                                              kv=self.kv_op,
+                                              role="writer"))
             except Exception as e:  # noqa: BLE001
                 # a real setup failure must not present as a silent hang:
                 # log it, and try to push the error downstream so the

@@ -125,8 +125,13 @@ class CompiledDag:
                 return "local"  # embedded runtime: everything same-node
             try:
                 return tuple(fn(aid))
-            except Exception:  # noqa: BLE001
-                return "remote"
+            except Exception as e:  # noqa: BLE001
+                # an unplaceable actor wired with a guessed host would
+                # surface as an undiagnosable execute() timeout — fail
+                # the COMPILE instead
+                raise ValueError(
+                    f"cannot compile DAG: actor {aid} has no known node "
+                    f"(dead, or not yet registered): {e!r}") from e
         driver_node = getattr(core, "_home", "local")
         if driver_node != "local":
             driver_node = tuple(driver_node)
@@ -228,10 +233,13 @@ class CompiledDag:
 
     def execute(self, value: Any, timeout_ms: int = 60_000) -> Any:
         """Synchronous call through the graph."""
+        from ray_tpu.dag.channel import _chan_dumps
+
+        data = _chan_dumps(("v", value))  # serialize ONCE for the fan-out
         with self._wlock:
             self._check_usable()
             for ch in self._inputs:
-                ch.write(("v", value), timeout_ms=timeout_ms)
+                ch.write_raw(data, timeout_ms=timeout_ms)
         with self._rlock:
             outs = self._read_outs(timeout_ms)
         vals = []
@@ -244,10 +252,13 @@ class CompiledDag:
     def execute_async(self, value: Any, timeout_ms: int = 60_000):
         """Returns a 0-arg callable resolving the result (the next read).
         Calls resolve in FIFO order; useful to overlap pipeline stages."""
+        from ray_tpu.dag.channel import _chan_dumps
+
+        data = _chan_dumps(("v", value))
         with self._wlock:
             self._check_usable()
             for ch in self._inputs:
-                ch.write(("v", value), timeout_ms=timeout_ms)
+                ch.write_raw(data, timeout_ms=timeout_ms)
 
         def resolve():
             with self._rlock:

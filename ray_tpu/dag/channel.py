@@ -93,7 +93,10 @@ class Channel:
         shm slot (a channel hop is latency-critical; the container format
         with OOB buffers buys nothing at message sizes a slot can hold),
         with cloudpickle as the fallback for closures/lambdas."""
-        data = _chan_dumps(value)
+        self.write_raw(_chan_dumps(value), timeout_ms)
+
+    def write_raw(self, data: bytes, timeout_ms: int = 10_000):
+        """Publish pre-pickled bytes (fan-out callers serialize ONCE)."""
         if len(data) > self._capacity:
             raise ValueError(
                 f"channel message ({len(data)}B) exceeds capacity "
@@ -229,12 +232,14 @@ class SocketChannel:
         return bytes(buf)
 
     def write(self, value: Any, timeout_ms: int = 10_000):
+        self.write_raw(_chan_dumps(value), timeout_ms)
+
+    def write_raw(self, data: bytes, timeout_ms: int = 10_000):
         self._ensure_conn(timeout_ms)
         if self._await_ack:
             if self._recv_exact(1, timeout_ms) != b"A":
                 raise ChannelClosed
             self._await_ack = False
-        data = _chan_dumps(value)
         self._conn.sendall(struct.pack("<Q", len(data)) + data)
         self._await_ack = True
 

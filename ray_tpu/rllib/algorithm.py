@@ -109,6 +109,11 @@ class Algorithm(RunnerDriver):
         self.module_spec = {"obs_dim": probe.obs_dim,
                             "num_actions": probe.num_actions,
                             "hidden": config.module_hidden}
+        # pixel envs advertise obs_shape: the module factory then builds
+        # the conv encoder instead of the MLP (reference: catalog picks
+        # the CNN encoder from the obs space, encoder.py:107)
+        if getattr(probe, "obs_shape", None):
+            self.module_spec["obs_shape"] = tuple(probe.obs_shape)
         self.learner = self._build_learner()
         self.runners = [
             EnvRunner.remote(config.env_name, config.num_envs_per_runner,

@@ -21,7 +21,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.envs import make_env
-from ray_tpu.rllib.rl_module import MLPModule
+from ray_tpu.rllib.rl_module import build_pv_module
 
 
 class _EpisodeTracker:
@@ -60,7 +60,7 @@ class EnvRunner(_EpisodeTracker):
                  seed: int = 0):
         self.env_name = env_name
         self.env = make_env(env_name, num_envs, seed=seed)
-        self.module = MLPModule(**module_spec)
+        self.module = build_pv_module(module_spec)
         self.rollout_len = rollout_len
         self.gamma = gamma
         self.lam = lam

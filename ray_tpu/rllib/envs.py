@@ -136,7 +136,68 @@ class PendulumVec:
         return self._obs(), (-cost).astype(np.float32), terminated, truncated
 
 
-ENVS = {"CartPole-v1": CartPoleVec, "Pendulum-v1": PendulumVec}
+class CatchPixelsVec:
+    """Procedural PIXEL-observation env (the image ships no ALE; this is
+    the Atari-shaped stand-in the CNN path trains on): a ball falls down
+    a GRID x GRID frame, a 3-cell paddle slides along the bottom row,
+    reward +1 on catch / -1 on miss at the bottom, episode length = GRID-1
+    steps. Observations are raw pixels, flattened [N, GRID*GRID] float32
+    (module reshapes to (H, W, 1) — see rl_module.CNNModule). Random play
+    scores ~-0.25; a learned policy approaches +1.
+    """
+
+    GRID = 10
+    obs_dim = GRID * GRID
+    obs_shape = (GRID, GRID, 1)
+    num_actions = 3  # left, stay, right
+    max_steps = GRID - 1
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        self.n = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.ball = np.zeros((num_envs, 2), np.int64)   # row, col
+        self.paddle = np.zeros(num_envs, np.int64)      # center col
+        self.reset()
+
+    def _respawn(self, idx):
+        self.ball[idx, 0] = 0
+        self.ball[idx, 1] = self.rng.integers(0, self.GRID, size=len(idx))
+        self.paddle[idx] = self.rng.integers(1, self.GRID - 1,
+                                             size=len(idx))
+
+    def _render(self) -> np.ndarray:
+        g = self.GRID
+        frame = np.zeros((self.n, g, g), np.float32)
+        env_i = np.arange(self.n)
+        frame[env_i, self.ball[:, 0], self.ball[:, 1]] = 1.0
+        for d in (-1, 0, 1):
+            cols = np.clip(self.paddle + d, 0, g - 1)
+            frame[env_i, g - 1, cols] = 0.5
+        return frame.reshape(self.n, -1)
+
+    def reset(self) -> np.ndarray:
+        self._respawn(np.arange(self.n))
+        return self._render()
+
+    def step(self, actions: np.ndarray):
+        self.paddle = np.clip(self.paddle + (actions.astype(np.int64) - 1),
+                              1, self.GRID - 2)
+        self.ball[:, 0] += 1
+        at_bottom = self.ball[:, 0] >= self.GRID - 1
+        caught = at_bottom & (np.abs(self.ball[:, 1] - self.paddle) <= 1)
+        reward = np.where(at_bottom,
+                          np.where(caught, 1.0, -1.0), 0.0
+                          ).astype(np.float32)
+        terminated = at_bottom
+        truncated = np.zeros(self.n, bool)
+        self.final_obs = self._render()
+        if at_bottom.any():
+            self._respawn(np.nonzero(at_bottom)[0])
+        return self._render(), reward, terminated, truncated
+
+
+ENVS = {"CartPole-v1": CartPoleVec, "Pendulum-v1": PendulumVec,
+        "CatchPixels-v0": CatchPixelsVec}
 
 
 def make_env(name: str, num_envs: int, seed: int = 0):

@@ -18,7 +18,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.algorithm import AlgorithmConfig, RunnerDriver
-from ray_tpu.rllib.rl_module import MLPModule, to_numpy
+from ray_tpu.rllib.rl_module import MLPModule, build_pv_module, to_numpy
 
 
 class ImpalaLearner:
@@ -162,7 +162,9 @@ class IMPALA(RunnerDriver):
         self.module_spec = {"obs_dim": probe.obs_dim,
                             "num_actions": probe.num_actions,
                             "hidden": config.module_hidden}
-        self.learner = ImpalaLearner(MLPModule(**self.module_spec),
+        if getattr(probe, "obs_shape", None):
+            self.module_spec["obs_shape"] = tuple(probe.obs_shape)
+        self.learner = ImpalaLearner(build_pv_module(self.module_spec),
                                      lr=config.lr, gamma=config.gamma,
                                      seed=config.seed, **kw)
         self.runners = [

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.learner import PPOLearner
-from ray_tpu.rllib.rl_module import MLPModule
+from ray_tpu.rllib.rl_module import build_pv_module
 
 
 class PPOConfig(AlgorithmConfig):
@@ -25,5 +25,5 @@ class PPO(Algorithm):
         cfg = self.config
         kw = dict(cfg.train_kwargs)
         kw.pop("lam", None)
-        return PPOLearner(MLPModule(**self.module_spec), lr=cfg.lr,
+        return PPOLearner(build_pv_module(self.module_spec), lr=cfg.lr,
                           seed=cfg.seed, **kw)

@@ -64,6 +64,114 @@ class MLPModule:
         return logits, value
 
 
+class CNNModule:
+    """Policy+value CONV encoder for pixel observations (reference:
+    rllib/core/models/torch/encoder.py:107 TorchCNNEncoder + the Atari
+    PPO/IMPALA configs). TPU-first: the convs are lax.conv NHWC programs
+    that jit into the same single-program learners as the MLP; observations
+    travel FLAT [B, H*W*C] through the runner/learner plumbing (so buffers
+    and batching stay shape-agnostic) and are reshaped inside apply.
+
+    Host-side inference jits the same pure function on CPU once per
+    process — a hand-written numpy conv would be slower than the XLA CPU
+    kernel it duplicates."""
+
+    def __init__(self, obs_shape: Sequence[int], num_actions: int,
+                 channels: Sequence[int] = (16, 32),
+                 kernels: Sequence[int] = (4, 3),
+                 strides: Sequence[int] = (2, 1),
+                 hidden: Sequence[int] = (128,), obs_dim: int = 0):
+        del obs_dim  # derived from obs_shape; accepted for spec parity
+        self.obs_shape = tuple(obs_shape)      # (H, W, C)
+        self.obs_dim = int(np.prod(obs_shape))
+        self.num_actions = num_actions
+        self.channels = tuple(channels)
+        self.kernels = tuple(kernels)
+        self.strides = tuple(strides)
+        self.hidden = tuple(hidden)
+        self._apply_cpu = None
+
+    def _conv_out_size(self) -> int:
+        h, w, _ = self.obs_shape
+        for k, s in zip(self.kernels, self.strides):
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+        return h * w * self.channels[-1]
+
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        n_conv = len(self.channels)
+        keys = jax.random.split(jax.random.PRNGKey(seed),
+                                n_conv + len(self.hidden) + 2)
+        params: Dict[str, Any] = {"conv": []}
+        cin = self.obs_shape[-1]
+        for i, (cout, k) in enumerate(zip(self.channels, self.kernels)):
+            fan_in = k * k * cin
+            params["conv"].append({
+                "w": jax.random.normal(keys[i], (k, k, cin, cout))
+                * np.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((cout,)),
+            })
+            cin = cout
+        sizes = (self._conv_out_size(),) + self.hidden
+        params["trunk"] = []
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            params["trunk"].append({
+                "w": jax.random.normal(keys[n_conv + i], (a, b))
+                * np.sqrt(2.0 / a),
+                "b": jnp.zeros((b,)),
+            })
+        h = sizes[-1]
+        params["pi"] = {
+            "w": jax.random.normal(keys[-2], (h, self.num_actions)) * 0.01,
+            "b": jnp.zeros((self.num_actions,)),
+        }
+        params["v"] = {"w": jax.random.normal(keys[-1], (h, 1)),
+                       "b": jnp.zeros((1,))}
+        return params
+
+    def apply(self, params, obs) -> Tuple[Any, Any]:
+        """obs [B, H*W*C] -> (logits [B, A], value [B]). jax-traceable."""
+        import jax
+        import jax.numpy as jnp
+
+        x = obs.reshape((-1,) + self.obs_shape)
+        for layer, s in zip(params["conv"], self.strides):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + layer["b"])
+        x = x.reshape((x.shape[0], -1))
+        for layer in params["trunk"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["v"]["w"] + params["v"]["b"])[..., 0]
+        return logits, value
+
+    def apply_np(self, params_np, obs: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """Runner-side inference: the SAME pure function, jitted once on
+        the host CPU (XLA's conv beats any numpy re-implementation)."""
+        import jax
+
+        if self._apply_cpu is None:
+            cpu = jax.devices("cpu")[0]
+            self._apply_cpu = jax.jit(self.apply, device=cpu)
+        logits, value = self._apply_cpu(params_np, obs)
+        return np.asarray(logits), np.asarray(value)
+
+
+def build_pv_module(spec: dict):
+    """Policy+value module from a spec dict: pixel specs (obs_shape) get
+    the conv encoder, vector specs the MLP."""
+    if spec.get("obs_shape"):
+        return CNNModule(**spec)
+    return MLPModule(**{k: v for k, v in spec.items()
+                        if k != "obs_shape"})
+
+
 def _init_mlp(keys, sizes, out_scale_last: float = 0.01):
     """He-init dense stack; last layer down-scaled (stable policy heads)."""
     import jax

@@ -274,16 +274,25 @@ class ServeController:
 
     def _stop_replica(self, info: _DeploymentInfo, r: _ReplicaInfo):
         info.replicas.pop(r.replica_id, None)
-        try:
-            # graceful first: lets DAG-mode replicas tear down their
-            # stage-actor pipelines (they outlive their creator otherwise)
-            ray_tpu.get(r.handle.graceful_shutdown.remote(), timeout=10)
-        except Exception:  # noqa: BLE001
-            pass
-        try:
-            ray_tpu.kill(r.handle)
-        except Exception:  # noqa: BLE001
-            pass
+        handle = r.handle
+
+        def stop():
+            try:
+                # graceful first: lets DAG-mode replicas tear down their
+                # stage-actor pipelines (they would outlive their creator)
+                ray_tpu.get(handle.graceful_shutdown.remote(), timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+
+        # background: call sites hold the controller lock — a busy
+        # replica must not stall the whole control plane for its grace
+        # period
+        threading.Thread(target=stop, daemon=True,
+                         name="replica-stop").start()
 
     def _health_check(self):
         now = time.monotonic()

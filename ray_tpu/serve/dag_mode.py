@@ -104,12 +104,15 @@ class _Generate:
     def ready(self):
         return True
 
+    PREFILL_BUCKET = 32  # one static prefill shape for the pipeline stage
+
     def run(self, tokens: List[int]) -> List[int]:
         import numpy as np
 
         jnp = self._jnp
-        toks = tokens[: self._max_len - self._max_new - 1]
-        rows = np.zeros((1, 32), np.int32)
+        # truncate to the prefill bucket (minus the sampled first token)
+        toks = tokens[: self.PREFILL_BUCKET - 1]
+        rows = np.zeros((1, self.PREFILL_BUCKET), np.int32)
         rows[0, : len(toks)] = toks
         logits, kv = self._prefill(jnp.asarray(rows),
                                    jnp.asarray([len(toks) - 1], np.int32))

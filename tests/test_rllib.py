@@ -70,6 +70,98 @@ def test_learner_update_improves_objective():
     assert np.isfinite(list(metrics.values())).all()
 
 
+def test_catch_pixels_env_dynamics():
+    from ray_tpu.rllib.envs import CatchPixelsVec
+
+    env = CatchPixelsVec(4, seed=0)
+    obs = env.reset()
+    assert obs.shape == (4, 100)
+    assert env.obs_shape == (10, 10, 1)
+    # ball pixel (1.0) and 3-wide paddle (0.5) are rendered
+    assert (obs == 1.0).sum(axis=1).tolist() == [1, 1, 1, 1]
+    assert (obs == 0.5).sum(axis=1).tolist() == [3, 3, 3, 3]
+    total, done_count = 0.0, 0
+    for _ in range(9 * 5):
+        obs, rew, term, trunc = env.step(
+            np.random.default_rng(1).integers(0, 3, 4))
+        total += rew.sum()
+        done_count += int(term.sum())
+    assert done_count == 4 * 5  # episodes are exactly GRID-1 steps
+
+
+def test_cnn_module_mesh_shardable():
+    """The conv module is one pure jax function: it jits over a dp mesh
+    with the batch sharded across all 8 virtual devices (the learner can
+    scale data-parallel without touching the module)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.rllib.rl_module import CNNModule
+
+    mod = CNNModule(obs_shape=(10, 10, 1), num_actions=3)
+    params = mod.init_params(0)
+    mesh = build_mesh(MeshSpec({"dp": len(jax.devices())}))
+    obs = jax.device_put(jnp.ones((16, 100), jnp.float32),
+                         NamedSharding(mesh, P("dp", None)))
+    logits, value = jax.jit(mod.apply)(params, obs)
+    assert logits.shape == (16, 3) and value.shape == (16,)
+
+
+def test_ppo_cnn_learns_pixel_catch(rl_ray):
+    """CNN RLModule + pixel env (BASELINE config #4's Atari path, sans
+    ALE): PPO with the conv encoder must go from random (~-0.3) to
+    catching (>0.6) in CI minutes. Reference:
+    rllib/core/models/torch/encoder.py:107 + ppo Atari configs."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("CatchPixels-v0")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=16,
+                         rollout_fragment_length=64)
+            .training(lr=1e-3, gamma=0.99)
+            .debugging(seed=0)
+            .build())
+    # the conv encoder actually engaged
+    from ray_tpu.rllib.rl_module import CNNModule
+    assert isinstance(algo.learner.module, CNNModule)
+    try:
+        best = -1.0
+        for _ in range(40):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"] or -1.0)
+            if best >= 0.6:
+                break
+        assert best >= 0.6, f"pixel PPO failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_impala_cnn_learns_pixel_catch(rl_ray):
+    """IMPALA (async actor-learner, V-trace) with the conv encoder on the
+    pixel env."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = (IMPALAConfig()
+            .environment("CatchPixels-v0")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=16,
+                         rollout_fragment_length=32)
+            .training(lr=1e-3, gamma=0.99)
+            .debugging(seed=0)
+            .build())
+    try:
+        best = -1.0
+        for _ in range(60):
+            result = algo.train()
+            best = max(best, result.get("episode_return_mean") or -1.0)
+            if best >= 0.5:
+                break
+        assert best >= 0.5, f"pixel IMPALA failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
 def test_ppo_cartpole_reaches_450(rl_ray):
     from ray_tpu.rllib import PPOConfig
 
