@@ -627,6 +627,9 @@ class NodeServer:
     def _op_state(self):
         return self.runtime.state_summary()
 
+    def _op_stack_dump(self):
+        return self.runtime.stack_dump()
+
     def _op_list_logs(self):
         from ray_tpu.core.log_monitor import list_log_files
 

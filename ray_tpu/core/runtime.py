@@ -1977,19 +1977,78 @@ class Runtime:
 
     # -------------------------------------------------------------- lifecycle
 
+    def stack_dump(self, timeout_s: float = 2.0) -> Dict[str, str]:
+        """Live profile of every worker: SIGUSR1 triggers each worker's
+        stack-dump handler, then the dump files are collected
+        (reference role: the dashboard's py-spy stack endpoint). Returns
+        {worker_id_hex: stacks_text}."""
+        import signal as _signal
+
+        from ray_tpu.core.proc_stats import stack_dump_path
+
+        with self._lock:
+            targets = [(w.worker_id.hex(), w.proc.pid)
+                       for w in self._workers.values()
+                       if w.alive and w.proc is not None]
+        paths = {}
+        for wid, pid in targets:
+            path = stack_dump_path(pid)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            try:
+                os.kill(pid, _signal.SIGUSR1)
+                paths[wid] = path
+            except OSError:
+                continue
+        out: Dict[str, str] = {}
+        deadline = time.monotonic() + timeout_s
+        while paths and time.monotonic() < deadline:
+            for wid, path in list(paths.items()):
+                try:
+                    with open(path) as f:
+                        out[wid] = f.read()
+                    paths.pop(wid)
+                    os.unlink(path)
+                except OSError:
+                    continue
+            if paths:
+                time.sleep(0.02)
+        for wid in paths:
+            out[wid] = "<no dump: worker busy in non-python code>"
+        return out
+
     def state_summary(self) -> dict:
         """Introspection snapshot for the state API (reference:
         python/ray/util/state/api.py:781 backed by the GCS/raylet state
         services; here the runtime answers directly)."""
+        from ray_tpu.core.proc_stats import CpuTracker
+
+        if not hasattr(self, "_cpu_tracker"):
+            self._cpu_tracker = CpuTracker()
         with self._lock:
-            workers = [{
-                "worker_id": w.worker_id.hex(),
-                "pid": w.proc.pid if w.proc else None,
-                "alive": w.alive,
-                "actor_id": w.actor_id.hex() if w.actor_id else None,
-                "inflight": len(w.inflight),
-                "blocked": w.blocked,
-            } for w in self._workers.values()]
+            self._cpu_tracker.prune(
+                w.proc.pid for w in self._workers.values()
+                if w.proc is not None)
+            workers = []
+            for w in self._workers.values():
+                pid = w.proc.pid if w.proc else None
+                entry = {
+                    "worker_id": w.worker_id.hex(),
+                    "pid": pid,
+                    "alive": w.alive,
+                    "actor_id": w.actor_id.hex() if w.actor_id else None,
+                    "inflight": len(w.inflight),
+                    "blocked": w.blocked,
+                }
+                # per-process CPU/RSS from /proc (reference:
+                # reporter_agent.py:428 via psutil)
+                if pid is not None and w.alive:
+                    ps = self._cpu_tracker.stats(pid)
+                    if ps is not None:
+                        entry.update(ps)
+                workers.append(entry)
             actors = [{
                 "actor_id": s.actor_id.hex(),
                 "name": s.name,

@@ -635,6 +635,34 @@ def main():
             raise KeyboardInterrupt
 
     signal.signal(signal.SIGINT, _on_sigint)
+
+    # Live profiling hook (reference role: the dashboard's py-spy stack
+    # endpoint, reporter_agent.py): SIGUSR1 dumps every thread's Python
+    # stack — with the CURRENT task id for attribution — to a well-known
+    # file the driver collects. The handler runs between bytecodes, so a
+    # busy worker can be profiled without stopping it.
+    def _on_sigusr1(signum, frame):
+        import sys as _sys
+        import traceback as _tb
+
+        from ray_tpu.core.proc_stats import stack_dump_path
+
+        path = stack_dump_path(os.getpid())
+        try:
+            # tmp + rename: the collector polls the final path and must
+            # never observe a partial write
+            with open(path + ".tmp", "w") as f:
+                f.write(f"pid {os.getpid()} task="
+                        f"{core.current_task_id} actor="
+                        f"{core.current_actor_id}\n")
+                for tid, fr in _sys._current_frames().items():
+                    f.write(f"\n--- thread {tid} ---\n")
+                    f.write("".join(_tb.format_stack(fr)))
+            os.replace(path + ".tmp", path)
+        except Exception:  # noqa: BLE001 — profiling must never kill
+            pass
+
+    signal.signal(signal.SIGUSR1, _on_sigusr1)
     try:
         core.run_loop()
     finally:

@@ -119,19 +119,26 @@ class CompiledDag:
 
         # ---- placement: which node hosts each endpoint ----
         def node_of(actor) -> Any:
+            import time as _time
+
             aid = _actor_id_of(actor)
             fn = getattr(core, "_actor_addr", None)
             if fn is None:
                 return "local"  # embedded runtime: everything same-node
-            try:
-                return tuple(fn(aid))
-            except Exception as e:  # noqa: BLE001
-                # an unplaceable actor wired with a guessed host would
-                # surface as an undiagnosable execute() timeout — fail
-                # the COMPILE instead
-                raise ValueError(
-                    f"cannot compile DAG: actor {aid} has no known node "
-                    f"(dead, or not yet registered): {e!r}") from e
+            # brief retry: a just-created actor's registration may still
+            # be racing compile; an actor that never appears fails the
+            # COMPILE loudly (a guessed host would surface as an
+            # undiagnosable execute() timeout instead)
+            last: Any = None
+            for _ in range(25):
+                try:
+                    return tuple(fn(aid))
+                except Exception as e:  # noqa: BLE001
+                    last = e
+                    _time.sleep(0.2)
+            raise ValueError(
+                f"cannot compile DAG: actor {aid} has no known node "
+                f"(dead, or never registered): {last!r}") from last
         driver_node = getattr(core, "_home", "local")
         if driver_node != "local":
             driver_node = tuple(driver_node)

@@ -145,6 +145,16 @@ def cmd_state(args):
     print(json.dumps(fn(), indent=2, default=str))
 
 
+def cmd_stack(args):
+    """Live worker stacks (py-spy-style profiling surface)."""
+    del args
+    _connect()
+    from ray_tpu import state
+
+    for wid, text in state.stack_dump().items():
+        print(f"===== worker {wid} =====\n{text}")
+
+
 def cmd_logs(args):
     _connect()
     from ray_tpu import state
@@ -210,6 +220,9 @@ def main(argv=None):
     sp.add_argument("what", choices=["nodes", "actors", "workers", "tasks",
                                      "objects", "summary"])
     sp.set_defaults(fn=cmd_state)
+
+    sp = sub.add_parser("stack", help="dump live worker stacks (profiling)")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("logs", help="list/tail session worker logs")
     sp.add_argument("file", nargs="?", default=None,

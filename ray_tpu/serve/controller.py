@@ -163,6 +163,12 @@ class ServeController:
                 for r in list(info.replicas.values()):
                     self._stop_replica(info, r)
             self._deployments.clear()
+        # wait for the backgrounded stops: returning before replicas (and
+        # their DAG stage actors) are gone would leak them past process
+        # teardown
+        deadline = time.monotonic() + 15.0
+        for t in getattr(self, "_stop_threads", []):
+            t.join(max(0.1, deadline - time.monotonic()))
 
     # --------------------------------------------------------- control loop
 
@@ -290,9 +296,15 @@ class ServeController:
 
         # background: call sites hold the controller lock — a busy
         # replica must not stall the whole control plane for its grace
-        # period
-        threading.Thread(target=stop, daemon=True,
-                         name="replica-stop").start()
+        # period. The threads are tracked so shutdown() can join them
+        # (a daemon thread killed at exit would leak the stage actors
+        # the graceful path exists to reclaim).
+        t = threading.Thread(target=stop, daemon=True, name="replica-stop")
+        if not hasattr(self, "_stop_threads"):
+            self._stop_threads = []
+        self._stop_threads = [x for x in self._stop_threads
+                              if x.is_alive()] + [t]
+        t.start()
 
     def _health_check(self):
         now = time.monotonic()

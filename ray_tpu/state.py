@@ -96,6 +96,28 @@ def list_workers() -> List[dict]:
     return core.state_summary()["workers"]
 
 
+def stack_dump() -> Dict[str, str]:
+    """Live stacks of every worker across the cluster — the py-spy-style
+    profiling surface (reference: dashboard worker-stack endpoint).
+    Returns {worker_id_hex (prefixed by node in cluster mode): text}."""
+    from ray_tpu.core.cluster.rpc import RpcError
+
+    core = _core()
+    if _is_cluster(core):
+        out: Dict[str, str] = {}
+        for n in core.nodes():
+            try:
+                dumps = core._nodes.get(tuple(n["address"])).call(
+                    ("stack_dump",))
+            except RpcError:
+                continue
+            nid = n["node_id"].hex()[:8]
+            out.update({f"{nid}:{wid}": text
+                        for wid, text in dumps.items()})
+        return out
+    return core.stack_dump()
+
+
 def summarize_tasks() -> Dict[str, Any]:
     core = _core()
     if _is_cluster(core):

@@ -128,6 +128,101 @@ def test_job_submission(cluster2):
         os.environ.pop("RTPU_CLUSTER_AUTHKEY", None)
 
 
+def test_worker_proc_stats_and_stack_dump(rt):
+    """Observability depth: per-worker CPU/RSS from /proc in the state
+    API (reference: reporter_agent.py:428) and live py-spy-style stack
+    dumps of a BUSY worker showing the executing function."""
+    import time as _time
+
+    from ray_tpu import state
+
+    @ray_tpu.remote
+    def spin_for(seconds):
+        deadline = _time.time() + seconds
+        while _time.time() < deadline:
+            sum(range(1000))
+        return "done"
+
+    ref = spin_for.remote(6.0)
+    _time.sleep(1.0)
+
+    workers = state.list_workers()
+    assert workers, "no workers listed"
+    stats_seen = [w for w in workers if "rss_bytes" in w]
+    assert stats_seen, f"no proc stats in worker rows: {workers}"
+    assert all(w["rss_bytes"] > 1 << 20 for w in stats_seen)
+    # second sample gives a cpu_percent delta; the spinning worker burns
+    state.list_workers()
+    _time.sleep(0.5)
+    busy = [w for w in state.list_workers()
+            if w.get("cpu_percent", 0) > 10]
+    assert busy, "spinning worker shows no CPU"
+
+    dumps = state.stack_dump()
+    assert dumps, "no stack dumps collected"
+    assert any("spin_for" in text for text in dumps.values()), (
+        f"busy worker's executing frame missing: {list(dumps)}")
+    assert ray_tpu.get(ref, timeout=60) == "done"
+
+
+def test_gce_tpu_provider_mocked_api():
+    """GCE TPU-VM provider against a mocked REST API (the reference tests
+    its cloud providers the same way, python/ray/tests/aws/): launch
+    creates a TPU node with the join-cluster startup script, listing
+    filters by cluster label and live state, terminate deletes the node
+    whose endpoint matches the departing cluster address."""
+    from ray_tpu.autoscaler import GceTpuNodeProvider
+
+    calls = []
+    nodes = {}
+
+    def transport(method, url, body=None):
+        calls.append((method, url, body))
+        if method == "POST":
+            name = url.split("nodeId=")[1]
+            full = f"projects/p/locations/z/nodes/{name}"
+            nodes[full] = dict(body, name=full, state="READY",
+                               networkEndpoints=[
+                                   {"ipAddress": f"10.0.0.{len(nodes)+1}"}])
+            return {"name": f"operations/{name}"}
+        if method == "GET":
+            return {"nodes": list(nodes.values())}
+        if method == "DELETE":
+            path = url.split("/v2/")[1]
+            nodes[path]["state"] = "DELETING"
+            return {}
+        raise AssertionError(f"unexpected {method}")
+
+    p = GceTpuNodeProvider("p", "z", ("10.9.9.9", 7000),
+                           accelerator_type="v5litepod-4",
+                           authkey_hex="cafe", transport=transport)
+    p.launch_node()
+    p.launch_node()
+    method, url, body = calls[0]
+    assert method == "POST" and "nodeId=rtpu-node-1" in url
+    assert body["acceleratorType"] == "v5litepod-4"
+    script = body["metadata"]["startup-script"]
+    assert "--address 10.9.9.9:7000" in script
+    assert "RTPU_CLUSTER_AUTHKEY=cafe" in script
+    # label value sanitized to the GCE charset (no dots)
+    assert body["labels"]["rtpu-cluster"] == "10-9-9-9-7000"
+
+    live = p.non_terminated_nodes()
+    assert len(live) == 2
+
+    # a node from ANOTHER cluster must be invisible
+    nodes["projects/p/locations/z/nodes/other"] = {
+        "name": "projects/p/locations/z/nodes/other", "state": "READY",
+        "labels": {"rtpu-cluster": "elsewhere"}, "networkEndpoints": []}
+    assert len(p.non_terminated_nodes()) == 2
+
+    # terminate by cluster address -> DELETE of the matching TPU node
+    p.terminate_node(("10.0.0.1", 9999))
+    deletes = [c for c in calls if c[0] == "DELETE"]
+    assert len(deletes) == 1 and "rtpu-node-1" in deletes[0][1]
+    assert len(p.non_terminated_nodes()) == 1
+
+
 def test_autoscaler_scales_up_and_down():
     from ray_tpu.autoscaler import AutoscalerMonitor, SubprocessNodeProvider
 
