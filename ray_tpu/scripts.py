@@ -145,6 +145,21 @@ def cmd_state(args):
     print(json.dumps(fn(), indent=2, default=str))
 
 
+def cmd_serve(args):
+    _connect()
+    from ray_tpu import serve
+
+    if args.action == "deploy":
+        if not args.config:
+            raise SystemExit("usage: ray_tpu serve deploy <config.yaml>")
+        names = serve.deploy_config(args.config)
+        print(json.dumps({"deployed": names}))
+    elif args.action == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.action == "shutdown":
+        serve.shutdown()
+
+
 def cmd_stack(args):
     """Live worker stacks (py-spy-style profiling surface)."""
     del args
@@ -223,6 +238,12 @@ def main(argv=None):
 
     sp = sub.add_parser("stack", help="dump live worker stacks (profiling)")
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("serve", help="declarative serve deploys")
+    sp.add_argument("action", choices=["deploy", "status", "shutdown"])
+    sp.add_argument("config", nargs="?", default=None,
+                    help="config file (for deploy)")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("logs", help="list/tail session worker logs")
     sp.add_argument("file", nargs="?", default=None,

@@ -32,6 +32,8 @@ from ray_tpu.serve.dag_mode import (  # noqa: F401
     LLMPipeline,
     PipelineDeployment,
 )
+from ray_tpu.serve.config_deploy import apply as deploy_config  # noqa: F401
+from ray_tpu.serve.grpc_proxy import start_grpc, stop_grpc  # noqa: F401
 from ray_tpu.serve.multiplex import (  # noqa: F401
     get_multiplexed_model_id,
     multiplexed,
@@ -39,7 +41,7 @@ from ray_tpu.serve.multiplex import (  # noqa: F401
 
 __all__ = [
     "Deployment", "DeploymentHandle", "LLMPipeline", "PipelineDeployment",
-    "batch", "delete", "deployment", "get_deployment_handle",
-    "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
-    "status",
+    "batch", "delete", "deploy_config", "deployment",
+    "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
+    "run", "shutdown", "start", "start_grpc", "status", "stop_grpc",
 ]

@@ -241,17 +241,22 @@ def status() -> Dict[str, Any]:
 
 
 def delete(name: str):
+    from ray_tpu.serve import grpc_proxy
     from ray_tpu.serve.router import stop_routers
 
     controller = ray_tpu.get_actor(CONTROLLER_NAME)
     ray_tpu.get(controller.delete_deployment.remote(name), timeout=30)
     stop_routers(name)
+    grpc_proxy.invalidate(name)
 
 
 def shutdown():
+    from ray_tpu.serve import grpc_proxy
     from ray_tpu.serve.router import stop_routers
 
     stop_routers()
+    grpc_proxy.invalidate()
+    grpc_proxy.stop_grpc()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:  # noqa: BLE001

@@ -1,0 +1,13 @@
+"""Import targets for declarative serve config tests."""
+
+
+def double(x):
+    return x * 2
+
+
+class Scaler:
+    def __init__(self, factor=3):
+        self.factor = factor
+
+    def __call__(self, x):
+        return x * self.factor

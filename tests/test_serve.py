@@ -219,6 +219,74 @@ def test_batched_admission_matches_single(rt):
     assert all(len(t) == 6 for t in burst.values())
 
 
+def test_grpc_ingress(serve_ray):
+    """gRPC ingress (reference: proxy.py:545 gRPCProxy): a generic
+    bytes-in/bytes-out Call method any gRPC client can hit without
+    generated stubs."""
+    import grpc
+
+    @serve.deployment
+    def triple(x):
+        return x * 3
+
+    serve.run(triple, name="triple")
+    proxy = serve.start_grpc()
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{proxy.port}")
+        call = ch.unary_unary("/ray_tpu.serve.Ingress/Call")
+        import json as _json
+
+        reply = _json.loads(call(_json.dumps(
+            {"deployment": "triple", "args": [14]}).encode(), timeout=60))
+        assert reply == {"result": 42}
+        # unknown deployment surfaces as an error payload, not a crash
+        reply = _json.loads(call(_json.dumps(
+            {"deployment": "nope", "args": [1]}).encode(), timeout=60))
+        assert "error" in reply
+    finally:
+        serve.stop_grpc()
+        serve.delete("triple")
+
+
+def test_declarative_config_deploy(serve_ray, tmp_path):
+    """serve.deploy_config: one document declares the applications;
+    applying it deploys them and prunes deployments that left the
+    document (reference: ServeDeploySchema, schema.py:707 + the
+    `serve deploy` CLI)."""
+    cfg = tmp_path / "serve.yaml"
+    cfg.write_text("""
+applications:
+  - name: dbl
+    import_path: tests.serve_targets:double
+    num_replicas: 1
+  - name: scale
+    import_path: tests.serve_targets:Scaler
+    init_kwargs: {factor: 5}
+""")
+    deployed = serve.deploy_config(str(cfg))
+    assert set(deployed) == {"dbl", "scale"}
+    from ray_tpu.serve.api import DeploymentHandle
+
+    assert DeploymentHandle("dbl").remote(4).result(timeout=60) == 8
+    assert DeploymentHandle("scale").remote(4).result(timeout=60) == 20
+
+    # convergence: dropping an app from the doc deletes its deployment
+    cfg.write_text("""
+applications:
+  - name: dbl
+    import_path: tests.serve_targets:double
+""")
+    serve.deploy_config(str(cfg))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status = serve.status()
+        if "scale" not in status:
+            break
+        time.sleep(0.2)
+    assert "dbl" in status and "scale" not in status, status
+    serve.delete("dbl")
+
+
 def test_serve_dag_mode_llm_pipeline(serve_ray):
     """Serve DAG mode: a deployment whose replica drives a compiled
     tokenize -> generate -> detokenize pipeline over channels, requests
