@@ -190,11 +190,15 @@ def forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
         return layer_fn(x_, p_), None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    return _final_head(cfg, params, x)
+
+
+def _final_head(cfg: LlamaConfig, params, x: jax.Array) -> jax.Array:
+    """Shared model tail: final norm + (tied) LM head in fp32."""
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = jnp.dot(x, head.astype(cfg.dtype),
-                     preferred_element_type=jnp.float32)
-    return logits
+    return jnp.dot(x, head.astype(cfg.dtype),
+                   preferred_element_type=jnp.float32)
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
@@ -224,6 +228,84 @@ def loss_fn(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
     if mask is not None:
         mask = mask[:, 1:]
     return cross_entropy_loss(logits, tokens[:, 1:], mask)
+
+
+def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
+               mesh, num_microbatches: int) -> jax.Array:
+    """Pipeline-parallel next-token loss: the layer stack is sharded over
+    the mesh's ``pp`` axis and microbatches flow through a GPipe schedule
+    compiled as ONE program (parallel/pipeline.py — shard_map + ppermute
+    rotation; jax.grad reverses the schedule for the backward pass).
+
+    Embed/head run replicated across pp (they are fsdp/tp-sharded by the
+    usual rules); only the decoder blocks pipeline. num_microbatches must
+    divide the batch and should be >> pp to amortize the bubble.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = jax.shard_map
+
+    if cfg.attn_impl == "ring":
+        raise ValueError(
+            "attn_impl='ring' composes its own shard_map over 'sp' and "
+            "cannot nest inside the pp pipeline program yet; use "
+            "'flash' or 'reference' attention with pipeline parallelism")
+    pp = dict(getattr(mesh, "shape", {})).get("pp", 1)
+    if cfg.num_layers % max(pp, 1):
+        raise ValueError(
+            f"num_layers={cfg.num_layers} must divide the mesh's "
+            f"pp={pp} (each stage holds num_layers/pp blocks)")
+
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    b, s = inputs.shape
+    M = num_microbatches
+    assert b % M == 0, f"batch {b} must divide into {M} microbatches"
+    x = params["embed"].astype(cfg.dtype)[inputs]
+    cos, sin = rope_frequencies(cfg.head_dim_, s, cfg.rope_theta,
+                                dtype=cfg.dtype)
+    mbs = x.reshape(M, b // M, s, cfg.hidden_size)
+
+    layer_fn = lambda x_, p_: _layer(cfg, x_, p_, cos, sin)  # noqa: E731
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def stage_fn(stage_layers, xmb):
+        # this stage's L/P layers, leading axis scanned
+        def body(x_, p_):
+            return layer_fn(x_, p_), None
+
+        out, _ = jax.lax.scan(body, xmb, stage_layers)
+        return out
+
+    def sharded_pipeline(stage_layers, mbs_rep):
+        from ray_tpu.parallel.pipeline import pipeline_apply
+
+        pp = jax.lax.axis_size("pp")
+        outs = pipeline_apply(stage_fn, stage_layers, mbs_rep, "pp")
+        # outputs live on the LAST stage; sum-rotate so every stage holds
+        # them (cheap: one psum of zeros elsewhere)
+        return jax.lax.psum(
+            jnp.where(jax.lax.axis_index("pp") == pp - 1, outs, 0.0), "pp")
+
+    layer_spec = P("pp")           # layer dim sharded over pp
+    # REAL data parallelism alongside pp: the per-microbatch batch dim
+    # shards over the mesh's data axes (each dp group pipelines its own
+    # slice); activations stay replicated only across pp
+    data_axes = tuple(a for a in mesh.axis_names if a in ("dp", "fsdp"))
+    mb_spec = P(None, data_axes if data_axes else None)
+    outs = shard_map(
+        sharded_pipeline, mesh=mesh,
+        in_specs=(layer_spec, mb_spec), out_specs=mb_spec,
+        check_vma=False,
+    )(params["layers"], mbs)
+
+    x = outs.reshape(b, s, cfg.hidden_size)
+    logits = _final_head(cfg, params, x)
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+    return cross_entropy_loss(logits, targets, mask)
 
 
 def num_params(params) -> int:

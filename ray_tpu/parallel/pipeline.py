@@ -1,0 +1,66 @@
+"""Pipeline parallelism: GPipe scheduling as ONE jitted SPMD program.
+
+TPU-native design (reference role: rllib/train pipeline stages run as
+torch RPC/NCCL p2p across actor processes — e.g. the reference's
+compiled-DAG PP inference path, python/ray/dag/compiled_dag_node.py; here
+the pipeline is a collective program): the stacked layer dimension is
+sharded over the mesh's ``pp`` axis (each stage holds L/P layers), and
+one ``shard_map``-wrapped ``lax.scan`` runs the whole schedule — per
+tick, every stage applies its layers to its current microbatch and
+rotates activations to the next stage with ``lax.ppermute`` over ICI.
+``jax.grad`` through the scan reverses the ppermutes automatically,
+yielding the standard GPipe backward schedule with no hand-written
+communication. Bubble fraction is (P-1)/(M+P-1) — pick
+num_microbatches >> pp.
+
+The generic primitive is ``pipeline_apply``; models expose thin wrappers
+(models/llama.py: ``loss_fn_pp``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, microbatches: jax.Array,
+                   axis_name: str = "pp") -> jax.Array:
+    """Run ``microbatches [M, ...]`` through a P-stage pipeline.
+
+    Call INSIDE shard_map over ``axis_name``: ``stage_params`` is this
+    stage's layer slice, ``microbatches`` the full input set (replicated
+    across pp; stage 0 injects them). Returns outputs [M, ...] valid on
+    the LAST stage (zeros elsewhere — combine with a masked psum or read
+    on the last stage). Differentiable end to end.
+    """
+    P = jax.lax.axis_size(axis_name)
+    M = microbatches.shape[0]
+    p = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    state0 = jnp.zeros_like(microbatches[0])
+    out0 = jnp.zeros_like(microbatches)
+
+    def tick(carry, t):
+        state, outs = carry
+        mb_idx = t - p                      # microbatch this stage sees
+        active = (mb_idx >= 0) & (mb_idx < M)
+        # stage 0 injects fresh microbatches; later stages consume the
+        # rotated activations from their predecessor
+        inject = microbatches[jnp.clip(mb_idx, 0, M - 1)]
+        x = jnp.where(p == 0, inject, state)
+        y = stage_fn(stage_params, x)
+        # the LAST stage's result for an active tick is a finished
+        # microbatch; bubble ticks write nowhere (scalar cond broadcasts)
+        should_write = active & (p == P - 1)
+        outs = jnp.where(should_write,
+                         outs.at[jnp.clip(mb_idx, 0, M - 1)].set(y), outs)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, outs), None
+
+    (state, outs), _ = jax.lax.scan(
+        tick, (state0, out0), jnp.arange(M + P - 1))
+    return outs

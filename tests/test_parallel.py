@@ -230,3 +230,67 @@ def test_host_ring_allreduce_matches_star(rt):
     for head, total in outs:
         np.testing.assert_allclose(head, expect[:5])
         assert abs(total - expect.sum()) < 1e-6
+
+
+def test_pipeline_parallel_matches_sequential():
+    """GPipe over the pp mesh axis (parallel/pipeline.py): sharded layer
+    stack + ppermute rotation in ONE scanned program must reproduce the
+    sequential model's loss AND grads (jax.grad reverses the schedule)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = llama.LlamaConfig.tiny(num_layers=4, remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size)
+    mesh = build_mesh(MeshSpec({"pp": 4}),
+                      devices=jax.devices()[:4])
+
+    ref = float(llama.loss_fn(cfg, params, {"tokens": tokens}))
+    pp_loss = jax.jit(lambda p, t: llama.loss_fn_pp(
+        cfg, p, {"tokens": t}, mesh, num_microbatches=4))
+    assert abs(ref - float(pp_loss(params, tokens))) < 1e-4
+
+    g_ref = jax.grad(lambda p: llama.loss_fn(cfg, p,
+                                             {"tokens": tokens}))(params)
+    g_pp = jax.jit(jax.grad(lambda p: llama.loss_fn_pp(
+        cfg, p, {"tokens": tokens}, mesh, num_microbatches=4)))(params)
+    errs = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                        g_ref, g_pp)
+    assert max(jax.tree.leaves(errs)) < 1e-3
+
+
+def test_pipeline_parallel_train_step_2x2():
+    """pp x dp: two pipeline stages replicated over two data shards; a
+    full adamw step runs and the loss decreases."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = llama.LlamaConfig.tiny(num_layers=4, remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshSpec({"pp": 2, "dp": 2}),
+                      devices=jax.devices()[:4])
+    tx = optax.adamw(1e-2)
+    opt = tx.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size)
+
+    @jax.jit
+    def step(params, opt, tokens):
+        loss, grads = jax.value_and_grad(lambda p: llama.loss_fn_pp(
+            cfg, p, {"tokens": tokens}, mesh, num_microbatches=4))(params)
+        upd, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    losses = []
+    for _ in range(6):
+        params, opt, loss = step(params, opt, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
