@@ -158,12 +158,34 @@ def timeline(filename: Optional[str] = None):
 
     core = runtime_context.get_core()
     events = getattr(core, "_events", None)
+    if events is None and hasattr(core, "_cluster_view"):
+        # cluster driver: aggregate every node's flag-gated event log
+        # (reference: ray.timeline merges per-raylet task events)
+        from ray_tpu.core.cluster.rpc import RpcClient, RpcError
+
+        events = None
+        for idx, n in enumerate(core._cluster_view(force=True)["nodes"]):
+            # dedicated short-timeout client: a freshly-dead node must
+            # cost ~2s, not the pooled client's full 10s connect retry
+            client = RpcClient(tuple(n["address"]), core._authkey,
+                               connect_timeout=2.0)
+            try:
+                node_events = client.call(("task_events",))
+            except RpcError:
+                continue
+            finally:
+                client.close()
+            if node_events is None:
+                continue  # recording disabled on that node
+            events = events if events is not None else []
+            nid = n["node_id"].hex()[:6] if hasattr(
+                n["node_id"], "hex") else str(n["node_id"])[:6]
+            for e in node_events:
+                # composite pid: same OS pid on different hosts must not
+                # merge into one chrome-trace process row
+                events.append({**e, "worker": f"{nid}:{e['worker']}",
+                               "pid": idx * 1_000_000 + int(e["pid"] or 0)})
     if events is None:
-        if hasattr(core, "_cluster_view"):
-            raise RuntimeError(
-                "timeline() reads the embedded runtime's event log; "
-                "cluster drivers do not record one yet — run with a "
-                "local init() to trace")
         raise RuntimeError(
             "task events are disabled; set RTPU_TASK_EVENTS_ENABLED=1 "
             "before init()")
@@ -176,6 +198,7 @@ def timeline(filename: Optional[str] = None):
         "pid": e["pid"],
         "tid": e["worker"],
         "args": {"task_id": e["task_id"],
+                 "parent_task_id": e.get("parent_task_id"),
                  "queued_ms": round(max(
                      0.0, (e["dispatched"] - e.get("submitted",
                                                    e["dispatched"]))

@@ -630,6 +630,13 @@ class NodeServer:
     def _op_stack_dump(self):
         return self.runtime.stack_dump()
 
+    def _op_task_events(self):
+        """Flag-gated task timeline events recorded by this node's
+        runtime (driver aggregates across nodes for ray_tpu.timeline).
+        None = recording disabled on this node."""
+        ev = self.runtime._events
+        return None if ev is None else list(ev)
+
     def _op_list_logs(self):
         from ray_tpu.core.log_monitor import list_log_files
 

@@ -78,7 +78,7 @@ class _TaskSpec:
         "actor_id", "method", "pending_deps", "request", "pg_wire",
         "acquired_bundle", "blocked_released", "nested_deps", "cancelled",
         "retries_left", "args_pinned", "dep_pins", "submitted_ts",
-        "dispatched_ts",
+        "dispatched_ts", "parent_task",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -113,6 +113,10 @@ class _TaskSpec:
         # timeline timestamps (recorded when task_events_enabled)
         self.submitted_ts = 0.0
         self.dispatched_ts = 0.0
+        # cross-process span propagation: the submitting task's id (hex)
+        # for nested submissions, None for driver-originated work
+        # (reference: tracing_helper.py's trace-context injection)
+        self.parent_task: Optional[str] = None
 
 
 class _Worker:
@@ -1119,6 +1123,7 @@ class Runtime:
                 now = time.time()
                 self._events.append({
                     "task_id": spec.task_id.hex(),
+                    "parent_task_id": spec.parent_task,
                     "fn": (spec.method if spec.method
                            else (spec.fn_id.hex()[:8] if spec.fn_id
                                  else "task")),
@@ -1874,12 +1879,14 @@ class Runtime:
                     self._functions.setdefault(fn_id, pickled_fn)
             deps = options.pop("__deps", [])
             nested = options.pop("__nested", [])
+            parent = options.pop("__parent", None)
             task_id = make_task_id(self.job_id)
             return_ids = [ObjectID.from_random() for _ in range(n_returns)]
             for rid in return_ids:
                 self._entry(rid)
             spec = _TaskSpec(task_id, fn_id, args_payload,
                              [ObjectID(d) for d in deps], return_ids, options)
+            spec.parent_task = parent
             spec.nested_deps = [ObjectID(b) for b in nested]
             spec.request, spec.pg_wire = self._prepare_request(
                 options, is_actor=False)
@@ -1898,6 +1905,7 @@ class Runtime:
                 self._entry(rid)
             spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
                              actor_id=state.actor_id, method=method)
+            spec.parent_task = extra.get("__parent")
             if state.dead:
                 self._store_error(
                     return_ids,

@@ -138,6 +138,10 @@ class WorkerCore:
         send_fn = None if fn_id in self._driver_known_fns else pickled_fn
         options = dict(options)
         options["__deps"] = deps
+        # span propagation: nested submissions carry the submitting
+        # task's id so cross-process traces keep causality
+        if self.current_task_id is not None:
+            options["__parent"] = self.current_task_id.hex()
         options["__nested"] = nested
         _, oid_bytes_list = self._request(
             protocol.REQ_SUBMIT, fn_id, send_fn, args_payload, {},
@@ -149,9 +153,12 @@ class WorkerCore:
     def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
                           kwargs: dict, num_returns: int) -> List[ObjectRef]:
         args_payload, deps, _nested = _prepare_args_local(self, args, kwargs)
+        extra = {"__deps": deps}
+        if self.current_task_id is not None:
+            extra["__parent"] = self.current_task_id.hex()
         _, oid_bytes_list = self._request(
             protocol.REQ_ACTOR_CALL, actor_id.binary(), method, args_payload,
-            {"__deps": deps}, num_returns,
+            extra, num_returns,
         )
         return [ObjectRef(ObjectID(b), core=self) for b in oid_bytes_list]
 

@@ -59,6 +59,24 @@ def test_state_api_embedded():
         trace = ray_tpu.timeline()
         assert len(trace) >= 6
         assert all(ev["ph"] == "X" and ev["dur"] >= 0 for ev in trace)
+
+        # cross-process span propagation: a task submitted FROM a task
+        # records its submitter as parent_task_id
+        @ray_tpu.remote
+        def child():
+            return 1
+
+        @ray_tpu.remote
+        def parent():
+            return ray_tpu.get(child.remote())
+
+        ray_tpu.get(parent.remote())
+        trace = ray_tpu.timeline()
+        parents = {ev["args"]["task_id"]: ev["args"]["parent_task_id"]
+                   for ev in trace}
+        linked = [p for p in parents.values() if p is not None]
+        assert linked and all(p in parents for p in linked), (
+            "nested task missing parent span link")
     finally:
         os.environ.pop("RTPU_TASK_EVENTS_ENABLED", None)
         config.reload()
@@ -126,6 +144,44 @@ def test_job_submission(cluster2):
         agent.close()
     finally:
         os.environ.pop("RTPU_CLUSTER_AUTHKEY", None)
+
+
+def test_cluster_timeline_aggregates_nodes():
+    """ray_tpu.timeline() in CLUSTER mode merges every node's flag-gated
+    task-event log, tids prefixed by node (reference: ray.timeline over
+    per-raylet events)."""
+    from ray_tpu.core.config import config
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    os.environ["RTPU_TASK_EVENTS_ENABLED"] = "1"
+    config.reload()
+    c = None
+    try:
+        c = Cluster(num_nodes=2, num_workers_per_node=1,
+                    node_resources=[{"ta": 4}, {"tb": 4}])
+        c.wait_for_nodes(2)
+        c.connect()
+
+        @ray_tpu.remote
+        def t(x):
+            return x
+
+        ray_tpu.get([t.options(resources={"ta": 1}).remote(i)
+                     for i in range(3)], timeout=60)
+        ray_tpu.get([t.options(resources={"tb": 1}).remote(i)
+                     for i in range(3)], timeout=60)
+        trace = ray_tpu.timeline()
+        assert len(trace) >= 6
+        # events from BOTH nodes, tid carrying the node prefix
+        prefixes = {ev["tid"].split(":")[0] for ev in trace}
+        assert len(prefixes) == 2, prefixes
+    finally:
+        os.environ.pop("RTPU_TASK_EVENTS_ENABLED", None)
+        config.reload()
+        if c is not None:
+            c.shutdown()
+        runtime_context.set_core(prev)
 
 
 def test_worker_proc_stats_and_stack_dump(rt):
