@@ -184,7 +184,7 @@ def timeline(filename: Optional[str] = None):
                 # composite pid: same OS pid on different hosts must not
                 # merge into one chrome-trace process row
                 events.append({**e, "worker": f"{nid}:{e['worker']}",
-                               "pid": idx * 1_000_000 + int(e["pid"] or 0)})
+                               "pid": idx * (1 << 23) + int(e["pid"] or 0)})
     if events is None:
         raise RuntimeError(
             "task events are disabled; set RTPU_TASK_EVENTS_ENABLED=1 "

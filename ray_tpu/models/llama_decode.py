@@ -15,7 +15,7 @@ decode batch between steps by prefilling into a free slot).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -269,27 +269,53 @@ def decode_step(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
     return {"k": new_k, "v": new_v}, logits
 
 
+def sample_tokens(logits: jax.Array, key: jax.Array,
+                  temperature: jax.Array, top_k: int = 0) -> jax.Array:
+    """Per-slot sampling: temperature 0 means greedy; ``top_k`` (static,
+    0 = off) masks everything below the k-th logit. logits [S, vocab],
+    temperature [S]. Mixed batches work — each slot applies its own
+    temperature, so greedy and sampled requests share one program."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, logits / temp,
+                                     axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
 def decode_chunk(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
                  tokens: jax.Array, positions: jax.Array, active: jax.Array,
-                 num_steps: int
+                 num_steps: int, rng: Optional[jax.Array] = None,
+                 temperature: Optional[jax.Array] = None, top_k: int = 0
                  ) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
-    """``num_steps`` greedy decode steps in ONE device program.
+    """``num_steps`` decode steps in ONE device program.
 
     Amortizes host<->device dispatch latency (dominant over a remote
-    tunnel) across many tokens: the greedy argmax feeds back on-device via
-    lax.scan. Returns (cache, out_tokens [num_steps, S], last_positions).
-    Slots keep generating past EOS inside a chunk; the engine truncates
-    host-side (bounded waste of num_steps-1 tokens per finished slot).
+    tunnel) across many tokens: the sampled (or greedy) token feeds back
+    on-device via lax.scan. Returns (cache, out_tokens [num_steps, S],
+    last_positions). Slots keep generating past EOS inside a chunk; the
+    engine truncates host-side (bounded waste of num_steps-1 tokens per
+    finished slot). With ``rng``/``temperature`` given, each slot samples
+    at its own temperature (0 = greedy) with optional static top_k.
     """
-    def step(carry, _):
-        cache, toks, pos = carry
-        cache, logits = decode_step(cfg, params, cache, toks, pos, active)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        nxt = jnp.where(active, nxt, toks)
-        return (cache, nxt, pos + active.astype(jnp.int32)), nxt
+    S = tokens.shape[0]
+    if temperature is None:
+        temperature = jnp.zeros((S,), jnp.float32)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
 
-    (cache, _, pos), out = jax.lax.scan(
-        step, (cache, tokens, positions), None, length=num_steps)
+    def step(carry, _):
+        cache, toks, pos, key = carry
+        cache, logits = decode_step(cfg, params, cache, toks, pos, active)
+        key, sub = jax.random.split(key)
+        nxt = sample_tokens(logits, sub, temperature, top_k)
+        nxt = jnp.where(active, nxt, toks)
+        return (cache, nxt, pos + active.astype(jnp.int32), key), nxt
+
+    (cache, _, pos, _), out = jax.lax.scan(
+        step, (cache, tokens, positions, rng), None, length=num_steps)
     return cache, out, pos
 
 
@@ -328,7 +354,7 @@ def make_engine_fns(cfg: LlamaConfig, params, num_slots: int, max_len: int,
     insert_many_j = jax.jit(insert_many, donate_argnums=(0,))
     decode_j = jax.jit(decode_step, static_argnums=(0,),
                        donate_argnums=(2,))
-    chunk_j = jax.jit(decode_chunk, static_argnums=(0, 6),
+    chunk_j = jax.jit(decode_chunk, static_argnums=(0, 6, 9),
                       donate_argnums=(2,))
 
     def pre_batch(tokens, last_idx):
@@ -337,8 +363,9 @@ def make_engine_fns(cfg: LlamaConfig, params, num_slots: int, max_len: int,
     def dec(cache, tokens, positions, active):
         return decode_j(cfg, params, cache, tokens, positions, active)
 
-    def dec_chunk(cache, tokens, positions, active, num_steps):
+    def dec_chunk(cache, tokens, positions, active, num_steps,
+                  rng=None, temperature=None, top_k=0):
         return chunk_j(cfg, params, cache, tokens, positions, active,
-                       num_steps)
+                       num_steps, rng, temperature, top_k)
 
     return pre_batch, insert_many_j, dec, dec_chunk

@@ -22,6 +22,21 @@ import time
 from typing import Any, Dict, List, Optional
 
 
+def _sample_np(logits, rng, temperature: float, top_k: int) -> int:
+    """Host-side single-row sampler (admission first-token path)."""
+    import numpy as np
+
+    z = np.asarray(logits, np.float64)
+    if top_k > 0:
+        kth = np.sort(z)[-top_k]
+        z = np.where(z < kth, -np.inf, z)
+    z = z / max(temperature, 1e-6)
+    z -= z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
 def _bucket(n: int, buckets: List[int]) -> int:
     for b in buckets:
         if n <= b:
@@ -38,7 +53,8 @@ class LLMEngine:
                  prefill_buckets: Optional[List[int]] = None,
                  max_new_tokens: int = 32, eos_id: int = -1,
                  greedy: bool = True, chunk_steps: int = 8,
-                 tp: int = 1, mesh=None):
+                 tp: int = 1, mesh=None, top_k: int = 0,
+                 sampling_seed: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -79,6 +95,8 @@ class LLMEngine:
         self._max_new = max_new_tokens
         self._eos = eos_id
         self._greedy = greedy
+        self._top_k = int(top_k)
+        self._seed = int(sampling_seed)
         self._jnp = jnp
 
         (self._prefill_batch, self._insert_many, self._decode,
@@ -106,6 +124,7 @@ class LLMEngine:
         self._slot_pos: Dict[int, int] = {}
         self._slot_start: Dict[int, float] = {}
         self._slot_ttft: Dict[int, float] = {}
+        self._slot_temp: Dict[int, float] = {}
 
         self._in: "queue.Queue[tuple]" = queue.Queue()
         self._cancelled: Dict[str, float] = {}  # req_id -> cancel time
@@ -120,9 +139,14 @@ class LLMEngine:
     # ---- mailbox (called from the actor's request thread) ------------------
 
     def submit(self, req_id: str, prompt_tokens: List[int],
-               max_new_tokens: Optional[int] = None) -> None:
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0) -> None:
+        """temperature 0 = greedy; >0 samples (engine-level ``top_k``
+        masks the tail). Mixed batches share one decode program — each
+        slot applies its own temperature on-device."""
         self._in.put((req_id, list(prompt_tokens),
-                      max_new_tokens or self._max_new, time.monotonic()))
+                      max_new_tokens or self._max_new, time.monotonic(),
+                      float(temperature)))
 
     def collect(self, req_ids: Optional[List[str]] = None) -> Dict[str, Any]:
         """Drain finished requests. With ``req_ids``, only those are
@@ -216,8 +240,8 @@ class LLMEngine:
                     break
             if not pending:
                 break
-            batch = []   # (req_id, toks, max_new, t0, slot)
-            for req_id, toks, max_new, t0 in pending:
+            batch = []   # (req_id, toks, max_new, t0, temp, slot)
+            for req_id, toks, max_new, t0, temp in pending:
                 with self._done_lock:
                     was_cancelled = (
                         self._cancelled.pop(req_id, None) is not None)
@@ -234,7 +258,8 @@ class LLMEngine:
                     continue
                 if len(toks) >= self._max_len:
                     toks = toks[: self._max_len - 1]
-                batch.append((req_id, toks, max_new, t0, self._free.pop()))
+                batch.append((req_id, toks, max_new, t0, temp,
+                              self._free.pop()))
             if not batch:
                 continue
             try:
@@ -244,13 +269,13 @@ class LLMEngine:
                 # like logits[len-1] would compile per distinct length —
                 # ~1s each over the tunnel, paid inside TTFT)
                 B = 1 if len(batch) == 1 else self._admit_batch
-                P = _bucket(max(len(t) for _, t, _, _, _ in batch),
+                P = _bucket(max(len(t) for _, t, _, _, _, _ in batch),
                             self._buckets)
                 rows = np.zeros((B, P), np.int32)
                 last = np.zeros((B,), np.int32)
                 slots = np.zeros((B,), np.int32)
                 valid = np.zeros((B,), bool)
-                for i, (_, toks, _, _, slot) in enumerate(batch):
+                for i, (_, toks, _, _, _, slot) in enumerate(batch):
                     rows[i, :len(toks)] = toks
                     last[i] = len(toks) - 1
                     slots[i], valid[i] = slot, True
@@ -260,16 +285,25 @@ class LLMEngine:
                     self._cache, kv, jnp.asarray(slots),
                     jnp.asarray(valid))
                 firsts = np.asarray(jnp.argmax(logits, axis=-1))
+                np_logits = None
+                if any(b[4] > 0 for b in batch):
+                    np_logits = np.asarray(logits, np.float64)
             except Exception as e:  # noqa: BLE001 — fail THESE requests
-                for req_id, _, _, _, slot in batch:
+                for req_id, _, _, _, _, slot in batch:
                     self._free.append(slot)
                     with self._done_lock:
                         self._done[req_id] = ValueError(
                             f"request rejected: {e!r}")
                 continue
             now = time.monotonic()
-            for i, (req_id, toks, max_new, t0, slot) in enumerate(batch):
+            rng = np.random.default_rng(self._seed + self._steps)
+            for i, (req_id, toks, max_new, t0, temp, slot) in \
+                    enumerate(batch):
                 first = int(firsts[i])
+                if temp > 0 and np_logits is not None:
+                    first = int(_sample_np(np_logits[i], rng, temp,
+                                           self._top_k))
+                self._slot_temp[slot] = temp
                 self._slot_req[slot] = req_id
                 self._slot_tokens[slot] = [first]
                 self._slot_budget[slot] = max_new
@@ -295,7 +329,7 @@ class LLMEngine:
                                       - self._slot_start[slot]),
                     }
             for d in (self._slot_tokens, self._slot_budget, self._slot_pos,
-                      self._slot_start, self._slot_ttft):
+                      self._slot_start, self._slot_ttft, self._slot_temp):
                 d.pop(slot, None)
             self._free.append(slot)
             return True
@@ -317,10 +351,15 @@ class LLMEngine:
         # warm the EAGER argmax op the k==1 decode path uses (eager ops
         # compile like jit programs on first use)
         np.asarray(jnp.argmax(logits, axis=-1))
+        import jax as _jax
+
+        zero_t = jnp.zeros((S,), jnp.float32)
+        key0 = _jax.random.PRNGKey(0)
         k = 2
         while k <= self._chunk_steps:
             self._cache, out, _ = self._decode_chunk(
-                self._cache, toks, poss, act, k)
+                self._cache, toks, poss, act, k, key0, zero_t,
+                self._top_k)
             np.asarray(out[0, 0])
             k *= 2
         sizes = sorted({1, self._admit_batch})
@@ -359,7 +398,7 @@ class LLMEngine:
                     self._slot_req.pop(slot, None)
                     for d in (self._slot_tokens, self._slot_budget,
                               self._slot_pos, self._slot_start,
-                              self._slot_ttft):
+                              self._slot_ttft, self._slot_temp):
                         d.pop(slot, None)
                     self._free.append(slot)
 
@@ -405,17 +444,42 @@ class LLMEngine:
         k = min(k, max(1, self._max_len - 1 - max(
             self._slot_pos[s] for s in active_slots)))
         k = 1 << (k.bit_length() - 1)
+        import jax as _jax
+
+        temps = np.zeros((S,), np.float32)
+        for s_ in active_slots:
+            temps[s_] = self._slot_temp.get(s_, 0.0)
+        # all-greedy ticks (the default mode) skip the per-tick PRNGKey
+        # dispatch — its value is dead in the argmax branch, and this
+        # loop is latency-critical over the tunnel
+        if temps.any():
+            rng_key = _jax.random.PRNGKey(
+                (self._seed << 20) ^ self._steps)
+        else:
+            if not hasattr(self, "_zero_key"):
+                self._zero_key = _jax.random.PRNGKey(0)
+            rng_key = self._zero_key
         if k > 1:
             self._cache, out, _ = self._decode_chunk(
                 self._cache, jnp.asarray(toks), jnp.asarray(poss),
-                jnp.asarray(act), k)
+                jnp.asarray(act), k, rng_key, jnp.asarray(temps),
+                self._top_k)
             steps_tokens = np.asarray(out)          # [k, S]
         else:
             self._cache, logits = self._decode(
                 self._cache, jnp.asarray(toks), jnp.asarray(poss),
                 jnp.asarray(act))
-            steps_tokens = np.asarray(
-                jnp.argmax(logits, axis=-1))[None]  # [1, S]
+            # writable COPY: jax's __array__ view is read-only
+            greedy_row = np.array(jnp.argmax(logits, axis=-1))
+            if temps.any():
+                nrng = np.random.default_rng(self._seed + self._steps)
+                np_logits = np.asarray(logits, np.float64)
+                for s_ in active_slots:
+                    if temps[s_] > 0:
+                        greedy_row[s_] = _sample_np(
+                            np_logits[s_], nrng, float(temps[s_]),
+                            self._top_k)
+            steps_tokens = greedy_row[None]          # [1, S]
         self._steps += steps_tokens.shape[0]
         for s in active_slots:
             for step in range(steps_tokens.shape[0]):

@@ -338,6 +338,43 @@ def test_model_multiplexing(serve_ray):
     serve.delete("mux")
 
 
+def test_llm_engine_sampling(rt):
+    """Per-request temperature sampling: a mixed greedy+sampled batch
+    shares one decode program (per-slot temperature on-device), greedy
+    rows stay deterministic, sampled rows diverge, and top_k gates the
+    tail (reference role: vLLM SamplingParams)."""
+    import time as _time
+
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    kw = dict(model_config={"preset": "tiny"}, num_slots=4, max_len=48,
+              prefill_buckets=[8], max_new_tokens=10, chunk_steps=4,
+              top_k=20)
+
+    def run(engine, reqs):
+        for rid, temp in reqs:
+            engine.submit(rid, [5, 3, 7], 10, temperature=temp)
+        out = {}
+        deadline = _time.time() + 90
+        while len(out) < len(reqs) and _time.time() < deadline:
+            out.update(engine.collect())
+            _time.sleep(0.01)
+        engine.shutdown()
+        return {k: v["tokens"] for k, v in out.items()}
+
+    toks = run(LLMEngine(**kw), [("g", 0.0), ("s1", 1.0), ("s2", 1.0)])
+    assert all(len(t) == 10 for t in toks.values())
+    assert toks["s1"] != toks["g"] or toks["s2"] != toks["g"]
+    # greedy rows are unchanged by sharing a batch with sampled ones
+    toks2 = run(LLMEngine(**kw), [("g", 0.0)])
+    assert toks2["g"] == toks["g"]
+    # single-step path (chunk_steps=1) with a sampled slot: the host-side
+    # sampler writes into the logits row — must complete, not crash
+    toks3 = run(LLMEngine(**dict(kw, chunk_steps=1)),
+                [("s", 1.0), ("g", 0.0)])
+    assert all(len(t) == 10 for t in toks3.values())
+
+
 def test_llm_engine_tensor_parallel_matches_single(rt):
     """Tensor-parallel decode (weights + KV cache sharded over a tp mesh,
     per-layer all-reduces emitted by XLA) must generate exactly the greedy
