@@ -1,0 +1,175 @@
+"""Load HuggingFace Llama-family checkpoints into ray_tpu param pytrees.
+
+Reference role: the reference serves/trains models loaded from HF hubs
+(e.g. python/ray/llm's engine configs name HF model ids); the TPU-native
+equivalent maps the HF state dict onto this repo's stacked-layer pytree:
+
+- torch ``nn.Linear`` stores [out, in] and computes ``x @ W.T``; our
+  params store [in, out] and compute ``x @ W`` — every projection
+  transposes on import.
+- per-layer tensors stack along a leading layer axis (the model scans
+  over it; pipeline parallelism shards it).
+- rotary embeddings are split-half (GPT-NeoX convention) in BOTH
+  implementations, so no head permutation is needed.
+
+Use ``llama_from_hf`` with a transformers model, a state dict, or a
+checkpoint path (anything ``LlamaForCausalLM.from_pretrained`` accepts).
+Logit parity with the HF implementation is asserted in
+tests/test_models.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+
+def llama_config_from_hf(hf_cfg) -> "Any":
+    from ray_tpu.models.llama import LlamaConfig
+
+    # refuse configs whose features this model does NOT implement —
+    # silently-wrong logits are worse than a load error
+    scaling = getattr(hf_cfg, "rope_scaling", None)
+    if scaling:
+        raise ValueError(
+            f"unsupported HF config: rope_scaling={scaling!r} (llama3/"
+            f"linear/yarn rope scaling is not implemented here)")
+    if getattr(hf_cfg, "attention_bias", False) \
+            or getattr(hf_cfg, "mlp_bias", False):
+        raise ValueError(
+            "unsupported HF config: attention_bias/mlp_bias checkpoints "
+            "carry bias tensors this model has no slots for")
+    return LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=getattr(hf_cfg, "num_key_value_heads", None)
+        or hf_cfg.num_attention_heads,
+        head_dim=getattr(hf_cfg, "head_dim", None),
+        max_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        rms_norm_eps=float(hf_cfg.rms_norm_eps),
+        tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+    )
+
+
+def llama_params_from_hf(state_dict: Dict[str, Any], cfg,
+                         dtype=None) -> Dict[str, Any]:
+    """HF Llama state dict (torch tensors or numpy) -> param pytree."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    dtype = dtype or cfg.param_dtype
+
+    def t(name):  # fetch + to-numpy
+        v = state_dict[name]
+        if hasattr(v, "detach"):
+            v = v.detach().to("cpu").float().numpy()
+        return np.asarray(v)
+
+    def lin(name):  # torch Linear [out, in] -> ours [in, out]
+        return t(name).T
+
+    bias_keys = [k for k in state_dict
+                 if k.endswith(("proj.bias",)) and "layers" in k]
+    if bias_keys:
+        raise ValueError(
+            f"unsupported checkpoint: projection bias tensors present "
+            f"(e.g. {bias_keys[0]}) — this model implements bias-free "
+            f"Llama projections")
+    L = cfg.num_layers
+    stacked: Dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate",
+        "w_up", "w_down")}
+    for i in range(L):
+        p = f"model.layers.{i}."
+        stacked["attn_norm"].append(t(p + "input_layernorm.weight"))
+        stacked["wq"].append(lin(p + "self_attn.q_proj.weight"))
+        stacked["wk"].append(lin(p + "self_attn.k_proj.weight"))
+        stacked["wv"].append(lin(p + "self_attn.v_proj.weight"))
+        stacked["wo"].append(lin(p + "self_attn.o_proj.weight"))
+        stacked["mlp_norm"].append(t(p + "post_attention_layernorm.weight"))
+        stacked["w_gate"].append(lin(p + "mlp.gate_proj.weight"))
+        stacked["w_up"].append(lin(p + "mlp.up_proj.weight"))
+        stacked["w_down"].append(lin(p + "mlp.down_proj.weight"))
+
+    params = {
+        "embed": jnp.asarray(t("model.embed_tokens.weight"), dtype),
+        "layers": {k: jnp.asarray(np.stack(v), dtype)
+                   for k, v in stacked.items()},
+        "final_norm": jnp.asarray(t("model.norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(lin("lm_head.weight"), dtype)
+    return params
+
+
+def gpt2_from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
+    """(cfg, params) from a transformers GPT2LMHeadModel (or a checkpoint
+    path/model id). GPT-2's HF weights use Conv1D layout [in, out] — the
+    same orientation this repo uses, so tensors map 1:1 with only the
+    per-layer stacking."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import GPT2Config
+
+    if isinstance(source, str):
+        from transformers import GPT2LMHeadModel
+
+        source = GPT2LMHeadModel.from_pretrained(source)
+    hf_cfg = source.config
+    cfg = GPT2Config(vocab_size=hf_cfg.vocab_size,
+                     hidden_size=hf_cfg.n_embd,
+                     num_layers=hf_cfg.n_layer,
+                     num_heads=hf_cfg.n_head,
+                     max_seq_len=hf_cfg.n_positions,
+                     ln_eps=float(hf_cfg.layer_norm_epsilon))
+    if dtype is not None:
+        from dataclasses import replace
+
+        cfg = replace(cfg, param_dtype=dtype)
+    sd = source.state_dict()
+
+    def t(name):
+        v = sd[name]
+        if hasattr(v, "detach"):
+            v = v.detach().to("cpu").float().numpy()
+        return np.asarray(v)
+
+    names = {"ln1_g": "ln_1.weight", "ln1_b": "ln_1.bias",
+             "w_qkv": "attn.c_attn.weight", "b_qkv": "attn.c_attn.bias",
+             "w_proj": "attn.c_proj.weight", "b_proj": "attn.c_proj.bias",
+             "ln2_g": "ln_2.weight", "ln2_b": "ln_2.bias",
+             "w_fc": "mlp.c_fc.weight", "b_fc": "mlp.c_fc.bias",
+             "w_out": "mlp.c_proj.weight", "b_out": "mlp.c_proj.bias"}
+    pd = cfg.param_dtype if dtype is None else dtype
+    layers = {ours: jnp.asarray(np.stack(
+        [t(f"transformer.h.{i}.{hf}") for i in range(cfg.num_layers)]), pd)
+        for ours, hf in names.items()}
+    params = {
+        "wte": jnp.asarray(t("transformer.wte.weight"), pd),
+        "wpe": jnp.asarray(t("transformer.wpe.weight"), pd),
+        "layers": layers,
+        "lnf_g": jnp.asarray(t("transformer.ln_f.weight"), pd),
+        "lnf_b": jnp.asarray(t("transformer.ln_f.bias"), pd),
+    }
+    return cfg, params
+
+
+def llama_from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
+    """(cfg, params) from a transformers model instance or a checkpoint
+    path/model id loadable by ``LlamaForCausalLM.from_pretrained``."""
+    if isinstance(source, str):
+        from transformers import LlamaForCausalLM
+
+        source = LlamaForCausalLM.from_pretrained(source)
+    cfg = llama_config_from_hf(source.config)
+    if dtype is not None:
+        from dataclasses import replace
+
+        cfg = replace(cfg, param_dtype=dtype)
+    return cfg, llama_params_from_hf(source.state_dict(), cfg, dtype=dtype)

@@ -288,7 +288,8 @@ def sample_tokens(logits: jax.Array, key: jax.Array,
 def decode_chunk(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
                  tokens: jax.Array, positions: jax.Array, active: jax.Array,
                  num_steps: int, rng: Optional[jax.Array] = None,
-                 temperature: Optional[jax.Array] = None, top_k: int = 0
+                 temperature: Optional[jax.Array] = None, top_k: int = 0,
+                 sample: bool = True
                  ) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
     """``num_steps`` decode steps in ONE device program.
 
@@ -309,8 +310,12 @@ def decode_chunk(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
     def step(carry, _):
         cache, toks, pos, key = carry
         cache, logits = decode_step(cfg, params, cache, toks, pos, active)
-        key, sub = jax.random.split(key)
-        nxt = sample_tokens(logits, sub, temperature, top_k)
+        if sample:
+            key, sub = jax.random.split(key)
+            nxt = sample_tokens(logits, sub, temperature, top_k)
+        else:
+            # static greedy variant: no categorical, no top-k sort
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         nxt = jnp.where(active, nxt, toks)
         return (cache, nxt, pos + active.astype(jnp.int32), key), nxt
 
@@ -354,7 +359,7 @@ def make_engine_fns(cfg: LlamaConfig, params, num_slots: int, max_len: int,
     insert_many_j = jax.jit(insert_many, donate_argnums=(0,))
     decode_j = jax.jit(decode_step, static_argnums=(0,),
                        donate_argnums=(2,))
-    chunk_j = jax.jit(decode_chunk, static_argnums=(0, 6, 9),
+    chunk_j = jax.jit(decode_chunk, static_argnums=(0, 6, 9, 10),
                       donate_argnums=(2,))
 
     def pre_batch(tokens, last_idx):
@@ -364,8 +369,8 @@ def make_engine_fns(cfg: LlamaConfig, params, num_slots: int, max_len: int,
         return decode_j(cfg, params, cache, tokens, positions, active)
 
     def dec_chunk(cache, tokens, positions, active, num_steps,
-                  rng=None, temperature=None, top_k=0):
+                  rng=None, temperature=None, top_k=0, sample=True):
         return chunk_j(cfg, params, cache, tokens, positions, active,
-                       num_steps, rng, temperature, top_k)
+                       num_steps, rng, temperature, top_k, sample)
 
     return pre_batch, insert_many_j, dec, dec_chunk

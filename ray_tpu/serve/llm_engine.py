@@ -61,11 +61,24 @@ class LLMEngine:
         from ray_tpu.models import llama, llama_decode
 
         cfg_kw = dict(model_config or {})
+        hf_model = cfg_kw.pop("hf_model", None)
         preset = cfg_kw.pop("preset", "tiny")
         for key in ("dtype", "param_dtype"):
             if isinstance(cfg_kw.get(key), str):
                 cfg_kw[key] = getattr(jnp, cfg_kw[key])
-        cfg = getattr(llama.LlamaConfig, preset)(**cfg_kw)
+        hf_params = None
+        if hf_model is not None:
+            # serve a real checkpoint: anything from_pretrained accepts
+            # (models/hf_weights.py maps the state dict onto our pytree)
+            from dataclasses import replace as _replace
+
+            from ray_tpu.models.hf_weights import llama_from_hf
+
+            cfg, hf_params = llama_from_hf(
+                hf_model, dtype=cfg_kw.pop("param_dtype", None))
+            cfg = _replace(cfg, **cfg_kw)
+        else:
+            cfg = getattr(llama.LlamaConfig, preset)(**cfg_kw)
         self._cfg = cfg
         # tensor-parallel serving (BASELINE config #5 is v5e-4): weights
         # and KV cache shard over a tp mesh; XLA emits the per-layer
@@ -79,7 +92,8 @@ class LLMEngine:
                     f"tp={tp} needs {tp} devices, found {len(devs)}")
             mesh = build_mesh(MeshSpec({"tp": tp}), devices=devs[:tp])
         self._mesh = mesh
-        self._params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        self._params = (hf_params if hf_params is not None else
+                        llama.init_params(cfg, jax.random.PRNGKey(0)))
         if mesh is not None:
             # shard NOW and drop the unsharded copy — keeping both would
             # hold 1x + 1/tp weights on chip 0, defeating TP's HBM saving
@@ -95,7 +109,11 @@ class LLMEngine:
         self._max_new = max_new_tokens
         self._eos = eos_id
         self._greedy = greedy
-        self._top_k = int(top_k)
+        # clamp: top_k >= vocab would fail at trace time and
+        # loop the engine on per-tick compile errors
+        self._top_k = min(int(top_k), cfg.vocab_size - 1)
+        if self._top_k < 0:
+            self._top_k = 0
         self._seed = int(sampling_seed)
         self._jnp = jnp
 
@@ -296,7 +314,10 @@ class LLMEngine:
                             f"request rejected: {e!r}")
                 continue
             now = time.monotonic()
-            rng = np.random.default_rng(self._seed + self._steps)
+            self._admit_count = getattr(self, "_admit_count", 0) + 1
+            rng = np.random.default_rng(
+                (self._seed << 24) ^ (self._admit_count << 8)
+                ^ self._steps)
             for i, (req_id, toks, max_new, t0, temp, slot) in \
                     enumerate(batch):
                 first = int(firsts[i])
@@ -358,8 +379,11 @@ class LLMEngine:
         k = 2
         while k <= self._chunk_steps:
             self._cache, out, _ = self._decode_chunk(
+                self._cache, toks, poss, act, k, key0, zero_t, 0, False)
+            np.asarray(out[0, 0])
+            self._cache, out, _ = self._decode_chunk(
                 self._cache, toks, poss, act, k, key0, zero_t,
-                self._top_k)
+                self._top_k, True)
             np.asarray(out[0, 0])
             k *= 2
         sizes = sorted({1, self._admit_batch})
@@ -452,7 +476,8 @@ class LLMEngine:
         # all-greedy ticks (the default mode) skip the per-tick PRNGKey
         # dispatch — its value is dead in the argmax branch, and this
         # loop is latency-critical over the tunnel
-        if temps.any():
+        sampling = bool(temps.any())
+        if sampling:
             rng_key = _jax.random.PRNGKey(
                 (self._seed << 20) ^ self._steps)
         else:
@@ -460,10 +485,12 @@ class LLMEngine:
                 self._zero_key = _jax.random.PRNGKey(0)
             rng_key = self._zero_key
         if k > 1:
+            # all-greedy ticks run the sample=False program variant —
+            # no categorical draw, no top-k sort on the hot loop
             self._cache, out, _ = self._decode_chunk(
                 self._cache, jnp.asarray(toks), jnp.asarray(poss),
                 jnp.asarray(act), k, rng_key, jnp.asarray(temps),
-                self._top_k)
+                self._top_k if sampling else 0, sampling)
             steps_tokens = np.asarray(out)          # [k, S]
         else:
             self._cache, logits = self._decode(
