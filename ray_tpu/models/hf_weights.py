@@ -54,16 +54,11 @@ def llama_config_from_hf(hf_cfg) -> "Any":
     )
 
 
-def llama_params_from_hf(state_dict: Dict[str, Any], cfg,
-                         dtype=None) -> Dict[str, Any]:
-    """HF Llama state dict (torch tensors or numpy) -> param pytree."""
+def _fetcher(state_dict):
+    """(t, lin): fetch-as-numpy, and torch-Linear-transposed fetch."""
     import numpy as np
 
-    import jax.numpy as jnp
-
-    dtype = dtype or cfg.param_dtype
-
-    def t(name):  # fetch + to-numpy
+    def t(name):
         v = state_dict[name]
         if hasattr(v, "detach"):
             v = v.detach().to("cpu").float().numpy()
@@ -72,28 +67,34 @@ def llama_params_from_hf(state_dict: Dict[str, Any], cfg,
     def lin(name):  # torch Linear [out, in] -> ours [in, out]
         return t(name).T
 
+    return t, lin
+
+
+def _refuse_proj_bias(state_dict):
     bias_keys = [k for k in state_dict
                  if k.endswith(("proj.bias",)) and "layers" in k]
     if bias_keys:
         raise ValueError(
             f"unsupported checkpoint: projection bias tensors present "
             f"(e.g. {bias_keys[0]}) — this model implements bias-free "
-            f"Llama projections")
-    L = cfg.num_layers
-    stacked: Dict[str, list] = {k: [] for k in (
-        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate",
-        "w_up", "w_down")}
-    for i in range(L):
-        p = f"model.layers.{i}."
-        stacked["attn_norm"].append(t(p + "input_layernorm.weight"))
-        stacked["wq"].append(lin(p + "self_attn.q_proj.weight"))
-        stacked["wk"].append(lin(p + "self_attn.k_proj.weight"))
-        stacked["wv"].append(lin(p + "self_attn.v_proj.weight"))
-        stacked["wo"].append(lin(p + "self_attn.o_proj.weight"))
-        stacked["mlp_norm"].append(t(p + "post_attention_layernorm.weight"))
-        stacked["w_gate"].append(lin(p + "mlp.gate_proj.weight"))
-        stacked["w_up"].append(lin(p + "mlp.up_proj.weight"))
-        stacked["w_down"].append(lin(p + "mlp.down_proj.weight"))
+            f"projections")
+
+
+def _stack_attn(stacked, t, lin, prefix):
+    """The llama-style attention block shared by Llama and Mixtral."""
+    stacked["attn_norm"].append(t(prefix + "input_layernorm.weight"))
+    stacked["wq"].append(lin(prefix + "self_attn.q_proj.weight"))
+    stacked["wk"].append(lin(prefix + "self_attn.k_proj.weight"))
+    stacked["wv"].append(lin(prefix + "self_attn.v_proj.weight"))
+    stacked["wo"].append(lin(prefix + "self_attn.o_proj.weight"))
+    stacked["mlp_norm"].append(
+        t(prefix + "post_attention_layernorm.weight"))
+
+
+def _assemble(cfg, stacked, t, lin, dtype):
+    import numpy as np
+
+    import jax.numpy as jnp
 
     params = {
         "embed": jnp.asarray(t("model.embed_tokens.weight"), dtype),
@@ -104,6 +105,24 @@ def llama_params_from_hf(state_dict: Dict[str, Any], cfg,
     if not cfg.tie_embeddings:
         params["lm_head"] = jnp.asarray(lin("lm_head.weight"), dtype)
     return params
+
+
+def llama_params_from_hf(state_dict: Dict[str, Any], cfg,
+                         dtype=None) -> Dict[str, Any]:
+    """HF Llama state dict (torch tensors or numpy) -> param pytree."""
+    dtype = dtype or cfg.param_dtype
+    t, lin = _fetcher(state_dict)
+    _refuse_proj_bias(state_dict)
+    stacked: Dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate",
+        "w_up", "w_down")}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        _stack_attn(stacked, t, lin, p)
+        stacked["w_gate"].append(lin(p + "mlp.gate_proj.weight"))
+        stacked["w_up"].append(lin(p + "mlp.up_proj.weight"))
+        stacked["w_down"].append(lin(p + "mlp.down_proj.weight"))
+    return _assemble(cfg, stacked, t, lin, dtype)
 
 
 def gpt2_from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
@@ -173,3 +192,74 @@ def llama_from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
 
         cfg = replace(cfg, param_dtype=dtype)
     return cfg, llama_params_from_hf(source.state_dict(), cfg, dtype=dtype)
+
+
+def mixtral_from_hf(source, dtype=None, capacity_factor=None
+                    ) -> Tuple[Any, Dict[str, Any]]:
+    """(cfg, params) from a transformers MixtralForCausalLM (or a
+    checkpoint path/model id). Experts map w1->e_gate, w3->e_up,
+    w2->e_down (Mixtral's naming), stacked [L, E, ...].
+
+    NOTE on parity: this repo's MoE uses GShard-style STATIC-capacity
+    dispatch (overflow drops); HF computes exact token-wise outputs.
+    Pass ``capacity_factor >= num_experts/top_k`` for drop-free exact
+    parity (the test does); production configs trade capacity for speed.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ray_tpu.models.mixtral import MixtralConfig
+
+    if isinstance(source, str):
+        from transformers import MixtralForCausalLM
+
+        source = MixtralForCausalLM.from_pretrained(source)
+    hf_cfg = source.config
+    sw = getattr(hf_cfg, "sliding_window", None)
+    if sw is not None and sw < hf_cfg.max_position_embeddings:
+        raise ValueError(
+            f"unsupported HF config: sliding_window={sw} (this model "
+            f"implements full causal attention only; sequences past the "
+            f"window would silently diverge from HF)")
+    cfg = MixtralConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=hf_cfg.num_key_value_heads,
+        head_dim=getattr(hf_cfg, "head_dim", None),
+        max_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=float(hf_cfg.rope_theta),
+        rms_norm_eps=float(hf_cfg.rms_norm_eps),
+        tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+        num_experts=hf_cfg.num_local_experts,
+        top_k=hf_cfg.num_experts_per_tok,
+    )
+    from dataclasses import replace
+
+    if dtype is not None:
+        cfg = replace(cfg, param_dtype=dtype)
+    if capacity_factor is not None:
+        cfg = replace(cfg, capacity_factor=float(capacity_factor))
+    sd = source.state_dict()
+    t, lin = _fetcher(sd)
+    _refuse_proj_bias(sd)
+    pd = cfg.param_dtype if dtype is None else dtype
+    L, E = cfg.num_layers, cfg.num_experts
+    stacked: Dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "router",
+        "e_gate", "e_up", "e_down")}
+    for i in range(L):
+        p = f"model.layers.{i}."
+        _stack_attn(stacked, t, lin, p)
+        moe = p + "block_sparse_moe."
+        stacked["router"].append(lin(moe + "gate.weight"))
+        stacked["e_gate"].append(np.stack(
+            [lin(f"{moe}experts.{e}.w1.weight") for e in range(E)]))
+        stacked["e_up"].append(np.stack(
+            [lin(f"{moe}experts.{e}.w3.weight") for e in range(E)]))
+        stacked["e_down"].append(np.stack(
+            [lin(f"{moe}experts.{e}.w2.weight") for e in range(E)]))
+    return cfg, _assemble(cfg, stacked, t, lin, pd)
