@@ -23,16 +23,26 @@ from __future__ import annotations
 from typing import Any, Dict, Tuple
 
 
+def _parse_rope_scaling(hf_cfg):
+    """llama3-type rope scaling is implemented
+    (ops/layers.rope_frequencies); every other type refuses loudly —
+    silently-wrong logits are worse than a load error."""
+    scaling = getattr(hf_cfg, "rope_scaling", None)
+    if not scaling:
+        return None
+    rope_type = scaling.get("rope_type") or scaling.get("type")
+    if rope_type != "llama3":
+        raise ValueError(
+            f"unsupported HF config: rope_scaling type {rope_type!r} "
+            f"(only 'llama3' is implemented)")
+    return tuple(sorted(
+        (k, v) for k, v in scaling.items() if v is not None))
+
+
 def llama_config_from_hf(hf_cfg) -> "Any":
     from ray_tpu.models.llama import LlamaConfig
 
-    # refuse configs whose features this model does NOT implement —
-    # silently-wrong logits are worse than a load error
-    scaling = getattr(hf_cfg, "rope_scaling", None)
-    if scaling:
-        raise ValueError(
-            f"unsupported HF config: rope_scaling={scaling!r} (llama3/"
-            f"linear/yarn rope scaling is not implemented here)")
+    rope_scaling = _parse_rope_scaling(hf_cfg)
     if getattr(hf_cfg, "attention_bias", False) \
             or getattr(hf_cfg, "mlp_bias", False):
         raise ValueError(
@@ -51,6 +61,7 @@ def llama_config_from_hf(hf_cfg) -> "Any":
         rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
         rms_norm_eps=float(hf_cfg.rms_norm_eps),
         tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+        rope_scaling=rope_scaling,
     )
 
 
@@ -152,12 +163,7 @@ def gpt2_from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
 
         cfg = replace(cfg, param_dtype=dtype)
     sd = source.state_dict()
-
-    def t(name):
-        v = sd[name]
-        if hasattr(v, "detach"):
-            v = v.detach().to("cpu").float().numpy()
-        return np.asarray(v)
+    t, _ = _fetcher(sd)
 
     names = {"ln1_g": "ln_1.weight", "ln1_b": "ln_1.bias",
              "w_qkv": "attn.c_attn.weight", "b_qkv": "attn.c_attn.bias",
@@ -236,6 +242,7 @@ def mixtral_from_hf(source, dtype=None, capacity_factor=None
         tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
         num_experts=hf_cfg.num_local_experts,
         top_k=hf_cfg.num_experts_per_tok,
+        rope_scaling=_parse_rope_scaling(hf_cfg),
     )
     from dataclasses import replace
 
@@ -246,7 +253,7 @@ def mixtral_from_hf(source, dtype=None, capacity_factor=None
     sd = source.state_dict()
     t, lin = _fetcher(sd)
     _refuse_proj_bias(sd)
-    pd = cfg.param_dtype if dtype is None else dtype
+    pd = cfg.param_dtype  # replace() above already applied dtype
     L, E = cfg.num_layers, cfg.num_experts
     stacked: Dict[str, list] = {k: [] for k in (
         "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "router",

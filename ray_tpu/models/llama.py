@@ -46,6 +46,13 @@ class LlamaConfig:
     attn_impl: str = "auto"  # auto | flash | reference | ring
     remat: bool = True
     tie_embeddings: bool = False
+    # optional llama3-style long-context rope scaling (the HF
+    # rope_scaling dict; see ops/layers.rope_frequencies)
+    rope_scaling: Optional[tuple] = None  # dict items, hashable for jit
+
+    @property
+    def rope_scaling_dict(self):
+        return dict(self.rope_scaling) if self.rope_scaling else None
 
     @property
     def head_dim_(self) -> int:
@@ -180,7 +187,8 @@ def forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     """tokens [b, s] int32 → logits [b, s, vocab] float32."""
     x = params["embed"].astype(cfg.dtype)[tokens]
     cos, sin = rope_frequencies(cfg.head_dim_, tokens.shape[1],
-                                cfg.rope_theta, dtype=cfg.dtype)
+                                cfg.rope_theta, dtype=cfg.dtype,
+                                scaling=cfg.rope_scaling_dict)
 
     layer_fn = lambda x_, p_: _layer(cfg, x_, p_, cos, sin, mesh=mesh)
     if cfg.remat:
@@ -263,7 +271,8 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
     assert b % M == 0, f"batch {b} must divide into {M} microbatches"
     x = params["embed"].astype(cfg.dtype)[inputs]
     cos, sin = rope_frequencies(cfg.head_dim_, s, cfg.rope_theta,
-                                dtype=cfg.dtype)
+                                dtype=cfg.dtype,
+                                scaling=cfg.rope_scaling_dict)
     mbs = x.reshape(M, b // M, s, cfg.hidden_size)
 
     layer_fn = lambda x_, p_: _layer(cfg, x_, p_, cos, sin)  # noqa: E731

@@ -95,7 +95,8 @@ def prefill(cfg: LlamaConfig, params, tokens: jax.Array
     x = params["embed"].astype(cfg.dtype)[tokens]
     P = tokens.shape[1]
     cos, sin = rope_frequencies(cfg.head_dim_, P, cfg.rope_theta,
-                                dtype=cfg.dtype)
+                                dtype=cfg.dtype,
+                                scaling=cfg.rope_scaling_dict)
 
     def layer(x, p):
         b, s, _ = x.shape
@@ -141,7 +142,8 @@ def prefill_batch(cfg: LlamaConfig, params, tokens: jax.Array,
     x = params["embed"].astype(cfg.dtype)[tokens]
     P = tokens.shape[1]
     cos, sin = rope_frequencies(cfg.head_dim_, P, cfg.rope_theta,
-                                dtype=cfg.dtype)
+                                dtype=cfg.dtype,
+                                scaling=cfg.rope_scaling_dict)
 
     def layer(x, p):
         b, s, _ = x.shape
@@ -229,7 +231,9 @@ def decode_step(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
     T = cache["k"].shape[2]
     hd = cfg.head_dim_
     x = params["embed"].astype(cfg.dtype)[tokens][:, None]  # [S, 1, h]
-    cos_t, sin_t = rope_frequencies(hd, T, cfg.rope_theta, dtype=cfg.dtype)
+    cos_t, sin_t = rope_frequencies(hd, T, cfg.rope_theta,
+                                    dtype=cfg.dtype,
+                                    scaling=cfg.rope_scaling_dict)
     pos2 = positions[:, None]  # [S, 1] — per-slot rope positions
 
     kv_mask = (jnp.arange(T)[None] <= positions[:, None])  # [S, T]

@@ -179,7 +179,8 @@ def forward(cfg: MixtralConfig, params, tokens: jax.Array, mesh=None
     """tokens [b, s] -> (logits [b, s, vocab] fp32, aux_loss scalar)."""
     x = params["embed"].astype(cfg.dtype)[tokens]
     cos, sin = rope_frequencies(cfg.head_dim_, tokens.shape[1],
-                                cfg.rope_theta, dtype=cfg.dtype)
+                                cfg.rope_theta, dtype=cfg.dtype,
+                                scaling=cfg.rope_scaling_dict)
 
     layer_fn = lambda x_, p_: _layer(cfg, x_, p_, cos, sin, mesh=mesh)
     if cfg.remat:

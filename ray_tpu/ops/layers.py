@@ -27,11 +27,40 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
 
 
 def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10_000.0,
-                     dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
-    """Precompute RoPE cos/sin tables: [max_seq_len, head_dim//2]."""
+                     dtype=jnp.float32, scaling: Optional[dict] = None
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Precompute RoPE cos/sin tables: [max_seq_len, head_dim//2].
+
+    ``scaling``: optional Llama-3.x long-context frequency scaling (the
+    HF ``rope_scaling`` dict with rope_type="llama3"): low-frequency
+    components are divided by ``factor`` (stretching their period to the
+    extended context), high-frequency components are untouched, and the
+    band between ``low_freq_factor`` and ``high_freq_factor`` wavelengths
+    interpolates smoothly — matching transformers'
+    modeling_rope_utils._compute_llama3_parameters.
+    """
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling:
+        rope_type = scaling.get("rope_type") or scaling.get("type")
+        if rope_type != "llama3":
+            raise ValueError(
+                f"unsupported rope_scaling type {rope_type!r} "
+                f"(only 'llama3' is implemented)")
+        factor = float(scaling["factor"])
+        low = float(scaling.get("low_freq_factor", 1.0))
+        high = float(scaling.get("high_freq_factor", 4.0))
+        old_len = float(scaling.get(
+            "original_max_position_embeddings", 8192))
+        wavelen = 2.0 * jnp.pi / inv_freq
+        # short wavelengths (high freq): keep; long wavelengths (low
+        # freq): divide by factor; the band between interpolates
+        smooth = (old_len / wavelen - low) / (high - low)
+        scaled = (1.0 - smooth) * (inv_freq / factor) + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen < old_len / high, inv_freq,
+            jnp.where(wavelen > old_len / low, inv_freq / factor, scaled))
     t = jnp.arange(max_seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)

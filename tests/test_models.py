@@ -324,3 +324,44 @@ def test_mixtral_hf_checkpoint_parity():
     out = mixtral.forward(cfg, params, jnp.asarray(tokens))
     ours = np.asarray(out[0] if isinstance(out, tuple) else out)
     assert np.abs(ours - ref).max() < 5e-5
+
+
+def test_llama3_rope_scaling_parity():
+    """llama3-type rope_scaling (long-context frequency scaling) matches
+    transformers bit-for-bit past the original context window — real
+    Llama-3.1+ checkpoints load and run correctly."""
+    from dataclasses import replace
+
+    import numpy as np
+    import torch
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    from ray_tpu.models import llama
+    from ray_tpu.models.hf_weights import llama_from_hf
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(HFConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=500000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64})).eval()
+    cfg, params = llama_from_hf(hf, dtype=jnp.float32)
+    assert cfg.rope_scaling is not None
+    cfg = replace(cfg, dtype=jnp.float32, attn_impl="reference",
+                  remat=False)
+    # sequence PAST the original 64-token context: scaling must engage
+    tokens = np.random.default_rng(5).integers(0, 256, (2, 100))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.forward(cfg, params, jnp.asarray(tokens)))
+    assert np.abs(ours - ref).max() < 5e-6
+
+    # unsupported scaling types still refuse loudly
+    import pytest as _pytest
+    hf.config.rope_scaling = {"rope_type": "yarn", "factor": 4.0}
+    with _pytest.raises(ValueError, match="yarn"):
+        llama_from_hf(hf)
