@@ -143,6 +143,7 @@ class LLMEngine:
         self._slot_start: Dict[int, float] = {}
         self._slot_ttft: Dict[int, float] = {}
         self._slot_temp: Dict[int, float] = {}
+        self._slot_stop: Dict[int, frozenset] = {}
 
         self._in: "queue.Queue[tuple]" = queue.Queue()
         self._cancelled: Dict[str, float] = {}  # req_id -> cancel time
@@ -158,13 +159,18 @@ class LLMEngine:
 
     def submit(self, req_id: str, prompt_tokens: List[int],
                max_new_tokens: Optional[int] = None,
-               temperature: float = 0.0) -> None:
+               temperature: float = 0.0,
+               stop_ids: Optional[List[int]] = None) -> None:
         """temperature 0 = greedy; >0 samples (engine-level ``top_k``
         masks the tail). Mixed batches share one decode program — each
-        slot applies its own temperature on-device."""
+        slot applies its own temperature on-device. ``stop_ids``: extra
+        per-request stop tokens besides the engine's eos_id (generation
+        ends when any is produced; the stop token is kept in the
+        output, reference: vLLM SamplingParams.stop_token_ids)."""
         self._in.put((req_id, list(prompt_tokens),
                       max_new_tokens or self._max_new, time.monotonic(),
-                      float(temperature)))
+                      float(temperature),
+                      frozenset(int(t) for t in (stop_ids or ()))))
 
     def collect(self, req_ids: Optional[List[str]] = None) -> Dict[str, Any]:
         """Drain finished requests. With ``req_ids``, only those are
@@ -258,8 +264,8 @@ class LLMEngine:
                     break
             if not pending:
                 break
-            batch = []   # (req_id, toks, max_new, t0, temp, slot)
-            for req_id, toks, max_new, t0, temp in pending:
+            batch = []   # (req_id, toks, max_new, t0, temp, stop, slot)
+            for req_id, toks, max_new, t0, temp, stop in pending:
                 with self._done_lock:
                     was_cancelled = (
                         self._cancelled.pop(req_id, None) is not None)
@@ -276,7 +282,7 @@ class LLMEngine:
                     continue
                 if len(toks) >= self._max_len:
                     toks = toks[: self._max_len - 1]
-                batch.append((req_id, toks, max_new, t0, temp,
+                batch.append((req_id, toks, max_new, t0, temp, stop,
                               self._free.pop()))
             if not batch:
                 continue
@@ -287,13 +293,13 @@ class LLMEngine:
                 # like logits[len-1] would compile per distinct length —
                 # ~1s each over the tunnel, paid inside TTFT)
                 B = 1 if len(batch) == 1 else self._admit_batch
-                P = _bucket(max(len(t) for _, t, _, _, _, _ in batch),
+                P = _bucket(max(len(t) for _, t, _, _, _, _, _ in batch),
                             self._buckets)
                 rows = np.zeros((B, P), np.int32)
                 last = np.zeros((B,), np.int32)
                 slots = np.zeros((B,), np.int32)
                 valid = np.zeros((B,), bool)
-                for i, (_, toks, _, _, _, slot) in enumerate(batch):
+                for i, (_, toks, _, _, _, _, slot) in enumerate(batch):
                     rows[i, :len(toks)] = toks
                     last[i] = len(toks) - 1
                     slots[i], valid[i] = slot, True
@@ -307,7 +313,7 @@ class LLMEngine:
                 if any(b[4] > 0 for b in batch):
                     np_logits = np.asarray(logits, np.float64)
             except Exception as e:  # noqa: BLE001 — fail THESE requests
-                for req_id, _, _, _, _, slot in batch:
+                for req_id, _, _, _, _, _, slot in batch:
                     self._free.append(slot)
                     with self._done_lock:
                         self._done[req_id] = ValueError(
@@ -318,13 +324,14 @@ class LLMEngine:
             rng = np.random.default_rng(
                 (self._seed << 24) ^ (self._admit_count << 8)
                 ^ self._steps)
-            for i, (req_id, toks, max_new, t0, temp, slot) in \
+            for i, (req_id, toks, max_new, t0, temp, stop, slot) in \
                     enumerate(batch):
                 first = int(firsts[i])
                 if temp > 0 and np_logits is not None:
                     first = int(_sample_np(np_logits[i], rng, temp,
                                            self._top_k))
                 self._slot_temp[slot] = temp
+                self._slot_stop[slot] = stop
                 self._slot_req[slot] = req_id
                 self._slot_tokens[slot] = [first]
                 self._slot_budget[slot] = max_new
@@ -337,7 +344,9 @@ class LLMEngine:
 
     def _maybe_finish(self, slot: int, last_token: int) -> bool:
         toks = self._slot_tokens[slot]
-        if last_token == self._eos or len(toks) >= self._slot_budget[slot]:
+        if (last_token == self._eos
+                or last_token in self._slot_stop.get(slot, ())
+                or len(toks) >= self._slot_budget[slot]):
             req_id = self._slot_req.pop(slot)
             with self._done_lock:
                 if self._cancelled.pop(req_id, None) is not None:
@@ -350,7 +359,8 @@ class LLMEngine:
                                       - self._slot_start[slot]),
                     }
             for d in (self._slot_tokens, self._slot_budget, self._slot_pos,
-                      self._slot_start, self._slot_ttft, self._slot_temp):
+                      self._slot_start, self._slot_ttft, self._slot_temp,
+                      self._slot_stop):
                 d.pop(slot, None)
             self._free.append(slot)
             return True
@@ -422,7 +432,8 @@ class LLMEngine:
                     self._slot_req.pop(slot, None)
                     for d in (self._slot_tokens, self._slot_budget,
                               self._slot_pos, self._slot_start,
-                              self._slot_ttft, self._slot_temp):
+                              self._slot_ttft, self._slot_temp,
+                              self._slot_stop):
                         d.pop(slot, None)
                     self._free.append(slot)
 

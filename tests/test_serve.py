@@ -377,6 +377,47 @@ def test_llm_engine_serves_hf_checkpoint(rt, tmp_path):
     assert out["r"]["tokens"] == ref, (out["r"]["tokens"], ref)
 
 
+
+
+def _run_engine(engine, reqs, n_expect=None, timeout_s=90):
+    """submit/poll/shutdown helper shared by the engine tests.
+    reqs: list of (req_id, submit_kwargs)."""
+    import time as _time
+
+    for rid, kw in reqs:
+        engine.submit(rid, [5, 3, 7], kw.pop("max_new", 6), **kw)
+    out = {}
+    deadline = _time.time() + timeout_s
+    want = n_expect if n_expect is not None else len(reqs)
+    while len(out) < want and _time.time() < deadline:
+        out.update(engine.collect())
+        _time.sleep(0.01)
+    engine.shutdown()
+    return {k: v["tokens"] for k, v in out.items()}
+
+def test_llm_engine_stop_ids(rt):
+    """Per-request stop tokens (reference: vLLM SamplingParams
+    stop_token_ids): generation ends at the first stop token, which is
+    kept in the output; other requests are unaffected."""
+    import time as _time
+
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    kw = dict(model_config={"preset": "tiny"}, num_slots=2, max_len=48,
+              prefill_buckets=[8], max_new_tokens=12, chunk_steps=4)
+
+    full = _run_engine(LLMEngine(**kw),
+                       [("a", {"max_new": 12})])["a"]
+    assert len(full) == 12
+    stop_tok = full[4]
+    toks = _run_engine(LLMEngine(**kw), [
+        ("b", {"max_new": 12, "stop_ids": [stop_tok]}),
+        ("c", {"max_new": 12})])
+    first = full.index(stop_tok)
+    assert toks["b"] == full[:first + 1]
+    assert toks["c"] == full  # unaffected slot in the same batch
+
+
 def test_llm_engine_sampling(rt):
     """Per-request temperature sampling: a mixed greedy+sampled batch
     shares one decode program (per-slot temperature on-device), greedy
@@ -390,27 +431,21 @@ def test_llm_engine_sampling(rt):
               prefill_buckets=[8], max_new_tokens=10, chunk_steps=4,
               top_k=20)
 
-    def run(engine, reqs):
-        for rid, temp in reqs:
-            engine.submit(rid, [5, 3, 7], 10, temperature=temp)
-        out = {}
-        deadline = _time.time() + 90
-        while len(out) < len(reqs) and _time.time() < deadline:
-            out.update(engine.collect())
-            _time.sleep(0.01)
-        engine.shutdown()
-        return {k: v["tokens"] for k, v in out.items()}
+    def reqs(*specs):
+        return [(rid, {"max_new": 10, "temperature": t})
+                for rid, t in specs]
 
-    toks = run(LLMEngine(**kw), [("g", 0.0), ("s1", 1.0), ("s2", 1.0)])
+    toks = _run_engine(LLMEngine(**kw),
+                       reqs(("g", 0.0), ("s1", 1.0), ("s2", 1.0)))
     assert all(len(t) == 10 for t in toks.values())
     assert toks["s1"] != toks["g"] or toks["s2"] != toks["g"]
     # greedy rows are unchanged by sharing a batch with sampled ones
-    toks2 = run(LLMEngine(**kw), [("g", 0.0)])
+    toks2 = _run_engine(LLMEngine(**kw), reqs(("g", 0.0)))
     assert toks2["g"] == toks["g"]
     # single-step path (chunk_steps=1) with a sampled slot: the host-side
     # sampler writes into the logits row — must complete, not crash
-    toks3 = run(LLMEngine(**dict(kw, chunk_steps=1)),
-                [("s", 1.0), ("g", 0.0)])
+    toks3 = _run_engine(LLMEngine(**dict(kw, chunk_steps=1)),
+                        reqs(("s", 1.0), ("g", 0.0)))
     assert all(len(t) == 10 for t in toks3.values())
 
 
