@@ -23,6 +23,7 @@ driver owns every ref it creates, like the single-node runtime.
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -98,6 +99,15 @@ class ClusterCore:
         self._view_time = 0.0
         self._death_seq = 0
         self._monitor_stop = False
+        # owner identity: this driver registers with the GCS and
+        # heartbeats; if it dies, nodes reclaim its objects and its
+        # non-detached actors stop restarting (reference: owner-failure
+        # semantics of reference_count.h:61, GCS-mediated)
+        self._driver_id = self.job_id.binary()
+        try:
+            self.gcs.call(("register_driver", self._driver_id, {}))
+        except RpcError:
+            pass
         self._monitor = threading.Thread(target=self._death_watch,
                                          daemon=True, name="driver-deaths")
         self._monitor.start()
@@ -144,8 +154,25 @@ class ClusterCore:
         return view
 
     def _death_watch(self):
+        last_hb = 0.0
+        # cadence must satisfy BOTH duties: node-death polling and the
+        # driver heartbeat (whose timeout is independent of the node
+        # heartbeat knobs — never let one flag starve the other)
+        period = min(config.gcs_heartbeat_interval_s * 2,
+                     config.driver_heartbeat_interval_s)
         while not self._monitor_stop:
-            time.sleep(config.gcs_heartbeat_interval_s * 2)
+            time.sleep(period)
+            now = time.monotonic()
+            if now - last_hb >= config.driver_heartbeat_interval_s:
+                last_hb = now
+                try:
+                    if not self.gcs.call(
+                            ("driver_heartbeat", self._driver_id)):
+                        # GCS restarted and lost the registry: re-register
+                        self.gcs.call(
+                            ("register_driver", self._driver_id, {}))
+                except (RpcError, Exception):  # noqa: BLE001
+                    pass
             try:
                 deaths = self.gcs.call(("deaths_since", self._death_seq))
             except (RpcError, Exception):  # noqa: BLE001
@@ -211,7 +238,8 @@ class ClusterCore:
         pickled = self._ship_fn(addr, cls_fn_id)
         opts_local = self._localize_pg(opts, addr)
         client.call(("create_actor", cls_fn_id, pickled, payload,
-                     deps, opts_local, None, actor_id.binary()))
+                     deps, opts_local, None, actor_id.binary(),
+                     os.urandom(16), self._driver_id))
         self._mark_shipped(addr, cls_fn_id)
         with self._lock:
             self._actor_node[actor_id] = addr
@@ -333,6 +361,15 @@ class ClusterCore:
                     [r.binary() for r in nested],
                     [r.binary() for r in return_ids])
         tried: List[Tuple[str, int]] = []
+        # One nonce per LOGICAL submission. The transport layer retries a
+        # lost reply on the SAME node, where the nonce dedups (exactly-
+        # once); reconstruction mints a new nonce because re-execution
+        # there is deliberate. The failover loop below only fires after
+        # the same-node retry failed too — i.e. the node is unreachable —
+        # so cross-node re-submission is at-least-once under a network
+        # partition (the reference's task max_retries has the same
+        # semantics).
+        nonce = os.urandom(16)
         while True:
             addr = self._pick_node(options, is_actor=False, exclude=tried)
             options2 = self._localize_pg(options, addr)
@@ -340,7 +377,7 @@ class ClusterCore:
             try:
                 self._nodes.get(addr).call(
                     ("submit", fn_id, pickled_fn, payload, *msg_tail,
-                     options2, locations))
+                     options2, locations, nonce, self._driver_id))
                 break
             except RpcError:
                 # stale view: the node died but isn't marked DEAD yet
@@ -386,7 +423,8 @@ class ClusterCore:
         pickled, views, total = serialization.serialize(value)
         buf = bytearray(total)
         serialization.write_container(memoryview(buf), pickled, views)
-        oid_b = self._nodes.get(self._home).call(("put", bytes(buf), None))
+        oid_b = self._nodes.get(self._home).call(
+            ("put", bytes(buf), None, self._driver_id))
         with self._lock:
             self._ref_node[oid_b] = self._home
         return ObjectRef(ObjectID(oid_b), core=self)
@@ -539,9 +577,13 @@ class ClusterCore:
                 if (options or {}).get("scheduling_strategy") \
                 else dict(options or {})
             try:
+                # fresh nonce: reconstruction deliberately RE-executes the
+                # creating task, it must never be deduped against the
+                # original submission
                 self._nodes.get(addr).call(
                     ("submit", fn_id, pickled_fn, payload, deps_b, nested_b,
-                     return_ids_b, options2, None))
+                     return_ids_b, options2, None, os.urandom(16),
+                     self._driver_id))
                 break
             except RpcError:
                 tried.append(addr)
@@ -642,9 +684,14 @@ class ClusterCore:
         locations = {d.binary(): self._ref_node.get(d.binary()) for d in deps}
         locations = {k: v for k, v in locations.items() if v is not None}
         dep_b = [d.binary() for d in deps]
-        actor_id_b = self._nodes.get(addr).call(
+        # driver-chosen actor id + per-request nonce: a retried
+        # create_actor whose reply was lost dedups server-side
+        # (exactly-once apply), while restarts under the same id mint a
+        # new nonce and re-apply
+        actor_id_b = ActorID.from_random().binary()
+        self._nodes.get(addr).call(
             ("create_actor", cls_fn_id, pickled_cls, payload, dep_b, opts2,
-             locations))
+             locations, actor_id_b, os.urandom(16), self._driver_id))
         self._mark_shipped(addr, cls_fn_id)
         actor_id = ActorID(actor_id_b)
         with self._lock:
@@ -665,12 +712,16 @@ class ClusterCore:
                     pickled_full = self._functions.get(cls_fn_id)
                 if pickled_full is not None:
                     self.gcs.call(("register_fn", cls_fn_id, pickled_full))
+                # full opts INCLUDING method_opts: after a GCS-owned
+                # restart, handles re-derived via get_actor() must keep
+                # per-method options (num_returns overrides etc.)
                 self.gcs.call(("register_actor_spec", actor_id_b, {
                     "cls_fn_id": cls_fn_id, "payload": payload,
-                    "deps": dep_b,
-                    "opts": {k: v for k, v in opts.items()
-                             if k != "method_opts"},
+                    "deps": dep_b, "opts": opts,
                     "name": opts.get("name"),
+                    # owner: if this driver dies, the GCS stops
+                    # restarting the actor unless it is detached
+                    "owner": self._driver_id,
                 }))
                 with self._lock:
                     self._gcs_owned.add(actor_id)
@@ -712,7 +763,8 @@ class ClusterCore:
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
         msg = ("actor_call", actor_id.binary(), method, payload,
                [d.binary() for d in deps], [r.binary() for r in nested],
-               [r.binary() for r in return_ids])
+               [r.binary() for r in return_ids], os.urandom(16),
+               self._driver_id)
         try:
             addr, _ = self._actor_call_with_retry(actor_id, lambda a: msg)
         except RpcError as e:
@@ -974,6 +1026,8 @@ class ClusterCore:
 
     def shutdown(self):
         self._monitor_stop = True
+        # clean exit: no death event, nodes keep objects until eviction
+        self.gcs.try_call(("unregister_driver", self._driver_id))
         if self._home_store is not None:
             try:
                 self._home_store.close()

@@ -82,7 +82,9 @@ class GcsServer:
     nodes re-register on their next rejected heartbeat (reference:
     src/ray/gcs/store_client/redis_store_client.h:33 — the role of the
     Redis-backed table storage, done as a single-writer WAL instead of an
-    external store)."""
+    external store). Durability: appends are flush()ed (survives GCS
+    process crash); set ``RTPU_GCS_WAL_FSYNC=1`` to fsync per append and
+    additionally survive host/OS crashes."""
 
     def __init__(self, port: int = 0, authkey: Optional[bytes] = None,
                  persistence_path: Optional[str] = None):
@@ -101,6 +103,13 @@ class GcsServer:
         self._functions: Dict[bytes, bytes] = {}
         self._deaths: List[Tuple[int, bytes]] = []  # (seq, node_id)
         self._death_seq = 0
+        # driver (owner) registry: drivers heartbeat like nodes; a dead
+        # driver's objects/actors are reclaimed cluster-wide (reference:
+        # job death handling, gcs_job_manager.h — owner-failure semantics
+        # of reference_count.h:61 done GCS-mediated)
+        self._drivers: Dict[bytes, float] = {}     # driver_id -> last hb
+        self._driver_deaths: List[Tuple[int, bytes]] = []
+        self._driver_death_seq = 0
         # pubsub channels: bounded event logs with long-poll subscribers
         # (reference: src/ray/pubsub/publisher.h:296)
         self._channels: Dict[str, List[Tuple[int, Any]]] = {}
@@ -155,6 +164,8 @@ class GcsServer:
                 "freed": dict(self._freed),
                 "deaths": list(self._deaths),
                 "death_seq": self._death_seq,
+                "driver_deaths": list(self._driver_deaths),
+                "driver_death_seq": self._driver_death_seq,
                 "channel_seq": dict(self._channel_seq),
                 "channels": {k: list(v) for k, v in self._channels.items()},
                 "view_version": self._view_version,
@@ -182,6 +193,9 @@ class GcsServer:
         self._freed = dict(s.get("freed", {}))
         self._deaths = [tuple(d) for d in s.get("deaths", [])]
         self._death_seq = s.get("death_seq", 0)
+        self._driver_deaths = [tuple(d)
+                               for d in s.get("driver_deaths", [])]
+        self._driver_death_seq = s.get("driver_death_seq", 0)
         self._channel_seq = dict(s.get("channel_seq", {}))
         self._channels = {k: [tuple(e) for e in v]
                           for k, v in s.get("channels", {}).items()}
@@ -206,6 +220,14 @@ class GcsServer:
                             if info is not None and info.state == "ALIVE":
                                 with self._lock:
                                     self._mark_dead_locked(info)
+                        elif op == "__driver_death__":
+                            # keep the seq monotonic across restarts so
+                            # nodes' watermarks stay valid (spec drops
+                            # replay via their own records)
+                            with self._lock:
+                                self._driver_death_seq += 1
+                                self._driver_deaths.append(
+                                    (self._driver_death_seq, args[0]))
                         else:
                             getattr(self, "_op_" + op)(*args)
                     except Exception:  # noqa: BLE001 — replay best-effort
@@ -223,6 +245,8 @@ class GcsServer:
             pickle.dump((op, args), self._wal)
             self._wal_count += 1
         self._wal.flush()
+        if config.gcs_wal_fsync:
+            os.fsync(self._wal.fileno())
         if self._wal_count >= _WAL_SNAPSHOT_EVERY:
             self._compact_locked()
 
@@ -252,6 +276,7 @@ class GcsServer:
 
     def _health_loop(self):
         timeout = config.gcs_heartbeat_timeout_s
+        drv_timeout = config.driver_heartbeat_timeout_s
         while not self._stop:
             time.sleep(min(0.1, timeout / 4))
             now = time.monotonic()
@@ -260,6 +285,9 @@ class GcsServer:
                     if (info.state == "ALIVE"
                             and now - info.last_heartbeat > timeout):
                         self._mark_dead_locked(info)
+                for did, last in list(self._drivers.items()):
+                    if now - last > drv_timeout:
+                        self._mark_driver_dead_locked(did)
             self._flush_pending_deaths()
 
     def _mark_dead_locked(self, info: _NodeInfo):
@@ -321,6 +349,7 @@ class GcsServer:
             if restarts > 0:
                 opts["max_restarts"] = restarts - 1
             deadline = time.monotonic() + timeout
+            nonce = os.urandom(16)
             while time.monotonic() < deadline and not self._stop:
                 addr = self._pick_restart_node(opts)
                 if addr is None:
@@ -329,29 +358,55 @@ class GcsServer:
                 with self._lock:
                     pickled = self._functions.get(spec["cls_fn_id"])
                 try:
+                    # one nonce per restart invocation: a lost reply is
+                    # retried same-node by the transport and deduped
+                    # there; later restarts of the same actor mint their
+                    # own nonce. An RpcError reaching HERE means the node
+                    # was unreachable even after the same-node retry, so
+                    # re-picking a node is right; a create that applied
+                    # on a PARTITIONED (not dead) node can still leave a
+                    # stale copy — at-least-once under partition, like
+                    # the reference's actor restart.
                     self._peers.get(addr).call(
                         ("create_actor", spec["cls_fn_id"], pickled,
                          spec["payload"], list(spec.get("deps") or []),
-                         opts, None, aid))
+                         opts, None, aid, nonce, spec.get("owner")))
                 except RpcError:
                     time.sleep(0.5)
                     continue
-                with self._lock:
-                    self._actor_specs[aid] = dict(spec, opts=opts)
-                    self._actor_table.setdefault(aid, {}).update(
-                        {"node": addr, "state": "RESTARTED"})
-                    name = spec.get("name")
-                    if name and self._named_actors.get(name, (None,))[0] \
-                            == aid:
-                        self._named_actors[name] = (aid, addr)
-                if self._wal is not None:
-                    with self._wal_lock:
+                # apply + log atomically under _wal_lock (same discipline
+                # as _handle) so a concurrent drop_actor_spec can never
+                # slot between our apply and our log — replay order must
+                # equal apply order or a replayed WAL resurrects a spec
+                # that was dropped
+                dropped = False
+                with self._wal_lock:
+                    with self._lock:
+                        dropped = aid not in self._actor_specs
+                        if not dropped:
+                            self._actor_specs[aid] = dict(spec, opts=opts)
+                            self._actor_table.setdefault(aid, {}).update(
+                                {"node": addr, "state": "RESTARTED"})
+                            name = spec.get("name")
+                            if name and self._named_actors.get(
+                                    name, (None,))[0] == aid:
+                                self._named_actors[name] = (aid, addr)
+                    if not dropped and self._wal is not None:
                         self._wal_write_locked(
                             "register_actor",
                             (aid, {"node": addr, "state": "RESTARTED"}))
                         self._wal_write_locked(
                             "register_actor_spec",
                             (aid, dict(spec, opts=opts)))
+                if dropped:
+                    # the actor was killed (drop_actor_spec) while our
+                    # create was in flight: reap the copy we just created
+                    # or it runs orphaned, holding resources forever
+                    try:
+                        self._peers.get(addr).call(
+                            ("kill_actor", aid, True))
+                    except RpcError:
+                        pass
                 break
 
     def _pick_restart_node(self, opts: dict):
@@ -439,6 +494,58 @@ class GcsServer:
                 if remaining <= 0:
                     return False
                 self._cond.wait(remaining)
+
+    # -- drivers (owners)
+
+    def _op_register_driver(self, driver_id: bytes, meta: dict = None):
+        with self._lock:
+            self._drivers[driver_id] = time.monotonic()
+        return True
+
+    def _op_driver_heartbeat(self, driver_id: bytes) -> bool:
+        """False tells the driver to re-register (GCS restarted and lost
+        the transient registry)."""
+        with self._lock:
+            if driver_id not in self._drivers:
+                return False
+            self._drivers[driver_id] = time.monotonic()
+            return True
+
+    def _op_unregister_driver(self, driver_id: bytes):
+        """Clean driver exit: no death event — nodes keep its objects
+        until normal eviction (a deliberate exit usually follows gets)."""
+        with self._lock:
+            self._drivers.pop(driver_id, None)
+        return True
+
+    def _op_driver_deaths_since(self, seq: int):
+        with self._lock:
+            return [d for d in self._driver_deaths if d[0] > seq]
+
+    def _mark_driver_dead_locked(self, driver_id: bytes):
+        self._drivers.pop(driver_id, None)
+        self._driver_death_seq += 1
+        self._driver_deaths.append((self._driver_death_seq, driver_id))
+        if len(self._driver_deaths) > 256:
+            del self._driver_deaths[:-256]
+        # stop restarting the dead driver's NON-detached actors; detached
+        # ones outlive their driver by definition. BUFFER the drops for
+        # the WAL (self._lock is held — same discipline as node deaths):
+        # without the record, a GCS restart would replay
+        # register_actor_spec and resurrect an ownerless actor forever.
+        for aid, spec in list(self._actor_specs.items()):
+            opts = spec.get("opts") or {}
+            if (spec.get("owner") == driver_id
+                    and opts.get("lifetime") != "detached"):
+                del self._actor_specs[aid]
+                if self._wal is not None:
+                    self._wal_pending.append(("drop_actor_spec", (aid,)))
+        # persist the death (like node __death__ records): a restarted
+        # GCS must keep the seq monotonic, or nodes whose watermark is
+        # already past a reset-to-0 seq would never see new deaths
+        if self._wal is not None:
+            self._wal_pending.append(("__driver_death__", (driver_id,)))
+        self._cond.notify_all()
 
     def _op_deaths_since(self, seq: int):
         with self._lock:

@@ -23,6 +23,7 @@ import os
 import socket
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol, serialization
@@ -278,6 +279,36 @@ class NodeServer:
             target=self._loc_flush_loop, daemon=True, name="node-locs")
         self._loc_thread.start()
 
+        # owner-death reclamation (see _owner_of above)
+        self._owner_thread = threading.Thread(
+            target=self._owner_watch_loop, daemon=True, name="node-owners")
+        self._owner_thread.start()
+
+        # exactly-once apply for retried submissions: the wire layer (and
+        # cluster_core's failover loops) may re-send a submit/actor_call/
+        # create_actor whose REPLY was lost. The sender attaches a fresh
+        # NONCE per logical request and reuses it on retries; deliberate
+        # re-executions (lineage reconstruction, actor restart) mint a new
+        # nonce, so they are never confused with duplicate delivery
+        # (reference: task-id dedup in
+        # src/ray/core_worker/transport/direct_actor_transport.cc)
+        self._applied: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._applied_lock = threading.Lock()
+
+        # ownership: driver-submitted work tags its return objects (and
+        # actors) with the owner driver id; when the GCS declares that
+        # driver dead, this node reclaims its objects and kills its
+        # non-detached actors (reference: owner-failure cleanup,
+        # core_worker/reference_count.h:61 + gcs_job_manager.h, done
+        # GCS-mediated instead of per-worker RPC). Worker-created objects
+        # carry no owner: the node owns them, so detached-actor state
+        # survives driver churn. Bounded: oldest entries age out (an aged
+        # object merely falls back to normal LRU/spill lifecycle).
+        self._owner_of: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._actor_owner: Dict[bytes, bytes] = {}
+        self._owner_lock = threading.Lock()
+        self._driver_death_seq = 0
+
         # in-flight fetch/proxy threads, keyed by oid bytes
         self._fetching: set = set()
         self._fetch_lock = threading.Lock()
@@ -530,7 +561,7 @@ class NodeServer:
                [d.binary() for d in spec.deps],
                [d.binary() for d in spec.nested_deps],
                [r.binary() for r in spec.return_ids],
-               spec.options, None)
+               spec.options, None, os.urandom(16))
         try:
             self._peers.get(target).call(msg)
         except RpcError:
@@ -576,7 +607,7 @@ class NodeServer:
         rt = self.runtime
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
         msg = ("actor_call", actor_id.binary(), method, payload, deps, nested,
-               [r.binary() for r in return_ids])
+               [r.binary() for r in return_ids], os.urandom(16))
         addr = self._actor_addr(actor_id)
         try:
             self._peers.get(addr).call(msg)
@@ -653,9 +684,74 @@ class NodeServer:
             rt._functions.setdefault(fn_id, pickled)
         return True
 
+    _APPLIED_CAP = 16384
+    _OWNED_CAP = 1 << 18
+
+    def _tag_owner(self, oid_bytes_list, owner):
+        with self._owner_lock:
+            for b in oid_bytes_list:
+                self._owner_of[b] = owner
+            while len(self._owner_of) > self._OWNED_CAP:
+                self._owner_of.popitem(last=False)
+
+    def _untag_owner(self, oid_bytes_list):
+        with self._owner_lock:
+            for b in oid_bytes_list:
+                self._owner_of.pop(b, None)
+
+    def _dedup(self, nonce, fn):
+        """Run ``fn`` exactly once per nonce (at-most-once apply).
+
+        A duplicate delivery (lost-reply retry) returns the original's
+        result; a duplicate racing an IN-PROGRESS original waits for it
+        (wip latch) instead of reporting phantom success. The result is
+        published only on completion — if the original raises, the entry
+        is dropped so a retry legitimately re-runs. ``nonce=None`` (older
+        peers / no retry in play) just runs ``fn``."""
+        if nonce is None:
+            return fn()
+        while True:
+            with self._applied_lock:
+                ent = self._applied.get(nonce)
+                if ent is None:
+                    ev = threading.Event()
+                    self._applied[nonce] = ("wip", ev)
+                    break
+            if ent[0] == "done":
+                return ent[1]
+            ent[1].wait(600)  # original still applying: wait, re-check
+        try:
+            result = fn()
+        except BaseException:
+            with self._applied_lock:
+                self._applied.pop(nonce, None)
+            ev.set()
+            raise
+        with self._applied_lock:
+            self._applied[nonce] = ("done", result)
+            # evict oldest DONE entries; wip entries (rare, transient) go
+            # back at the tail. O(evictions), not O(cap).
+            requeue = []
+            while len(self._applied) - len(requeue) > self._APPLIED_CAP:
+                k, v = self._applied.popitem(last=False)
+                if v[0] == "wip":
+                    requeue.append((k, v))
+            for k, v in requeue:
+                self._applied[k] = v
+        ev.set()
+        return result
+
     def _op_submit(self, fn_id, pickled_fn, args_payload, deps, nested,
-                   return_ids, options, locations):
+                   return_ids, options, locations, nonce=None, owner=None):
+        return self._dedup(nonce, lambda: self._do_submit(
+            fn_id, pickled_fn, args_payload, deps, nested, return_ids,
+            options, locations, owner))
+
+    def _do_submit(self, fn_id, pickled_fn, args_payload, deps, nested,
+                   return_ids, options, locations, owner=None):
         rt = self.runtime
+        if owner is not None:
+            self._tag_owner(return_ids, owner)
         if pickled_fn is not None:
             with rt._lock:
                 rt._functions.setdefault(fn_id, pickled_fn)
@@ -783,6 +879,49 @@ class NodeServer:
             del view
             rt.store.release(oid)
 
+    def _owner_watch_loop(self):
+        """Poll the GCS for driver deaths; reclaim a dead driver's
+        objects and kill its non-detached actors on THIS node. Every node
+        runs the same loop over its own ownership maps, so cleanup needs
+        no fan-out coordinator (reference: owner-failure cleanup paths of
+        reference_count.h:61 / gcs_job_manager.h)."""
+        while not self._stop:
+            time.sleep(config.gcs_heartbeat_interval_s * 2)
+            try:
+                deaths = self.gcs.call(
+                    ("driver_deaths_since", self._driver_death_seq))
+            except (RpcError, Exception):  # noqa: BLE001
+                continue
+            for seq, driver_id in deaths:
+                self._driver_death_seq = max(self._driver_death_seq, seq)
+                try:
+                    self._reclaim_owner(driver_id)
+                except Exception:  # noqa: BLE001 — cleanup is best-effort
+                    pass
+
+    def _reclaim_owner(self, driver_id: bytes):
+        with self._owner_lock:
+            dead_oids = [b for b, o in self._owner_of.items()
+                         if o == driver_id]
+            dead_actors = [b for b, o in self._actor_owner.items()
+                           if o == driver_id]
+            for b in dead_oids:
+                self._owner_of.pop(b, None)
+            for b in dead_actors:
+                self._actor_owner.pop(b, None)
+        if dead_oids:
+            self._op_free(dead_oids)
+        for aid_b in dead_actors:
+            try:
+                self.runtime.kill_actor(ActorID(aid_b), no_restart=True)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _op_owner_cleanup(self, driver_id: bytes):
+        """Test/ops hook: reclaim one owner's footprint immediately."""
+        self._reclaim_owner(driver_id)
+        return True
+
     def _op_free(self, oid_bytes_list):
         """Eager deletion (driver free fan-out). Returns the ids actually
         freed here (the driver unions across nodes — a replicated object
@@ -796,6 +935,7 @@ class NodeServer:
         finally:
             for b in oid_bytes_list:
                 self._unpublished.discard(b)
+        self._untag_owner(oid_bytes_list)
         for b in oid_bytes_list:
             self.gcs.try_call(("loc_drop", b, self.address))
         return freed
@@ -851,10 +991,12 @@ class NodeServer:
         ready, rest = rt.wait(refs, num_returns=num_returns, timeout=timeout)
         return [r.binary() for r in ready], [r.binary() for r in rest]
 
-    def _op_put(self, data: bytes, oid_bytes=None):
+    def _op_put(self, data: bytes, oid_bytes=None, owner=None):
         rt = self.runtime
         oid = ObjectID(oid_bytes) if oid_bytes else ObjectID.from_random()
         store_incoming(rt, oid, data)
+        if owner is not None:
+            self._tag_owner([oid.binary()], owner)
         return oid.binary()
 
     def _op_release(self, oid_bytes_list):
@@ -889,8 +1031,19 @@ class NodeServer:
     # -- actors
 
     def _op_create_actor(self, cls_fn_id, pickled_cls, args_payload, deps,
-                         opts, locations, actor_id_b=None):
+                         opts, locations, actor_id_b=None, nonce=None,
+                         owner=None):
+        return self._dedup(nonce, lambda: self._do_create_actor(
+            cls_fn_id, pickled_cls, args_payload, deps, opts, locations,
+            actor_id_b, owner))
+
+    def _do_create_actor(self, cls_fn_id, pickled_cls, args_payload, deps,
+                         opts, locations, actor_id_b=None, owner=None):
         rt = self.runtime
+        if (owner is not None and actor_id_b is not None
+                and (opts or {}).get("lifetime") != "detached"):
+            with self._owner_lock:
+                self._actor_owner[actor_id_b] = owner
         if pickled_cls is not None:
             with rt._lock:
                 rt._functions.setdefault(cls_fn_id, pickled_cls)
@@ -905,8 +1058,16 @@ class NodeServer:
         return actor_id.binary()
 
     def _op_actor_call(self, actor_id_bytes, method, args_payload, deps,
-                       nested, return_ids):
+                       nested, return_ids, nonce=None, owner=None):
+        return self._dedup(nonce, lambda: self._do_actor_call(
+            actor_id_bytes, method, args_payload, deps, nested, return_ids,
+            owner))
+
+    def _do_actor_call(self, actor_id_bytes, method, args_payload, deps,
+                       nested, return_ids, owner=None):
         rt = self.runtime
+        if owner is not None:
+            self._tag_owner(return_ids, owner)
         actor_id = ActorID(actor_id_bytes)
         state = rt._actors.get(actor_id)
         if state is None:
@@ -933,6 +1094,12 @@ class NodeServer:
 
     def _op_actor_opts(self, actor_id_bytes):
         return self.runtime.get_actor_method_opts(ActorID(actor_id_bytes))
+
+    def _op_prestart_workers(self, num: int):
+        """Backlog hint: pre-fork idle workers ahead of a burst
+        (reference: PrestartWorkers RPC, raylet/worker_pool.h:344)."""
+        self.runtime.prestart_workers(int(num))
+        return True
 
     def _op_kill_actor(self, actor_id_bytes, no_restart):
         self.runtime.kill_actor(ActorID(actor_id_bytes), no_restart=no_restart)

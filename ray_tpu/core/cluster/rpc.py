@@ -112,6 +112,49 @@ class RpcServer:
             pass
 
 
+# Ops that are safe to retry after the request may have been APPLIED once
+# (reply lost: send succeeded, recv failed). Everything here is a read or a
+# set-style write where apply-twice == apply-once. Deliberately excluded:
+# submit / create_actor / actor_call (side effects run twice), publish
+# (duplicate pubsub event), free/release (refcount double-decrement),
+# kv merge/cas_merge (double-merge) — see the kv sub-op check below.
+# The reference splits the same way: gRPC retries are enabled per-method
+# only for idempotent GCS reads (src/ray/rpc/gcs_server/gcs_rpc_client.h).
+_IDEMPOTENT_OPS = frozenset({
+    # reads / polls
+    "ping", "status", "state", "stack_dump", "task_events", "list_logs",
+    "get_log", "list_nodes", "wait_nodes", "deaths_since", "freed_check",
+    "get_named_actor", "list_actors", "loc_get", "poll", "get_fn",
+    "get", "fetch", "fetch_size", "fetch_range", "has", "wait",
+    "actor_opts",
+    # set/last-writer-wins writes (apply-twice == apply-once)
+    "register_node", "heartbeat", "unregister_node", "freed_add",
+    "name_actor", "drop_actor_name", "register_actor",
+    "register_actor_spec", "drop_actor_spec", "loc_add", "loc_add_batch",
+    "loc_drop", "register_fn", "cancel", "kill_actor", "prestart_workers",
+    "register_driver", "driver_heartbeat", "unregister_driver",
+    "driver_deaths_since", "owner_cleanup",
+    # exactly-once via server-side dedup on the caller-chosen id
+    # (NodeServer._dedup): re-apply is a no-op
+    "submit", "actor_call", "create_actor",
+})
+
+_IDEMPOTENT_KV_SUBOPS = frozenset({"put", "get", "del", "exists", "keys"})
+
+
+def _retry_safe_after_apply(msg) -> bool:
+    """True when re-sending ``msg`` is safe even if the server already
+    applied it once (at-least-once delivery is indistinguishable from
+    exactly-once for these ops)."""
+    try:
+        op = msg[0]
+    except Exception:  # noqa: BLE001
+        return False
+    if op == "kv":
+        return len(msg) > 1 and msg[1] in _IDEMPOTENT_KV_SUBOPS
+    return op in _IDEMPOTENT_OPS
+
+
 class RpcClient:
     """Pooled client to one RpcServer address.
 
@@ -146,24 +189,30 @@ class RpcClient:
             raise RpcError("client closed")
         with self._lock:
             conn = self._pool.pop() if self._pool else None
-        pooled = conn is not None
         if conn is None:
             conn = self._connect()
+        sent = False
         try:
             conn.send(msg)
+            sent = True
             tag, value = conn.recv()
         except (EOFError, OSError, BrokenPipeError) as e:
             try:
                 conn.close()
             except Exception:  # noqa: BLE001
                 pass
-            if pooled:
-                # keepalive-retry heuristic: an idle pooled connection
-                # that fails immediately almost certainly died while
-                # parked (server restart) — drop the whole pool (parked
-                # siblings share its fate) and retry ONCE on a fresh
-                # connection so a restarted GCS/node is transparent to
-                # callers (reference: GCS client reconnect)
+            # same-address retry: a pooled connection that fails almost
+            # certainly died while parked (server restart) — drop the
+            # whole pool (parked siblings share its fate); a fresh
+            # connection that fails mid-exchange gets one more try too,
+            # so a lost REPLY is retried on the SAME server, where nonce
+            # dedup (node_server._dedup) makes re-delivery exactly-once.
+            # Retry is only safe when the request cannot have been
+            # applied (send itself failed — partial frames are discarded
+            # server-side) OR the op is retry-safe per the whitelist; a
+            # lost reply to anything else surfaces as RpcError, never
+            # re-runs side effects (at-least-once hazard).
+            if not sent or _retry_safe_after_apply(msg):
                 with self._lock:
                     stale, self._pool = self._pool, []
                 for c in stale:

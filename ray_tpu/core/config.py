@@ -114,6 +114,23 @@ _FLAGS: List[Flag] = [
          "A node missing heartbeats for this long is marked DEAD "
          "(reference: health_check_timeout_ms, "
          "gcs_health_check_manager.h)."),
+    Flag("worker_zygote", bool, True,
+         "Fork new workers from a pre-warmed zygote template (~10ms) "
+         "instead of cold interpreter starts (~300ms). TPU workers always "
+         "cold-spawn (reference: PrestartWorkers, "
+         "raylet/worker_pool.h:344)."),
+    Flag("gcs_wal_fsync", bool, False,
+         "fsync the GCS write-ahead log on every append. Default off: "
+         "durability then covers GCS process crashes (the common failure), "
+         "not host/OS crashes. Turn on for strict durability at ~ms/append "
+         "cost (reference: gcs_storage durability knobs)."),
+    Flag("driver_heartbeat_interval_s", float, 0.5,
+         "Driver -> GCS owner-liveness heartbeat period."),
+    Flag("driver_heartbeat_timeout_s", float, 3.0,
+         "A driver missing heartbeats this long is declared dead; its "
+         "objects are reclaimed cluster-wide and its non-detached actors "
+         "stop restarting (reference: owner-failure semantics, "
+         "core_worker/reference_count.h:61, gcs_job_manager.h)."),
     Flag("cluster_view_refresh_s", float, 0.25,
          "Driver-side cluster view (node table + loads) max staleness "
          "before re-fetching from the GCS."),

@@ -18,6 +18,7 @@ control plane):
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -117,6 +118,70 @@ class _TaskSpec:
         # for nested submissions, None for driver-originated work
         # (reference: tracing_helper.py's trace-context injection)
         self.parent_task: Optional[str] = None
+
+
+class _ForkedProc:
+    """Popen-compatible handle for a worker forked by the zygote.
+
+    The child is the ZYGOTE's child (kernel-reaped there via SIG_IGN),
+    so Popen's wait machinery doesn't apply. Liveness and signaling go
+    through a pidfd: the fd names the exact process, so a recycled pid
+    can never be misread as the worker still alive, nor signaled by
+    mistake (a bare signal-0 probe has both hazards). Matches the subset
+    of the Popen surface the runtime uses (pid/poll/terminate/kill/
+    wait)."""
+
+    __slots__ = ("pid", "returncode", "_pidfd")
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode = None
+        try:
+            self._pidfd = os.pidfd_open(pid)
+        except OSError:
+            # already gone (or no pidfd support): treat as exited —
+            # never fall back to pid probing, it can alias a recycled pid
+            self._pidfd = None
+            self.returncode = -1
+
+    def poll(self):
+        if self.returncode is not None:
+            return self.returncode
+        import select
+
+        r, _, _ = select.select([self._pidfd], [], [], 0)
+        if r:  # pidfd becomes readable when the process exits
+            self.returncode = -1
+            os.close(self._pidfd)
+            self._pidfd = None
+        return self.returncode
+
+    def _signal(self, sig):
+        if self._pidfd is None:
+            return
+        try:
+            signal.pidfd_send_signal(self._pidfd, sig)
+        except (OSError, ProcessLookupError):
+            pass
+
+    def terminate(self):
+        self._signal(signal.SIGTERM)
+
+    def kill(self):
+        self._signal(signal.SIGKILL)
+
+    def wait(self, timeout=None):
+        import select
+
+        if self.returncode is not None:
+            return self.returncode
+        r, _, _ = select.select([self._pidfd], [], [], timeout)
+        if not r:
+            raise subprocess.TimeoutExpired("forked-worker", timeout)
+        self.returncode = -1
+        os.close(self._pidfd)
+        self._pidfd = None
+        return self.returncode
 
 
 class _Worker:
@@ -260,14 +325,22 @@ class Runtime:
             target=self._accept_loop, daemon=True, name="rtpu-accept"
         )
         self._accept_thread.start()
+        # zygote: pre-warmed fork template for ~10ms worker launch
+        # (reference: prestarted workers, raylet/worker_pool.h:344)
+        self._zygote: Optional[subprocess.Popen] = None
+        self._zygote_lock = threading.Lock()
+        if config.worker_zygote:
+            try:
+                self._start_zygote_locked()
+            except Exception:  # noqa: BLE001 — fall back to cold spawns
+                self._zygote = None
         for _ in range(self.num_workers):
             self._spawn_worker()
 
     # ------------------------------------------------------------------ pool
 
-    def _spawn_worker(self, tpu: bool = False,
-                      extra_env: Optional[Dict[str, str]] = None) -> _Worker:
-        worker_id = WorkerID.from_random()
+    def _pool_env(self, tpu: bool,
+                  extra_env: Optional[Dict[str, str]]) -> Dict[str, str]:
         env = dict(os.environ)
         env.update(
             RTPU_ADDRESS=self._sock_path,
@@ -275,38 +348,111 @@ class Runtime:
             RTPU_STORE="/" + self._session,
             RTPU_PKG_DIR=os.path.join("/tmp", self._session, "packages"),
             RTPU_NODE_ID=self.node_id.hex(),
-            RTPU_WORKER_ID=worker_id.hex(),
         )
         if extra_env:
             env.update(extra_env)
         if not tpu:
-            # Plain pool workers skip TPU/PJRT plugin registration, which
-            # this environment's sitecustomize triggers off these vars and
-            # which costs ~2s of jax import per process. Workers that land
-            # TPU actors (num_tpus>0) are spawned with the env intact.
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env.setdefault("JAX_PLATFORMS", "cpu")
-            if env.get("JAX_PLATFORMS") == "axon":
-                env["JAX_PLATFORMS"] = "cpu"
-        out = err = None
+            # Plain pool workers skip TPU/PJRT plugin registration
+            # (~2s jax import per process); workers that land TPU actors
+            # (num_tpus>0) are spawned with the env intact. Shared with
+            # the zygote fork path — see worker_env.py.
+            from ray_tpu.core.worker_env import sanitize_cpu_worker_env
+
+            sanitize_cpu_worker_env(env)
+        return env
+
+    def _start_zygote_locked(self):
+        # bufsize=0: replies are read through select(), which must never
+        # be defeated by data parked in a userspace buffer
+        self._zygote = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main", "--zygote"],
+            env=self._pool_env(tpu=False, extra_env=None),
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, bufsize=0,
+            stderr=open(os.path.join(self.log_dir, "zygote.err"), "ab",
+                        buffering=0),
+        )
+        self._zygote_ready = False
+
+    def _fork_from_zygote(self, worker_id: WorkerID,
+                          extra_env: Optional[Dict[str, str]],
+                          out_path: Optional[str],
+                          err_path: Optional[str]) -> Optional[int]:
+        """Ask the zygote for a forked worker; returns the pid or None
+        (zygote unavailable — caller cold-spawns)."""
+        import json
+        import select
+
+        with self._zygote_lock:
+            z = self._zygote
+            if z is None or z.poll() is not None:
+                if self._shutdown:
+                    return None
+                try:
+                    self._start_zygote_locked()
+                    z = self._zygote
+                except Exception:  # noqa: BLE001
+                    self._zygote = None
+                    return None
+            try:
+                if not self._zygote_ready:
+                    # first use: wait for the warm-import banner
+                    r, _, _ = select.select([z.stdout], [], [], 30.0)
+                    if not r or b"ZYGOTE_READY" not in z.stdout.readline():
+                        raise RuntimeError("zygote never became ready")
+                    self._zygote_ready = True
+                req = {"wid": worker_id.hex(), "env": extra_env or {},
+                       "out": out_path, "err": err_path}
+                z.stdin.write((json.dumps(req) + "\n").encode())
+                z.stdin.flush()
+                r, _, _ = select.select([z.stdout], [], [], 30.0)
+                if not r:
+                    raise RuntimeError("zygote fork timed out")
+                return int(z.stdout.readline())
+            except Exception:  # noqa: BLE001 — zygote wedged: drop it
+                try:
+                    z.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._zygote = None
+                return None
+
+    def _spawn_worker(self, tpu: bool = False,
+                      extra_env: Optional[Dict[str, str]] = None) -> _Worker:
+        worker_id = WorkerID.from_random()
+        out_path = err_path = None
         if config.worker_log_redirect:
             from ray_tpu.core.log_monitor import worker_log_paths
 
             out_path, err_path = worker_log_paths(self.log_dir,
                                                   worker_id.hex())
-            out = open(out_path, "ab", buffering=0)
-            err = open(err_path, "ab", buffering=0)
-        try:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker_main"],
-                env=env, stdin=subprocess.DEVNULL, stdout=out, stderr=err,
-            )
-        finally:
-            # the child holds its own descriptors after fork/exec
-            if out is not None:
-                out.close()
-            if err is not None:
-                err.close()
+        proc = None
+        if not tpu and self._zygote is not None:
+            # fast path: fork from the warm template. TPU workers need a
+            # fresh interpreter (PJRT plugin registration is env-driven
+            # at startup), so they always cold-spawn.
+            pid = self._fork_from_zygote(worker_id, extra_env,
+                                         out_path, err_path)
+            if pid is not None:
+                proc = _ForkedProc(pid)
+        if proc is None:
+            env = self._pool_env(tpu, extra_env)
+            env["RTPU_WORKER_ID"] = worker_id.hex()
+            out = err = None
+            if out_path is not None:
+                out = open(out_path, "ab", buffering=0)
+                err = open(err_path, "ab", buffering=0)
+            try:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                    env=env, stdin=subprocess.DEVNULL, stdout=out,
+                    stderr=err,
+                )
+            finally:
+                # the child holds its own descriptors after fork/exec
+                if out is not None:
+                    out.close()
+                if err is not None:
+                    err.close()
         w = _Worker(worker_id, proc)
         with self._lock:
             self._workers[worker_id] = w
@@ -2097,6 +2243,21 @@ class Runtime:
             return None
         raise ValueError(op)
 
+    def prestart_workers(self, num: int):
+        """Pre-spawn up to ``num`` EXTRA idle workers ahead of an
+        anticipated burst (reference: WorkerPool::PrestartWorkers,
+        src/ray/raylet/worker_pool.h:344 — there driven by task-backlog
+        hints). With the zygote this is ~10ms each; surplus workers are
+        retired by the normal pool-trim path once load passes."""
+        with self._lock:
+            if self._shutdown:
+                return
+            have = sum(1 for w in self._workers.values()
+                       if w.alive and w.actor_id is None) + self._spawning
+            want = min(num, 4 * self.num_workers - have)
+        for _ in range(max(0, want)):
+            self._spawn_worker()
+
     def wait_for_workers(self, count: Optional[int] = None,
                          timeout: Optional[float] = None):
         from ray_tpu.core.config import config
@@ -2133,6 +2294,13 @@ class Runtime:
                 w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 w.proc.kill()
+        if self._zygote is not None:
+            try:
+                self._zygote.stdin.close()  # EOF -> zygote exits
+                self._zygote.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+            self._zygote = None
         try:
             self._listener.close()
         except OSError:

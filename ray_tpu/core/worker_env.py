@@ -1,0 +1,17 @@
+"""Env rules shared by BOTH worker spawn paths (cold Popen and zygote
+fork). One definition so a new TPU/PJRT env rule can never apply to one
+path and silently miss the other."""
+
+from __future__ import annotations
+
+
+def sanitize_cpu_worker_env(env) -> None:
+    """Strip TPU/PJRT triggers from a plain CPU pool worker's env.
+
+    This environment's sitecustomize keys TPU plugin registration (and a
+    ~2s jax import) off these variables; CPU workers must never pay that
+    or claim the chip. Mutates ``env`` in place (works for both a dict
+    and os.environ)."""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if env.get("JAX_PLATFORMS", "axon") == "axon":
+        env["JAX_PLATFORMS"] = "cpu"

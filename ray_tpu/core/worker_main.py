@@ -1,11 +1,19 @@
-"""Entry-point shim: ``python -m ray_tpu.core.worker_main``.
+"""Entry-point shim: ``python -m ray_tpu.core.worker_main [--zygote]``.
 
 Kept separate from the implementation so that classes defined in the worker
 module are never duplicated between ``__main__`` and the canonical module
 path (which would break isinstance checks on unpickled objects).
+
+``--zygote`` starts the pre-warmed fork template instead of a worker
+(reference: prestarted workers, src/ray/raylet/worker_pool.h:344).
 """
 
-from ray_tpu.core.worker_proc import main
+import sys
+
+from ray_tpu.core.worker_proc import main, zygote_main
 
 if __name__ == "__main__":
-    main()
+    if "--zygote" in sys.argv[1:]:
+        zygote_main()
+    else:
+        main()

@@ -677,5 +677,82 @@ def main():
             store.close()
 
 
+def zygote_main():
+    """Pre-warmed worker template: fork new workers in milliseconds.
+
+    Answers the reference's prestarted-worker pool
+    (src/ray/raylet/worker_pool.h:344 PrestartWorkers, prestarted idle
+    pool at :163): instead of keeping N idle full processes around, keep
+    ONE warm template whose fork is ~10 ms — interpreter start and module
+    imports (the ~300 ms that made actor launch slow) are paid once.
+    Forked children share the template's pages copy-on-write, so a fleet
+    of workers is also cheaper in RSS than N separate interpreters.
+
+    Protocol (runtime -> zygote over stdin, replies on stdout)::
+
+        {"wid": hex, "env": {...}, "out": path|null, "err": path|null}\\n
+        -> "<pid>\\n"
+
+    The zygote runs NO threads and holds NO locks at fork time; children
+    reset signal handlers, apply their env, redirect stdio, and enter the
+    normal ``main()``. EOF on stdin (runtime gone) exits the zygote;
+    SIGCHLD is ignored so the kernel auto-reaps dead children.
+    """
+    import json
+    import signal
+
+    signal.signal(signal.SIGCHLD, signal.SIG_IGN)
+    # warm everything main() touches before the first fork
+    import ray_tpu.api  # noqa: F401
+    from ray_tpu.core.config import config  # noqa: F401
+
+    stdin = sys.stdin.buffer if hasattr(sys.stdin, "buffer") else sys.stdin
+    stdout = sys.stdout
+    print("ZYGOTE_READY", flush=True)
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+        except Exception:  # noqa: BLE001
+            continue
+        pid = os.fork()
+        if pid == 0:
+            # ---- child: become a normal worker ----
+            try:
+                signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+                os.environ.update(req.get("env") or {})
+                os.environ["RTPU_WORKER_ID"] = req["wid"]
+                # same non-TPU sanitization the cold-spawn path applies
+                # AFTER merging extra_env: zygote children are always
+                # plain CPU workers, so a user runtime_env must not drag
+                # in TPU/PJRT registration (shared rules: worker_env.py)
+                from ray_tpu.core.worker_env import sanitize_cpu_worker_env
+
+                sanitize_cpu_worker_env(os.environ)
+                devnull = os.open(os.devnull, os.O_RDONLY)
+                os.dup2(devnull, 0)
+                os.close(devnull)
+                for path, fd in ((req.get("err"), 2), (req.get("out"), 1)):
+                    if path:
+                        f = os.open(path,
+                                    os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+                        os.dup2(f, fd)
+                        os.close(f)
+                if not req.get("out"):
+                    # NEVER leave fd 1 on the zygote's protocol pipe — a
+                    # worker print would corrupt fork replies. No log
+                    # path -> route stdout alongside stderr.
+                    os.dup2(2, 1)
+                main()
+            except BaseException:  # noqa: BLE001
+                traceback.print_exc()
+            finally:
+                os._exit(0)
+        stdout.write(f"{pid}\n")
+        stdout.flush()
+
+
 if __name__ == "__main__":
     main()

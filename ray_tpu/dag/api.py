@@ -95,6 +95,9 @@ class CompiledDag:
         self._store = getattr(core, "store", None) \
             or getattr(core, "_home_store", None)
         self._kv = core.kv_op
+        # socket-channel auth rides the cluster authkey; the driver holds
+        # it programmatically (env may be unset in test drivers)
+        self._chan_authkey = getattr(core, "_authkey", None)
 
         # ---- collect stages in topological order (DFS postorder) ----
         stages: List[_BoundStage] = []
@@ -208,10 +211,12 @@ class CompiledDag:
         # driver endpoints (socket endpoints rendezvous lazily; stage
         # loops are already up, so their reader sides publish)
         self._inputs = [ch if ch is not None else
-                        open_endpoint(desc, kv=self._kv, role="writer")
+                        open_endpoint(desc, kv=self._kv, role="writer",
+                                      authkey=self._chan_authkey)
                         for desc, ch in self._in_edges]
         self._outputs = [ch if ch is not None else
-                         open_endpoint(desc, kv=self._kv, role="reader")
+                         open_endpoint(desc, kv=self._kv, role="reader",
+                                       authkey=self._chan_authkey)
                          for desc, ch in self._out_edges]
 
     # ------------------------------------------------------------- calls

@@ -13,3 +13,26 @@ from ray_tpu.core.scheduling_strategies import (  # noqa: F401
 )
 from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
 from ray_tpu.util.queue import Empty, Full, Queue  # noqa: F401
+
+
+def host_node_pid() -> int:
+    """Pid of the node-server (or embedded-runtime driver) process that
+    hosts this worker. Workers are spawned either directly (cold spawn)
+    or by the node's fork zygote; this walks past any ``--zygote``
+    ancestor so callers get a stable "which node am I on" answer
+    (reference role: ray.get_runtime_context().get_node_id, but by
+    process identity, which tests can match against fixture pids)."""
+    import os
+
+    pid = os.getppid()
+    for _ in range(4):
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace")
+            if "--zygote" not in cmd:
+                return pid
+            with open(f"/proc/{pid}/stat") as f:
+                pid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            return pid
+    return pid

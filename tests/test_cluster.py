@@ -84,14 +84,11 @@ def cluster():
     runtime_context.set_core(prev_core)
 
 
-def _node_pid():
-    return os.getppid()
-
-
 def test_cluster_tasks_schedule_across_nodes(cluster):
     @ray_tpu.remote
     def who():
-        return os.getppid()
+        from ray_tpu.util import host_node_pid
+        return host_node_pid()
 
     # pin one task per node via its unique resource
     pids = {}
@@ -193,7 +190,8 @@ def test_cluster_actor_cross_node_calls(cluster):
     class Counter:
         def __init__(self):
             self.n = 0
-            self.pid = os.getppid()
+            from ray_tpu.util import host_node_pid
+            self.pid = host_node_pid()
 
         def incr(self):
             self.n += 1
@@ -284,7 +282,8 @@ def test_cluster_placement_group_spread(cluster):
 
     @ray_tpu.remote
     def who():
-        return os.getppid()
+        from ray_tpu.util import host_node_pid
+        return host_node_pid()
 
     pids = set()
     for i in range(3):
@@ -300,7 +299,8 @@ def test_cluster_spillback_from_worker_submission(cluster):
     # the node-0 scheduler must spill it to node 2
     @ray_tpu.remote
     def inner():
-        return os.getppid()
+        from ray_tpu.util import host_node_pid
+        return host_node_pid()
 
     @ray_tpu.remote
     def outer():
@@ -378,7 +378,8 @@ def test_cluster_remove_node_survival():
 
         @ray_tpu.remote
         def who():
-            return os.getppid()
+            from ray_tpu.util import host_node_pid
+            return host_node_pid()
 
         @ray_tpu.remote
         class Sticky:
@@ -445,7 +446,8 @@ def test_runtime_env_working_dir_across_nodes(cluster, tmp_path):
     @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
     def read_marker():
         with open("marker.txt") as f:
-            return f.read(), os.getppid()
+            from ray_tpu.util import host_node_pid
+            return f.read(), host_node_pid()
 
     # spread over enough tasks to hit more than one node's workers
     results = ray_tpu.get([read_marker.remote() for _ in range(8)],

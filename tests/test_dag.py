@@ -291,3 +291,128 @@ def test_cross_node_dag():
     finally:
         c.shutdown()
         runtime_context.set_core(prev)
+
+
+def test_socket_channel_rejects_unauthenticated_peer():
+    """A stray/hostile connection must neither hijack the edge nor wedge
+    it: the reader keeps accepting until an authkey'd peer completes the
+    HMAC handshake (ADVICE r3: unauthenticated SocketChannel)."""
+    import socket as _socket
+
+    from ray_tpu.dag.channel import SocketChannel
+
+    kv_store = {}
+
+    def kv(op, key, value=None):
+        if op == "put":
+            kv_store[key] = value
+        elif op == "get":
+            return kv_store.get(key)
+        elif op == "del":
+            kv_store.pop(key, None)
+
+    key = b"k" * 16
+    cid = SocketChannel.create_id()
+    reader = SocketChannel(cid, kv, "reader", host="127.0.0.1", authkey=key)
+    port = kv_store[f"dagchan:{cid}"]
+
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(reader.read(timeout_ms=20_000)),
+        daemon=True)
+    t.start()
+
+    # hostile peer: connects first, sends garbage instead of a valid HMAC
+    # answer — must be dropped, not accepted
+    evil = _socket.create_connection(("127.0.0.1", port), timeout=5)
+    evil.sendall(b"\x00" * 64)
+    time.sleep(0.3)
+
+    # wrong-key peer: completes the handshake protocol but can't answer
+    # the challenge
+    with pytest.raises(Exception):
+        bad = SocketChannel(cid, kv, "writer", host="127.0.0.1",
+                            authkey=b"x" * 16)
+        bad.write("stolen", timeout_ms=3000)
+
+    # the real writer still gets through
+    writer = SocketChannel(cid, kv, "writer", host="127.0.0.1", authkey=key)
+    writer.write("hello", timeout_ms=10_000)
+    t.join(timeout=10)
+    assert got == ["hello"]
+    evil.close()
+    writer.release()
+    reader.release()
+
+
+def test_rpc_retry_whitelist():
+    """Lost-reply retries are restricted to idempotent ops (ADVICE r3:
+    at-least-once hazard on submit/kv-merge/publish)."""
+    from ray_tpu.core.cluster.rpc import _retry_safe_after_apply
+
+    assert _retry_safe_after_apply(("loc_get", b"x"))
+    assert _retry_safe_after_apply(("heartbeat", b"n", {}, 0))
+    assert _retry_safe_after_apply(("kv", "get", "k"))
+    assert _retry_safe_after_apply(("kv", "put", "k", 1))
+    assert not _retry_safe_after_apply(("kv", "merge", "k", {}))
+    assert not _retry_safe_after_apply(("kv", "cas_merge", "k", {}, 0))
+    assert not _retry_safe_after_apply(("publish", "ch", "m"))
+    assert not _retry_safe_after_apply(("free", [b"o"]))
+    assert not _retry_safe_after_apply(("release", [b"o"]))
+    # submit/actor_call/create_actor are retry-safe ONLY because the node
+    # dedups them on the per-request nonce (NodeServer._dedup)
+    assert _retry_safe_after_apply(("submit", b"f"))
+    assert _retry_safe_after_apply(("actor_call", b"a"))
+    assert _retry_safe_after_apply(("create_actor", b"c"))
+
+
+def test_node_server_dedups_retried_submissions():
+    """A re-delivered submit/actor_call (lost-reply retry) must not run
+    side effects twice, while a FAILED apply must be re-runnable and an
+    in-progress apply must latch duplicates (ADVICE r3 + review r4)."""
+    from collections import OrderedDict
+
+    from ray_tpu.core.cluster.node_server import NodeServer
+
+    s = NodeServer.__new__(NodeServer)
+    s._applied = OrderedDict()
+    s._applied_lock = threading.Lock()
+
+    calls = []
+    assert s._dedup(b"n1", lambda: calls.append(1) or "r1") == "r1"
+    assert s._dedup(b"n1", lambda: calls.append(2) or "r2") == "r1"
+    assert calls == [1]                      # duplicate deduped
+    assert s._dedup(None, lambda: "x") == "x"  # no nonce: always runs
+
+    # a failed apply is NOT memoized: the retry re-runs it
+    with pytest.raises(ValueError):
+        s._dedup(b"n2", lambda: (_ for _ in ()).throw(ValueError("boom")))
+    assert s._dedup(b"n2", lambda: "ok") == "ok"
+
+    # wip latch: a duplicate racing an in-progress apply waits for the
+    # original result instead of reporting phantom success
+    started, release = threading.Event(), threading.Event()
+
+    def slow():
+        started.set()
+        release.wait(10)
+        return "slow-result"
+
+    results = []
+    t1 = threading.Thread(target=lambda: results.append(
+        s._dedup(b"n3", slow)), daemon=True)
+    t1.start()
+    started.wait(5)
+    t2 = threading.Thread(target=lambda: results.append(
+        s._dedup(b"n3", lambda: "dup-ran")), daemon=True)
+    t2.start()
+    time.sleep(0.2)
+    release.set()
+    t1.join(5)
+    t2.join(5)
+    assert results.count("slow-result") == 2 and "dup-ran" not in results
+
+    # bounded: old done entries age out
+    for i in range(NodeServer._APPLIED_CAP + 10):
+        s._dedup(b"x%d" % i, lambda: True)
+    assert len(s._applied) <= NodeServer._APPLIED_CAP

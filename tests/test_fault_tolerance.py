@@ -215,3 +215,133 @@ def test_cluster_reconstruction_after_node_death():
     finally:
         c.shutdown()
         runtime_context.set_core(prev)
+
+
+def test_driver_death_reclaims_owned_state():
+    """Owner-failure semantics (reference: reference_count.h:61 owner
+    death, gcs_job_manager.h): kill -9 a driver mid-workload; its
+    detached actor keeps serving, its non-detached actor is killed, and
+    its owned objects are reclaimed from the store."""
+    import subprocess
+    import sys
+
+    from ray_tpu.core.cluster.fixture import Cluster
+    from ray_tpu.core.cluster.rpc import RpcClient
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=1, num_workers_per_node=2,
+                object_store_memory=64 << 20)
+    try:
+        script = r"""
+import os, sys, time
+import ray_tpu
+from ray_tpu.core import runtime_context
+from ray_tpu.core.cluster.cluster_core import ClusterCore
+
+core = ClusterCore((sys.argv[1], int(sys.argv[2])))
+runtime_context.set_core(core)
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self): self.n = 0
+    def bump(self): self.n += 1; return self.n
+
+det = Counter.options(name="survivor", lifetime="detached").remote()
+assert ray_tpu.get(det.bump.remote(), timeout=60) == 1
+plain = Counter.options(name="casualty", max_restarts=5).remote()
+assert ray_tpu.get(plain.bump.remote(), timeout=60) == 1
+
+import numpy as np
+ref = ray_tpu.put(np.zeros(4 << 20, dtype=np.uint8))  # 4 MiB, driver-owned
+print("OID", ref.binary().hex(), flush=True)
+print("DRIVER_READY", flush=True)
+time.sleep(600)  # parked until killed
+"""
+        env = dict(os.environ)
+        env["RTPU_CLUSTER_AUTHKEY"] = c.authkey.hex()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script,
+             c.gcs_address[0], str(c.gcs_address[1])],
+            stdout=subprocess.PIPE, env=env, text=True)
+        oid_hex = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline().strip()
+            if line.startswith("OID "):
+                oid_hex = line.split()[1]
+            if line == "DRIVER_READY":
+                break
+        assert oid_hex, "driver never published its object id"
+        oid_b = bytes.fromhex(oid_hex)
+
+        node = RpcClient(c.nodes[0].address, c.authkey)
+        assert node.call(("has", oid_b)), "object should exist pre-kill"
+
+        proc.kill()
+        proc.wait()
+
+        # the GCS declares the driver dead after its heartbeat timeout;
+        # nodes then reclaim. Poll for the cleanup to land.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and node.call(("has", oid_b)):
+            time.sleep(0.25)
+        assert not node.call(("has", oid_b)), \
+            "dead driver's object was never reclaimed"
+
+        # a second driver: the detached actor lives, the plain one died
+        core2 = c.connect()
+        runtime_context.set_core(core2)
+        h = ray_tpu.get_actor("survivor")
+        assert ray_tpu.get(h.bump.remote(), timeout=60) == 2
+
+        from ray_tpu.exceptions import ActorDiedError, GetTimeoutError
+        deadline = time.monotonic() + 30
+        dead = False
+        while time.monotonic() < deadline and not dead:
+            try:
+                h2 = ray_tpu.get_actor("casualty")
+                ray_tpu.get(h2.bump.remote(), timeout=5)
+                time.sleep(0.5)       # still serving: poll again
+            except GetTimeoutError:
+                continue              # slow cluster is NOT death
+            except (ActorDiedError, ValueError):
+                dead = True           # killed, or name already dropped
+        assert dead, "non-detached actor outlived its dead driver"
+        node.close()
+    finally:
+        runtime_context.set_core(prev)
+        c.shutdown()
+
+
+def test_owner_cleanup_op_reclaims_immediately():
+    """The ops hook ('owner_cleanup', driver_id) reclaims one owner's
+    objects deterministically — the node-local half of the organic
+    driver-death path, without waiting for heartbeat timeouts."""
+    from ray_tpu.core import runtime_context as rc
+    from ray_tpu.core.cluster.fixture import Cluster
+    from ray_tpu.core.cluster.rpc import RpcClient
+
+    prev = rc.get_core_or_none()
+    rc.set_core(None)
+    c = Cluster(num_nodes=1, num_workers_per_node=1,
+                object_store_memory=64 << 20)
+    try:
+        core = c.connect()
+        rc.set_core(core)
+        ref = ray_tpu.put(np.zeros(1 << 20, dtype=np.uint8))
+        node = RpcClient(c.nodes[0].address, c.authkey)
+        assert node.call(("has", ref.binary()))
+        node.call(("owner_cleanup", core._driver_id))
+        assert not node.call(("has", ref.binary()))
+        # untagged (worker-owned) objects are untouched by owner cleanup
+        @ray_tpu.remote
+        def make():
+            return ray_tpu.put(b"worker-owned")
+        inner = ray_tpu.get(make.remote(), timeout=60)
+        node.call(("owner_cleanup", core._driver_id))
+        assert ray_tpu.get(inner, timeout=30) == b"worker-owned"
+        node.close()
+    finally:
+        rc.set_core(prev)
+        c.shutdown()
