@@ -158,6 +158,21 @@ class NodeRuntime(Runtime):
                         ActorID(actor_id_b), method, args_payload,
                         extra.get("__deps", []), n_returns)
                     return ("ok", [r.binary() for r in refs])
+            elif tag == protocol.REQ_ACTOR_CALL_ASYNC:
+                _, actor_id_b, method, args_payload, extra, rids_b = msg
+                if ActorID(actor_id_b) not in self._actors:
+                    try:
+                        srv.forward_actor_call_payload(
+                            ActorID(actor_id_b), method, args_payload,
+                            extra.get("__deps", []), len(rids_b),
+                            return_ids=[ObjectID(b) for b in rids_b])
+                    except BaseException as e:  # noqa: BLE001 — at get()
+                        self._store_error(
+                            [ObjectID(b) for b in rids_b],
+                            e if isinstance(e, ActorDiedError)
+                            else ActorDiedError(
+                                f"actor call failed: {e!r}"))
+                    return protocol.NO_REPLY
         return super()._handle_data_request(w, msg)
 
     # spillback: infeasible plain tasks leave for a fitting peer
@@ -596,16 +611,22 @@ class NodeServer:
 
     def forward_actor_call_payload(self, actor_id: ActorID, method: str,
                                    args_payload, deps: List[bytes],
-                                   num_returns: int) -> List[ObjectRef]:
-        """Route a worker's call on a peer node's actor (payload level)."""
+                                   num_returns: int,
+                                   return_ids: Optional[List[ObjectID]]
+                                   = None) -> List[ObjectRef]:
+        """Route a worker's call on a peer node's actor (payload level).
+        ``return_ids`` preset = fire-and-forget caller already handed
+        refs out."""
         return self._send_actor_call(
             actor_id, method, materialize(self.runtime, args_payload),
-            list(deps), [], num_returns)
+            list(deps), [], num_returns, return_ids=return_ids)
 
     def _send_actor_call(self, actor_id, method, payload, deps, nested,
-                         num_returns) -> List[ObjectRef]:
+                         num_returns, return_ids=None) -> List[ObjectRef]:
         rt = self.runtime
-        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        if return_ids is None:
+            return_ids = [ObjectID.from_random()
+                          for _ in range(num_returns)]
         msg = ("actor_call", actor_id.binary(), method, payload, deps, nested,
                [r.binary() for r in return_ids], os.urandom(16))
         addr = self._actor_addr(actor_id)

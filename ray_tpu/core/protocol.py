@@ -55,6 +55,18 @@ REQ_NEED_SPACE = "need_space"      # (REQ_NEED_SPACE, nbytes) -> ("ok", freed_bo
 REQ_FREE = "free_objs"             # (REQ_FREE, [oid_bytes]) -> ("ok", count_freed)
 REQ_KILL_ACTOR = "kill_actor_req"  # (REQ_KILL_ACTOR, actor_id_bytes, no_restart) -> ("ok",)
 
+# fire-and-forget variants (NO reply — the worker pre-generates the ids,
+# so the owner's round trip leaves the submission hot path; errors land
+# in the return-object entries and surface at get(), like the
+# reference's async task submission through the core worker):
+REQ_PUT_META_ASYNC = "put_meta_async"      # (.., oid_bytes, payload_or_none)
+REQ_SUBMIT_ASYNC = "submit_async"          # (.., fn_id, pickled_fn_or_none, args_payload, inline_values, return_ids, options)
+REQ_ACTOR_CALL_ASYNC = "actor_call_async"  # (.., actor_id_b, method, args_payload, extra, return_ids)
+
+REQ_BARRIER = "barrier"  # (REQ_BARRIER,) -> ("ok",): all earlier async sends applied
+
+NO_REPLY = ("__no_reply__",)  # sentinel: data server sends nothing back
+
 class ErrorValue:
     """Marker wrapping an exception stored as an object's value.
 

@@ -1957,6 +1957,50 @@ class Runtime:
 
     # ------------------------------------------------------------ data server
 
+    def _apply_worker_submit(self, fn_id, pickled_fn, args_payload,
+                             return_ids: List[ObjectID], options: dict):
+        """Shared body of REQ_SUBMIT (server-generated ids) and
+        REQ_SUBMIT_ASYNC (worker-generated ids, no reply)."""
+        if pickled_fn is not None:
+            with self._lock:
+                self._functions.setdefault(fn_id, pickled_fn)
+        options = dict(options)
+        deps = options.pop("__deps", [])
+        nested = options.pop("__nested", [])
+        parent = options.pop("__parent", None)
+        task_id = make_task_id(self.job_id)
+        for rid in return_ids:
+            self._entry(rid)
+        spec = _TaskSpec(task_id, fn_id, args_payload,
+                         [ObjectID(d) for d in deps], return_ids, options)
+        spec.parent_task = parent
+        spec.nested_deps = [ObjectID(b) for b in nested]
+        spec.request, spec.pg_wire = self._prepare_request(
+            options, is_actor=False)
+        self._cancellable[return_ids[0].binary()] = spec
+        self._enqueue(spec)
+
+    def _apply_worker_actor_call(self, actor_id_b, method, args_payload,
+                                 extra: dict, return_ids: List[ObjectID]):
+        """Shared body of REQ_ACTOR_CALL / REQ_ACTOR_CALL_ASYNC."""
+        state = self._actors.get(ActorID(actor_id_b))
+        if state is None:
+            raise ActorDiedError("unknown actor")
+        deps = [ObjectID(d) for d in extra.get("__deps", [])]
+        task_id = make_task_id(self.job_id)
+        for rid in return_ids:
+            self._entry(rid)
+        spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
+                         actor_id=state.actor_id, method=method)
+        spec.parent_task = extra.get("__parent")
+        if state.dead:
+            self._store_error(
+                return_ids,
+                ActorDiedError(str(state.death_cause or "actor is dead")),
+            )
+        else:
+            self._enqueue(spec)
+
     def _data_server(self, w: _Worker):
         conn = w.data_conn
         try:
@@ -1967,10 +2011,16 @@ class Runtime:
                 except BaseException as e:  # noqa: BLE001
                     # Preserve the exception type (GetTimeoutError,
                     # ActorDiedError, ...) so worker-side handlers behave
-                    # exactly like driver-side ones.
+                    # exactly like driver-side ones. Errors in a
+                    # fire-and-forget request have no reply channel —
+                    # they were already stored into the return entries
+                    # (or are put-metadata failures, surfaced at get).
+                    if msg and str(msg[0]).endswith("_async"):
+                        continue
                     reply = ("err", protocol.serialize_value(
                         protocol.ErrorValue(e), store=None))
-                conn.send(reply)
+                if reply is not protocol.NO_REPLY:
+                    conn.send(reply)
         except (EOFError, OSError):
             pass
 
@@ -2018,47 +2068,59 @@ class Runtime:
             oid = ObjectID(oid_bytes)
             self._store_payload(oid, ("shm", oid_bytes) if payload is None else payload)
             return ("ok",)
+        if tag == protocol.REQ_PUT_META_ASYNC:
+            _, oid_bytes, payload = msg
+            oid = ObjectID(oid_bytes)
+            try:
+                self._store_payload(
+                    oid, ("shm", oid_bytes) if payload is None else payload)
+            except BaseException as e:  # noqa: BLE001 — no reply channel:
+                # the worker already holds the ref, so the error must
+                # live in the entry or a later get() hangs forever
+                self._store_error(
+                    [oid], TaskError(f"put failed owner-side: {e!r}"))
+            return protocol.NO_REPLY
+        if tag == protocol.REQ_BARRIER:
+            # sync point: all earlier fire-and-forget sends on this conn
+            # are applied once this replies (FIFO per connection)
+            return ("ok",)
+        if tag == protocol.REQ_SUBMIT_ASYNC:
+            # worker pre-generated the return ids: apply without replying
+            _, fn_id, pickled_fn, args_payload, inline_values, \
+                return_ids_b, options = msg
+            return_ids = [ObjectID(b) for b in return_ids_b]
+            try:
+                self._apply_worker_submit(fn_id, pickled_fn, args_payload,
+                                          return_ids, options)
+            except BaseException as e:  # noqa: BLE001 — surface at get()
+                self._store_error(
+                    return_ids, e if isinstance(e, TaskError)
+                    else TaskError(f"submission failed: {e!r}"))
+            return protocol.NO_REPLY
+        if tag == protocol.REQ_ACTOR_CALL_ASYNC:
+            _, actor_id_b, method, args_payload, extra, return_ids_b = msg
+            return_ids = [ObjectID(b) for b in return_ids_b]
+            try:
+                self._apply_worker_actor_call(actor_id_b, method,
+                                              args_payload, extra,
+                                              return_ids)
+            except BaseException as e:  # noqa: BLE001 — surface at get()
+                # _store_error creates missing entries itself
+                self._store_error(
+                    return_ids, e if isinstance(e, ActorDiedError)
+                    else ActorDiedError(f"actor call failed: {e!r}"))
+            return protocol.NO_REPLY
         if tag == protocol.REQ_SUBMIT:
             _, fn_id, pickled_fn, args_payload, inline_values, n_returns, options = msg
-            if pickled_fn is not None:
-                with self._lock:
-                    self._functions.setdefault(fn_id, pickled_fn)
-            deps = options.pop("__deps", [])
-            nested = options.pop("__nested", [])
-            parent = options.pop("__parent", None)
-            task_id = make_task_id(self.job_id)
             return_ids = [ObjectID.from_random() for _ in range(n_returns)]
-            for rid in return_ids:
-                self._entry(rid)
-            spec = _TaskSpec(task_id, fn_id, args_payload,
-                             [ObjectID(d) for d in deps], return_ids, options)
-            spec.parent_task = parent
-            spec.nested_deps = [ObjectID(b) for b in nested]
-            spec.request, spec.pg_wire = self._prepare_request(
-                options, is_actor=False)
-            self._cancellable[return_ids[0].binary()] = spec
-            self._enqueue(spec)
+            self._apply_worker_submit(fn_id, pickled_fn, args_payload,
+                                      return_ids, options)
             return ("ok", [r.binary() for r in return_ids])
         if tag == protocol.REQ_ACTOR_CALL:
             _, actor_id_b, method, args_payload, extra, n_returns = msg
-            state = self._actors.get(ActorID(actor_id_b))
-            if state is None:
-                raise ActorDiedError("unknown actor")
-            deps = [ObjectID(d) for d in extra.get("__deps", [])]
-            task_id = make_task_id(self.job_id)
             return_ids = [ObjectID.from_random() for _ in range(n_returns)]
-            for rid in return_ids:
-                self._entry(rid)
-            spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
-                             actor_id=state.actor_id, method=method)
-            spec.parent_task = extra.get("__parent")
-            if state.dead:
-                self._store_error(
-                    return_ids,
-                    ActorDiedError(str(state.death_cause or "actor is dead")),
-                )
-            else:
-                self._enqueue(spec)
+            self._apply_worker_actor_call(actor_id_b, method, args_payload,
+                                          extra, return_ids)
             return ("ok", [r.binary() for r in return_ids])
         if tag == protocol.REQ_WAIT:
             _, oid_bytes_list, num_returns, timeout_s, cur_task = msg

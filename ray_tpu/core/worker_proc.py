@@ -52,6 +52,7 @@ class WorkerCore:
         self._ctx_tls = threading.local()
         self._data_lock = threading.Lock()
         self._send_lock = threading.Lock()
+        self._async_dirty = False  # async sends since last barrier
         self._functions: Dict[bytes, Any] = {}
         self._driver_known_fns: set = set()
         self._actors: Dict[bytes, Any] = {}
@@ -93,6 +94,19 @@ class WorkerCore:
             raise err.error if isinstance(err, protocol.ErrorValue) else err
         return reply
 
+    def _send_async(self, *msg):
+        """Fire-and-forget send: the owner applies in FIFO order on this
+        connection, so a later REQ_GET can never observe pre-apply state.
+        Removing the reply round trip from put/submit is what lets a
+        worker drive thousands of calls/s through the owner (reference:
+        async task submission via the core worker's io loop). Results
+        travel a DIFFERENT connection — _send_results barriers first so a
+        returned ref can never reach the driver before its submission is
+        applied (else ray.cancel on it would silently no-op)."""
+        with self._data_lock:
+            self.data_conn.send(msg)
+        self._async_dirty = True
+
     # ---- core-client surface (same as driver Runtime) -----------------------
 
     def get_objects(self, refs: List[ObjectRef], timeout: Optional[float] = None):
@@ -127,9 +141,10 @@ class WorkerCore:
             # Data already in shm under a scratch id; re-register under oid is
             # avoided by just using the payload's id as the object id.
             oid = ObjectID(payload[1])
-            self._request(protocol.REQ_PUT_META, oid.binary(), None)
+            self._send_async(protocol.REQ_PUT_META_ASYNC, oid.binary(), None)
         else:
-            self._request(protocol.REQ_PUT_META, oid.binary(), payload)
+            self._send_async(protocol.REQ_PUT_META_ASYNC, oid.binary(),
+                             payload)
         return ObjectRef(oid, core=self)
 
     def submit_task(self, fn_id: bytes, pickled_fn: Optional[bytes], args: tuple,
@@ -143,12 +158,13 @@ class WorkerCore:
         if self.current_task_id is not None:
             options["__parent"] = self.current_task_id.hex()
         options["__nested"] = nested
-        _, oid_bytes_list = self._request(
-            protocol.REQ_SUBMIT, fn_id, send_fn, args_payload, {},
-            num_returns, options,
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        self._send_async(
+            protocol.REQ_SUBMIT_ASYNC, fn_id, send_fn, args_payload, {},
+            [r.binary() for r in return_ids], options,
         )
         self._driver_known_fns.add(fn_id)
-        return [ObjectRef(ObjectID(b), core=self) for b in oid_bytes_list]
+        return [ObjectRef(rid, core=self) for rid in return_ids]
 
     def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
                           kwargs: dict, num_returns: int) -> List[ObjectRef]:
@@ -156,11 +172,12 @@ class WorkerCore:
         extra = {"__deps": deps}
         if self.current_task_id is not None:
             extra["__parent"] = self.current_task_id.hex()
-        _, oid_bytes_list = self._request(
-            protocol.REQ_ACTOR_CALL, actor_id.binary(), method, args_payload,
-            extra, num_returns,
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        self._send_async(
+            protocol.REQ_ACTOR_CALL_ASYNC, actor_id.binary(), method,
+            args_payload, extra, [r.binary() for r in return_ids],
         )
-        return [ObjectRef(ObjectID(b), core=self) for b in oid_bytes_list]
+        return [ObjectRef(rid, core=self) for rid in return_ids]
 
     def create_actor_from_worker(self, fn_id: bytes, pickled_cls: Optional[bytes],
                                  args: tuple, kwargs: dict, opts: dict) -> ActorID:
@@ -413,6 +430,12 @@ class WorkerCore:
 
     def _send_results(self, task_id_b: bytes, result, num_returns: int,
                       return_id_bytes: List[bytes]):
+        if self._async_dirty:
+            # cross-connection ordering barrier: flush the owner's data
+            # queue before the result (with any escaping refs) crosses
+            # the task conn (see _send_async)
+            self._async_dirty = False
+            self._request(protocol.REQ_BARRIER)
         values = self._split_returns(result, num_returns)
         payloads = []
         for value, rid in zip(values, return_id_bytes):
