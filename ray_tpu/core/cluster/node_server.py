@@ -27,6 +27,8 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol, serialization
+from ray_tpu.core.cluster.pull_manager import (PRIO_GET, PRIO_TASK_ARGS,
+                                               PRIO_WAIT)
 from ray_tpu.core.cluster.rpc import (ClientCache, RpcClient, RpcError,
                                       RpcServer, cluster_authkey)
 from ray_tpu.core.config import config
@@ -36,6 +38,11 @@ from ray_tpu.core.runtime import Runtime, _TaskSpec
 from ray_tpu.exceptions import ActorDiedError, ObjectLostError
 
 # Tag prefix for ops; kept as plain strings (framed pickle transport).
+
+
+class _PullAdmissionTimeout(Exception):
+    """Bulk-pull budget stayed full past the wait: retry, don't treat
+    the source location as dead."""
 
 
 def materialize(runtime: Runtime, payload) -> Tuple[str, bytes]:
@@ -116,8 +123,10 @@ class NodeRuntime(Runtime):
         tag = msg[0]
         if srv is not None:
             if tag in (protocol.REQ_GET, protocol.REQ_WAIT):
+                prio = (PRIO_GET if tag == protocol.REQ_GET
+                        else PRIO_WAIT)
                 for b in msg[1]:
-                    srv.ensure_available(b)
+                    srv.ensure_available(b, priority=prio)
             elif tag == protocol.REQ_KV:
                 _, op, key, value = msg
                 return ("ok", srv.gcs.call(("kv", op, key, value)))
@@ -327,6 +336,15 @@ class NodeServer:
         # in-flight fetch/proxy threads, keyed by oid bytes
         self._fetching: set = set()
         self._fetch_lock = threading.Lock()
+        # pull admission: bulk transfers reserve their byte size against
+        # a store-derived budget, in priority order task-args > get >
+        # wait (reference: pull_manager.h:52). Small payloads (below the
+        # ranged-transfer threshold) skip admission — they are bounded
+        # by the threshold itself.
+        from ray_tpu.core.cluster.pull_manager import PullManager
+        self.pulls = PullManager(int(
+            self.runtime.store.stats()["heap_size"]
+            * config.pull_admission_fraction))
         # return ids a local submission will produce (no fetch needed)
         self._local_products: set = set()
         # ids whose stored payload must NOT be published as a location
@@ -404,10 +422,13 @@ class NodeServer:
                 oid if isinstance(oid, bytes) else oid.binary())
 
     def ensure_available(self, oid_bytes: bytes,
-                         hint: Optional[Tuple[str, int]] = None):
+                         hint: Optional[Tuple[str, int]] = None,
+                         priority: int = PRIO_GET):
         """Ensure an object id will eventually resolve locally, starting at
         most one background fetch/proxy per id. No-ops for ids a local
-        submission will produce, and for already-resolved entries."""
+        submission will produce, and for already-resolved entries.
+        ``priority`` orders bulk-transfer admission (pull_manager.py:
+        PRIO_TASK_ARGS=0 > PRIO_GET=1 > PRIO_WAIT=2)."""
         if oid_bytes in self._local_products:
             return
         rt = self.runtime
@@ -429,11 +450,12 @@ class NodeServer:
             self._fetching.add(oid_bytes)
         fwd = self._forwarded.get(oid_bytes)
         t = threading.Thread(target=self._fetch_object,
-                             args=(oid_bytes, fwd or hint),
+                             args=(oid_bytes, fwd or hint, priority),
                              daemon=True, name="node-fetch")
         t.start()
 
-    def _fetch_from(self, addr, oid_bytes: bytes) -> Optional[bytes]:
+    def _fetch_from(self, addr, oid_bytes: bytes,
+                    priority: int = PRIO_GET) -> Optional[bytes]:
         """Pull one object from a peer. Large payloads transfer as ranged
         chunks over ``fetch_parallelism`` dedicated connections — the DCN
         bulk path (reference: object_manager chunked pushes over multiple
@@ -449,6 +471,39 @@ class NodeServer:
             return data[1]
         size = data[1]
 
+        # bulk transfer: reserve the payload size against the pull
+        # budget, in priority order (reference: pull_manager.h:52). A
+        # timed-out reservation surfaces as a retriable failure — the
+        # caller's fetch loop re-attempts, so pressure delays, never
+        # deadlocks.
+        requested_ts = time.time()
+        if not self.pulls.acquire(size, priority, timeout=120.0):
+            raise _PullAdmissionTimeout(
+                f"pull admission timed out for {size}B (priority "
+                f"{priority})")
+        granted_ts = time.time()
+        ok = False
+        try:
+            data = self._fetch_ranged(addr, oid_bytes, size, cfg)
+            ok = True
+            return data
+        finally:
+            self.pulls.release(size)
+            rt = self.runtime
+            if rt._events is not None and len(rt._events) < 200_000:
+                from ray_tpu.core.cluster.pull_manager import prio_name
+                rt._events.append({
+                    "task_id": oid_bytes.hex(),
+                    "parent_task_id": None,
+                    "fn": (f"pull:{prio_name(priority)}"
+                           + ("" if ok else ":failed")),
+                    "actor": None, "worker": "pull", "pid": 0,
+                    "submitted": requested_ts,
+                    "dispatched": granted_ts,
+                    "done": time.time(),
+                })
+
+    def _fetch_ranged(self, addr, oid_bytes: bytes, size: int, cfg):
         chunk = max(1 << 20, cfg.fetch_chunk_bytes)
         nstreams = max(1, min(cfg.fetch_parallelism,
                               (size + chunk - 1) // chunk))
@@ -490,7 +545,8 @@ class NodeServer:
                            f"{addr} failed: {failed[0]}")
         return bytes(out)
 
-    def _fetch_object(self, oid_bytes: bytes, hint):
+    def _fetch_object(self, oid_bytes: bytes, hint,
+                      priority: int = PRIO_GET):
         rt = self.runtime
         oid = ObjectID(oid_bytes)
         deadline = time.monotonic() + 600.0
@@ -509,7 +565,17 @@ class NodeServer:
                     if addr == self.address:
                         continue
                     try:
-                        data = self._fetch_from(addr, oid_bytes)
+                        data = self._fetch_from(addr, oid_bytes, priority)
+                    except _PullAdmissionTimeout:
+                        # location is fine — the budget was busy.
+                        # Age the priority (a starved get/wait climbs to
+                        # task-args class, whose FIFO bounds its wait)
+                        # and push the loss deadline out: congestion is
+                        # delay, never data loss.
+                        priority = max(0, priority - 1)
+                        deadline = max(deadline,
+                                       time.monotonic() + 300.0)
+                        continue
                     except (RpcError, Exception):  # noqa: BLE001
                         self.gcs.try_call(("loc_drop", oid_bytes, addr))
                         continue
@@ -674,6 +740,7 @@ class NodeServer:
                 "load": len(rt._task_queue),
                 "num_workers": len(rt._workers),
                 "store": rt.store.stats(),
+                "oom_kills": getattr(rt, "_oom_kill_count", 0),
             }
 
     def _op_state(self):
@@ -785,9 +852,9 @@ class NodeServer:
         for b, d in zip(deps, dep_ids):
             self.ensure_available(
                 b, hint=tuple(locations[b]) if locations and b in locations
-                else None)
+                else None, priority=PRIO_TASK_ARGS)
         for b in nested:
-            self.ensure_available(b)
+            self.ensure_available(b, priority=PRIO_TASK_ARGS)
         task_id = make_task_id(rt.job_id)
         for rid in ret_ids:
             rt._entry(rid)
@@ -1007,7 +1074,7 @@ class NodeServer:
     def _op_wait(self, oid_bytes_list, num_returns, timeout):
         rt = self.runtime
         for b in oid_bytes_list:
-            self.ensure_available(b)
+            self.ensure_available(b, priority=PRIO_WAIT)
         refs = [ObjectRef(ObjectID(b), core=rt) for b in oid_bytes_list]
         ready, rest = rt.wait(refs, num_returns=num_returns, timeout=timeout)
         return [r.binary() for r in ready], [r.binary() for r in rest]
@@ -1071,7 +1138,7 @@ class NodeServer:
         for b in deps:
             self.ensure_available(
                 b, hint=tuple(locations[b]) if locations and b in locations
-                else None)
+                else None, priority=PRIO_TASK_ARGS)
         actor_id = rt._create_actor_from_payload(
             cls_fn_id, args_payload, [ObjectID(b) for b in deps],
             dict(opts or {}),
@@ -1094,9 +1161,9 @@ class NodeServer:
         if state is None:
             raise ActorDiedError(f"actor {actor_id} is not on this node")
         for b in deps:
-            self.ensure_available(b)
+            self.ensure_available(b, priority=PRIO_TASK_ARGS)
         for b in nested:
-            self.ensure_available(b)
+            self.ensure_available(b, priority=PRIO_TASK_ARGS)
         ret_ids = [ObjectID(b) for b in return_ids]
         for rid in ret_ids:
             rt._entry(rid)

@@ -114,6 +114,27 @@ _FLAGS: List[Flag] = [
          "A node missing heartbeats for this long is marked DEAD "
          "(reference: health_check_timeout_ms, "
          "gcs_health_check_manager.h)."),
+    Flag("pull_admission_fraction", float, 0.5,
+         "Fraction of object-store capacity that concurrent bulk pulls "
+         "may reserve; excess pulls queue by priority task-args > get > "
+         "wait (reference: pull_manager.h:52)."),
+    Flag("memory_monitor_enabled", bool, True,
+         "Kill workers under node memory pressure instead of letting the "
+         "kernel OOM the node (reference: memory_monitor.h:52)."),
+    Flag("memory_monitor_interval_s", float, 0.25,
+         "Memory monitor poll period (reference: "
+         "memory_monitor_refresh_ms)."),
+    Flag("memory_usage_threshold", float, 0.95,
+         "Usage fraction above which the kill policy fires (reference: "
+         "memory_usage_threshold)."),
+    Flag("memory_limit_bytes", int, 0,
+         "When >0, bound the WORKER TREE's summed RSS by this many bytes "
+         "instead of watching host/cgroup usage — deterministic for "
+         "tests, and a fence on shared hosts."),
+    Flag("task_oom_retries", int, 3,
+         "OOM kills a retriable task survives without consuming its "
+         "max_retries budget; past this, callers get OutOfMemoryError "
+         "(reference: task_oom_retries, -1 = infinite)."),
     Flag("worker_zygote", bool, True,
          "Fork new workers from a pre-warmed zygote template (~10ms) "
          "instead of cold interpreter starts (~300ms). TPU workers always "

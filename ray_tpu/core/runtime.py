@@ -79,7 +79,7 @@ class _TaskSpec:
         "actor_id", "method", "pending_deps", "request", "pg_wire",
         "acquired_bundle", "blocked_released", "nested_deps", "cancelled",
         "retries_left", "args_pinned", "dep_pins", "submitted_ts",
-        "dispatched_ts", "parent_task",
+        "dispatched_ts", "parent_task", "oom_kills",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -107,6 +107,9 @@ class _TaskSpec:
         # Worker-crash retry budget (reference: max_retries,
         # src/ray/core_worker/task_manager.h:208); resolved at enqueue.
         self.retries_left: Optional[int] = None
+        # memory-monitor kills survived so far (OOM retries are budgeted
+        # separately from crash retries — reference: task_oom_retries)
+        self.oom_kills = 0
         self.args_pinned = False
         # Real store refs taken at dispatch on shm dep containers, so spill
         # can never pull a dep out from under a worker mid-read.
@@ -188,7 +191,7 @@ class _Worker:
     __slots__ = (
         "worker_id", "proc", "task_conn", "data_conn", "ready", "alive",
         "registered_fns", "actor_id", "inflight", "reader", "data_thread",
-        "send_lock", "blocked",
+        "send_lock", "blocked", "oom_killed",
     )
 
     def __init__(self, worker_id, proc):
@@ -209,6 +212,9 @@ class _Worker:
         # True while the worker is blocked in a driver-side get/wait; used
         # by the scheduler to oversubscribe the pool instead of deadlocking.
         self.blocked = False
+        # set by the memory monitor just before SIGKILL: death handling
+        # then applies OOM retry semantics instead of crash semantics
+        self.oom_killed = False
 
 
 class _ActorState:
@@ -336,6 +342,13 @@ class Runtime:
                 self._zygote = None
         for _ in range(self.num_workers):
             self._spawn_worker()
+
+        # memory monitor + OOM kill policy (reference:
+        # memory_monitor.h:52, worker_killing_policy_group_by_owner.h)
+        self._oom_kill_count = 0
+        if config.memory_monitor_enabled:
+            threading.Thread(target=self._memory_monitor_loop,
+                             daemon=True, name="rtpu-memmon").start()
 
     # ------------------------------------------------------------------ pool
 
@@ -537,25 +550,46 @@ class Runtime:
             inflight = list(w.inflight.values())
             w.inflight.clear()
             actor_id = w.actor_id
+            oom = w.oom_killed
         if inflight:
             # Results flush per task, so inflight = not-yet-completed, in
             # dispatch order. Only the head task can have been executing
             # when the process died; the rest never started and are safe to
             # requeue on another worker. The head itself is retried while
             # its max_retries budget lasts (reference: task_manager.h
-            # retries apply to system failures, not app exceptions).
+            # retries apply to system failures, not app exceptions). OOM
+            # kills budget separately: the memory monitor's SIGKILL does
+            # not consume max_retries (reference: task_oom_retries) —
+            # only the dedicated OOM budget, after which callers see a
+            # typed OutOfMemoryError.
             if actor_id is None:
                 head = inflight[0]
-                if head.retries_left and not head.cancelled:
+                if oom and not head.cancelled:
+                    head.oom_kills += 1
+                    if (config.task_oom_retries < 0
+                            or head.oom_kills <= config.task_oom_retries):
+                        fail, requeue = [], inflight
+                    else:
+                        fail, requeue = inflight[:1], inflight[1:]
+                elif head.retries_left and not head.cancelled:
                     head.retries_left -= 1
                     fail, requeue = [], inflight
                 else:
                     fail, requeue = inflight[:1], inflight[1:]
             else:
                 fail, requeue = inflight, []
-            err = WorkerCrashedError(
-                f"worker {w.worker_id.hex()[:8]} died while executing task"
-            )
+            if oom:
+                from ray_tpu.exceptions import OutOfMemoryError
+
+                err = OutOfMemoryError(
+                    f"worker {w.worker_id.hex()[:8]} was killed by the "
+                    f"node memory monitor (usage above "
+                    f"{config.memory_usage_threshold:.0%}) and the task "
+                    f"is out of OOM retries")
+            else:
+                err = WorkerCrashedError(
+                    f"worker {w.worker_id.hex()[:8]} died while "
+                    f"executing task")
             # Cancelled specs must not come back: report them cancelled
             # whether they were executing or merely batched behind the head.
             fail = fail + [s for s in requeue if s.cancelled]
@@ -2304,6 +2338,75 @@ class Runtime:
             self._kv.pop(key, None)
             return None
         raise ValueError(op)
+
+    # -------------------------------------------------- memory monitor
+
+    def _memory_monitor_loop(self):
+        """Poll memory usage; above the threshold, kill one worker per
+        tick by the group-by-owner policy so the node sheds load instead
+        of letting the kernel OOM-kill it wholesale."""
+        from ray_tpu.core.memory_monitor import MemoryMonitor
+
+        mon = MemoryMonitor(limit_bytes=config.memory_limit_bytes)
+        while not self._shutdown:
+            time.sleep(config.memory_monitor_interval_s)
+            try:
+                mon.limit_bytes = config.memory_limit_bytes  # reloadable
+                with self._lock:
+                    pids = [w.proc.pid for w in self._workers.values()
+                            if w.alive and w.proc is not None]
+                if mon.usage_fraction(pids) >= config.memory_usage_threshold:
+                    self._kill_for_memory()
+            except Exception:  # noqa: BLE001 — monitoring must not die
+                pass
+
+    def _kill_for_memory(self):
+        """Pick and SIGKILL one victim worker (reference policy,
+        worker_killing_policy_group_by_owner.h): group running tasks by
+        owner (submitting parent), prefer the group with the most
+        in-flight tasks, and within it the NEWEST dispatch — last-in
+        first-killed keeps earlier (likely further-along) work alive.
+        Retriable tasks are preferred over non-retriable; actor workers
+        are a last resort (their death is more disruptive)."""
+        with self._lock:
+            task_workers = []   # (group_size, dispatched_ts, worker)
+            groups: Dict[Optional[str], int] = {}
+            for w in self._workers.values():
+                if not w.alive or w.actor_id is not None or not w.inflight:
+                    continue
+                head = next(iter(w.inflight.values()))
+                groups[head.parent_task] = groups.get(head.parent_task,
+                                                      0) + 1
+            for w in self._workers.values():
+                if not w.alive or w.actor_id is not None or not w.inflight:
+                    continue
+                head = next(iter(w.inflight.values()))
+                retriable = (config.task_oom_retries < 0
+                             or head.oom_kills < config.task_oom_retries)
+                task_workers.append((
+                    0 if retriable else 1,       # retriable first
+                    -groups.get(head.parent_task, 0),  # biggest group
+                    -head.dispatched_ts,         # newest dispatch
+                    id(w), w))
+            victim = None
+            if task_workers:
+                task_workers.sort(key=lambda t: t[:4])
+                victim = task_workers[0][4]
+            else:
+                # no plain-task candidates: newest busy actor worker
+                actors = [w for w in self._workers.values()
+                          if w.alive and w.actor_id is not None
+                          and w.inflight]
+                if actors:
+                    victim = actors[-1]
+            if victim is None:
+                return
+            victim.oom_killed = True
+            self._oom_kill_count += 1
+        try:
+            victim.proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
 
     def prestart_workers(self, num: int):
         """Pre-spawn up to ``num`` EXTRA idle workers ahead of an

@@ -63,3 +63,9 @@ class ObjectTimeoutError(RayTpuError, TimeoutError):
 
 class PlacementGroupError(RayTpuError):
     pass
+
+
+class OutOfMemoryError(TaskError):
+    """A task's worker was killed by the node memory monitor and the
+    task is out of OOM retries (reference: ray.exceptions.OutOfMemoryError
+    raised by the worker-killing policy, memory_monitor.h:52)."""

@@ -345,3 +345,76 @@ def test_owner_cleanup_op_reclaims_immediately():
     finally:
         rc.set_core(prev)
         c.shutdown()
+
+
+def test_memory_monitor_oom_kill_retry_and_typed_error(local_ray, tmp_path):
+    """Memory monitor + group-by-owner kill policy (reference:
+    memory_monitor.h:52, worker_killing_policy_group_by_owner.h): drive
+    the worker tree into (bounded) memory pressure; the newest retriable
+    task's worker is killed and the task retries WITHOUT consuming its
+    crash budget; with OOM retries exhausted the caller gets a typed
+    OutOfMemoryError; the node survives throughout."""
+    from ray_tpu.core.config import config
+    from ray_tpu.core.memory_monitor import tree_rss
+    from ray_tpu.exceptions import OutOfMemoryError
+
+    os.environ["RTPU_MEMORY_MONITOR_INTERVAL_S"] = "0.1"
+    try:
+        from ray_tpu.core.config import config as _c
+        _c.reload()
+        ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+        core = runtime_context.get_core()
+        core.wait_for_workers()
+        pids = [w.proc.pid for w in core._workers.values()
+                if w.proc is not None]
+        base = tree_rss(pids)
+        # cap the worker tree a bit above its idle footprint: a ~500 MB
+        # hog must trip the monitor, the retry's modest path must not
+        os.environ["RTPU_MEMORY_LIMIT_BYTES"] = str(base + (250 << 20))
+        config.reload()
+
+        marker = str(tmp_path / "oom_attempt")
+
+        @ray_tpu.remote
+        def hog(path):
+            import os as _os
+            import time as _time
+
+            import numpy as np
+            if not _os.path.exists(path):
+                open(path, "w").close()
+                a = np.ones((500 << 20) // 8)  # ~500 MB: over the cap
+                _time.sleep(30)                # stay fat until killed
+                return float(a[0])
+            return 41.0                        # retry: fits fine
+
+        assert ray_tpu.get(hog.remote(marker), timeout=120) == 41.0
+        assert core._oom_kill_count >= 1, "monitor never fired"
+
+        # OOM budget exhausted -> typed error, not a crash error
+        os.environ["RTPU_TASK_OOM_RETRIES"] = "0"
+        config.reload()
+
+        @ray_tpu.remote
+        def hog_forever():
+            import time as _time
+
+            import numpy as np
+            a = np.ones((500 << 20) // 8)
+            _time.sleep(30)
+            return float(a[0])
+
+        with pytest.raises(OutOfMemoryError):
+            ray_tpu.get(hog_forever.remote(), timeout=120)
+
+        # the node is alive and healthy after policy kills
+        @ray_tpu.remote
+        def fine():
+            return "fine"
+
+        assert ray_tpu.get(fine.remote(), timeout=60) == "fine"
+    finally:
+        for k in ("RTPU_MEMORY_MONITOR_INTERVAL_S",
+                  "RTPU_MEMORY_LIMIT_BYTES", "RTPU_TASK_OOM_RETRIES"):
+            os.environ.pop(k, None)
+        config.reload()
