@@ -333,8 +333,11 @@ class NodeServer:
         self._owner_lock = threading.Lock()
         self._driver_death_seq = 0
 
-        # in-flight fetch/proxy threads, keyed by oid bytes
+        # in-flight fetch/proxy threads, keyed by oid bytes; _fetch_prio
+        # holds each fetch's mutable priority box (upgradable while the
+        # pull is queued for admission)
         self._fetching: set = set()
+        self._fetch_prio: Dict[bytes, list] = {}
         self._fetch_lock = threading.Lock()
         # pull admission: bulk transfers reserve their byte size against
         # a store-derived budget, in priority order task-args > get >
@@ -446,16 +449,24 @@ class NodeServer:
                 return
         with self._fetch_lock:
             if oid_bytes in self._fetching:
+                # already pulling: UPGRADE its class if ours is more
+                # urgent (reference: PullManager re-prioritizes when a
+                # higher-priority requester arrives for the same object)
+                box = self._fetch_prio.get(oid_bytes)
+                if box is not None and priority < box[0]:
+                    box[0] = priority
                 return
             self._fetching.add(oid_bytes)
+            box = [priority]
+            self._fetch_prio[oid_bytes] = box
         fwd = self._forwarded.get(oid_bytes)
         t = threading.Thread(target=self._fetch_object,
-                             args=(oid_bytes, fwd or hint, priority),
+                             args=(oid_bytes, fwd or hint, box),
                              daemon=True, name="node-fetch")
         t.start()
 
     def _fetch_from(self, addr, oid_bytes: bytes,
-                    priority: int = PRIO_GET) -> Optional[bytes]:
+                    prio_box=None) -> Optional[bytes]:
         """Pull one object from a peer. Large payloads transfer as ranged
         chunks over ``fetch_parallelism`` dedicated connections — the DCN
         bulk path (reference: object_manager chunked pushes over multiple
@@ -475,12 +486,20 @@ class NodeServer:
         # budget, in priority order (reference: pull_manager.h:52). A
         # timed-out reservation surfaces as a retriable failure — the
         # caller's fetch loop re-attempts, so pressure delays, never
-        # deadlocks.
+        # deadlocks. Admission runs in short slices so a concurrent
+        # priority UPGRADE (ensure_available on the same oid from a
+        # task-args requester) takes effect within one slice.
+        prio_box = prio_box if prio_box is not None else [PRIO_GET]
         requested_ts = time.time()
-        if not self.pulls.acquire(size, priority, timeout=120.0):
-            raise _PullAdmissionTimeout(
-                f"pull admission timed out for {size}B (priority "
-                f"{priority})")
+        adm_deadline = time.monotonic() + 120.0
+        while True:
+            priority = prio_box[0]
+            if self.pulls.acquire(size, priority, timeout=15.0):
+                break
+            if time.monotonic() >= adm_deadline:
+                raise _PullAdmissionTimeout(
+                    f"pull admission timed out for {size}B (priority "
+                    f"{priority})")
         granted_ts = time.time()
         ok = False
         try:
@@ -545,10 +564,10 @@ class NodeServer:
                            f"{addr} failed: {failed[0]}")
         return bytes(out)
 
-    def _fetch_object(self, oid_bytes: bytes, hint,
-                      priority: int = PRIO_GET):
+    def _fetch_object(self, oid_bytes: bytes, hint, prio_box=None):
         rt = self.runtime
         oid = ObjectID(oid_bytes)
+        prio_box = prio_box if prio_box is not None else [PRIO_GET]
         deadline = time.monotonic() + 600.0
         try:
             while not self._stop:
@@ -565,14 +584,15 @@ class NodeServer:
                     if addr == self.address:
                         continue
                     try:
-                        data = self._fetch_from(addr, oid_bytes, priority)
+                        data = self._fetch_from(addr, oid_bytes,
+                                                prio_box)
                     except _PullAdmissionTimeout:
                         # location is fine — the budget was busy.
                         # Age the priority (a starved get/wait climbs to
                         # task-args class, whose FIFO bounds its wait)
                         # and push the loss deadline out: congestion is
                         # delay, never data loss.
-                        priority = max(0, priority - 1)
+                        prio_box[0] = max(0, prio_box[0] - 1)
                         deadline = max(deadline,
                                        time.monotonic() + 300.0)
                         continue
@@ -616,6 +636,7 @@ class NodeServer:
         finally:
             with self._fetch_lock:
                 self._fetching.discard(oid_bytes)
+                self._fetch_prio.pop(oid_bytes, None)
 
     # --------------------------------------------------------------- spilling
 

@@ -1,4 +1,10 @@
-"""Compile the shm store C++ extension on first use (cached by mtime)."""
+"""Compile the shm store C++ extension on first use (cached by mtime).
+
+``python -m ray_tpu.core.object_store.build --sanitize=thread`` (or
+``address``) builds a sanitizer-instrumented variant next to the normal
+one; the stress harness (tests/test_store_sanitize.py) loads it via
+RTPU_STORE_LIB (reference practice: TSAN/ASAN CI jobs over the plasma
+store, SURVEY §4.3)."""
 
 from __future__ import annotations
 
@@ -11,17 +17,45 @@ _SRC = os.path.join(_DIR, "_shm_store.cc")
 _LIB = os.path.join(_DIR, "_shm_store.so")
 _lock = threading.Lock()
 
+_SAN_FLAGS = {
+    "thread": ["-fsanitize=thread", "-O1", "-g"],
+    "address": ["-fsanitize=address", "-O1", "-g",
+                "-fno-omit-frame-pointer"],
+}
 
-def ensure_built() -> str:
-    """Build _shm_store.so if missing or stale; return its path."""
+
+def _compile(out: str, extra: list) -> None:
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = (["g++", "-std=c++17", "-shared", "-fPIC"] + extra
+           + ["-o", tmp, _SRC, "-lpthread", "-lrt"])
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, out)
+
+
+def ensure_built(sanitize: str = "") -> str:
+    """Build the store library if missing or stale; return its path.
+
+    ``sanitize`` in {"thread", "address"} builds/returns the
+    instrumented variant (separate .so — normal users never pay the
+    sanitizer tax)."""
+    if sanitize:
+        lib = os.path.join(_DIR, f"_shm_store_{sanitize}.so")
+        flags = _SAN_FLAGS[sanitize]
+    else:
+        lib, flags = _LIB, ["-O2"]
     with _lock:
-        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-            return _LIB
-        tmp = _LIB + f".tmp{os.getpid()}"
-        cmd = [
-            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-            "-o", tmp, _SRC, "-lpthread", "-lrt",
-        ]
-        subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(tmp, _LIB)
-        return _LIB
+        if os.path.exists(lib) and \
+                os.path.getmtime(lib) >= os.path.getmtime(_SRC):
+            return lib
+        _compile(lib, flags)
+        return lib
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sanitize", choices=["thread", "address", ""],
+                    default="")
+    path = ensure_built(ap.parse_args().sanitize)
+    print(path)

@@ -1267,8 +1267,8 @@ class Runtime:
         try:
             entries = []
             for spec in batch:
-                if self._events is not None:
-                    spec.dispatched_ts = time.time()
+                # unconditional: the OOM kill policy sorts on this
+                spec.dispatched_ts = time.time()
                 self._ensure_fn_on_worker(w, spec.fn_id)
                 inline_values = self._inline_values_for(spec.deps, spec)
                 entries.append((
@@ -1282,8 +1282,8 @@ class Runtime:
 
     def _send_actor_call(self, w: _Worker, spec: _TaskSpec):
         try:
-            if self._events is not None:
-                spec.dispatched_ts = time.time()
+            # unconditional: the OOM kill policy sorts on this
+            spec.dispatched_ts = time.time()
             inline_values = self._inline_values_for(spec.deps, spec)
             self._send_msg(w, (
                 protocol.MSG_ACTOR_CALL, spec.task_id.binary(),
@@ -2403,7 +2403,20 @@ class Runtime:
                 return
             victim.oom_killed = True
             self._oom_kill_count += 1
+        # kill the DESCENDANTS first: bounded-mode accounting charges the
+        # worker's whole tree, so forked helpers (mp pools, loaders) must
+        # die with it or their RSS survives the kill and the monitor
+        # starts executing innocent workers
         try:
+            from ray_tpu.core.memory_monitor import _descendants
+
+            pid = victim.proc.pid
+            for child in _descendants([pid]):
+                if child != pid:
+                    try:
+                        os.kill(child, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
             victim.proc.kill()
         except Exception:  # noqa: BLE001
             pass

@@ -13,6 +13,7 @@ metadata.
 from __future__ import annotations
 
 import collections
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -37,23 +38,39 @@ class RefBundle:
 
 # ---- remote task bodies ----------------------------------------------------
 
+def _measured_metas(out: List[Block], wall_s: float,
+                    cpu_s: float) -> List[BlockMetadata]:
+    """Metadata with in-task execution stats on the FIRST meta (one dict
+    per task — the executor sums per operator; reference:
+    BlockExecStats in data/_internal/stats.py)."""
+    metas = [BlockAccessor(b).get_metadata() for b in out]
+    if metas:
+        metas[0].exec_stats = {
+            "wall_s": wall_s, "cpu_s": cpu_s,
+            "peak_block_bytes": max(m.size_bytes for m in metas),
+        }
+    return metas
+
+
 @ray_tpu.remote
 def _run_map_task(chain: MapTransformChain, blocks: List[Block]
                   ) -> Tuple[List[Block], List[BlockMetadata]]:
+    t0, c0 = time.perf_counter(), time.process_time()
     out = list(chain(blocks))
-    metas = [BlockAccessor(b).get_metadata() for b in out]
-    return out, metas
+    return out, _measured_metas(out, time.perf_counter() - t0,
+                                time.process_time() - c0)
 
 
 @ray_tpu.remote
 def _run_read_task(read_task, chain: Optional[MapTransformChain]
                    ) -> Tuple[List[Block], List[BlockMetadata]]:
+    t0, c0 = time.perf_counter(), time.process_time()
     blocks = read_task()
     if chain is not None:
         blocks = chain(blocks)
     out = list(blocks)
-    metas = [BlockAccessor(b).get_metadata() for b in out]
-    return out, metas
+    return out, _measured_metas(out, time.perf_counter() - t0,
+                                time.process_time() - c0)
 
 
 @ray_tpu.remote
@@ -216,9 +233,10 @@ class _MapWorker:
                 else:
                     bound.append(s)
             chain = MapTransformChain(bound, chain.target_max_block_size)
+        t0, c0 = time.perf_counter(), time.process_time()
         out = list(chain(blocks))
-        metas = [BlockAccessor(b).get_metadata() for b in out]
-        return out, metas
+        return out, _measured_metas(out, time.perf_counter() - t0,
+                                    time.process_time() - c0)
 
 
 class _CallableClassMarker:
@@ -245,6 +263,16 @@ class PhysicalOperator:
         self.inputs_complete = False
         self.rows_out = 0
         self.tasks_launched = 0
+        # per-op accounting for Dataset.stats() (reference:
+        # data/_internal/stats.py); the executor snapshots these
+        self.rows_in = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.task_wall_s = 0.0
+        self.task_cpu_s = 0.0
+        self.sched_wall_s = 0.0
+        self.peak_block_bytes = 0
+        self._launch_ts: Dict[ObjectRef, float] = {}
         # Ordered emission: outputs enter output_queue in LAUNCH order even
         # though tasks complete out of order (reference: preserve_order in
         # streaming_executor_state; required for sort/zip/limit determinism).
@@ -257,6 +285,7 @@ class PhysicalOperator:
         """Register an in-flight task in launch order."""
         self.pending[meta_ref] = blocks_ref
         self._pending_seq[meta_ref] = self._seq
+        self._launch_ts[meta_ref] = time.perf_counter()
         self._seq += 1
 
     def _emit(self, seq: int, bundle: RefBundle):
@@ -272,6 +301,8 @@ class PhysicalOperator:
         self._emit(seq, bundle)
 
     def add_input(self, bundle: RefBundle):
+        self.rows_in += bundle.num_rows
+        self.bytes_in += bundle.size_bytes
         self.input_queue.append(bundle)
 
     def mark_inputs_done(self):
@@ -291,10 +322,22 @@ class PhysicalOperator:
         """A waited ref completed: fetch metadata, enqueue output bundle."""
         blocks_ref = self.pending.pop(meta_ref)
         seq = self._pending_seq.pop(meta_ref)
+        launched = self._launch_ts.pop(meta_ref, None)
+        if launched is not None:
+            self.sched_wall_s += time.perf_counter() - launched
         metas: List[BlockMetadata] = ray_tpu.get(meta_ref)
         num_rows = sum(m.num_rows for m in metas)
         size = sum(m.size_bytes for m in metas)
         self.rows_out += num_rows
+        self.bytes_out += size
+        for m in metas:
+            es = m.exec_stats
+            if es:
+                self.task_wall_s += es.get("wall_s", 0.0)
+                self.task_cpu_s += es.get("cpu_s", 0.0)
+                self.peak_block_bytes = max(
+                    self.peak_block_bytes,
+                    es.get("peak_block_bytes", 0))
         self._emit(seq, RefBundle(blocks_ref, num_rows, size, metas))
 
     @property
@@ -527,7 +570,10 @@ class UnionOperator(PhysicalOperator):
         return bool(self.input_queue)
 
     def launch_one(self):
-        self._emit_direct(self.input_queue.popleft())
+        bundle = self.input_queue.popleft()
+        self.rows_out += bundle.num_rows
+        self.bytes_out += bundle.size_bytes
+        self._emit_direct(bundle)
 
 
 class ZipOperator(PhysicalOperator):

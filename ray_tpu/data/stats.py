@@ -1,7 +1,11 @@
 """Per-dataset execution statistics.
 
-Reference: python/ray/data/_internal/stats.py — per-operator wall time,
-task counts, and rows, surfaced via Dataset.stats().
+Reference: python/ray/data/_internal/stats.py — per-operator wall/cpu
+time, rows and bytes in/out, peak block size, task counts, and
+backpressure wait, surfaced via ``Dataset.stats()`` as a formatted
+summary. Task-side numbers ride each block's ``BlockMetadata.exec_stats``
+(measured inside the remote task); executor-side numbers (queueing,
+backpressure) are accumulated by the scheduling loop.
 """
 
 from __future__ import annotations
@@ -10,11 +14,60 @@ from dataclasses import dataclass, field
 from typing import List
 
 
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GB"
+
+
 @dataclass
 class OpStats:
     name: str
+    tasks_launched: int = 0
     tasks_finished: int = 0
-    rows: int = 0
+    rows_in: int = 0
+    rows_out: int = 0
+    bytes_in: int = 0
+    bytes_out: int = 0
+    task_wall_s: float = 0.0      # summed in-task execution wall time
+    task_cpu_s: float = 0.0       # summed in-task process_time
+    sched_wall_s: float = 0.0     # launch -> completion (incl. queueing)
+    peak_block_bytes: int = 0
+    backpressure_s: float = 0.0   # time gated by downstream pressure
+
+    # kept for pre-existing callers
+    @property
+    def rows(self) -> int:
+        return self.rows_out
+
+    @rows.setter
+    def rows(self, v: int):
+        self.rows_out = v
+
+    def lines(self) -> List[str]:
+        out = [f"  {self.name}:"]
+        out.append(
+            f"    tasks: {self.tasks_finished} finished"
+            + (f" / {self.tasks_launched} launched"
+               if self.tasks_launched else ""))
+        out.append(
+            f"    rows: {self.rows_in} in -> {self.rows_out} out"
+            f"  ({_fmt_bytes(self.bytes_in)} -> "
+            f"{_fmt_bytes(self.bytes_out)})")
+        if self.task_wall_s or self.task_cpu_s:
+            out.append(
+                f"    time: {self.task_wall_s:.3f}s wall, "
+                f"{self.task_cpu_s:.3f}s cpu in tasks; "
+                f"{self.sched_wall_s:.3f}s launch-to-done")
+        if self.peak_block_bytes:
+            out.append(
+                f"    peak block: {_fmt_bytes(self.peak_block_bytes)}")
+        if self.backpressure_s > 0.0005:
+            out.append(
+                f"    backpressured: {self.backpressure_s:.3f}s")
+        return out
 
 
 @dataclass
@@ -27,9 +80,20 @@ class DatasetStats:
         self.ops.append(s)
         return s
 
+    def bottleneck(self) -> str:
+        """Name of the operator with the most in-task wall time (ties:
+        launch-to-done time) — the first place to look when a pipeline
+        is slow."""
+        if not self.ops:
+            return ""
+        return max(self.ops, key=lambda s: (s.task_wall_s,
+                                            s.sched_wall_s)).name
+
     def summary(self) -> str:
-        lines = [f"Dataset execution: {self.wall_time_s:.3f}s"]
+        lines = [f"Dataset execution: {self.wall_time_s:.3f}s wall"]
         for s in self.ops:
-            lines.append(
-                f"  {s.name}: {s.tasks_finished} tasks, {s.rows} rows")
+            lines.extend(s.lines())
+        bn = self.bottleneck()
+        if bn:
+            lines.append(f"  bottleneck: {bn}")
         return "\n".join(lines)

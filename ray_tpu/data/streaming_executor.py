@@ -71,12 +71,14 @@ class StreamingExecutor:
         ctx = self._ctx
         max_in_flight = ctx.max_tasks_in_flight_per_op or self._default_cap()
         op_stats = {id(op): self._stats.add_op(op.name) for op in ops}
+        self._bp_since: Dict[int, float] = {}  # op id -> gated since
         t0 = time.perf_counter()
         try:
             while True:
                 progressed = self._process_completed(ops, op_stats)
                 self._route_outputs(topo, sink)
-                launched = self._launch_ready(topo, max_in_flight)
+                launched = self._launch_ready(topo, max_in_flight,
+                                              op_stats)
                 while sink.output_queue:
                     bundle = sink.output_queue.popleft()
                     op_stats[id(sink)].rows += bundle.num_rows
@@ -97,9 +99,28 @@ class StreamingExecutor:
                         time.sleep(0.002)
         finally:
             self._stats.wall_time_s = time.perf_counter() - t0
+            now = time.perf_counter()
             for op in ops:
+                since = self._bp_since.pop(id(op), None)
+                if since is not None:
+                    op_stats[id(op)].backpressure_s += now - since
+                self._snapshot_op(op, op_stats[id(op)])
                 if isinstance(op, ActorPoolMapOperator):
                     op.shutdown()
+
+    @staticmethod
+    def _snapshot_op(op, s):
+        """Copy the operator's live counters into its OpStats row
+        (reference: per-op breakdown in data/_internal/stats.py)."""
+        s.tasks_launched = op.tasks_launched
+        s.rows_in = op.rows_in
+        s.rows_out = op.rows_out
+        s.bytes_in = op.bytes_in
+        s.bytes_out = op.bytes_out
+        s.task_wall_s = op.task_wall_s
+        s.task_cpu_s = op.task_cpu_s
+        s.sched_wall_s = op.sched_wall_s
+        s.peak_block_bytes = op.peak_block_bytes
 
     # ---- internals ----
 
@@ -149,7 +170,8 @@ class StreamingExecutor:
                             for u in topo.upstream_of(down)):
                         down.mark_inputs_done()
 
-    def _launch_ready(self, topo: Topology, max_in_flight: int) -> bool:
+    def _launch_ready(self, topo: Topology, max_in_flight: int,
+                      op_stats=None) -> bool:
         launched = False
         ctx = self._ctx
         # Favor draining downstream ops first (iterate sink -> source) so
@@ -159,15 +181,27 @@ class StreamingExecutor:
         # what actually engages: _route_outputs drains our own
         # output_queue every tick, so gating on it alone never fires
         # (reference: OpBufferQueue accounting in streaming_executor_state).
+        now = time.perf_counter()
         for op in reversed(topo.ops):
             # Limit reached upstream: stop feeding.
             if self._limit_reached_below(topo, op):
                 continue
+            launched_here = False
             while (op.can_launch(max_in_flight) and
                    len(op.output_queue) < ctx.max_op_output_queue_blocks and
                    not self._backpressured(topo, op, ctx)):
                 op.launch_one()
-                launched = True
+                launched = launched_here = True
+            if op_stats is not None:
+                # backpressure accounting: has runnable work but is gated
+                gated = (not launched_here and op.can_launch(max_in_flight)
+                         and self._backpressured(topo, op, ctx))
+                since = self._bp_since.get(id(op))
+                if gated and since is None:
+                    self._bp_since[id(op)] = now
+                elif not gated and since is not None:
+                    op_stats[id(op)].backpressure_s += now - since
+                    del self._bp_since[id(op)]
         return launched
 
     def _backpressured(self, topo: Topology, op: PhysicalOperator,

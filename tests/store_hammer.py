@@ -1,0 +1,145 @@
+"""Multi-process stress driver for the C++ shm store, run under
+TSAN/ASAN by tests/test_store_sanitize.py (reference practice: sanitizer
+CI over the plasma store, SURVEY §4.3).
+
+Modes:
+  driver <name> <n_workers> <ops>  - creates the store, spawns workers +
+                                     a channel ping-pong pair, reaps all
+  worker <name> <ops> <seed>       - create/seal/get/release/delete/evict
+                                     hammer against the shared arena
+  chan_writer/chan_reader <name> <desc_file> <iters>
+"""
+
+import os
+import random
+import subprocess
+import sys
+import time
+
+
+def main():
+    mode = sys.argv[1]
+    name = sys.argv[2]
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store.store import ShmObjectStore
+    from ray_tpu.exceptions import ObjectStoreFullError, ObjectTimeoutError
+
+    if mode == "driver":
+        n_workers, ops = int(sys.argv[3]), int(sys.argv[4])
+        store = ShmObjectStore.create(name, 24 << 20)
+        desc_file = f"/tmp/{name.strip('/')}.chan"
+        try:
+            procs = [subprocess.Popen(
+                [sys.executable, __file__, "worker", name, str(ops),
+                 str(i)]) for i in range(n_workers)]
+            procs.append(subprocess.Popen(
+                [sys.executable, __file__, "chan_reader", name, desc_file,
+                 "200"]))
+            procs.append(subprocess.Popen(
+                [sys.executable, __file__, "chan_writer", name, desc_file,
+                 "200"]))
+            rcs = [p.wait(timeout=600) for p in procs]
+            assert all(rc == 0 for rc in rcs), f"worker rcs: {rcs}"
+            print("HAMMER_OK", flush=True)
+        finally:
+            store.close()
+            try:
+                os.unlink(desc_file)
+            except OSError:
+                pass
+        return
+
+    if mode == "worker":
+        import threading
+
+        ops, seed = int(sys.argv[3]), int(sys.argv[4])
+        store = ShmObjectStore.connect(name)
+        failures = []
+
+        # several THREADS per process: cross-process contention exercises
+        # the pshared mutexes; in-process thread contention is what TSAN
+        # can actually see (one runtime process has many store-touching
+        # threads in production: data servers, fetchers, spiller)
+        def hammer(tseed):
+            rng = random.Random(tseed)
+            held = []  # (oid, expected_byte)
+            try:
+                for i in range(ops):
+                    op = rng.random()
+                    try:
+                        if op < 0.5 or not held:
+                            oid = ObjectID.from_random()
+                            size = rng.choice(
+                                (1 << 10, 64 << 10, 512 << 10))
+                            fill = (tseed * 31 + i) % 251
+                            try:
+                                mv = store.create_object_with_pressure(
+                                    oid, size)
+                            except ObjectStoreFullError:
+                                continue
+                            mv[:] = bytes([fill]) * size
+                            store.seal(oid)
+                            held.append((oid, fill))
+                        elif op < 0.8:
+                            oid, fill = rng.choice(held)
+                            try:
+                                view = store.get(oid, timeout_ms=0)
+                            except (ObjectTimeoutError, Exception):
+                                continue  # evicted: fine
+                            assert view[0] == fill and view[-1] == fill, \
+                                f"corruption in {oid}"
+                            del view
+                            store.release(oid)
+                        elif op < 0.9:
+                            oid, _ = held.pop(rng.randrange(len(held)))
+                            store.delete(oid)
+                        else:
+                            store.stats()
+                            if held:
+                                store.contains(held[0][0])
+                    except ObjectStoreFullError:
+                        continue
+            except BaseException as e:  # noqa: BLE001
+                failures.append(repr(e))
+
+        threads = [threading.Thread(target=hammer, args=(seed * 10 + t,))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not failures, failures
+        store.close()
+        return
+
+    # channel seqno ping-pong over the shared arena
+    desc_file, iters = sys.argv[3], int(sys.argv[4])
+    from ray_tpu.dag.channel import Channel
+    store = ShmObjectStore.connect(name)
+    if mode == "chan_writer":
+        ch = Channel.create(store, capacity=1 << 16)
+        with open(desc_file + ".tmp", "w") as f:
+            f.write(repr(ch.descriptor()))
+        os.replace(desc_file + ".tmp", desc_file)
+        for i in range(iters):
+            ch.write({"i": i, "pad": b"x" * (i % 1000)},
+                     timeout_ms=60_000)
+        ch.close(timeout_ms=60_000)
+        ch.release()
+    else:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(desc_file):
+            assert time.monotonic() < deadline, "writer never published"
+            time.sleep(0.01)
+        with open(desc_file) as f:
+            desc = eval(f.read())  # trusted test fixture
+        ch = Channel.open(store, desc)
+        for i in range(iters):
+            msg = ch.read(timeout_ms=60_000)
+            assert msg["i"] == i
+        ch.release()
+    store.close()
+
+
+if __name__ == "__main__":
+    main()

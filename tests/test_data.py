@@ -713,3 +713,41 @@ def test_cloud_readers_are_gated():
         rd.read_lance("s3://bucket/path")
     with pytest.raises(ImportError, match="read_delta requires"):
         rd.read_delta("s3://bucket/table")
+
+
+def test_dataset_stats_identifies_bottleneck():
+    """Dataset.stats() (reference: data/_internal/stats.py): per-operator
+    rows/bytes in+out, in-task wall/cpu time, and a bottleneck call-out —
+    a deliberately skewed pipeline must blame the slow operator."""
+    import time as _time
+
+    import ray_tpu.data as rd
+
+    def fast(b):
+        return {"id": [x * 2 for x in b["id"]]}
+
+    def slow(b):
+        _time.sleep(0.15)
+        return {"id": b["id"]}
+
+    # the shuffle is a fusion barrier, so the pipeline keeps THREE
+    # physical ops: fused read+fast map | shuffle | slow map
+    ds = (rd.range(200, parallelism=4)
+          .map_batches(fast)
+          .random_shuffle(seed=7)
+          .map_batches(slow))
+    assert ds.count() == 200
+    report = ds.stats()
+    assert "rows" in report and "bottleneck" in report, report
+
+    lines = report.splitlines()
+    bn = [ln for ln in lines if "bottleneck:" in ln][0]
+    names = [ln.strip().rstrip(":") for ln in lines
+             if ln.strip().endswith(":")]
+    assert len(names) >= 3, names
+    # the deliberately slow LAST map must be blamed
+    assert bn.split("bottleneck:")[1].strip() == names[-1], report
+    # row accounting: the slow op saw all 200 rows in and out
+    assert "200 in -> 200 out" in report, report
+    # in-task timing present for the slow op (4 tasks x >=0.15s sleep)
+    assert any("wall" in ln and "cpu" in ln for ln in lines), report

@@ -1,0 +1,76 @@
+"""TSAN/ASAN hammer for the C++ shm store (_shm_store.cc).
+
+Reference practice: the reference runs its plasma store + core under
+ThreadSanitizer/AddressSanitizer CI jobs (SURVEY §4.3). Here the
+instrumented .so (build.py --sanitize=...) is loaded into subprocesses
+via RTPU_STORE_LIB + LD_PRELOADed sanitizer runtime, and a multi-process
+hammer (tests/store_hammer.py) drives concurrent create/seal/get/
+release/delete/eviction plus channel seqno ping-pong across the shared
+arena. Any sanitizer report fails the run via exitcode."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import uuid
+
+import pytest
+
+_HAMMER = os.path.join(os.path.dirname(__file__), "store_hammer.py")
+
+
+def _san_runtime(libname: str) -> str:
+    out = subprocess.run(["g++", f"-print-file-name={libname}"],
+                         capture_output=True, text=True).stdout.strip()
+    return out if out and os.path.sep in out else ""
+
+
+def _run_hammer(sanitize: str, preload: str, opts_var: str, opts: str):
+    from ray_tpu.core.object_store.build import ensure_built
+
+    lib = ensure_built(sanitize)
+    env = dict(os.environ)
+    env.update({
+        "RTPU_STORE_LIB": lib,
+        "LD_PRELOAD": preload,
+        opts_var: opts,
+        # keep the subprocesses lean: no jax/TPU plugin probing
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.pathsep.join(filter(None, (
+            os.path.dirname(os.path.dirname(_HAMMER)),
+            os.environ.get("PYTHONPATH")))),
+    })
+    name = f"/rtpu_san_{sanitize}_{uuid.uuid4().hex[:8]}"
+    proc = subprocess.run(
+        [sys.executable, _HAMMER, "driver", name, "3", "400"],
+        env=env, capture_output=True, text=True, timeout=560)
+    sys.stderr.write(proc.stderr[-4000:])
+    assert proc.returncode == 0, \
+        f"hammer rc={proc.returncode}\n{proc.stderr[-4000:]}"
+    assert "HAMMER_OK" in proc.stdout
+    assert "WARNING: ThreadSanitizer" not in proc.stderr
+    assert "ERROR: AddressSanitizer" not in proc.stderr
+
+
+def test_store_hammer_asan():
+    rt = _san_runtime("libasan.so")
+    if not rt:
+        pytest.skip("libasan not available")
+    _run_hammer(
+        "address", rt, "ASAN_OPTIONS",
+        # leak detection off: CPython itself 'leaks' interned objects at
+        # exit; we are after heap corruption in the store, not that
+        "detect_leaks=0:abort_on_error=0:exitcode=66")
+
+
+def test_store_hammer_tsan():
+    rt = _san_runtime("libtsan.so")
+    if not rt:
+        pytest.skip("libtsan not available")
+    _run_hammer(
+        "thread", rt, "TSAN_OPTIONS",
+        # die_after_fork=0: the driver subprocess-spawns its workers;
+        # report_signal_unsafe off for CPython's signal handling
+        "halt_on_error=1:exitcode=66:die_after_fork=0"
+        ":report_signal_unsafe=0")
