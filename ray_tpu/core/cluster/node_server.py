@@ -486,20 +486,16 @@ class NodeServer:
         # budget, in priority order (reference: pull_manager.h:52). A
         # timed-out reservation surfaces as a retriable failure — the
         # caller's fetch loop re-attempts, so pressure delays, never
-        # deadlocks. Admission runs in short slices so a concurrent
-        # priority UPGRADE (ensure_available on the same oid from a
-        # task-args requester) takes effect within one slice.
+        # deadlocks. The priority BOX rides into acquire: a concurrent
+        # upgrade (ensure_available from a task-args requester) re-ranks
+        # the waiter in place without losing its queue position.
         prio_box = prio_box if prio_box is not None else [PRIO_GET]
         requested_ts = time.time()
-        adm_deadline = time.monotonic() + 120.0
-        while True:
-            priority = prio_box[0]
-            if self.pulls.acquire(size, priority, timeout=15.0):
-                break
-            if time.monotonic() >= adm_deadline:
-                raise _PullAdmissionTimeout(
-                    f"pull admission timed out for {size}B (priority "
-                    f"{priority})")
+        if not self.pulls.acquire(size, prio_box, timeout=120.0):
+            raise _PullAdmissionTimeout(
+                f"pull admission timed out for {size}B (priority "
+                f"{prio_box[0]})")
+        priority = prio_box[0]  # class at grant time, for the timeline
         granted_ts = time.time()
         ok = False
         try:

@@ -41,20 +41,32 @@ class PullManager:
         self._granted = set()
         self._cv = threading.Condition(threading.Lock())
 
-    def acquire(self, nbytes: int, priority: int = PRIO_GET,
+    def acquire(self, nbytes: int, priority=PRIO_GET,
                 timeout: Optional[float] = None) -> bool:
         """Block until ``nbytes`` of transfer budget is granted (False on
         timeout). Strict priority: only the best-priority waiter is
         admitted next, so task-argument pulls overtake queued get/wait
-        pulls during pressure."""
+        pulls during pressure.
+
+        ``priority`` may be a 1-element mutable list ("priority box"): a
+        concurrent upgrade (ensure_available from a more urgent
+        requester) takes effect at the next wakeup WITHOUT losing the
+        waiter's queue position — its original seq is kept, so smaller
+        same-priority pulls can never leapfrog it (an oversized pull at
+        the head eventually sees inflight==0 and is admitted)."""
+        box = priority if isinstance(priority, list) else [priority]
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
-            me = (priority, self._seq)
+            me = [box[0], self._seq]  # mutable: priority may upgrade
             self._seq += 1
             heapq.heappush(self._waiting, me)
             try:
                 while True:
-                    if self._waiting[0] == me and (
+                    if box[0] != me[0]:
+                        # re-rank under the upgraded priority, SAME seq
+                        me[0] = box[0]
+                        heapq.heapify(self._waiting)
+                    if self._waiting[0] is me and (
                             self._inflight == 0
                             or self._inflight + nbytes
                             <= self.budget_bytes):
@@ -64,7 +76,10 @@ class PullManager:
                                  else deadline - time.monotonic())
                     if remaining is not None and remaining <= 0:
                         return False
-                    self._cv.wait(remaining)
+                    # bounded wait: a priority-box upgrade has no
+                    # notifier, so re-check it at least once a second
+                    self._cv.wait(1.0 if remaining is None
+                                  else min(remaining, 1.0))
             finally:
                 # success or timeout: leave the queue either way
                 self._waiting.remove(me)

@@ -366,6 +366,9 @@ class InputDataBuffer(PhysicalOperator):
         self._chain = chain
         self._resources = resources or {}
         if bundles:
+            for b in bundles:
+                self.rows_out += b.num_rows
+                self.bytes_out += b.size_bytes
             self.output_queue.extend(bundles)
         self.inputs_complete = True
 
@@ -547,6 +550,7 @@ class LimitOperator(PhysicalOperator):
         if bundle.num_rows <= want:
             self.rows_taken += bundle.num_rows
             self.rows_out += bundle.num_rows
+            self.bytes_out += bundle.size_bytes
             self._emit_direct(bundle)
         else:
             blocks_ref, meta_ref = _truncate_blocks.options(

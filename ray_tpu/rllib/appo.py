@@ -1,0 +1,133 @@
+"""APPO: asynchronous PPO — the IMPALA architecture with a clipped
+surrogate loss against a periodically-updated target network.
+
+Reference: rllib/algorithms/appo/appo.py:277 and the APPO learner
+(appo_learner / appo_tf_policy): V-trace advantages are computed with
+the TARGET ("old") policy's outputs, the PPO ratio is corrected by a
+clipped behavior/target importance ratio, and the target network copies
+the live weights every ``target_update_freq`` learner updates. The whole
+loss (V-trace scan + clipped surrogate + SGD step) runs as one jitted
+program, like the IMPALA learner it extends.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ray_tpu.rllib.impala import IMPALA, ImpalaLearner, IMPALAConfig
+
+
+class AppoLearner(ImpalaLearner):
+    def __init__(self, module, clip_param: float = 0.4,
+                 target_update_freq: int = 8, **kw):
+        import jax
+
+        super().__init__(module, **kw)
+        self._clip = clip_param
+        self._target_update_freq = target_update_freq
+        self._updates = 0
+        self.target_params = jax.tree_util.tree_map(
+            lambda p: p.copy(), self.params)
+        # re-jit with the target params threaded through
+        self._update = jax.jit(self._appo_update_impl,
+                               donate_argnums=(0, 1))
+
+    def _loss(self, params, target_params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        T, N = batch["rewards"].shape
+        obs_flat = batch["obs"].reshape(T * N, -1)
+        next_flat = batch["next_obs"].reshape(T * N, -1)
+
+        logits, values = self.module.apply(params, obs_flat)
+        logits = logits.reshape(T, N, -1)
+        values = values.reshape(T, N)
+
+        tgt_logits, tgt_values = self.module.apply(target_params, obs_flat)
+        _, tgt_next_values = self.module.apply(target_params, next_flat)
+        tgt_logits = jax.lax.stop_gradient(tgt_logits.reshape(T, N, -1))
+        tgt_values = jax.lax.stop_gradient(tgt_values.reshape(T, N))
+        tgt_next_values = jax.lax.stop_gradient(
+            tgt_next_values.reshape(T, N))
+
+        a = batch["actions"][..., None]
+        logp_all = jax.nn.log_softmax(logits)
+        cur_logp = jnp.take_along_axis(logp_all, a, axis=-1)[..., 0]
+        b_logp_all = jax.nn.log_softmax(batch["behavior_logits"])
+        behavior_logp = jnp.take_along_axis(b_logp_all, a, axis=-1)[..., 0]
+        t_logp_all = jax.nn.log_softmax(tgt_logits)
+        tgt_logp = jnp.take_along_axis(t_logp_all, a, axis=-1)[..., 0]
+
+        disc_boot = self._gamma * (1.0 - batch["terminateds"])
+        cont = 1.0 - batch["dones"]
+
+        # V-trace against the TARGET policy (reference: APPO computes
+        # vtrace with the old_policy's outputs so targets stay stable
+        # across the async lag)
+        vs, pg_adv = self._vtrace(tgt_logp, behavior_logp, tgt_values,
+                                  tgt_next_values, batch["rewards"],
+                                  disc_boot, cont)
+
+        # clipped-surrogate with the behavior->target importance
+        # correction (reference: appo_tf_policy is_ratio clip to [0, 2])
+        is_ratio = jnp.clip(jnp.exp(behavior_logp - tgt_logp), 0.0, 2.0)
+        ratio = is_ratio * jnp.exp(cur_logp - behavior_logp)
+        surr = jnp.minimum(
+            pg_adv * ratio,
+            pg_adv * jnp.clip(ratio, 1.0 - self._clip, 1.0 + self._clip))
+        pg_loss = -surr.mean()
+        vf_loss = 0.5 * jnp.square(vs - values).mean()
+        ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        loss = pg_loss + self._vf_coef * vf_loss - self._ent_coef * ent
+        return loss, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                      "entropy": ent}
+
+    def _appo_update_impl(self, params, opt_state, target_params, batch):
+        import jax
+
+        grads, aux = jax.grad(self._loss, has_aux=True)(
+            params, target_params, batch)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                        updates)
+        return params, opt_state, aux
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        jb["dones"] = jb["dones"].astype(jnp.float32)
+        jb["terminateds"] = jb["terminateds"].astype(jnp.float32)
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, self.target_params, jb)
+        self._updates += 1
+        if self._updates % self._target_update_freq == 0:
+            self.target_params = jax.tree_util.tree_map(
+                lambda p: p.copy(), self.params)
+        return {k: float(v) for k, v in aux.items()}
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.train_kwargs = {
+            "vf_coef": 0.5, "ent_coef": 0.01, "rho_bar": 1.0,
+            "c_bar": 1.0, "max_grad_norm": 40.0,
+            "clip_param": 0.4, "target_update_freq": 8,
+            "batches_per_iter": 8,
+        }
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    """IMPALA runner gang + APPO learner (reference: appo.py:277 — APPO
+    subclasses Impala the same way)."""
+
+    LEARNER_CLS = AppoLearner

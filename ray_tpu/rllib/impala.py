@@ -151,6 +151,8 @@ class IMPALA(RunnerDriver):
     """Async driver: one in-flight rollout per runner, learner consumes
     batches in completion order (the IMPALA architecture)."""
 
+    LEARNER_CLS = ImpalaLearner
+
     def __init__(self, config: IMPALAConfig):
         from ray_tpu.rllib.env_runner import EnvRunner
         from ray_tpu.rllib.envs import make_env
@@ -164,9 +166,9 @@ class IMPALA(RunnerDriver):
                             "hidden": config.module_hidden}
         if getattr(probe, "obs_shape", None):
             self.module_spec["obs_shape"] = tuple(probe.obs_shape)
-        self.learner = ImpalaLearner(build_pv_module(self.module_spec),
-                                     lr=config.lr, gamma=config.gamma,
-                                     seed=config.seed, **kw)
+        self.learner = self.LEARNER_CLS(build_pv_module(self.module_spec),
+                                        lr=config.lr, gamma=config.gamma,
+                                        seed=config.seed, **kw)
         self.runners = [
             EnvRunner.remote(config.env_name, config.num_envs_per_runner,
                              config.rollout_len, self.module_spec,

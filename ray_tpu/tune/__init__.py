@@ -8,7 +8,10 @@ schedulers (schedulers/: ASHA, median stopping, PBT).
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -31,6 +34,7 @@ from ray_tpu.tune.trial import (  # noqa: F401
 )
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
+    BOHBSearcher,
     Searcher,
     TPESearcher,
 )
@@ -44,6 +48,7 @@ __all__ = [
     "uniform", "loguniform", "quniform", "randint", "lograndint",
     "choice", "sample_from", "grid_search",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "HyperBandScheduler", "HyperBandForBOHB", "PB2",
     "MedianStoppingRule", "PopulationBasedTraining",
-    "Searcher", "BasicVariantGenerator", "TPESearcher",
+    "Searcher", "BasicVariantGenerator", "TPESearcher", "BOHBSearcher",
 ]

@@ -195,3 +195,253 @@ class PopulationBasedTraining(TrialScheduler):
                     # snap to nearest allowed value
                     out[key] = min(spec, key=lambda v: abs(v - out[key]))
         return out
+
+
+PAUSE = "PAUSE"
+
+
+class _Bracket:
+    """One synchronous successive-halving bracket (reference:
+    hyperband.py Bracket): n0 trials starting at r0 iterations; at each
+    rung every live trial pauses until all have reported, then the top
+    1/eta continue to the next rung and the rest stop."""
+
+    def __init__(self, s: int, s_max: int, max_t: int, eta: float):
+        self.s = s
+        self.eta = eta
+        self.max_t = max_t
+        self.n0 = max(1, math.ceil((s_max + 1) / (s + 1) * eta ** s))
+        self.r0 = max(1, int(max_t * eta ** -s))
+        self.rung = 0
+        self.members: set = set()       # alive trial ids
+        self.recorded: Dict[str, float] = {}   # scores at current rung
+        self.paused: set = set()
+
+    @property
+    def milestone(self) -> int:
+        return min(self.max_t, int(self.r0 * self.eta ** self.rung))
+
+    def has_capacity(self) -> bool:
+        return len(self.members) < self.n0 and self.rung == 0
+
+    def keep_count(self) -> int:
+        return max(1, int(len(self.recorded) / self.eta))
+
+    def promotion_ready(self) -> bool:
+        return (self.members
+                and all(tid in self.recorded for tid in self.members))
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference: schedulers/hyperband.py
+    HyperBandScheduler). Trials are assigned round-robin into brackets
+    s = s_max..0; each bracket successively halves at shared milestones.
+    Requires checkpointing trainables (paused trials resume from their
+    latest checkpoint, like the reference's PAUSE decision)."""
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3.0):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.s_max = int(math.log(max_t) / math.log(self.eta))
+        self._brackets: List[_Bracket] = []
+        self._next_s = self.s_max
+        self._of: Dict[str, _Bracket] = {}
+        self._unpause: List[str] = []
+        self._stop_parked: List[str] = []
+
+    # -- controller hooks ---------------------------------------------------
+
+    def on_trial_add(self, trial):
+        for b in self._brackets:
+            if b.has_capacity():
+                b.members.add(trial.trial_id)
+                self._of[trial.trial_id] = b
+                return
+        b = _Bracket(self._next_s, self.s_max, self.max_t, self.eta)
+        self._next_s = self._next_s - 1 if self._next_s > 0 else self.s_max
+        self._brackets.append(b)
+        b.members.add(trial.trial_id)
+        self._of[trial.trial_id] = b
+
+    def pop_unpaused(self) -> List[str]:
+        out, self._unpause = self._unpause, []
+        return out
+
+    def pop_parked_stops(self) -> List[str]:
+        out, self._stop_parked = self._stop_parked, []
+        return out
+
+    # -- decisions ----------------------------------------------------------
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        b = self._of.get(trial.trial_id)
+        if b is None:
+            self.on_trial_add(trial)
+            b = self._of[trial.trial_id]
+        t = result.get(self.time_attr, trial.iterations)
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        if t < b.milestone:
+            return CONTINUE
+        b.recorded[trial.trial_id] = score
+        if not b.promotion_ready():
+            return PAUSE  # wait for bracket peers at this rung
+        return self._promote(b, trial.trial_id)
+
+    def _promote(self, b: _Bracket, reporter_id: str) -> str:
+        """All bracket members reached the rung: keep the top 1/eta."""
+        ranked = sorted(b.recorded.items(), key=lambda kv: -kv[1])
+        keep = {tid for tid, _ in ranked[:b.keep_count()]}
+        for tid in list(b.members):
+            if tid == reporter_id:
+                continue
+            if tid in keep:
+                if tid in b.paused:
+                    b.paused.discard(tid)
+                    self._unpause.append(tid)
+            else:
+                b.members.discard(tid)
+                self._of.pop(tid, None)
+                if tid in b.paused:
+                    b.paused.discard(tid)
+                    self._stop_parked.append(tid)
+        b.rung += 1
+        b.recorded = {}
+        if reporter_id in keep:
+            return CONTINUE
+        b.members.discard(reporter_id)
+        self._of.pop(reporter_id, None)
+        return STOP
+
+    def on_trial_complete(self, trial):
+        """A member left (finished/errored): don't deadlock its bracket."""
+        b = self._of.pop(trial.trial_id, None)
+        if b is None:
+            return
+        b.members.discard(trial.trial_id)
+        b.recorded.pop(trial.trial_id, None)
+        b.paused.discard(trial.trial_id)
+        if b.promotion_ready():
+            # promote on behalf of a phantom reporter
+            self._promote(b, reporter_id="__gone__")
+
+    def note_paused(self, trial_id: str):
+        b = self._of.get(trial_id)
+        if b is not None:
+            b.paused.add(trial_id)
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand variant pairing with the BOHB searcher (reference:
+    schedulers/hb_bohb.py HyperBandForBOHB): identical bracket mechanics;
+    trials are filled into ONE bracket at a time (the reference processes
+    brackets sequentially so the model-based searcher sees each budget's
+    results before proposing the next batch)."""
+
+    def on_trial_add(self, trial):
+        if self._brackets and self._brackets[-1].has_capacity():
+            b = self._brackets[-1]
+            b.members.add(trial.trial_id)
+            self._of[trial.trial_id] = b
+            return
+        super().on_trial_add(trial)
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (reference: schedulers/pb2.py:256
+    PB2): PBT where EXPLORE picks new hyperparameters with a Gaussian-
+    process UCB bandit fit to observed (config, score-delta) data,
+    instead of random perturbation — far more sample-efficient for small
+    populations.
+
+    ``hyperparam_bounds`` maps each tuned key to [low, high]."""
+
+    def __init__(self, *, hyperparam_bounds: Dict[str, Any],
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(
+            time_attr=time_attr,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations={},
+            quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self._obs_x: List[List[float]] = []   # normalized configs
+        self._obs_y: List[float] = []         # score deltas
+        self._prev_score: Dict[str, float] = {}
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        score = self._score(result)
+        if score is not None:
+            prev = self._prev_score.get(trial.trial_id)
+            if prev is not None:
+                self._record(trial.config, score - prev)
+            self._prev_score[trial.trial_id] = score
+        decision = super().on_result(trial, result)
+        if decision == self.EXPLOIT:
+            # the trial restarts from the DONOR's checkpoint: its next
+            # score delta reflects the clone, not the explored config —
+            # it must not be attributed to the new config
+            self._prev_score.pop(trial.trial_id, None)
+        return decision
+
+    def on_trial_complete(self, trial):
+        self._prev_score.pop(trial.trial_id, None)
+        super().on_trial_complete(trial)
+
+    # -- GP-UCB explore ------------------------------------------------------
+
+    def _norm(self, config: Dict[str, Any]) -> List[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return out
+
+    def _record(self, config: Dict[str, Any], dy: float):
+        self._obs_x.append(self._norm(config))
+        self._obs_y.append(dy)
+        if len(self._obs_y) > 256:   # bounded fit cost
+            self._obs_x = self._obs_x[-256:]
+            self._obs_y = self._obs_y[-256:]
+
+    def explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        out = dict(config)
+        keys = list(self.bounds.keys())
+        if len(self._obs_y) < 4:
+            for k in keys:  # cold start: uniform sample
+                lo, hi = self.bounds[k]
+                out[k] = lo + (hi - lo) * self._rng.random()
+            return out
+        X = np.asarray(self._obs_x)
+        y = np.asarray(self._obs_y)
+        y = (y - y.mean()) / (y.std() + 1e-9)
+        # RBF-kernel GP posterior (reference fits TV-SquaredExp; plain
+        # RBF keeps the bandit while staying dependency-free)
+        ls, noise = 0.2, 1e-2
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * ls * ls))
+        K = k(X, X) + noise * np.eye(len(X))
+        Kinv = np.linalg.inv(K)
+        cand = np.asarray([[self._rng.random() for _ in keys]
+                           for _ in range(64)])
+        Ks = k(cand, X)
+        mu = Ks @ Kinv @ y
+        var = np.clip(1.0 - np.einsum("ij,jk,ik->i", Ks, Kinv, Ks),
+                      1e-9, None)
+        beta = math.sqrt(2 * math.log(len(self._obs_y) + 1))
+        best = cand[int(np.argmax(mu + beta * np.sqrt(var)))]
+        for k_, u in zip(keys, best):
+            lo, hi = self.bounds[k_]
+            out[k_] = lo + (hi - lo) * float(u)
+        return out

@@ -153,7 +153,16 @@ class TPESearcher(Searcher):
         bx = [xform(o[0][path]) for o in bad if path in o[0]]
         if not gx:
             return dom.sample(self._rng)
-        bw = max((hi - lo) / max(len(gx), 1) ** 0.5, 1e-3 * (hi - lo))
+        # Scott-style bandwidth from the GOOD points' spread (floored):
+        # the old (hi-lo)/sqrt(n) rule stayed range-wide for small n, and
+        # clamping its out-of-range samples piled candidate mass on the
+        # domain boundaries — an artificial attractor at lo/hi.
+        if len(gx) > 1:
+            mean = sum(gx) / len(gx)
+            std = (sum((v - mean) ** 2 for v in gx) / len(gx)) ** 0.5
+        else:
+            std = 0.0
+        bw = max(std * len(gx) ** -0.2, 0.05 * (hi - lo), 1e-12)
 
         def kde(xs, x):
             if not xs:
@@ -164,7 +173,14 @@ class TPESearcher(Searcher):
         best_x, best_score = None, -1.0
         for _ in range(self._n_cand):
             center = self._rng.choice(gx)
-            x = min(hi, max(lo, self._rng.gauss(center, bw)))
+            # rejection sampling keeps the proposal INSIDE the domain
+            # without boundary pile-up; fall back to clamp if unlucky
+            for _try in range(8):
+                x = self._rng.gauss(center, bw)
+                if lo <= x <= hi:
+                    break
+            else:
+                x = min(hi, max(lo, x))
             score = kde(gx, x) / kde(bx, x)
             if score > best_score:
                 best_x, best_score = x, score
@@ -214,3 +230,56 @@ class TPESearcher(Searcher):
 
     def on_restore(self, num_existing: int):
         self._count = max(self._count, num_existing)
+
+
+class BOHBSearcher(TPESearcher):
+    """Model-based half of BOHB (reference: the TuneBOHB searcher paired
+    with schedulers/hb_bohb.py). BOHB fits its KDE model PER BUDGET and
+    proposes from the largest budget with enough observations — a trial
+    HyperBand stopped at a low rung reports a low score because of its
+    short BUDGET, not its config, so mixing budgets in one model (plain
+    TPE) poisons it. Observations are bucketed by training_iteration;
+    ``suggest`` rebuilds the TPE observation set from the deepest bucket
+    that has at least ``n_startup`` entries before proposing."""
+
+    def __init__(self, n_startup: int = 6, gamma: float = 0.25,
+                 n_candidates: int = 64):
+        super().__init__(n_startup=n_startup, gamma=gamma,
+                         n_candidates=n_candidates)
+        self._by_budget: Dict[int, List[Tuple[dict, float]]] = {}
+
+    def on_trial_complete(self, trial_id, result):
+        flat = self._configs.pop(trial_id, None)
+        if flat is None or not result:
+            return
+        score = result.get(self._metric)
+        if score is None:
+            return
+        budget = int(result.get("training_iteration", 1))
+        self._by_budget.setdefault(budget, []).append(
+            (flat, float(score)))
+
+    _RESTORED_BUDGET = 1 << 30  # restored trials ran to completion
+
+    def observe(self, config, score):
+        """Restored-experiment history (TuneController.restore_trials):
+        completed trials count as deepest-budget observations so a
+        restored BOHB search keeps its model instead of restarting
+        random."""
+        self._by_budget.setdefault(self._RESTORED_BUDGET, []).append(
+            (_flatten(config), float(score)))
+
+    def suggest(self, trial_id: str):
+        # model on the deepest budget with enough data (reference: BOHB's
+        # "use the KDE of the highest budget with sufficient points")
+        self._obs = []
+        for budget in sorted(self._by_budget, reverse=True):
+            bucket = self._by_budget[budget]
+            if len(bucket) >= max(4, self._n_startup // 2):
+                self._obs = list(bucket)
+                break
+        else:
+            # not enough at any single budget yet: pool the deepest few
+            for budget in sorted(self._by_budget, reverse=True):
+                self._obs.extend(self._by_budget[budget])
+        return super().suggest(trial_id)

@@ -15,7 +15,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu.tune.schedulers import (CONTINUE, STOP, FIFOScheduler,
+from ray_tpu.tune.schedulers import (CONTINUE, PAUSE, STOP, FIFOScheduler,
                                      PopulationBasedTraining, TrialScheduler)
 from ray_tpu.tune.trial import Trial, TrialActor, TrialStatus
 
@@ -122,7 +122,10 @@ class TuneController:
 
     def run(self) -> List[Trial]:
         pending = [t for t in self.trials if not t.is_finished]
+        for t in pending:
+            self._notify_added(t)
         running: Dict[Any, Trial] = {}  # pending_result ref -> trial
+        parked: Dict[str, Trial] = {}   # PAUSED, awaiting the scheduler
         exhausted = False
         try:
             while True:
@@ -132,8 +135,10 @@ class TuneController:
                     if t is None:
                         exhausted = True
                     else:
+                        self._notify_added(t)
                         pending.append(t)
-                if not (pending or running):
+                self._drain_parked(parked, pending)
+                if not (pending or running or parked):
                     if self._searcher is None or exhausted:
                         break
                 while pending and len(running) < self._max_concurrent:
@@ -141,6 +146,15 @@ class TuneController:
                     self._start_trial(trial)
                     running[trial.pending_result] = trial
                 if not running:
+                    if parked:
+                        # nothing can progress and the scheduler released
+                        # nobody (e.g. bracket peers all errored):
+                        # fail-safe unpause everyone rather than hang
+                        for t in parked.values():
+                            t.status = TrialStatus.PENDING
+                            pending.append(t)
+                        parked.clear()
+                        continue
                     break
                 ready, _ = ray_tpu.wait(list(running.keys()),
                                         num_returns=1, timeout=5.0)
@@ -149,6 +163,8 @@ class TuneController:
                     requeue = self._process(trial)
                     if requeue == "requeue":
                         pending.append(trial)
+                    elif requeue == "park":
+                        parked[trial.trial_id] = trial
                     elif not trial.is_finished:
                         running[trial.pending_result] = trial
                 self._checkpoint_experiment()
@@ -157,6 +173,32 @@ class TuneController:
                 self._kill_actor(trial)
             self._checkpoint_experiment()
         return self.trials
+
+    def _notify_added(self, trial: Trial):
+        hook = getattr(self._scheduler, "on_trial_add", None)
+        if hook is not None:
+            hook(trial)
+
+    def _drain_parked(self, parked: Dict[str, Trial],
+                      pending: List[Trial]):
+        """Apply the scheduler's verdicts for paused trials (HyperBand
+        releases a bracket's survivors once all peers hit the rung)."""
+        sched = self._scheduler
+        for tid in (sched.pop_unpaused()
+                    if hasattr(sched, "pop_unpaused") else []):
+            t = parked.pop(tid, None)
+            if t is not None:
+                t.status = TrialStatus.PENDING
+                pending.append(t)
+        for tid in (sched.pop_parked_stops()
+                    if hasattr(sched, "pop_parked_stops") else []):
+            t = parked.pop(tid, None)
+            if t is not None:
+                t.status = TrialStatus.TERMINATED
+                sched.on_trial_complete(t)
+                if self._searcher is not None:
+                    self._searcher.on_trial_complete(t.trial_id,
+                                                     t.last_result)
 
     # ------------------------------------------------------------ internals
 
@@ -201,6 +243,20 @@ class TuneController:
             decision = STOP
         if decision == PopulationBasedTraining.EXPLOIT:
             return self._exploit(trial)
+        if decision == PAUSE:
+            # park at the latest checkpoint until the scheduler releases
+            # the bracket (reference: HyperBand's PauseTrial)
+            trial.pending_result = trial.actor.ack_and_next.remote("stop")
+            try:
+                ray_tpu.get(trial.pending_result, timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+            self._kill_actor(trial)
+            trial.status = TrialStatus.PAUSED
+            note = getattr(self._scheduler, "note_paused", None)
+            if note is not None:
+                note(trial.trial_id)
+            return "park"
         action = "stop" if decision == STOP else "continue"
         trial.pending_result = trial.actor.ack_and_next.remote(action)
         return None

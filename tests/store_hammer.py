@@ -84,8 +84,8 @@ def main():
                             oid, fill = rng.choice(held)
                             try:
                                 view = store.get(oid, timeout_ms=0)
-                            except (ObjectTimeoutError, Exception):
-                                continue  # evicted: fine
+                            except (ObjectTimeoutError, KeyError):
+                                continue  # evicted/deleted: fine
                             assert view[0] == fill and view[-1] == fill, \
                                 f"corruption in {oid}"
                             del view

@@ -404,3 +404,118 @@ def test_restore_with_searcher(run_cfg, tmp_path):
     new = {r.trial_id: r.metrics.get("score") for r in r2}
     for tid, score in old.items():
         assert new[tid] == score
+
+
+def _ckpt_objective_factory(optimum: float, max_steps: int):
+    """Checkpointing objective: score grows with steps, capped by how
+    close config['x'] is to the optimum — separates good configs only
+    after enough budget, which is what bracket schedulers exploit."""
+    def objective(config):
+        import json as _json
+        quality = 1.0 - abs(config["x"] - optimum)
+        ckpt = tune.get_checkpoint()
+        start = 0
+        if ckpt:
+            start = _json.load(
+                open(os.path.join(ckpt.path, "s.json")))["step"] + 1
+        for step in range(start, max_steps):
+            d = os.path.join(tune.get_trial_dir(), f"c{step}")
+            os.makedirs(d, exist_ok=True)
+            _json.dump({"step": step},
+                       open(os.path.join(d, "s.json"), "w"))
+            tune.report({"score": quality * (step + 1),
+                         "training_iteration": step + 1}, checkpoint=d)
+    return objective
+
+
+def test_hyperband_brackets_beat_random_budget(run_cfg):
+    """HyperBand (reference: schedulers/hyperband.py): synchronized
+    brackets pause at rungs and promote the top 1/eta. Same trial count
+    as exhaustive random search, but the bad trials burn far less budget
+    and the best config still wins."""
+    objective = _ckpt_objective_factory(optimum=0.7, max_steps=9)
+    xs = [0.05, 0.2, 0.35, 0.5, 0.68, 0.9, 0.15, 0.45, 0.72]
+    sched = tune.HyperBandScheduler(max_t=9, reduction_factor=3)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search(xs)},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=3),
+        run_config=run_cfg(name="hyperband"))
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    # the best configs (0.68 / 0.72) survive every rung
+    assert abs(best.config["x"] - 0.7) < 0.05, best.config
+    # budget: exhaustive = 9 trials x 9 iters = 81; brackets must cut
+    # a large share of that
+    total_iters = sum(t.iterations for t in grid._trials)
+    assert total_iters < 65, total_iters
+
+
+def test_bohb_beats_random_search(run_cfg):
+    """BOHB = HyperBandForBOHB + the TPE-based BOHBSearcher (reference:
+    schedulers/hb_bohb.py + TuneBOHB): on a seeded smooth objective the
+    model-guided search must find a better config than seeded random
+    search with the same trial budget."""
+    objective = _ckpt_objective_factory(optimum=0.37, max_steps=6)
+    n = 14
+
+    def run(search_alg, name):
+        tuner = tune.Tuner(
+            objective,
+            param_space={"x": tune.uniform(0.0, 1.0)},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max", num_samples=n,
+                search_alg=search_alg,
+                scheduler=tune.HyperBandForBOHB(max_t=6,
+                                                reduction_factor=3),
+                max_concurrent_trials=3, seed=5),
+            run_config=run_cfg(name=name))
+        grid = tuner.fit()
+        return min(abs(t.config["x"] - 0.37) for t in grid._trials
+                   if t.config)
+
+    bohb_err = run(tune.BOHBSearcher(n_startup=5), "bohb")
+    rand_err = run(tune.BasicVariantGenerator(), "bohb_rand")
+    assert bohb_err <= rand_err + 1e-9, (bohb_err, rand_err)
+    assert bohb_err < 0.15, bohb_err
+
+
+def test_pb2_learns_better_configs(run_cfg):
+    """PB2 (reference: schedulers/pb2.py): GP-UCB explore. The
+    population's bad trials adopt model-proposed configs; the final best
+    score must beat what the initial population could produce alone."""
+    def objective(config):
+        import json as _json
+        ckpt = tune.get_checkpoint()
+        w, start = 0.0, 0
+        if ckpt:
+            st = _json.load(open(os.path.join(ckpt.path, "w.json")))
+            w, start = st["w"], st["step"] + 1
+        for step in range(start, 16):
+            lr = config["lr"]
+            w += 1.0 - abs(lr - 0.6)   # best gain at lr=0.6
+            d = os.path.join(tune.get_trial_dir(), f"c{step}")
+            os.makedirs(d, exist_ok=True)
+            _json.dump({"w": w, "step": step},
+                       open(os.path.join(d, "w.json"), "w"))
+            tune.report({"score": w, "training_iteration": step + 1},
+                        checkpoint=d)
+
+    sched = tune.PB2(hyperparam_bounds={"lr": [0.0, 1.0]},
+                     perturbation_interval=3, seed=3)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.05, 0.95, 0.3, 0.85])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=2),
+        run_config=run_cfg(name="pb2"))
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result().metrics["score"]
+    # the best INITIAL config (0.3: gain 0.7/step) alone gives 11.2
+    # over 16 steps; exploit+GP-explore must end above it
+    assert best > 11.3, best
