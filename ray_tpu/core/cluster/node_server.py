@@ -26,7 +26,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.core import protocol, serialization
+from ray_tpu.core import external_storage, protocol, serialization
 from ray_tpu.core.cluster.pull_manager import (PRIO_GET, PRIO_TASK_ARGS,
                                                PRIO_WAIT)
 from ray_tpu.core.cluster.rpc import (ClientCache, RpcClient, RpcError,
@@ -52,8 +52,7 @@ def materialize(runtime: Runtime, payload) -> Tuple[str, bytes]:
         return payload
     if kind == "spilled":
         path = data[0] if isinstance(data, tuple) else data
-        with open(path, "rb") as f:
-            return ("inline", f.read())
+        return ("inline", bytes(external_storage.read_buffer(path)))
     oid = ObjectID(data)
     view = runtime.store.get(oid, timeout_ms=0)
     try:
@@ -944,10 +943,7 @@ class NodeServer:
             return len(data)
         if kind == "spilled":
             path = data[0] if isinstance(data, tuple) else data
-            try:
-                return os.path.getsize(path)
-            except OSError:
-                return None
+            return external_storage.size(path)
         view = rt.store.get(oid, timeout_ms=0)
         try:
             return view.nbytes
@@ -972,10 +968,8 @@ class NodeServer:
         if kind == "spilled":
             path = data[0] if isinstance(data, tuple) else data
             try:
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    return f.read(length)
-            except OSError:
+                return external_storage.read_range(path, offset, length)
+            except Exception:  # noqa: BLE001
                 return None
         view = rt.store.get(oid, timeout_ms=0)
         try:

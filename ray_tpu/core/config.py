@@ -91,8 +91,11 @@ _FLAGS: List[Flag] = [
          "lost object before giving up (reference: "
          "object_recovery_manager.h)."),
     Flag("spill_dir", str, "/tmp/ray_tpu_spill",
-         "Directory for objects spilled to disk under store memory "
-         "pressure (reference: object_spilling_config)."),
+         "Spill location under store memory pressure: a local directory "
+         "(mmap'd reads) or any fsspec URI (s3://..., gs://...). URI "
+         "backends must be reachable from EVERY process — memory:// is "
+         "driver-process-only, for tests (reference: "
+         "object_spilling_config + external_storage.py S3 spilling)."),
     Flag("lineage_max_bytes", int, 256 << 20,
          "Byte budget for the driver's lineage table (serialized task "
          "descriptions kept for object reconstruction); oldest entries "

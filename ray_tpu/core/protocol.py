@@ -145,16 +145,15 @@ def _store_or_inline(pickled, views, total, store) -> Payload:
 
 
 def spilled_unpack(path_and_size) -> Any:
-    """Decode a payload spilled to local disk (reference: external_storage
-    restore, python/ray/_private/external_storage.py). The file holds the
-    same container format as a shm object; mmap it so large tensors stay
-    file-backed until touched."""
-    import mmap as _mmap
+    """Decode a spilled payload (reference: external_storage restore,
+    python/ray/_private/external_storage.py:451). Local files hold the
+    same container format as a shm object and are mmap'd so large
+    tensors stay file-backed until touched; fsspec URIs (s3://...) read
+    through the filesystem driver."""
+    from ray_tpu.core import external_storage as _ext
 
     path = path_and_size[0] if isinstance(path_and_size, tuple) else path_and_size
-    with open(path, "rb") as f:
-        mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
-    return serialization.unpack(memoryview(mm))
+    return serialization.unpack(memoryview(_ext.read_buffer(path)))
 
 
 class _Pin:

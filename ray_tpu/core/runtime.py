@@ -27,7 +27,7 @@ from collections import deque
 from multiprocessing.connection import Listener
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu.core import protocol, serialization
+from ray_tpu.core import external_storage, protocol, serialization
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import (
     ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID,
@@ -267,7 +267,8 @@ class Runtime:
             object_store_memory or default_store_capacity(),
         )
         self.store.need_space_hook = self._try_free_space
-        self._spill_dir = os.path.join(config.spill_dir, self._session)
+        self._spill_dir = external_storage.spill_dir_for(
+            config.spill_dir, self._session)
 
         self._lock = threading.Lock()
         self._objects: Dict[ObjectID, _ObjectEntry] = {}
@@ -796,10 +797,7 @@ class Runtime:
                     kind, data = payload
             if kind == "spilled":
                 path = data[0] if isinstance(data, tuple) else data
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
+                external_storage.delete(path)
                 if isinstance(data, tuple):
                     with self._spill_lock:
                         self._spilled_bytes -= data[1]
@@ -842,11 +840,8 @@ class Runtime:
         except Exception:  # noqa: BLE001
             return 0
         try:
-            os.makedirs(self._spill_dir, exist_ok=True)
-            path = os.path.join(self._spill_dir, oid.hex())
-            with open(path, "wb") as f:
-                f.write(view)
-            size = view.nbytes
+            path, size = external_storage.write(self._spill_dir,
+                                                oid.hex(), view)
         finally:
             del view
             try:
@@ -863,10 +858,7 @@ class Runtime:
             # a concurrent free() won (payload is now a freed-error marker
             # or gone): discard the file we just wrote — accounting it
             # would leak disk and inflate _spilled_bytes forever
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+            external_storage.delete(path)
             return 0
         with self._spill_lock:
             self._pinned.pop(oid_b, None)
@@ -2492,7 +2484,7 @@ class Runtime:
             self._log_monitor.stop(flush=True)  # drain final worker output
         import shutil
 
-        shutil.rmtree(self._spill_dir, ignore_errors=True)
+        external_storage.cleanup_dir(self._spill_dir)
         shutil.rmtree(os.path.join("/tmp", self._session),
                       ignore_errors=True)
         if runtime_context.get_core_or_none() is self:

@@ -54,6 +54,29 @@ class StepNode:
         return out
 
 
+class EventNode(StepNode):
+    """DAG node resolved by an external event, not a task (see
+    events.py; reference: workflow.wait_for_event). Executes INLINE in
+    the workflow driver — it blocks the graph by design — and its
+    payload checkpoints like any step result, so resume never re-waits a
+    consumed event."""
+
+    def __init__(self, key: str, provider, timeout=None):
+        super().__init__(fn=None, args=(), kwargs={},
+                         name=f"event__{key}")
+        self.key = key
+        self.provider = provider
+        self.timeout = timeout
+
+    def __getstate__(self):
+        # providers hold live sockets/servers: the persisted DAG drops
+        # them; resume(event_providers={key: provider}) re-attaches for
+        # events that had not yet arrived
+        state = dict(self.__dict__)
+        state["provider"] = None
+        return state
+
+
 class _Step:
     def __init__(self, fn, name: Optional[str] = None,
                  max_retries: int = 3):
@@ -162,33 +185,73 @@ def _execute(node: StepNode, wf_dir: str) -> Any:
                 else refs[v.step_id]
         return v
 
+    def checkpoint(step_id: str, value):
+        path = _result_path(wf_dir, step_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)  # atomic: a crash never half-writes
+        values[step_id] = value
+
     for n in order:
         path = _result_path(wf_dir, n.step_id)
         if os.path.exists(path):
             with open(path, "rb") as f:
                 values[n.step_id] = pickle.load(f)
-            continue
+
+    def submit(n: StepNode):
         args = [resolve(v) for v in n.args]
         kwargs = {k: resolve(v) for k, v in n.kwargs.items()}
         remote_fn = ray_tpu.remote(max_retries=n.max_retries)(n.fn)
         refs[n.step_id] = remote_fn.remote(*args, **kwargs)
 
+    def submittable(n: StepNode) -> bool:
+        return all(u.step_id in values or u.step_id in refs
+                   for u in n.upstream())
+
+    # Submit every step whose deps don't hang on an unresolved event
+    # BEFORE blocking on any event: independent branches (including the
+    # step whose side effect may TRIGGER the event) run in parallel
+    # with the wait. Then resolve events in topo order, releasing their
+    # dependents as payloads arrive.
+    unplaced = [n for n in order if n.step_id not in values]
+    while unplaced:
+        rest = []
+        for n in unplaced:
+            if not isinstance(n, EventNode) and submittable(n):
+                submit(n)
+            else:
+                rest.append(n)
+        if not rest:
+            break
+        ev = next((n for n in rest if isinstance(n, EventNode)), None)
+        if ev is None:
+            raise RuntimeError(
+                "workflow DAG has unsatisfiable dependencies: "
+                + ", ".join(n.step_id for n in rest))
+        if ev.provider is None:
+            raise RuntimeError(
+                f"event {ev.key!r} has not arrived and its provider "
+                f"did not survive persistence; pass "
+                f"resume(..., event_providers={{{ev.key!r}: provider}})")
+        # the payload checkpoints so resume never re-waits it
+        checkpoint(ev.step_id, ev.provider.poll(ev.key, ev.timeout))
+        rest.remove(ev)
+        unplaced = rest
+
     for n in order:
         if n.step_id not in refs:
             continue
-        value = ray_tpu.get(refs[n.step_id])
-        path = _result_path(wf_dir, n.step_id)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(value, f)
-        os.replace(tmp, path)  # atomic: a crash never half-writes a step
-        values[n.step_id] = value
+        checkpoint(n.step_id, ray_tpu.get(refs[n.step_id]))
 
     return values[node.step_id]
 
 
-def resume(workflow_id: str, *, storage: Optional[str] = None) -> Any:
-    """Re-run an interrupted workflow; completed steps load from disk."""
+def resume(workflow_id: str, *, storage: Optional[str] = None,
+           event_providers: Optional[Dict[str, Any]] = None) -> Any:
+    """Re-run an interrupted workflow; completed steps load from disk.
+    ``event_providers`` re-attaches providers (keyed by event key) to
+    event nodes whose payloads had not yet arrived."""
     wf_dir = _wf_dir(workflow_id, storage)
     dag_path = os.path.join(wf_dir, "dag.pkl")
     if not os.path.exists(dag_path):
@@ -197,6 +260,10 @@ def resume(workflow_id: str, *, storage: Optional[str] = None) -> Any:
 
     with open(dag_path, "rb") as f:
         dag = cloudpickle.load(f)
+    if event_providers:
+        for n in _topo(dag):
+            if isinstance(n, EventNode) and n.key in event_providers:
+                n.provider = event_providers[n.key]
     _set_status(wf_dir, "RUNNING")
     try:
         out = _execute(dag, wf_dir)
@@ -233,3 +300,11 @@ def delete(workflow_id: str, *, storage: Optional[str] = None):
     import shutil
 
     shutil.rmtree(_wf_dir(workflow_id, storage), ignore_errors=True)
+
+
+from ray_tpu.workflow.events import (  # noqa: E402,F401
+    EventProvider,
+    HTTPEventProvider,
+    LocalEventProvider,
+    wait_for_event,
+)

@@ -1,0 +1,99 @@
+"""Workflow events: durably wait on EXTERNAL signals inside a step DAG.
+
+Reference: python/ray/workflow/http_event_provider.py (HTTP ingress for
+events) + workflow.wait_for_event (event_listener.py). An event node
+blocks the workflow until its payload arrives; once received it
+checkpoints exactly like a step result, so a resumed workflow never
+waits for (or double-consumes) an event it already saw.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+class EventProvider:
+    """Interface: block until the payload for ``key`` arrives."""
+
+    def poll(self, key: str, timeout: Optional[float] = None) -> Any:
+        raise NotImplementedError
+
+
+class LocalEventProvider(EventProvider):
+    """In-process provider: tests and same-process producers call
+    ``send_event`` directly."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._events: Dict[str, Any] = {}
+
+    def send_event(self, key: str, payload: Any):
+        with self._cv:
+            self._events[key] = payload
+            self._cv.notify_all()
+
+    def poll(self, key: str, timeout: Optional[float] = None) -> Any:
+        with self._cv:
+            if not self._cv.wait_for(lambda: key in self._events,
+                                     timeout):
+                raise TimeoutError(f"event {key!r} never arrived")
+            return self._events[key]
+
+
+class HTTPEventProvider(LocalEventProvider):
+    """HTTP ingress for external event producers (reference:
+    http_event_provider.py — there a Serve deployment; here a stdlib
+    HTTP listener).
+
+        POST /event/<key>      body: JSON payload
+
+    resolves any workflow waiting on ``key`` with the decoded body."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__()
+        import http.server
+
+        provider = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — stdlib API
+                if not self.path.startswith("/event/"):
+                    self.send_error(404)
+                    return
+                key = self.path[len("/event/"):]
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b"null"
+                try:
+                    payload = json.loads(raw)
+                except ValueError:
+                    self.send_error(400, "body must be JSON")
+                    return
+                provider.send_event(key, payload)
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      Handler)
+        self.address: Tuple[str, int] = self._httpd.server_address
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="wf-events").start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def wait_for_event(key: str, provider: EventProvider,
+                   timeout: Optional[float] = None):
+    """A DAG node that blocks the workflow until the event for ``key``
+    arrives, then checkpoints its payload as the node's durable result
+    (reference: workflow.wait_for_event)."""
+    from ray_tpu.workflow import EventNode
+
+    return EventNode(key, provider, timeout)

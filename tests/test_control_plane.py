@@ -723,3 +723,64 @@ def test_runtime_env_missing_package_fails_task_not_worker(rt):
         rt.get(doomed.remote(), timeout=60)
     # pool is still healthy
     assert rt.get(fine.remote(), timeout=60) == 2
+
+
+def test_workflow_waits_for_http_event(tmp_path):
+    """workflow.wait_for_event + HTTPEventProvider (reference:
+    python/ray/workflow/http_event_provider.py): the DAG blocks at the
+    event node until an external HTTP POST delivers the payload; the
+    payload checkpoints durably, so a resume returns without re-waiting
+    (and without a provider)."""
+    import json
+    import threading
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import workflow
+    from ray_tpu.core import runtime_context
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+    provider = workflow.HTTPEventProvider()
+    try:
+        @workflow.step
+        def enrich(payload, factor):
+            return {"value": payload["value"] * factor, "src": "enriched"}
+
+        dag = enrich.bind(
+            workflow.wait_for_event("approval", provider, timeout=60),
+            10)
+
+        result_box = []
+        t = threading.Thread(
+            target=lambda: result_box.append(workflow.run(
+                dag, workflow_id="wf_event", storage=str(tmp_path))),
+            daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert not result_box, "workflow finished before the event?!"
+
+        host, port = provider.address
+        req = urllib.request.Request(
+            f"http://{host}:{port}/event/approval",
+            data=json.dumps({"value": 7}).encode(),
+            headers={"Content-Type": "application/json"})
+        assert urllib.request.urlopen(req, timeout=10).status == 200
+
+        t.join(timeout=60)
+        assert result_box and result_box[0] == {"value": 70,
+                                                "src": "enriched"}
+        assert workflow.get_status("wf_event",
+                                   storage=str(tmp_path)) == "SUCCESSFUL"
+
+        # resume: the event payload is checkpointed — no provider needed,
+        # no re-wait
+        out = workflow.resume("wf_event", storage=str(tmp_path))
+        assert out == {"value": 70, "src": "enriched"}
+    finally:
+        provider.close()
+        core = runtime_context.get_core_or_none()
+        if core is not None:
+            core.shutdown()
+        runtime_context.set_core(prev)
