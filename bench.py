@@ -843,7 +843,44 @@ def main():
         "loss": round(loss, 4),
         "rows": rows,
     }
+
+    # Regression guard (round-3 verdict: north-star drift must not land
+    # silently): on the real chip, fail LOUDLY when a published headline
+    # regresses >10%. "Published" values were measured on quiet hardware;
+    # direction-aware comparison (latency metrics regress UP).
+    guard = []
+    if backend == "tpu" and published:
+        by_name = {r["metric"]: r["value"] for r in rows
+                   if isinstance(r.get("value"), (int, float))
+                   and r["value"] > 0}
+        by_name["train_tokens_per_sec_per_chip"] = tok_s
+        checks = [  # (published key, row key, higher_is_better)
+            ("train_tokens_per_sec_per_chip",
+             "train_tokens_per_sec_per_chip", True),
+            ("train_mfu", "train_mfu", True),
+            ("moe_train_tokens_per_sec_per_chip",
+             "moe_train_tokens_per_sec_per_chip", True),
+            ("serve_decode_tokens_per_sec",
+             "serve_decode_tokens_per_sec", True),
+            ("serve_ttft_p50_ms_loaded", "serve_ttft_p50_ms", False),
+        ]
+        for pub_key, row_key, hib in checks:
+            pub, got = published.get(pub_key), by_name.get(row_key)
+            if not pub or not got:
+                continue
+            ratio = got / pub if hib else pub / got
+            if ratio < 0.90:
+                guard.append(f"{row_key}: {got:.1f} vs published "
+                             f"{pub:.1f} ({ratio:.2f}x)")
+        out["regression_guard"] = ("FAILED: " + "; ".join(guard)
+                                   if guard else "ok")
     print(json.dumps(out))
+    if guard:
+        import sys
+
+        print(f"REGRESSION GUARD FAILED: {'; '.join(guard)}",
+              file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -26,17 +26,24 @@ def _fs_and_path(uri: str):
     return fs, paths[0]
 
 
+def _join_uri(base: str, name: str) -> str:
+    """URI join that survives bare-root bases: 'memory://'.rstrip('/')
+    would collapse to 'memory:' and silently stop being a URI."""
+    return base + name if base.endswith("://") \
+        else base.rstrip("/") + "/" + name
+
+
 def spill_dir_for(base: str, session: str) -> str:
     """Session-scoped spill location under the configured base."""
     if is_uri(base):
-        return base.rstrip("/") + "/" + session
+        return _join_uri(base, session)
     return os.path.join(base, session)
 
 
 def write(spill_dir: str, name: str, view) -> Tuple[str, int]:
     """Write one spilled payload; returns (path_or_uri, size)."""
     if is_uri(spill_dir):
-        uri = spill_dir.rstrip("/") + "/" + name
+        uri = _join_uri(spill_dir, name)
         fs, p = _fs_and_path(uri)
         fs.makedirs(os.path.dirname(p), exist_ok=True)
         with fs.open(p, "wb") as f:

@@ -222,7 +222,7 @@ class _ActorState:
         "actor_id", "worker", "cls_fn_id", "creation_args_payload",
         "creation_deps", "opts", "queue", "ready", "dead", "death_cause",
         "restarts_left", "name", "creation_event", "request", "pg_wire",
-        "acquired_bundle", "chips", "resources_acquired",
+        "acquired_bundle", "chips", "resources_acquired", "capacity",
     )
 
     def __init__(self, actor_id, cls_fn_id, args_payload, deps, opts):
@@ -232,6 +232,12 @@ class _ActorState:
         self.creation_args_payload = args_payload
         self.creation_deps = deps
         self.opts = opts
+        # in-flight call budget the driver may keep on the worker: the
+        # default pool plus every named concurrency group's threads
+        # (reference: concurrency_group_manager.h:34 — per-group limits)
+        self.capacity = max(1, int(opts.get("max_concurrency") or 1)) + \
+            sum(int(v) for v in
+                (opts.get("concurrency_groups") or {}).values())
         self.queue: deque = deque()
         self.ready = False
         self.dead = False
@@ -840,8 +846,13 @@ class Runtime:
         except Exception:  # noqa: BLE001
             return 0
         try:
-            path, size = external_storage.write(self._spill_dir,
-                                                oid.hex(), view)
+            try:
+                path, size = external_storage.write(self._spill_dir,
+                                                    oid.hex(), view)
+            except Exception:  # noqa: BLE001 — transient backend error
+                # (s3 hiccup etc.): skip this candidate; the caller's
+                # put must see store pressure, never a raw fsspec error
+                return 0
         finally:
             del view
             try:
@@ -1170,25 +1181,28 @@ class Runtime:
         spec.request = None
 
     def _dispatch_actor(self, state: _ActorState):
-        spec = None
+        specs: List[_TaskSpec] = []
         failed: List[_TaskSpec] = []
         with self._lock:
             w = state.worker
             if state.dead and state.queue:
                 failed = list(state.queue)
                 state.queue.clear()
-            elif (
-                w is not None and state.ready and not state.dead
-                and not w.inflight and state.queue
-            ):
-                spec = state.queue.popleft()
-                w.inflight[spec.task_id.binary()] = spec
+            elif w is not None and state.ready and not state.dead:
+                # keep up to `capacity` calls in flight: with
+                # max_concurrency / concurrency groups the worker-side
+                # pools overlap them (default actors stay FIFO, cap 1)
+                while (state.queue
+                       and len(w.inflight) < state.capacity):
+                    spec = state.queue.popleft()
+                    w.inflight[spec.task_id.binary()] = spec
+                    specs.append(spec)
         for f in failed:
             self._store_error(
                 f.return_ids,
                 ActorDiedError(str(state.death_cause or "actor is dead")),
             )
-        if spec is not None:
+        for spec in specs:
             self._send_actor_call(w, spec)
 
     def _inline_values_for(self, deps: List[ObjectID],

@@ -58,6 +58,11 @@ class WorkerCore:
         self._actors: Dict[bytes, Any] = {}
         self._actor_loops: Dict[bytes, Any] = {}  # actor_id -> asyncio loop
         self._actor_pools: Dict[bytes, Any] = {}  # actor_id -> executor
+        # named concurrency groups (reference:
+        # concurrency_group_manager.h:34): per-group executors + the
+        # method -> group routing map declared at actor creation
+        self._actor_group_pools: Dict[bytes, Dict[str, Any]] = {}
+        self._actor_method_group: Dict[bytes, Dict[str, str]] = {}
 
     @property
     def current_task_id(self) -> Optional[TaskID]:
@@ -268,6 +273,9 @@ class WorkerCore:
             if tag == protocol.MSG_SHUTDOWN:
                 for pool in self._actor_pools.values():
                     pool.shutdown(wait=False, cancel_futures=True)
+                for pools in self._actor_group_pools.values():
+                    for pool in pools.values():
+                        pool.shutdown(wait=False, cancel_futures=True)
                 break
             elif tag == protocol.MSG_REGISTER_FN:
                 _, fn_id, pickled_fn = msg
@@ -277,7 +285,15 @@ class WorkerCore:
             elif tag == protocol.MSG_CREATE_ACTOR:
                 self._create_actor(msg)
             elif tag == protocol.MSG_ACTOR_CALL:
-                pool = self._actor_pools.get(msg[2])
+                group = self._actor_method_group.get(msg[2], {}).get(msg[3])
+                pool = None
+                if group is not None:
+                    # named concurrency group: this method's calls share
+                    # the group's own thread budget, isolated from other
+                    # groups (reference: concurrency groups)
+                    pool = self._actor_group_pools[msg[2]].get(group)
+                if pool is None:
+                    pool = self._actor_pools.get(msg[2])
                 if pool is not None:
                     # max_concurrency > 1: calls overlap on pool threads
                     # (FIFO submission; completion may reorder — the
@@ -560,6 +576,26 @@ class WorkerCore:
 
                 self._actor_pools[actor_id_b] = ThreadPoolExecutor(
                     max_workers=mc, thread_name_prefix="actor-conc")
+            cgs = opts.get("concurrency_groups") or {}
+            if cgs:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._actor_group_pools[actor_id_b] = {
+                    name: ThreadPoolExecutor(
+                        max_workers=int(limit),
+                        thread_name_prefix=f"actor-cg-{name}")
+                    for name, limit in cgs.items()}
+                self._actor_method_group[actor_id_b] = {
+                    m: mo["concurrency_group"]
+                    for m, mo in (opts.get("method_opts") or {}).items()
+                    if mo.get("concurrency_group")}
+                # the DEFAULT group gets its own executor too, so a long
+                # ungrouped call can never block the recv loop from
+                # feeding the named groups (reference: the default group
+                # is just another concurrency group)
+                if actor_id_b not in self._actor_pools:
+                    self._actor_pools[actor_id_b] = ThreadPoolExecutor(
+                        max_workers=mc, thread_name_prefix="actor-conc")
             if opts.get("has_async_methods"):
                 import asyncio
 

@@ -24,17 +24,26 @@ from typing import Any, Dict, Tuple
 
 
 def _parse_rope_scaling(hf_cfg):
-    """llama3-type rope scaling is implemented
+    """llama3 / linear / yarn rope scaling are implemented
     (ops/layers.rope_frequencies); every other type refuses loudly —
     silently-wrong logits are worse than a load error."""
     scaling = getattr(hf_cfg, "rope_scaling", None)
     if not scaling:
         return None
     rope_type = scaling.get("rope_type") or scaling.get("type")
-    if rope_type != "llama3":
+    if rope_type not in ("llama3", "linear", "yarn"):
         raise ValueError(
             f"unsupported HF config: rope_scaling type {rope_type!r} "
-            f"(only 'llama3' is implemented)")
+            f"(implemented: 'llama3', 'linear', 'yarn')")
+    scaling = dict(scaling)
+    if rope_type == "yarn" and not scaling.get(
+            "original_max_position_embeddings"):
+        # transformers falls back to the FIXED config length; pinning it
+        # here keeps inv_freq identical across prefill/decode/training
+        # table lengths (rope_frequencies would otherwise see each
+        # call's max_seq_len)
+        scaling["original_max_position_embeddings"] = \
+            hf_cfg.max_position_embeddings
     return tuple(sorted(
         (k, v) for k, v in scaling.items() if v is not None))
 

@@ -135,20 +135,27 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
     return params
 
 
-def _attend(cfg: LlamaConfig, q, k, v, mesh=None):
+def _attend(cfg: LlamaConfig, q, k, v, mesh=None, ring_axis=None):
     impl = cfg.attn_impl
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "reference"
     if impl == "flash":
         return flash_attention(q, k, v, causal=True)
     if impl == "ring":
+        if ring_axis is not None:
+            # already INSIDE a shard_map that includes the sp axis (the
+            # pp pipeline program): run the per-shard ring body directly
+            from ray_tpu.ops.ring_attention import ring_attention_local
+
+            return ring_attention_local(q, k, v, ring_axis, causal=True)
         if mesh is None:
             raise ValueError("attn_impl='ring' requires a mesh with an 'sp' axis")
         return ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
     return attention_reference(q, k, v, causal=True)
 
 
-def attention_block(cfg: LlamaConfig, x, p, cos, sin, mesh=None):
+def attention_block(cfg: LlamaConfig, x, p, cos, sin, mesh=None,
+                    ring_axis=None):
     """Pre-norm attention sub-block with residual: x + wo(attend(qkv)).
     Shared by every model in the family (llama dense, mixtral MoE)."""
     b, s, _ = x.shape
@@ -165,17 +172,19 @@ def attention_block(cfg: LlamaConfig, x, p, cos, sin, mesh=None):
     v = v.reshape(b, s, cfg.num_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = _attend(cfg, q, k, v, mesh=mesh)
+    attn = _attend(cfg, q, k, v, mesh=mesh, ring_axis=ring_axis)
     attn = attn.reshape(b, s, cfg.num_heads * hd)
     attn_out = jnp.dot(attn, p["wo"].astype(cfg.dtype),
                        preferred_element_type=jnp.float32).astype(cfg.dtype)
     return x + attn_out
 
 
-def _layer(cfg: LlamaConfig, x, layer_params, cos, sin, mesh=None):
+def _layer(cfg: LlamaConfig, x, layer_params, cos, sin, mesh=None,
+           ring_axis=None):
     """One decoder block. x: [b, s, h]."""
     p = layer_params
-    x = attention_block(cfg, x, p, cos, sin, mesh=mesh)
+    x = attention_block(cfg, x, p, cos, sin, mesh=mesh,
+                        ring_axis=ring_axis)
     h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
     mlp = swiglu(h2, p["w_gate"].astype(cfg.dtype),
                  p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype))
@@ -253,11 +262,16 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
 
     shard_map = jax.shard_map
 
-    if cfg.attn_impl == "ring":
+    # pp x ring-attention composition: pp OUTER (this shard_map), sp
+    # INNER (ring_attention_local runs per-shard inside it, KV blocks
+    # rotating on the sp sub-axis). Sequences shard over sp; rope
+    # tables enter as sp-sharded inputs so each rank holds its slice.
+    ring = cfg.attn_impl == "ring"
+    sp = dict(getattr(mesh, "shape", {})).get("sp", 1)
+    if ring and sp <= 1:
         raise ValueError(
-            "attn_impl='ring' composes its own shard_map over 'sp' and "
-            "cannot nest inside the pp pipeline program yet; use "
-            "'flash' or 'reference' attention with pipeline parallelism")
+            "attn_impl='ring' with pipeline parallelism requires a mesh "
+            "with an 'sp' axis (> 1)")
     pp = dict(getattr(mesh, "shape", {})).get("pp", 1)
     if cfg.num_layers % max(pp, 1):
         raise ValueError(
@@ -275,23 +289,33 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
                                 scaling=cfg.rope_scaling_dict)
     mbs = x.reshape(M, b // M, s, cfg.hidden_size)
 
-    layer_fn = lambda x_, p_: _layer(cfg, x_, p_, cos, sin)  # noqa: E731
+    ring_axis = "sp" if ring else None
+    if ring and s % sp:
+        raise ValueError(
+            f"sequence length {s} must be divisible by the mesh's "
+            f"sp={sp}")
+
+    def layer_fn(x_, p_, cos_, sin_):
+        return _layer(cfg, x_, p_, cos_, sin_, ring_axis=ring_axis)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
-    def stage_fn(stage_layers, xmb):
-        # this stage's L/P layers, leading axis scanned
-        def body(x_, p_):
-            return layer_fn(x_, p_), None
+    def stage_fn_with_rope(cos_, sin_):
+        def stage_fn(stage_layers, xmb):
+            # this stage's L/P layers, leading axis scanned
+            def body(x_, p_):
+                return layer_fn(x_, p_, cos_, sin_), None
 
-        out, _ = jax.lax.scan(body, xmb, stage_layers)
-        return out
+            out, _ = jax.lax.scan(body, xmb, stage_layers)
+            return out
+        return stage_fn
 
-    def sharded_pipeline(stage_layers, mbs_rep):
+    def sharded_pipeline(stage_layers, mbs_rep, cos_, sin_):
         from ray_tpu.parallel.pipeline import pipeline_apply
 
         pp = jax.lax.axis_size("pp")
-        outs = pipeline_apply(stage_fn, stage_layers, mbs_rep, "pp")
+        outs = pipeline_apply(stage_fn_with_rope(cos_, sin_),
+                              stage_layers, mbs_rep, "pp")
         # outputs live on the LAST stage; sum-rotate so every stage holds
         # them (cheap: one psum of zeros elsewhere)
         return jax.lax.psum(
@@ -300,14 +324,19 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
     layer_spec = P("pp")           # layer dim sharded over pp
     # REAL data parallelism alongside pp: the per-microbatch batch dim
     # shards over the mesh's data axes (each dp group pipelines its own
-    # slice); activations stay replicated only across pp
+    # slice); activations stay replicated only across pp. With ring
+    # attention the SEQUENCE dim additionally shards over sp, and each
+    # rank receives its slice of the rope tables.
     data_axes = tuple(a for a in mesh.axis_names if a in ("dp", "fsdp"))
-    mb_spec = P(None, data_axes if data_axes else None)
+    mb_spec = P(None, data_axes if data_axes else None,
+                "sp" if ring else None)
+    rope_spec = P("sp" if ring else None)
     outs = shard_map(
         sharded_pipeline, mesh=mesh,
-        in_specs=(layer_spec, mb_spec), out_specs=mb_spec,
+        in_specs=(layer_spec, mb_spec, rope_spec, rope_spec),
+        out_specs=mb_spec,
         check_vma=False,
-    )(params["layers"], mbs)
+    )(params["layers"], mbs, cos, sin)
 
     x = outs.reshape(b, s, cfg.hidden_size)
     logits = _final_head(cfg, params, x)

@@ -39,31 +39,93 @@ def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10_000.0,
     interpolates smoothly — matching transformers'
     modeling_rope_utils._compute_llama3_parameters.
     """
+    import math
+
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    attention_factor = 1.0
     if scaling:
         rope_type = scaling.get("rope_type") or scaling.get("type")
-        if rope_type != "llama3":
+        if rope_type == "llama3":
+            factor = float(scaling["factor"])
+            low = float(scaling.get("low_freq_factor", 1.0))
+            high = float(scaling.get("high_freq_factor", 4.0))
+            old_len = float(scaling.get(
+                "original_max_position_embeddings", 8192))
+            wavelen = 2.0 * jnp.pi / inv_freq
+            # short wavelengths (high freq): keep; long wavelengths (low
+            # freq): divide by factor; the band between interpolates
+            smooth = (old_len / wavelen - low) / (high - low)
+            scaled = ((1.0 - smooth) * (inv_freq / factor)
+                      + smooth * inv_freq)
+            inv_freq = jnp.where(
+                wavelen < old_len / high, inv_freq,
+                jnp.where(wavelen > old_len / low, inv_freq / factor,
+                          scaled))
+        elif rope_type == "linear":
+            # position interpolation (transformers
+            # _compute_linear_scaling_rope): all frequencies divide by
+            # the factor
+            inv_freq = inv_freq / float(scaling["factor"])
+        elif rope_type == "yarn":
+            # NTK-by-parts (YaRN, arXiv:2309.00071) — mirrors
+            # transformers' _compute_yarn_parameters exactly: low-freq
+            # dims interpolate (1/factor), high-freq dims extrapolate
+            # (untouched), a linear ramp blends between, and the cos/sin
+            # tables scale by the attention factor (mscale).
+            factor = float(scaling["factor"])
+            beta_fast = float(scaling.get("beta_fast") or 32)
+            beta_slow = float(scaling.get("beta_slow") or 1)
+            old_len = float(
+                scaling.get("original_max_position_embeddings")
+                or max_seq_len)
+            mscale = scaling.get("mscale")
+            mscale_all_dim = scaling.get("mscale_all_dim")
+
+            def get_mscale(scale, ms=1.0):
+                if scale <= 1:
+                    return 1.0
+                return 0.1 * ms * math.log(scale) + 1.0
+
+            attention_factor = scaling.get("attention_factor")
+            if attention_factor is None:
+                if mscale and mscale_all_dim:
+                    attention_factor = float(
+                        get_mscale(factor, mscale)
+                        / get_mscale(factor, mscale_all_dim))
+                else:
+                    attention_factor = get_mscale(factor)
+
+            def correction_dim(num_rotations):
+                return (head_dim * math.log(
+                    old_len / (num_rotations * 2 * math.pi))
+                    ) / (2 * math.log(theta))
+
+            low = correction_dim(beta_fast)
+            high = correction_dim(beta_slow)
+            if scaling.get("truncate", True):
+                low, high = math.floor(low), math.ceil(high)
+            low, high = max(low, 0), min(high, head_dim - 1)
+            if low == high:
+                high += 0.001  # prevent singularity
+            ramp = jnp.clip(
+                (jnp.arange(head_dim // 2, dtype=jnp.float32) - low)
+                / (high - low), 0.0, 1.0)
+            extrapolation_factor = 1.0 - ramp
+            inv_freq = ((inv_freq / factor)
+                        * (1.0 - extrapolation_factor)
+                        + inv_freq * extrapolation_factor)
+        else:
             raise ValueError(
                 f"unsupported rope_scaling type {rope_type!r} "
-                f"(only 'llama3' is implemented)")
-        factor = float(scaling["factor"])
-        low = float(scaling.get("low_freq_factor", 1.0))
-        high = float(scaling.get("high_freq_factor", 4.0))
-        old_len = float(scaling.get(
-            "original_max_position_embeddings", 8192))
-        wavelen = 2.0 * jnp.pi / inv_freq
-        # short wavelengths (high freq): keep; long wavelengths (low
-        # freq): divide by factor; the band between interpolates
-        smooth = (old_len / wavelen - low) / (high - low)
-        scaled = (1.0 - smooth) * (inv_freq / factor) + smooth * inv_freq
-        inv_freq = jnp.where(
-            wavelen < old_len / high, inv_freq,
-            jnp.where(wavelen > old_len / low, inv_freq / factor, scaled))
+                f"(implemented: 'llama3', 'linear', 'yarn')")
     t = jnp.arange(max_seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)
-    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+    # attention factor (yarn mscale) scales the tables in float32 first,
+    # like transformers' cos() * attention_scaling before the cast
+    return ((jnp.cos(freqs) * attention_factor).astype(dtype),
+            (jnp.sin(freqs) * attention_factor).astype(dtype))
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,

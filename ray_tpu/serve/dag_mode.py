@@ -8,10 +8,11 @@ inference). Here a deployment subclasses (or instantiates)
 compiles the graph, and serves each request as ONE dag.execute — the hot
 path never touches the scheduler.
 
-Stage actors are created with default scheduling (they land on the
-replica's own node, where the compiled channels are shm); a pipeline that
-must span nodes can pass pre-created actors pinned elsewhere — the
-compiler picks socket channels for those edges automatically.
+Stage actors default to the replica's own node (compiled channels are
+shm there); a stage entry may carry an OPTIONS dict (resources,
+num_cpus, num_tpus, ...) to pin it elsewhere — e.g. each pipeline stage
+on its own TPU host — and the compiler picks socket channels for the
+cross-node edges automatically. Pre-created actors work too.
 
 ``LLMPipeline`` is the shipped example: tokenize -> generate (KV-cached
 greedy decode on the Llama family) -> detokenize, each hop a channel.
@@ -26,19 +27,25 @@ import ray_tpu
 
 class PipelineDeployment:
     """Base for DAG-mode deployments: ``stages`` is a list of
-    (actor_class, method, init_args) — actors are spawned at replica init
-    and compiled into a resident pipeline."""
+    (actor_class, method, init_args) or (actor_class, method, init_args,
+    options) — actors are spawned at replica init (options place them:
+    resources/num_cpus/num_tpus route a stage to a fitting node, and
+    cross-node edges compile to socket channels) and compiled into a
+    resident pipeline."""
 
-    def __init__(self, stages: Sequence[Tuple[Any, str, tuple]],
-                 capacity: int = 1 << 20):
+    def __init__(self, stages: Sequence[Tuple], capacity: int = 1 << 20):
         from ray_tpu.dag import compile_pipeline
 
         self._actors = []
         compiled_stages = []
         ready_refs = []
-        for cls, method, init_args in stages:
+        for entry in stages:
+            cls, method, init_args = entry[:3]
+            opts = entry[3] if len(entry) > 3 else None
             wrapped = hasattr(cls, "remote")
             actor_cls = cls if wrapped else ray_tpu.remote(cls)
+            if opts:
+                actor_cls = actor_cls.options(**opts)
             a = actor_cls.remote(*init_args)
             self._actors.append(a)
             compiled_stages.append((a, method))

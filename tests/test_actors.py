@@ -191,3 +191,40 @@ def test_async_actor_method(rt):
 
     a = AsyncActor.remote()
     assert rt.get(a.compute.remote(21)) == 42
+
+
+def test_actor_concurrency_groups(rt):
+    """Named concurrency groups (reference:
+    concurrency_group_manager.h:34): each group gets its own thread
+    budget — a saturated 'compute' group (limit 1) cannot block 'io'
+    methods, and two 'io' calls (limit 2) overlap."""
+    ray_tpu = rt
+
+    @ray_tpu.remote
+    class Mixed:
+        def __init__(self):
+            import threading
+            self._ev = threading.Event()
+
+        @ray_tpu.method(concurrency_group="compute")
+        def block(self):
+            self._ev.wait(30)
+            return "unblocked"
+
+        @ray_tpu.method(concurrency_group="io")
+        def unblock(self):
+            self._ev.set()
+            return "set"
+
+        @ray_tpu.method(concurrency_group="io")
+        def touch(self):
+            return "io-ok"
+
+    a = Mixed.options(
+        concurrency_groups={"compute": 1, "io": 2}).remote()
+    blocked = a.block.remote()
+    # the compute group is saturated by the blocked call; io methods
+    # must still run — including the one that releases it
+    assert ray_tpu.get(a.touch.remote(), timeout=20) == "io-ok"
+    assert ray_tpu.get(a.unblock.remote(), timeout=20) == "set"
+    assert ray_tpu.get(blocked, timeout=30) == "unblocked"

@@ -362,6 +362,47 @@ def test_llama3_rope_scaling_parity():
 
     # unsupported scaling types still refuse loudly
     import pytest as _pytest
-    hf.config.rope_scaling = {"rope_type": "yarn", "factor": 4.0}
-    with _pytest.raises(ValueError, match="yarn"):
+    hf.config.rope_scaling = {"rope_type": "longrope", "factor": 4.0}
+    with _pytest.raises(ValueError, match="longrope"):
         llama_from_hf(hf)
+
+
+@pytest.mark.parametrize("scaling", [
+    {"rope_type": "linear", "factor": 4.0},
+    {"rope_type": "yarn", "factor": 4.0,
+     "original_max_position_embeddings": 64},
+    {"rope_type": "yarn", "factor": 8.0, "beta_fast": 16.0,
+     "beta_slow": 2.0, "attention_factor": 1.3,
+     "original_max_position_embeddings": 64},
+])
+def test_linear_and_yarn_rope_scaling_parity(scaling):
+    """linear (position-interpolation) and yarn (NTK-by-parts,
+    arXiv:2309.00071) rope scaling match transformers bit-for-bit past
+    the original context (reference parity: modeling_rope_utils
+    _compute_linear_scaling_rope / _compute_yarn_parameters)."""
+    from dataclasses import replace
+
+    import numpy as np
+    import torch
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    from ray_tpu.models import llama
+    from ray_tpu.models.hf_weights import llama_from_hf
+
+    torch.manual_seed(1)
+    hf = LlamaForCausalLM(HFConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=500000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False,
+        rope_scaling=dict(scaling))).eval()
+    cfg, params = llama_from_hf(hf, dtype=jnp.float32)
+    assert cfg.rope_scaling is not None
+    cfg = replace(cfg, dtype=jnp.float32, attn_impl="reference",
+                  remat=False)
+    tokens = np.random.default_rng(9).integers(0, 256, (2, 120))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.forward(cfg, params, jnp.asarray(tokens)))
+    assert np.abs(ours - ref).max() < 5e-6
