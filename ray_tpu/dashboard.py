@@ -1,9 +1,11 @@
 """Dashboard-lite: a single-page cluster overview over the state API.
 
 Reference: the Ray dashboard (python/ray/dashboard/) — here a stdlib HTTP
-server with two routes: ``/`` renders an auto-refreshing HTML overview and
-``/api/state`` returns the raw state_summary JSON (also the programmatic
-endpoint the CLI's `status` could target remotely).
+server with these routes: ``/`` renders an auto-refreshing HTML overview
+(including inline-SVG TIME-SERIES sparklines of cluster metrics — the
+role of the reference's embedded Grafana panels, dependency-free),
+``/api/state`` returns the raw state_summary JSON, and
+``/api/metrics/history`` the sampled series.
 """
 
 from __future__ import annotations
@@ -11,8 +13,88 @@ from __future__ import annotations
 import html
 import json
 import threading
+import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
+
+
+class _History:
+    """Ring-buffered samples of cluster gauges for the sparkline view
+    (reference role: dashboard/modules/metrics time-series panels)."""
+
+    MAXLEN = 300
+
+    def __init__(self, period_s: float = 2.0):
+        self.period_s = period_s
+        self._lock = threading.Lock()
+        self._t: deque = deque(maxlen=self.MAXLEN)
+        self._series: Dict[str, deque] = {}
+        self._stop = False
+        threading.Thread(target=self._loop, daemon=True,
+                         name="dash-sampler").start()
+
+    def _loop(self):
+        while not self._stop:
+            time.sleep(self.period_s)
+            try:
+                self._sample()
+            except Exception:  # noqa: BLE001 — sampling must not die
+                pass
+
+    def _sample(self):
+        from ray_tpu import state
+
+        s = state.state_summary()
+        tasks = s.get("tasks") or {}
+        objs = s.get("objects") or {}
+        now = time.time()
+        vals = {
+            "nodes_alive": sum(1 for n in s.get("nodes", [])
+                               if n.get("state") == "ALIVE"),
+            "actors": len(s.get("actors", [])),
+            "tasks_queued": float(tasks.get("queued", 0) or 0),
+            "tasks_running": float(tasks.get("running", 0) or 0),
+            "objects_tracked": float(objs.get("tracked", 0) or 0),
+            "store_bytes": float(
+                objs.get("store_bytes_in_use",
+                         objs.get("spilled_bytes", 0)) or 0),
+        }
+        with self._lock:
+            self._t.append(now)
+            for k, v in vals.items():
+                self._series.setdefault(
+                    k, deque(maxlen=self.MAXLEN)).append(float(v))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"t": list(self._t),
+                    "series": {k: list(v)
+                               for k, v in self._series.items()}}
+
+    def sparklines_html(self) -> str:
+        snap = self.snapshot()
+        out = []
+        for name, ys in sorted(snap["series"].items()):
+            if len(ys) < 2:
+                continue
+            lo, hi = min(ys), max(ys)
+            span = (hi - lo) or 1.0
+            w, h = 240, 36
+            n = len(ys)
+            pts = " ".join(
+                f"{i * w / (n - 1):.1f},"
+                f"{h - 3 - (y - lo) / span * (h - 6):.1f}"
+                for i, y in enumerate(ys))
+            out.append(
+                f"<div class=spark><span>{html.escape(name)}: "
+                f"{ys[-1]:g}</span><svg width={w} height={h}>"
+                f"<polyline points='{pts}' fill='none' "
+                f"stroke='#7fd4ff' stroke-width='1.5'/></svg></div>")
+        return "".join(out) or "<i>collecting…</i>"
+
+
+_history: Optional[_History] = None
 
 _PAGE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
@@ -24,8 +106,12 @@ _PAGE = """<!doctype html>
  table {{ border-collapse: collapse; }}
  td, th {{ border: 1px solid #444; padding: 3px 10px; text-align: left; }}
  .dead {{ color: #f77; }}
+ .spark {{ display: inline-block; margin: 0 14px 8px 0; }}
+ .spark span {{ display: block; color: #9f9; font-size: 12px; }}
+ .spark svg {{ background: #181818; border: 1px solid #333; }}
 </style></head><body>
 <h1>ray_tpu</h1>
+<h2>metrics</h2><div>{sparklines}</div>
 <h2>resources</h2><pre>{resources}</pre>
 <h2>tasks</h2><pre>{tasks}</pre>
 <h2>objects</h2><pre>{objects}</pre>
@@ -43,6 +129,12 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         from ray_tpu import state
 
+        hist = _history  # read once: stop_dashboard() may null the global
+        if self.path.startswith("/api/metrics/history"):
+            snap = hist.snapshot() if hist else {}
+            self._reply(200, json.dumps(snap).encode(),
+                        "application/json")
+            return
         try:
             s = state.state_summary()
         except Exception as e:  # noqa: BLE001
@@ -66,6 +158,8 @@ class _Handler(BaseHTTPRequestHandler):
             f"<td>{a.get('state', '')}</td></tr>"
             for a in s["actors"])
         page = _PAGE.format(
+            sparklines=(hist.sparklines_html() if hist
+                        else "<i>sampler off</i>"),
             resources=html.escape(
                 f"total: {s['cluster_resources']}\n"
                 f"avail: {s['available_resources']}"),
@@ -87,17 +181,21 @@ _server: Optional[ThreadingHTTPServer] = None
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 0):
-    global _server
+    global _server, _history
     if _server is None:
         _server = ThreadingHTTPServer((host, port), _Handler)
         threading.Thread(target=_server.serve_forever, daemon=True,
                          name="dashboard-http").start()
+        _history = _History()
     return _server.server_address
 
 
 def stop_dashboard():
-    global _server
+    global _server, _history
     if _server is not None:
         _server.shutdown()
         _server.server_close()  # release the listening socket now
         _server = None
+    if _history is not None:
+        _history._stop = True
+        _history = None

@@ -125,13 +125,18 @@ class FileDatasource(Datasource):
     """Shared logic for file-based sources: split files across read tasks."""
 
     suffixes: Optional[List[str]] = None
+    # decoded-size multiplier for read-parallelism inference (reference:
+    # ParquetDatasource's encoding-ratio estimate — on-disk parquet/
+    # compressed formats expand in memory)
+    size_multiplier: float = 1.0
 
     def __init__(self, paths):
         self._paths = _expand_paths(paths, self.suffixes)
 
     def estimate_inmemory_data_size(self):
         try:
-            return sum(os.path.getsize(p) for p in self._paths)
+            return int(sum(os.path.getsize(p) for p in self._paths)
+                       * self.size_multiplier)
         except OSError:
             return None
 
@@ -159,6 +164,8 @@ class FileDatasource(Datasource):
 
 
 class ParquetDatasource(FileDatasource):
+    size_multiplier = 5.0  # columnar compression expands in memory
+
     suffixes = [".parquet"]
 
     def __init__(self, paths, columns: Optional[List[str]] = None):

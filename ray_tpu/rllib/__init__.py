@@ -19,7 +19,7 @@ from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput  # noqa:
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.multi_agent import (MultiAgentPPO,  # noqa: F401
                                        MultiAgentPPOConfig)
-from ray_tpu.rllib.offline import (BCLearner, CQLLearner,  # noqa: F401
+from ray_tpu.rllib.offline import (BCLearner, CQLLearner, MARWILLearner,  # noqa: F401
                                    train_offline)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,  # noqa: F401

@@ -130,6 +130,72 @@ class CQLLearner:
         return to_numpy(self.params)
 
 
+class MARWILLearner:
+    """Monotonic Advantage Re-Weighted Imitation Learning (reference:
+    rllib/algorithms/marwil/marwil.py — Wang et al. 2018). Cloning
+    weighted by exponentiated advantage: the policy imitates the data's
+    GOOD actions more than its bad ones, interpolating between pure BC
+    (beta=0) and policy improvement. A value head regresses returns; the
+    advantage for the weight is ``R - V(s)`` with a running-norm
+    (reference: MARWIL's moving average of squared advantages)."""
+
+    def __init__(self, module: MLPModule, lr: float = 1e-3,
+                 beta: float = 1.0, vf_coef: float = 1.0, seed: int = 0):
+        import jax
+        import optax
+
+        self.module = module
+        self.params = module.init_params(seed)
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+        self._beta = beta
+        self._vf_coef = vf_coef
+        self._ma_adv_sq = 1.0  # running norm (host-side, like the ref)
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1))
+
+    def _loss(self, params, obs, actions, returns, adv_norm):
+        import jax
+        import jax.numpy as jnp
+
+        logits, values = self.module.apply(params, obs)
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+        adv = jax.lax.stop_gradient(returns - values)
+        weight = jnp.exp(self._beta * jnp.clip(adv / adv_norm, -5.0, 5.0))
+        pg_loss = -(jax.lax.stop_gradient(weight) * logp_a).mean()
+        vf_loss = jnp.square(values - returns).mean()
+        return (pg_loss + self._vf_coef * vf_loss,
+                (jnp.square(adv).mean(),))
+
+    def _update_impl(self, params, opt_state, obs, actions, returns,
+                     adv_norm):
+        import jax
+
+        (loss, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            params, obs, actions, returns, adv_norm)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                        updates)
+        return params, opt_state, loss, aux[0]
+
+    def update(self, batch: Dict[str, np.ndarray]) -> float:
+        import jax.numpy as jnp
+
+        adv_norm = max(self._ma_adv_sq, 1e-8) ** 0.5
+        self.params, self.opt_state, loss, adv_sq = self._update(
+            self.params, self.opt_state,
+            jnp.asarray(batch["obs"], jnp.float32),
+            jnp.asarray(batch["actions"], jnp.int32),
+            jnp.asarray(batch["returns"], jnp.float32),
+            jnp.asarray(adv_norm, jnp.float32))
+        self._ma_adv_sq = (0.99 * self._ma_adv_sq
+                           + 0.01 * float(adv_sq))
+        return float(loss)
+
+    def get_weights(self):
+        return to_numpy(self.params)
+
+
 def train_offline(learner, dataset, *, num_epochs: int = 1,
                   batch_size: int = 256, shuffle: bool = True) -> float:
     """Drive a BC/CQL learner over a Dataset; returns the last loss.

@@ -123,10 +123,18 @@ def _set_status(wf_dir: str, status: str):
         f.write(status)
 
 
+def _new_workflow_id() -> str:
+    # timestamp for human sort order + random suffix so concurrent
+    # launches (run_async) can never collide on a checkpoint directory
+    import uuid
+
+    return f"wf_{int(time.time() * 1e3):x}_{uuid.uuid4().hex[:8]}"
+
+
 def run(dag: StepNode, *, workflow_id: Optional[str] = None,
         storage: Optional[str] = None) -> Any:
     """Execute the DAG durably; completed steps are never re-executed."""
-    workflow_id = workflow_id or f"wf_{int(time.time()*1e3):x}"
+    workflow_id = workflow_id or _new_workflow_id()
     wf_dir = _wf_dir(workflow_id, storage)
     os.makedirs(wf_dir, exist_ok=True)
     _set_status(wf_dir, "RUNNING")
@@ -308,3 +316,50 @@ from ray_tpu.workflow.events import (  # noqa: E402,F401
     LocalEventProvider,
     wait_for_event,
 )
+
+
+class WorkflowRun:
+    """Handle for an in-flight async workflow (reference:
+    workflow.run_async returns an ObjectRef; here a thread-backed future
+    — the workflow driver orchestrates its steps through the caller's
+    core, so a thread in the caller is the honest executor)."""
+
+    def __init__(self, workflow_id: str, thread, box: list):
+        self.workflow_id = workflow_id
+        self._thread = thread
+        self._box = box
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                f"workflow {self.workflow_id!r} still running")
+        kind, value = self._box[0]
+        if kind == "err":
+            raise value
+        return value
+
+
+def run_async(dag: StepNode, *, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None) -> WorkflowRun:
+    """Start a workflow without blocking; returns a ``WorkflowRun``
+    whose ``result()`` is ``run``'s return value. Steps still run as
+    parallel ray_tpu tasks; only the orchestration loop moves off the
+    caller's thread."""
+    workflow_id = workflow_id or _new_workflow_id()
+    box: list = [("err", RuntimeError("workflow never ran"))]
+
+    def drive():
+        try:
+            box[0] = ("ok", run(dag, workflow_id=workflow_id,
+                                storage=storage))
+        except BaseException as e:  # noqa: BLE001
+            box[0] = ("err", e)
+
+    t = threading.Thread(target=drive, daemon=True,
+                         name=f"wf-{workflow_id}")
+    t.start()
+    return WorkflowRun(workflow_id, t, box)

@@ -534,6 +534,22 @@ def test_dashboard_lite(rt):
         api = json.loads(urllib.request.urlopen(
             f"http://{host}:{port}/api/state", timeout=15).read())
         assert "nodes" in api and "cluster_resources" in api
+
+        # time-series view: the sampler fills the history ring; the page
+        # renders SVG sparklines and /api/metrics/history serves JSON
+        # (reference role: dashboard/modules/metrics Grafana panels)
+        from ray_tpu import dashboard as _d
+        for _ in range(3):
+            _d._history._sample()
+        hist = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/api/metrics/history",
+            timeout=15).read())
+        assert len(hist["t"]) >= 3
+        assert "tasks_running" in hist["series"]
+        assert "nodes_alive" in hist["series"]
+        page2 = urllib.request.urlopen(
+            f"http://{host}:{port}/", timeout=15).read().decode()
+        assert "<svg" in page2 and "polyline" in page2
     finally:
         stop_dashboard()
 
@@ -784,3 +800,25 @@ def test_workflow_waits_for_http_event(tmp_path):
         if core is not None:
             core.shutdown()
         runtime_context.set_core(prev)
+
+
+def test_workflow_run_async(tmp_path, rt):
+    from ray_tpu import workflow
+
+    @workflow.step
+    def slow_double(x):
+        time.sleep(0.3)
+        return x * 2
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    dag = add.bind(slow_double.bind(3), slow_double.bind(4))
+    h = workflow.run_async(dag, workflow_id="wf_async",
+                           storage=str(tmp_path))
+    assert not h.done()
+    assert h.result(timeout=60) == 14
+    assert h.done()
+    assert workflow.get_status("wf_async",
+                               storage=str(tmp_path)) == "SUCCESSFUL"
