@@ -16,6 +16,7 @@ from ray_tpu.rllib.algorithm import AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput  # noqa: F401
+from ray_tpu.rllib.catalog import Box, Catalog, Discrete  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.multi_agent import (MultiAgentPPO,  # noqa: F401
                                        MultiAgentPPOConfig)

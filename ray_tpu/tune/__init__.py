@@ -13,7 +13,9 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     MedianStoppingRule,
     PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
     TrialScheduler,
+    evenly_distribute_cpus,
 )
 from ray_tpu.tune.search_space import (  # noqa: F401
     choice,
@@ -50,5 +52,6 @@ __all__ = [
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "HyperBandScheduler", "HyperBandForBOHB", "PB2",
     "MedianStoppingRule", "PopulationBasedTraining",
+    "ResourceChangingScheduler", "evenly_distribute_cpus",
     "Searcher", "BasicVariantGenerator", "TPESearcher", "BOHBSearcher",
 ]

@@ -445,3 +445,112 @@ class PB2(PopulationBasedTraining):
             lo, hi = self.bounds[k_]
             out[k_] = lo + (hi - lo) * float(u)
         return out
+
+
+REALLOC = "REALLOC"
+
+
+def evenly_distribute_cpus(total_cpus: float, num_running: int,
+                           trial, base: Dict[str, Any]
+                           ) -> Dict[str, Any]:
+    """Default allocation policy (reference: the DistributeResources
+    function in tune/schedulers/resource_changing_scheduler.py): spread
+    the cluster's CPUs evenly over the trials still running, never below
+    the trial's base request."""
+    if num_running <= 0:
+        return dict(base)
+    share = max(float(base.get("num_cpus", 1)), total_cpus // num_running)
+    out = dict(base)
+    out["num_cpus"] = share
+    return out
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Reallocate trial resources mid-experiment (reference:
+    tune/schedulers/resource_changing_scheduler.py:ResourceChangingScheduler).
+
+    Wraps a base scheduler (default FIFO): every decision is the base
+    scheduler's; after a CONTINUE, ``resources_allocation_function(
+    total_cpus, running_trials, trial, base_resources)`` may return a new
+    resource dict for the trial. A change checkpoints the trial, stops
+    its actor, and requeues it so it restarts under the new allocation —
+    the same restart path PBT exploitation uses.
+    """
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None):
+        self.base = base_scheduler or FIFOScheduler()
+        self._alloc = resources_allocation_function
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._running_ids: set = set()
+        self._running_count_fn = None
+        self._realloc_count = 0
+
+    def set_experiment(self, metric: str, mode: str):
+        super().set_experiment(metric, mode)
+        self.base.set_experiment(metric, mode)
+
+    def __getattr__(self, name):
+        # Delegate base-scheduler-specific surface the controller probes
+        # for (on_trial_add, HyperBand's pause bookkeeping, PBT's
+        # explore) so wrapping changes no behavior of the wrapped one.
+        if name.startswith("_") or name == "base":
+            raise AttributeError(name)
+        return getattr(self.base, name)
+
+    # PBT's exploit protocol: the controller both reads AND assigns
+    # pending_exploit, so a plain __getattr__ forward is not enough —
+    # the property keeps reads/writes on the wrapped scheduler.
+    @property
+    def pending_exploit(self):
+        return getattr(self.base, "pending_exploit", None)
+
+    @pending_exploit.setter
+    def pending_exploit(self, value):
+        self.base.pending_exploit = value
+
+    def on_trial_complete(self, trial):
+        self._running_ids.discard(trial.trial_id)
+        self.base.on_trial_complete(trial)
+
+    def pop_realloc(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        return self._pending.pop(trial_id, None)
+
+    def set_cluster_view(self, total_cpus: float, base_resources: dict,
+                         running_count_fn=None):
+        """Called by the controller before the run loop starts.
+        ``running_count_fn`` reports the live number of RUNNING trials
+        (the controller knows; reported-once bookkeeping here would
+        hand the first reporter the whole cluster)."""
+        self._total_cpus = float(total_cpus)
+        self._base_resources = dict(base_resources)
+        self._running_count_fn = running_count_fn
+
+    def _num_running(self) -> int:
+        if self._running_count_fn is not None:
+            try:
+                return max(1, int(self._running_count_fn()))
+            except Exception:  # noqa: BLE001
+                pass
+        return max(1, len(self._running_ids))
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        self._running_ids.add(trial.trial_id)
+        decision = self.base.on_result(trial, result)
+        if decision != CONTINUE or self._alloc is None:
+            if decision in (STOP, PAUSE):
+                self._running_ids.discard(trial.trial_id)
+            return decision
+        base = dict(getattr(self, "_base_resources", {}) or
+                    {"num_cpus": 1})
+        want = self._alloc(getattr(self, "_total_cpus", 1.0),
+                           self._num_running(), trial, base)
+        # normalize both sides over the base keys: partial dicts from
+        # the allocation function must not oscillate vs the stored state
+        want = {**base, **(want or {})}
+        have = {**base, **(trial.resources or {})}
+        if want != have:
+            self._pending[trial.trial_id] = want
+            self._realloc_count += 1
+            return REALLOC
+        return decision

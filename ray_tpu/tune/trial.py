@@ -40,6 +40,7 @@ class Trial:
     checkpoint_path: Optional[str] = None
     num_failures: int = 0
     iterations: int = 0
+    resources: Optional[Dict[str, Any]] = None  # per-trial override
     actor: Any = None           # ActorHandle while running
     pending_result: Any = None  # in-flight ObjectRef from next_result
 
@@ -57,6 +58,7 @@ class Trial:
             "checkpoint_path": self.checkpoint_path,
             "iterations": self.iterations,
             "num_failures": self.num_failures,
+            "resources": self.resources,
         }
 
     @staticmethod
@@ -68,6 +70,7 @@ class Trial:
         t.checkpoint_path = snap.get("checkpoint_path")
         t.iterations = snap.get("iterations", 0)
         t.num_failures = snap.get("num_failures", 0)
+        t.resources = snap.get("resources")
         return t
 
 

@@ -15,7 +15,8 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu.tune.schedulers import (CONTINUE, PAUSE, STOP, FIFOScheduler,
+from ray_tpu.tune.schedulers import (CONTINUE, PAUSE, REALLOC, STOP,
+                                     FIFOScheduler,
                                      PopulationBasedTraining, TrialScheduler)
 from ray_tpu.tune.trial import Trial, TrialActor, TrialStatus
 
@@ -119,8 +120,19 @@ class TuneController:
                     t.error = r.error
                     t.iterations = r.iterations
                 t.checkpoint_path = r.checkpoint_path
+                t.resources = r.resources
 
     def run(self) -> List[Trial]:
+        view = getattr(self._scheduler, "set_cluster_view", None)
+        if view is not None:
+            from ray_tpu import state
+            try:
+                total = state.cluster_resources().get("CPU", 1.0)
+            except Exception:  # noqa: BLE001 — view is best-effort
+                total = 1.0
+            view(total, self._trial_resources or {"num_cpus": 1},
+                 lambda: self._num_live)
+        self._num_live = 0
         pending = [t for t in self.trials if not t.is_finished]
         for t in pending:
             self._notify_added(t)
@@ -156,6 +168,7 @@ class TuneController:
                         parked.clear()
                         continue
                     break
+                self._num_live = len(running)
                 ready, _ = ray_tpu.wait(list(running.keys()),
                                         num_returns=1, timeout=5.0)
                 for ref in ready:
@@ -205,6 +218,7 @@ class TuneController:
     def _start_trial(self, trial: Trial, action: str = "continue"):
         trial_dir = os.path.join(self._experiment_dir, trial.trial_id)
         opts = dict(self._trial_resources)
+        opts.update(trial.resources or {})
         trial.actor = TrialActor.options(**opts).remote(
             self._trainable, trial.config, trial_dir,
             checkpoint_path=trial.checkpoint_path)
@@ -243,6 +257,8 @@ class TuneController:
             decision = STOP
         if decision == PopulationBasedTraining.EXPLOIT:
             return self._exploit(trial)
+        if decision == REALLOC:
+            return self._realloc(trial)
         if decision == PAUSE:
             # park at the latest checkpoint until the scheduler releases
             # the bracket (reference: HyperBand's PauseTrial)
@@ -261,6 +277,19 @@ class TuneController:
         trial.pending_result = trial.actor.ack_and_next.remote(action)
         return None
 
+    def _stop_and_requeue(self, trial: Trial) -> str:
+        """Stop the trial's actor at its latest checkpoint and mark the
+        trial PENDING for a restart (shared by PBT exploitation and
+        resource reallocation)."""
+        trial.pending_result = trial.actor.ack_and_next.remote("stop")
+        try:
+            ray_tpu.get(trial.pending_result, timeout=30)
+        except Exception:  # noqa: BLE001
+            pass
+        self._kill_actor(trial)
+        trial.status = TrialStatus.PENDING
+        return "requeue"
+
     def _exploit(self, trial: Trial) -> str:
         """PBT exploit: stop this trial, clone donor checkpoint+config
         (perturbed), and requeue it to restart from there."""
@@ -269,18 +298,22 @@ class TuneController:
         sched.pending_exploit = None
         donor = next((t for t in self.trials
                       if t.trial_id == info.get("donor_id")), None)
-        # Stop the current actor (fn raises StopTrial at its report).
-        trial.pending_result = trial.actor.ack_and_next.remote("stop")
-        try:
-            ray_tpu.get(trial.pending_result, timeout=30)
-        except Exception:
-            pass
-        self._kill_actor(trial)
+        out = self._stop_and_requeue(trial)
         if donor is not None:
             trial.checkpoint_path = donor.checkpoint_path
             trial.config = sched.explore(dict(donor.config))
-        trial.status = TrialStatus.PENDING
-        return "requeue"
+        return out
+
+    def _realloc(self, trial: Trial) -> str:
+        """ResourceChangingScheduler: restart the trial from its latest
+        checkpoint under a new resource allocation (reference:
+        resource_changing_scheduler.py — same stop/requeue path as PBT
+        exploitation, config untouched)."""
+        new_res = self._scheduler.pop_realloc(trial.trial_id)
+        out = self._stop_and_requeue(trial)
+        if new_res:
+            trial.resources = new_res
+        return out
 
     def _on_error(self, trial: Trial, err: str,
                   tb: Optional[str] = None) -> Optional[str]:

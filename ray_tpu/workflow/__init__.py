@@ -31,6 +31,14 @@ import ray_tpu
 
 _DEFAULT_STORAGE = "/tmp/ray_tpu_workflows"
 
+from ray_tpu.workflow import access  # noqa: E402  (needs ray_tpu bound)
+from ray_tpu.workflow.access import (  # noqa: E402,F401
+    WorkflowCancellationError,
+    WorkflowManagementActor,
+    cancel,
+    get_output,
+)
+
 
 class StepNode:
     """A bound step invocation (DAG node). Step ids are assigned at run
@@ -137,7 +145,9 @@ def run(dag: StepNode, *, workflow_id: Optional[str] = None,
     workflow_id = workflow_id or _new_workflow_id()
     wf_dir = _wf_dir(workflow_id, storage)
     os.makedirs(wf_dir, exist_ok=True)
+    _clear_cancel(wf_dir)
     _set_status(wf_dir, "RUNNING")
+    access.register_run(workflow_id, wf_dir)
 
     # persist the dag so resume() can re-execute without the caller
     # rebuilding it
@@ -152,9 +162,19 @@ def run(dag: StepNode, *, workflow_id: Optional[str] = None,
         out = _execute(dag, wf_dir)
         _set_status(wf_dir, "SUCCESSFUL")
         return out
+    except access.WorkflowCancellationError:
+        _set_status(wf_dir, "CANCELED")
+        raise
     except BaseException:
         _set_status(wf_dir, "FAILED")
         raise
+
+
+def _clear_cancel(wf_dir: str):
+    try:
+        os.remove(os.path.join(wf_dir, "CANCEL"))
+    except OSError:
+        pass
 
 
 def _topo(node: StepNode) -> List[StepNode]:
@@ -222,8 +242,19 @@ def _execute(node: StepNode, wf_dir: str) -> Any:
     # step whose side effect may TRIGGER the event) run in parallel
     # with the wait. Then resolve events in topo order, releasing their
     # dependents as payloads arrive.
+    def check_cancel():
+        if access.cancel_requested(wf_dir):
+            for r in refs.values():
+                try:
+                    ray_tpu.cancel(r)
+                except Exception:  # noqa: BLE001 — best-effort abort
+                    pass
+            wf_id = os.path.basename(wf_dir)
+            raise access.WorkflowCancellationError(wf_id)
+
     unplaced = [n for n in order if n.step_id not in values]
     while unplaced:
+        check_cancel()
         rest = []
         for n in unplaced:
             if not isinstance(n, EventNode) and submittable(n):
@@ -242,14 +273,35 @@ def _execute(node: StepNode, wf_dir: str) -> Any:
                 f"event {ev.key!r} has not arrived and its provider "
                 f"did not survive persistence; pass "
                 f"resume(..., event_providers={{{ev.key!r}: provider}})")
-        # the payload checkpoints so resume never re-waits it
-        checkpoint(ev.step_id, ev.provider.poll(ev.key, ev.timeout))
+        # the payload checkpoints so resume never re-waits it; the wait
+        # polls in slices so cancel() can interrupt a blocked event
+        deadline = (None if ev.timeout is None
+                    else time.monotonic() + ev.timeout)
+        while True:
+            check_cancel()
+            if deadline is None:
+                remain = 0.25
+            else:
+                remain = min(0.25, deadline - time.monotonic())
+            try:
+                payload = ev.provider.poll(ev.key, max(remain, 0.0))
+                break
+            except TimeoutError:
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    raise
+        checkpoint(ev.step_id, payload)
         rest.remove(ev)
         unplaced = rest
 
     for n in order:
         if n.step_id not in refs:
             continue
+        while True:
+            check_cancel()
+            done, _ = ray_tpu.wait([refs[n.step_id]], timeout=0.25)
+            if done:
+                break
         checkpoint(n.step_id, ray_tpu.get(refs[n.step_id]))
 
     return values[node.step_id]
@@ -272,11 +324,16 @@ def resume(workflow_id: str, *, storage: Optional[str] = None,
         for n in _topo(dag):
             if isinstance(n, EventNode) and n.key in event_providers:
                 n.provider = event_providers[n.key]
+    _clear_cancel(wf_dir)
     _set_status(wf_dir, "RUNNING")
+    access.register_run(workflow_id, wf_dir)
     try:
         out = _execute(dag, wf_dir)
         _set_status(wf_dir, "SUCCESSFUL")
         return out
+    except access.WorkflowCancellationError:
+        _set_status(wf_dir, "CANCELED")
+        raise
     except BaseException:
         _set_status(wf_dir, "FAILED")
         raise
@@ -308,6 +365,12 @@ def delete(workflow_id: str, *, storage: Optional[str] = None):
     import shutil
 
     shutil.rmtree(_wf_dir(workflow_id, storage), ignore_errors=True)
+    mgr = access.get_management_actor()
+    if mgr is not None:
+        try:
+            ray_tpu.get(mgr.unregister.remote(workflow_id))
+        except Exception:  # noqa: BLE001 — registry is best-effort
+            pass
 
 
 from ray_tpu.workflow.events import (  # noqa: E402,F401

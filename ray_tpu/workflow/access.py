@@ -1,0 +1,168 @@
+"""Workflow management actor: the cluster-wide control surface.
+
+Reference: python/ray/workflow/workflow_access.py — a named detached
+``WorkflowManagementActor`` that every driver registers runs with, so
+any process in the cluster can list, query, and cancel workflows
+without knowing which driver launched them. Storage stays the source
+of truth for step state (as in the reference); the actor is the
+directory of live runs and the cancellation broadcast point.
+
+Cancellation is cooperative and durable: ``cancel()`` drops a CANCEL
+marker in the workflow's storage directory (visible to the driving
+process through shared storage, exactly the reference's assumption)
+and the workflow driver checks it between step waves and while waiting
+on step results, aborting outstanding tasks via ``ray_tpu.cancel``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import RayTpuError
+
+MANAGEMENT_ACTOR_NAME = "__workflow_manager"
+
+
+class WorkflowCancellationError(RayTpuError):
+    """Raised from run()/result() when a workflow was canceled."""
+
+    def __init__(self, workflow_id: str):
+        super().__init__(f"workflow {workflow_id!r} was canceled")
+        self.workflow_id = workflow_id
+
+
+class WorkflowManagementActor:
+    """Registry of known workflow runs (reference:
+    workflow_access.WorkflowManagementActor). Methods are plain data
+    ops — the actor's value is its NAME: one instance per cluster."""
+
+    def __init__(self):
+        self._runs: Dict[str, Dict[str, str]] = {}
+
+    def register(self, workflow_id: str, storage: str):
+        self._runs[workflow_id] = {"workflow_id": workflow_id,
+                                   "storage": storage}
+        return True
+
+    def storage_of(self, workflow_id: str) -> Optional[str]:
+        run = self._runs.get(workflow_id)
+        return run["storage"] if run else None
+
+    def list_registered(self) -> List[Dict[str, str]]:
+        return list(self._runs.values())
+
+    def unregister(self, workflow_id: str):
+        self._runs.pop(workflow_id, None)
+        return True
+
+
+def _cancel_path(wf_dir: str) -> str:
+    return os.path.join(wf_dir, "CANCEL")
+
+
+def cancel_requested(wf_dir: str) -> bool:
+    return os.path.exists(_cancel_path(wf_dir))
+
+
+def get_management_actor():
+    """Get-or-create the named detached management actor. Returns None
+    when no runtime is initialized (pure-local workflow use keeps
+    working without a cluster)."""
+    from ray_tpu.core import runtime_context
+
+    try:
+        runtime_context.get_core()
+    except Exception:  # noqa: BLE001 — not initialized
+        return None
+    try:
+        return ray_tpu.get_actor(MANAGEMENT_ACTOR_NAME)
+    except Exception:  # noqa: BLE001 — not created yet (or raced)
+        pass
+    try:
+        cls = ray_tpu.remote(WorkflowManagementActor)
+        return cls.options(name=MANAGEMENT_ACTOR_NAME,
+                           lifetime="detached").remote()
+    except Exception:  # noqa: BLE001 — lost a creation race
+        try:
+            return ray_tpu.get_actor(MANAGEMENT_ACTOR_NAME)
+        except Exception:  # noqa: BLE001
+            return None
+
+
+def register_run(workflow_id: str, wf_dir: str):
+    mgr = get_management_actor()
+    if mgr is not None:
+        try:
+            ray_tpu.get(mgr.register.remote(workflow_id,
+                                            os.path.dirname(wf_dir)))
+        except Exception:  # noqa: BLE001 — registry is best-effort
+            pass
+
+
+def cancel(workflow_id: str, *, storage: Optional[str] = None):
+    """Request cancellation of a (possibly remote) workflow run.
+
+    Reference: workflow.cancel (workflow_access.py). With no explicit
+    ``storage``, the management actor resolves where the run lives.
+    """
+    from ray_tpu import workflow as wf
+
+    if storage is None:
+        mgr = get_management_actor()
+        if mgr is not None:
+            try:
+                storage = ray_tpu.get(
+                    mgr.storage_of.remote(workflow_id))
+            except Exception:  # noqa: BLE001
+                storage = None
+    wf_dir = wf._wf_dir(workflow_id, storage)
+    if not os.path.isdir(wf_dir):
+        raise KeyError(f"no workflow {workflow_id!r}")
+    # canceling a finished workflow is a no-op (reference behavior):
+    # never clobber a terminal SUCCESSFUL/FAILED status
+    try:
+        if wf.get_status(workflow_id, storage=storage) in (
+                "SUCCESSFUL", "FAILED"):
+            return
+    except KeyError:
+        pass
+    with open(_cancel_path(wf_dir), "w") as f:
+        f.write("1")
+    wf._set_status(wf_dir, "CANCELED")
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None,
+               timeout: Optional[float] = None):
+    """Return the final result of a workflow, blocking while it is
+    still RUNNING (reference: workflow.get_output). The result loads
+    from the root step's checkpoint, so it works from any process with
+    access to the storage — not just the launching driver."""
+    import pickle
+    import time as _time
+
+    from ray_tpu import workflow as wf
+
+    wf_dir = wf._wf_dir(workflow_id, storage)
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while True:
+        status = wf.get_status(workflow_id, storage=storage)
+        if status == "SUCCESSFUL":
+            break
+        if status == "CANCELED":
+            raise WorkflowCancellationError(workflow_id)
+        if status == "FAILED":
+            raise RuntimeError(f"workflow {workflow_id!r} failed")
+        if deadline is not None and _time.monotonic() > deadline:
+            raise TimeoutError(
+                f"workflow {workflow_id!r} still {status}")
+        _time.sleep(0.1)
+
+    import cloudpickle
+
+    with open(os.path.join(wf_dir, "dag.pkl"), "rb") as f:
+        dag = cloudpickle.load(f)
+    root_id = wf._topo(dag)[-1].step_id
+    with open(wf._result_path(wf_dir, root_id), "rb") as f:
+        return pickle.load(f)

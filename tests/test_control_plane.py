@@ -822,3 +822,54 @@ def test_workflow_run_async(tmp_path, rt):
     assert h.done()
     assert workflow.get_status("wf_async",
                                storage=str(tmp_path)) == "SUCCESSFUL"
+
+
+def test_workflow_cancel_and_management_actor(tmp_path, rt):
+    """The management surface (reference: workflow_access.py): runs
+    register with a named detached actor; cancel() aborts an in-flight
+    workflow from OUTSIDE the driving thread; get_output() reads a
+    finished workflow's result from storage alone."""
+    from ray_tpu import workflow
+
+    @workflow.step
+    def crawl(x):
+        time.sleep(30)  # long enough that cancel lands mid-step
+        return x
+
+    h = workflow.run_async(crawl.bind(7), workflow_id="wf_cancel",
+                           storage=str(tmp_path))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if workflow.get_status("wf_cancel",
+                                   storage=str(tmp_path)) == "RUNNING":
+                break
+        except KeyError:
+            pass
+        time.sleep(0.05)
+    workflow.cancel("wf_cancel", storage=str(tmp_path))
+    with pytest.raises(workflow.WorkflowCancellationError):
+        h.result(timeout=60)
+    assert workflow.get_status("wf_cancel",
+                               storage=str(tmp_path)) == "CANCELED"
+
+    # registry: the run registered with the named management actor, and
+    # cancel with NO storage argument resolves it through the registry
+    mgr = rt.get_actor(workflow.access.MANAGEMENT_ACTOR_NAME)
+    ids = [r["workflow_id"] for r in
+           rt.get(mgr.list_registered.remote())]
+    assert "wf_cancel" in ids
+
+    # get_output: result read back from storage, not the driver thread
+    @workflow.step
+    def quick(x):
+        return x * 3
+
+    workflow.run(quick.bind(5), workflow_id="wf_out",
+                 storage=str(tmp_path))
+    assert workflow.get_output("wf_out", storage=str(tmp_path)) == 15
+
+    workflow.delete("wf_cancel", storage=str(tmp_path))
+    ids = [r["workflow_id"] for r in
+           rt.get(mgr.list_registered.remote())]
+    assert "wf_cancel" not in ids

@@ -726,6 +726,64 @@ print("CLIENT_DONE", flush=True)
         srv.close()
 
 
+def test_model_catalog_space_dispatch():
+    """The catalog (reference: rllib/models/catalog.py ModelCatalog)
+    maps space pairs onto default modules, derives spaces from vec
+    envs, and routes custom_model to a registered factory."""
+    from ray_tpu.rllib import Box, Catalog, Discrete
+    from ray_tpu.rllib.envs import make_env
+    from ray_tpu.rllib.rl_module import (CNNModule, MLPModule,
+                                         QMLPModule,
+                                         SquashedGaussianModule,
+                                         TwinQModule)
+
+    m = Catalog.get_module(Box((4,)), Discrete(2))
+    assert isinstance(m, MLPModule) and m.obs_dim == 4
+
+    m = Catalog.get_module(Box((8, 8, 1)), Discrete(3))
+    assert isinstance(m, CNNModule) and m.obs_shape == (8, 8, 1)
+
+    m = Catalog.get_module(Box((3,)), Box((1,), low=-2.0, high=2.0))
+    assert isinstance(m, SquashedGaussianModule)
+    assert (m.action_low, m.action_high) == (-2.0, 2.0)
+
+    assert isinstance(Catalog.get_q_module(Box((4,)), Discrete(2)),
+                      QMLPModule)
+    assert isinstance(Catalog.get_q_module(Box((3,)), Box((1,))),
+                      TwinQModule)
+
+    # spaces derive from the vec-env attribute convention
+    obs, act = Catalog.spaces_of(make_env("CartPole-v1", 1))
+    assert obs.shape == (4,) and isinstance(act, Discrete) and act.n == 2
+    obs, act = Catalog.spaces_of(make_env("Pendulum-v1", 1))
+    assert obs.shape == (3,) and isinstance(act, Box)
+    obs, act = Catalog.spaces_of(make_env("CatchPixels-v0", 1))
+    assert len(obs.shape) == 3 and obs.shape[-1] == 1
+
+    # custom model registration wins over the defaults
+    class Tiny(MLPModule):
+        pass
+
+    Catalog.register_custom_model(
+        "tiny", lambda o, a, mc: Tiny(o.shape[0], a.n, hidden=(8,)))
+    m = Catalog.get_module(Box((4,)), Discrete(2),
+                           {"custom_model": "tiny"})
+    assert isinstance(m, Tiny) and m.hidden == (8,)
+
+    # a catalog-built module slots straight into a jitted forward
+    m = Catalog.get_module(Box((4,)), Discrete(2))
+    logits, v = m.apply_np(
+        {k: _np_tree(v) for k, v in m.init_params(0).items()},
+        np.zeros((5, 4), np.float32))
+    assert logits.shape == (5, 2) and v.shape == (5,)
+
+
+def _np_tree(x):
+    import jax
+
+    return jax.tree_util.tree_map(np.asarray, x)
+
+
 def test_marwil_outweighs_bad_demonstrations(rl_ray):
     """MARWIL (reference: rllib/algorithms/marwil) weights imitation by
     exp(beta * advantage): trained on a 50/50 mix of expert and

@@ -519,3 +519,73 @@ def test_pb2_learns_better_configs(run_cfg):
     # the best INITIAL config (0.3: gain 0.7/step) alone gives 11.2
     # over 16 steps; exploit+GP-explore must end above it
     assert best > 11.3, best
+
+
+def test_resource_changing_scheduler_reallocates(run_cfg):
+    """ResourceChangingScheduler (reference:
+    tune/schedulers/resource_changing_scheduler.py): after the allocation
+    function raises a trial's request, the trial checkpoints, restarts
+    under the new resources, and resumes from where it left off."""
+    def objective(config):
+        import json as _json
+        ckpt = tune.get_checkpoint()
+        start, restarts = 0, 0
+        if ckpt:
+            st = _json.load(open(os.path.join(ckpt.path, "s.json")))
+            start, restarts = st["step"] + 1, st["restarts"] + 1
+        for step in range(start, 6):
+            d = os.path.join(tune.get_trial_dir(), f"c{step}")
+            os.makedirs(d, exist_ok=True)
+            _json.dump({"step": step, "restarts": restarts},
+                       open(os.path.join(d, "s.json"), "w"))
+            tune.report({"score": float(step), "restarts": restarts,
+                         "training_iteration": step + 1}, checkpoint=d)
+
+    def grow_after_two(total_cpus, num_running, trial, base):
+        if trial.last_result.get("training_iteration", 0) >= 2:
+            return {"num_cpus": 2}
+        return dict(base)
+
+    sched = tune.ResourceChangingScheduler(
+        resources_allocation_function=grow_after_two)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched),
+        run_config=run_cfg(name="rcs"))
+    grid = tuner.fit()
+    assert not grid.errors
+    t = grid._trials[0]
+    # completed all steps, under the grown allocation, via exactly one
+    # checkpointed restart (steps are not re-run from scratch)
+    assert t.last_result["score"] == 5.0
+    assert t.resources == {"num_cpus": 2}
+    assert t.last_result["restarts"] == 1
+    assert sched._realloc_count == 1
+
+
+def test_evenly_distribute_cpus_policy():
+    from ray_tpu.tune.schedulers import evenly_distribute_cpus
+
+    base = {"num_cpus": 1}
+    assert evenly_distribute_cpus(8.0, 2, None, base)["num_cpus"] == 4
+    # never below the base request
+    assert evenly_distribute_cpus(2.0, 4, None, base)["num_cpus"] == 1
+
+
+def test_resource_changing_wraps_pbt_protocol():
+    """Wrapping PBT must forward its exploit protocol: the controller
+    reads AND assigns pending_exploit on the scheduler it holds, and
+    calls explore() — all three must reach the wrapped scheduler."""
+    pbt = tune.PopulationBasedTraining(
+        perturbation_interval=2,
+        hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=0)
+    rcs = tune.ResourceChangingScheduler(base_scheduler=pbt)
+    rcs.set_experiment("score", "max")
+    pbt.pending_exploit = {"donor_id": "t1"}
+    assert rcs.pending_exploit == {"donor_id": "t1"}
+    rcs.pending_exploit = None
+    assert pbt.pending_exploit is None
+    out = rcs.explore({"lr": 0.5})
+    assert 0.1 <= out["lr"] <= 1.0
