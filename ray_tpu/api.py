@@ -40,7 +40,14 @@ def init(num_workers: Optional[int] = None,
     if address is None:
         # submitted jobs inherit the cluster address from the job agent
         address = os.environ.get("RTPU_ADDRESS")
-    if address:
+    if address and address.startswith("ray://"):
+        # thin-client mode through the multi-tenant proxy (reference:
+        # ray.init("ray://...") -> util/client; see ray_tpu/client.py)
+        from ray_tpu.client import ProxyCore
+
+        host, _, port = address[len("ray://"):].rpartition(":")
+        _runtime = ProxyCore((host, int(port)))
+    elif address:
         from ray_tpu.core.cluster.cluster_core import ClusterCore
 
         host, _, port = address.rpartition(":")

@@ -1,0 +1,308 @@
+"""Autoscaler v2: instance-manager state machine + reconciler.
+
+Reference: python/ray/autoscaler/v2/instance_manager/ — the v2 design
+splits policy from mechanism: an ``InstanceManager`` owns per-instance
+lifecycle records and validates every status transition against an
+explicit FSM; a ``Reconciler`` periodically diffs three views of the
+world (desired capacity, the cloud provider's instance list, live nodes
+in the GCS) and issues the transitions; ``InstanceStorage`` versions
+every update so concurrent reconcile passes can't clobber each other
+(reference: instance_storage.py batch_upsert's expected-version CAS).
+
+The v1 monitor (`ray_tpu/autoscaler.py`) stays the simple path; this
+module is the audited-lifecycle path: every instance records WHERE in
+its life it is (queued, requested from the cloud, allocated, running
+in the cluster, stopping, terminated) and every transition is
+validated + timestamped, which is what makes scale-up failures
+(quota, preemption, image errors) debuggable in production.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class InstanceStatus(str, enum.Enum):
+    """Reference: instance_manager.proto Instance.InstanceStatus."""
+
+    QUEUED = "QUEUED"                    # decided, not yet requested
+    REQUESTED = "REQUESTED"              # launch issued to the provider
+    ALLOCATED = "ALLOCATED"              # provider reports it exists
+    RAY_INSTALLING = "RAY_INSTALLING"    # bootstrapping the runtime
+    RAY_RUNNING = "RAY_RUNNING"          # heartbeating in the GCS
+    RAY_STOPPING = "RAY_STOPPING"        # drain requested
+    TERMINATED = "TERMINATED"            # gone from the provider
+    ALLOCATION_FAILED = "ALLOCATION_FAILED"
+
+
+# Legal transitions (reference: InstanceUtil.get_valid_transitions).
+_TRANSITIONS: Dict[InstanceStatus, Tuple[InstanceStatus, ...]] = {
+    InstanceStatus.QUEUED: (InstanceStatus.REQUESTED,),
+    InstanceStatus.REQUESTED: (InstanceStatus.ALLOCATED,
+                               InstanceStatus.ALLOCATION_FAILED),
+    InstanceStatus.ALLOCATED: (InstanceStatus.RAY_INSTALLING,
+                               InstanceStatus.RAY_RUNNING,
+                               InstanceStatus.TERMINATED),
+    InstanceStatus.RAY_INSTALLING: (InstanceStatus.RAY_RUNNING,
+                                    InstanceStatus.TERMINATED),
+    InstanceStatus.RAY_RUNNING: (InstanceStatus.RAY_STOPPING,
+                                 InstanceStatus.TERMINATED),
+    InstanceStatus.RAY_STOPPING: (InstanceStatus.TERMINATED,),
+    InstanceStatus.ALLOCATION_FAILED: (InstanceStatus.QUEUED,
+                                       InstanceStatus.TERMINATED),
+    InstanceStatus.TERMINATED: (),
+}
+# a QUEUED instance that is no longer wanted can be dropped directly
+_TRANSITIONS[InstanceStatus.QUEUED] += (InstanceStatus.TERMINATED,)
+
+
+class InvalidTransitionError(ValueError):
+    pass
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    status: InstanceStatus = InstanceStatus.QUEUED
+    address: Optional[Tuple[str, int]] = None  # once RAY_RUNNING
+    launch_request_time: float = 0.0
+    history: List[Tuple[str, float]] = field(default_factory=list)
+
+    def snapshot(self) -> dict:
+        return {"instance_id": self.instance_id,
+                "status": self.status.value,
+                "address": list(self.address) if self.address else None,
+                "history": [[s, t] for s, t in self.history]}
+
+
+class InstanceStorage:
+    """Versioned instance table (reference: instance_storage.py). Every
+    mutation bumps the version; writers pass the version they read and
+    lose cleanly on a concurrent update (CAS) instead of clobbering."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instances: Dict[str, Instance] = {}
+        self._version = 0
+
+    def get_all(self) -> Tuple[Dict[str, Instance], int]:
+        with self._lock:
+            return dict(self._instances), self._version
+
+    def upsert(self, inst: Instance,
+               expected_version: Optional[int] = None) -> bool:
+        with self._lock:
+            if (expected_version is not None
+                    and expected_version != self._version):
+                return False
+            self._instances[inst.instance_id] = inst
+            self._version += 1
+            return True
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+
+class InstanceManager:
+    """Owns the FSM: all status changes go through ``transition``,
+    which validates against the legal-transition table and appends to
+    the instance's timestamped history (reference:
+    instance_manager.py InstanceManager.update_instance_manager_state).
+    """
+
+    def __init__(self, storage: Optional[InstanceStorage] = None):
+        self.storage = storage or InstanceStorage()
+
+    def create_instance(self) -> Instance:
+        inst = Instance(instance_id=uuid.uuid4().hex[:12])
+        inst.history.append((inst.status.value, time.time()))
+        self.storage.upsert(inst)
+        return inst
+
+    def transition(self, inst: Instance, to: InstanceStatus,
+                   address: Optional[Tuple[str, int]] = None):
+        if to not in _TRANSITIONS[inst.status]:
+            raise InvalidTransitionError(
+                f"{inst.instance_id}: {inst.status.value} -> {to.value} "
+                f"is not a legal transition")
+        inst.status = to
+        if address is not None:
+            inst.address = tuple(address)
+        inst.history.append((to.value, time.time()))
+        self.storage.upsert(inst)
+
+    def instances(self, *statuses: InstanceStatus) -> List[Instance]:
+        all_i, _ = self.storage.get_all()
+        if not statuses:
+            return list(all_i.values())
+        return [i for i in all_i.values() if i.status in statuses]
+
+
+class Reconciler:
+    """One reconcile pass = diff desired/cloud/cluster views and issue
+    transitions (reference: autoscaler/v2/instance_manager/reconciler.py
+    Reconciler.reconcile). Pure logic — the caller supplies the three
+    views, so the pass is deterministic and unit-testable; the
+    ``AutoscalerV2`` loop below feeds it live views.
+
+    - desired_count > non-terminated instances -> create QUEUED,
+      QUEUED -> REQUESTED via provider.launch_node()
+    - provider-visible instance -> ALLOCATED
+    - GCS-alive node at a known address -> RAY_RUNNING
+    - REQUESTED older than ``request_timeout_s`` -> ALLOCATION_FAILED,
+      then requeued (bounded retries)
+    - desired_count < running -> RAY_STOPPING via
+      provider.terminate_node, provider-gone -> TERMINATED
+    """
+
+    def __init__(self, manager: InstanceManager, provider,
+                 request_timeout_s: float = 30.0,
+                 max_allocation_retries: int = 2):
+        self.im = manager
+        self.provider = provider
+        self.request_timeout_s = request_timeout_s
+        self.max_retries = max_allocation_retries
+        self._retries: Dict[str, int] = {}
+
+    def reconcile(self, desired_count: int,
+                  cloud_instance_count: int,
+                  ray_node_addrs: List[Tuple[str, int]]):
+        now = time.time()
+        live = self.im.instances(
+            InstanceStatus.QUEUED, InstanceStatus.REQUESTED,
+            InstanceStatus.ALLOCATED, InstanceStatus.RAY_INSTALLING,
+            InstanceStatus.RAY_RUNNING)
+
+        # ---- converge upward: queue + request, bounded by how far the
+        # in-flight fleet falls short of desired (launching every QUEUED
+        # record would over-provision after a scale-down)
+        for _ in range(max(0, desired_count - len(live))):
+            live.append(self.im.create_instance())
+        in_flight = len(live) - len(self.im.instances(InstanceStatus.QUEUED))
+        launch_budget = max(0, desired_count - in_flight)
+        for inst in self.im.instances(InstanceStatus.QUEUED):
+            if launch_budget <= 0:
+                # surplus queued records are dropped, not launched
+                self.im.transition(inst, InstanceStatus.TERMINATED)
+                continue
+            try:
+                self.provider.launch_node()
+            except Exception:  # noqa: BLE001 — provider hiccup: retry
+                continue
+            inst.launch_request_time = now
+            self.im.transition(inst, InstanceStatus.REQUESTED)
+            launch_budget -= 1
+
+        # ---- provider view: REQUESTED -> ALLOCATED (oldest first), and
+        # time out requests the cloud never honored. RAY_STOPPING
+        # instances still count against the provider's list — real
+        # clouds terminate asynchronously, so a draining node must not
+        # make a pending request look satisfied.
+        requested = sorted(self.im.instances(InstanceStatus.REQUESTED),
+                           key=lambda i: i.launch_request_time)
+        allocated = self.im.instances(InstanceStatus.ALLOCATED,
+                                      InstanceStatus.RAY_INSTALLING,
+                                      InstanceStatus.RAY_RUNNING,
+                                      InstanceStatus.RAY_STOPPING)
+        newly_visible = cloud_instance_count - len(allocated)
+        for inst in requested:
+            if newly_visible > 0:
+                self.im.transition(inst, InstanceStatus.ALLOCATED)
+                newly_visible -= 1
+            elif now - inst.launch_request_time > self.request_timeout_s:
+                self.im.transition(inst, InstanceStatus.ALLOCATION_FAILED)
+                n = self._retries.get(inst.instance_id, 0)
+                if n < self.max_retries:
+                    self._retries[inst.instance_id] = n + 1
+                    self.im.transition(inst, InstanceStatus.QUEUED)
+                else:
+                    self.im.transition(inst, InstanceStatus.TERMINATED)
+
+        # ---- cluster view: ALLOCATED -> RAY_RUNNING once a ray node
+        # heartbeats at an address not yet claimed by another instance
+        claimed = {i.address for i in self.im.instances(
+            InstanceStatus.RAY_RUNNING, InstanceStatus.RAY_STOPPING)
+            if i.address}
+        free_addrs = [a for a in ray_node_addrs if tuple(a) not in claimed]
+        for inst in self.im.instances(InstanceStatus.ALLOCATED,
+                                      InstanceStatus.RAY_INSTALLING):
+            if not free_addrs:
+                break
+            self.im.transition(inst, InstanceStatus.RAY_RUNNING,
+                               address=free_addrs.pop(0))
+
+        # ---- converge downward: drain newest-idle first
+        running = self.im.instances(InstanceStatus.RAY_RUNNING)
+        excess = len(running) - desired_count
+        for inst in running[:max(0, excess)]:
+            try:
+                if inst.address:
+                    self.provider.terminate_node(inst.address)
+            except Exception:  # noqa: BLE001 — retried next pass
+                continue
+            self.im.transition(inst, InstanceStatus.RAY_STOPPING)
+
+        # ---- stopping instances leave once the provider forgets them
+        stopping = self.im.instances(InstanceStatus.RAY_STOPPING)
+        gone = (len(self.im.instances(
+            InstanceStatus.ALLOCATED, InstanceStatus.RAY_INSTALLING,
+            InstanceStatus.RAY_RUNNING)) + len(stopping)
+            - cloud_instance_count)
+        for inst in stopping[:max(0, gone)]:
+            self.im.transition(inst, InstanceStatus.TERMINATED)
+
+
+class AutoscalerV2:
+    """Live loop: feeds the reconciler GCS + provider views (the v2
+    analogue of AutoscalerMonitor; reference: autoscaler/v2/monitor.py).
+    Demand policy is the v1 monitor's (sustained queueing grows the
+    target, sustained idleness shrinks it) — v2's contribution is the
+    audited instance lifecycle underneath it."""
+
+    def __init__(self, gcs_address, provider, *, min_nodes: int = 1,
+                 max_nodes: int = 4, tick_s: float = 0.5,
+                 authkey: Optional[bytes] = None):
+        from ray_tpu.core.cluster.rpc import RpcClient, cluster_authkey
+
+        self._gcs = RpcClient(tuple(gcs_address),
+                              authkey or cluster_authkey())
+        self.provider = provider
+        self.im = InstanceManager()
+        self.reconciler = Reconciler(self.im, provider)
+        self._min = min_nodes
+        self._max = max_nodes
+        self._desired = min_nodes
+        self._tick_s = tick_s
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler-v2")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                pass
+            time.sleep(self._tick_s)
+
+    def _tick(self):
+        view = self._gcs.call(("list_nodes", True))
+        addrs = [tuple(n["address"]) for n in view["nodes"]]
+        cloud = len(self.provider.non_terminated_nodes()) \
+            if hasattr(self.provider, "non_terminated_nodes") else len(addrs)
+        self.reconciler.reconcile(self._desired, cloud, addrs)
+
+    def set_desired(self, n: int):
+        self._desired = max(self._min, min(self._max, n))
+
+    def stop(self):
+        self._stop = True
+        self._gcs.close()

@@ -18,6 +18,7 @@ control plane):
 from __future__ import annotations
 
 import os
+import queue
 import signal
 import subprocess
 import sys
@@ -307,6 +308,11 @@ class Runtime:
         self._cancellable: Dict[bytes, _TaskSpec] = {}
         self._shutdown = False
         self._spawning = 0
+        # Pool workers stolen by actors and not yet replaced. Replacement
+        # is DEMAND-driven (reference: worker_pool.h prestart-on-backlog,
+        # inverted): an actor-creation burst pays zero replacement forks;
+        # the first queued task that finds the pool empty triggers one.
+        self._pool_deficit = 0
 
         # Resource model: CPU slots == pool size; TPU chips from the slice
         # topology (detected or injected for tests).
@@ -349,6 +355,11 @@ class Runtime:
                 self._zygote = None
         for _ in range(self.num_workers):
             self._spawn_worker()
+
+        # serialized actor-start lane (see _actor_spawner_loop)
+        self._actor_start_queue: "queue.Queue" = queue.Queue()
+        threading.Thread(target=self._actor_spawner_loop, daemon=True,
+                         name="rtpu-actor-spawner").start()
 
         # memory monitor + OOM kill policy (reference:
         # memory_monitor.h:52, worker_killing_policy_group_by_owner.h)
@@ -549,6 +560,10 @@ class Runtime:
             if not w.alive:
                 return
             w.alive = False
+            if not w.ready:
+                # died before MSG_READY: release the spawning slot it
+                # held, or scale-up/pool-repay gates stay closed forever
+                self._spawning = max(0, self._spawning - 1)
             self._workers.pop(w.worker_id, None)
             try:
                 self._idle.remove(w)
@@ -1029,10 +1044,10 @@ class Runtime:
                 return
             pool = [w for w in self._workers.values()
                     if w.alive and w.actor_id is None]
-            if pool and all(w.blocked or not w.ready for w in pool):
-                spawn = True
-            else:
-                spawn = False
+            # an EMPTY pool (every worker stolen by actors under lazy
+            # replacement) must also scale, or queued tasks starve
+            spawn = not pool or all(w.blocked or not w.ready
+                                    for w in pool)
         if spawn:
             self._spawn_worker()
 
@@ -1049,6 +1064,17 @@ class Runtime:
                 while self._idle and not self._idle[0].alive:
                     self._idle.popleft()
                 if not self._task_queue or not self._idle:
+                    # queued work + drained pool: repay ONE stolen
+                    # worker (actor creations defer replacement forks
+                    # to exactly this moment — see _pool_deficit)
+                    if (self._task_queue and not self._idle
+                            and not self._shutdown
+                            and self._spawning == 0
+                            and self._pool_deficit > 0):
+                        self._pool_deficit -= 1
+                        threading.Thread(
+                            target=self._repay_pool_deficit,
+                            daemon=True).start()
                     return
                 # Fair division: divide the queue across the whole pool
                 # (busy workers rejoin soon), so one early-finishing worker
@@ -1513,8 +1539,42 @@ class Runtime:
             if not placed:
                 self._pending_actors.append(state)
         if placed:
-            self._start_actor(state)
+            # Start (fork + handshake) OFF the caller's thread: the
+            # creator only needs the id it already chose, and method
+            # calls queue on the actor state until MSG_ACTOR_READY —
+            # so a creation burst pipelines instead of paying a
+            # serialized fork per reply (reference: actor creation is
+            # async task submission, core_worker.cc SubmitActorCreationTask).
+            # One spawner thread per runtime: concurrent forks on few
+            # cores thrash (page-table churn + context switches).
+            self._actor_start_queue.put(state)
         return actor_id
+
+    def _actor_spawner_loop(self):
+        while not self._shutdown:
+            try:
+                state = self._actor_start_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if state.dead:
+                continue  # killed while queued: never fork for it
+            try:
+                self._start_actor(state)
+            except Exception as e:  # noqa: BLE001
+                # transient start failure (fork EAGAIN, zygote respawn):
+                # spend the restart budget like a worker death would,
+                # only then declare the actor dead
+                if state.restarts_left != 0 and not state.dead:
+                    if state.restarts_left > 0:
+                        state.restarts_left -= 1
+                    time.sleep(0.05)
+                    self._actor_start_queue.put(state)
+                    continue
+                try:
+                    self._mark_actor_dead(state, ActorDiedError(
+                        f"actor failed to start: {e!r}"))
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _start_actor(self, state: _ActorState):
         needs_tpu = bool(state.chips) or state.opts.get("num_tpus", 0) > 0
@@ -1533,11 +1593,37 @@ class Runtime:
                 extra_env["RTPU_TPU_CHIPS"] = chips_str
             w = self._spawn_worker(tpu=needs_tpu, extra_env=extra_env)
         else:
-            self._spawn_worker()  # keep task-pool capacity
+            # replace task-pool capacity lazily (see _pool_deficit): the
+            # fork (~10-25ms even from the zygote) must not serialize
+            # into every create_actor RPC reply, and a burst of actor
+            # creations should not pay a fork per actor at all
+            with self._lock:
+                self._pool_deficit += 1
         with self._lock:
             w.actor_id = state.actor_id
             state.worker = w
+            died = state.dead
+        if died:
+            # killed between the queue pop and here: reclaim the worker
+            # instead of pinning it to a dead actor
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except OSError:
+                    pass
+            return
         self._when_worker_ready(w, lambda: self._send_create_actor(w, state))
+
+    def _repay_pool_deficit(self):
+        """Spawn ONE replacement for a stolen pool worker (called when
+        queued work finds the pool empty). On failure the debt stays."""
+        try:
+            self._spawn_worker()
+            return
+        except Exception:  # noqa: BLE001 — racing shutdown
+            pass
+        with self._lock:
+            self._pool_deficit += 1
 
     def _when_worker_ready(self, w: _Worker, fn):
         def poll():
@@ -1618,7 +1704,7 @@ class Runtime:
                 state.restarts_left -= 1
             state.ready = False
             state.worker = None
-            self._start_actor(state)
+            self._actor_start_queue.put(state)
         else:
             self._mark_actor_dead(
                 state, ActorDiedError("the actor's worker process died")
@@ -1931,7 +2017,7 @@ class Runtime:
         for st in newly_ready:
             self._resolve_pg_waiters(st)
         for astate in to_start:
-            self._start_actor(astate)
+            self._actor_start_queue.put(astate)
         if newly_ready:
             self._dispatch()
 

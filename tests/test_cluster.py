@@ -590,3 +590,86 @@ def test_pull_admission_bounded_concurrent_fetch():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
         c.shutdown()
         runtime_context.set_core(prev)
+
+
+def test_ray_client_proxy_multi_tenant(tmp_path):
+    """The Ray-Client proxy (reference: util/client/server/proxier.py):
+    one endpoint, isolated per-client drivers. A subprocess client works
+    through `init(address="ray://...")`; a second tenant's disconnect
+    tears down only ITS state; idle tenants reap."""
+    import subprocess
+    import sys
+
+    from ray_tpu.client import ClientProxyServer, ProxyCore
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=2, num_workers_per_node=2,
+                object_store_memory=64 << 20)
+    proxy = None
+    try:
+        c.wait_for_nodes(2)
+        proxy = ClientProxyServer(c.gcs_address, authkey=c.authkey,
+                                  idle_timeout_s=30.0)
+        host, port = proxy.address
+
+        # tenant A: a full thin-client session in a subprocess
+        script = f"""
+import ray_tpu
+import numpy as np
+ray_tpu.init(address="ray://{host}:{port}")
+
+@ray_tpu.remote
+def double(x):
+    return x * 2
+
+@ray_tpu.remote
+def plus(a, b):
+    return a + b
+
+assert ray_tpu.get(double.remote(21), timeout=60) == 42
+# nested ref in args crosses the proxy by id
+assert ray_tpu.get(plus.remote(double.remote(1), 3), timeout=60) == 5
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def incr(self):
+        self.n += 1
+        return self.n
+
+cnt = Counter.remote()
+assert ray_tpu.get(cnt.incr.remote(), timeout=60) == 1
+assert ray_tpu.get(cnt.incr.remote(), timeout=60) == 2
+
+arr = np.arange(1000, dtype=np.float32)
+ref = ray_tpu.put(arr)
+back = ray_tpu.get(ref, timeout=60)
+assert (back == arr).all()
+print("CLIENT_A_DONE", flush=True)
+ray_tpu.shutdown()
+"""
+        env = dict(os.environ)
+        env["RTPU_CLUSTER_AUTHKEY"] = c.authkey.hex()
+        out = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=240)
+        assert "CLIENT_A_DONE" in out.stdout, out.stderr[-2000:]
+
+        # tenant B and C side by side in this process (direct ProxyCore)
+        pb = ProxyCore(proxy.address, authkey=c.authkey)
+        pc2 = ProxyCore(proxy.address, authkey=c.authkey)
+        assert proxy.num_tenants == 2  # A already disconnected at exit
+        rb = pb.put_object({"who": "B"})
+        rc = pc2.put_object({"who": "C"})
+        # C leaves: B's objects stay fetchable (isolated teardown)
+        pc2.shutdown()
+        assert proxy.num_tenants == 1
+        assert pb.get_objects([rb], timeout=30)[0] == {"who": "B"}
+        pb.shutdown()
+        assert proxy.num_tenants == 0
+    finally:
+        if proxy is not None:
+            proxy.close()
+        c.shutdown()
+        runtime_context.set_core(prev)

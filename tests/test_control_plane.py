@@ -873,3 +873,125 @@ def test_workflow_cancel_and_management_actor(tmp_path, rt):
     ids = [r["workflow_id"] for r in
            rt.get(mgr.list_registered.remote())]
     assert "wf_cancel" not in ids
+
+
+class _FakeCloud:
+    """Deterministic provider double: launches become visible only when
+    the test advances the 'cloud', so REQUESTED->ALLOCATED timing is
+    controlled; terminations disappear likewise."""
+
+    def __init__(self, fail_launches: int = 0):
+        self.pending = 0           # requested, not yet visible
+        self.visible = 0           # provider-listed instances
+        self.terminated = []
+        self._fail = fail_launches
+
+    def launch_node(self):
+        if self._fail > 0:
+            self._fail -= 1
+            raise RuntimeError("quota")
+        self.pending += 1
+
+    def satisfy(self, n=None):
+        take = self.pending if n is None else min(n, self.pending)
+        self.pending -= take
+        self.visible += take
+
+    def terminate_node(self, address):
+        self.terminated.append(tuple(address))
+        self.visible -= 1
+
+    def non_terminated_nodes(self):
+        return [{"i": i} for i in range(self.visible)]
+
+
+def test_instance_manager_fsm_and_reconciler():
+    """Autoscaler v2 (reference: autoscaler/v2/instance_manager/): every
+    instance walks the audited FSM QUEUED->REQUESTED->ALLOCATED->
+    RAY_RUNNING->RAY_STOPPING->TERMINATED; illegal jumps raise; request
+    timeouts retry through ALLOCATION_FAILED with a bounded budget."""
+    from ray_tpu.autoscaler_v2 import (InstanceManager, InstanceStatus,
+                                       InvalidTransitionError, Reconciler)
+
+    cloud = _FakeCloud()
+    im = InstanceManager()
+    rec = Reconciler(im, cloud, request_timeout_s=0.2,
+                     max_allocation_retries=1)
+
+    # scale 0 -> 2: instances queue and get requested
+    rec.reconcile(2, cloud.visible, [])
+    assert len(im.instances(InstanceStatus.REQUESTED)) == 2
+    assert cloud.pending == 2
+
+    # the cloud honors one launch; one instance allocates
+    cloud.satisfy(1)
+    rec.reconcile(2, cloud.visible, [])
+    assert len(im.instances(InstanceStatus.ALLOCATED)) == 1
+
+    # a ray node heartbeats at an address: ALLOCATED -> RAY_RUNNING
+    rec.reconcile(2, cloud.visible, [("10.0.0.1", 7000)])
+    running = im.instances(InstanceStatus.RAY_RUNNING)
+    assert [i.address for i in running] == [("10.0.0.1", 7000)]
+
+    # the second request times out -> ALLOCATION_FAILED -> requeued;
+    # the NEXT pass re-requests it (reconcilers converge over passes)
+    time.sleep(0.25)
+    rec.reconcile(2, cloud.visible, [("10.0.0.1", 7000)])
+    inst2 = [i for i in im.instances() if not i.address][0]
+    states = [s for s, _ in inst2.history]
+    assert "ALLOCATION_FAILED" in states and states[-1] == "QUEUED"
+    rec.reconcile(2, cloud.visible, [("10.0.0.1", 7000)])
+    assert inst2.history[-1][0] == "REQUESTED"
+
+    # second timeout exhausts the retry budget -> TERMINATED
+    time.sleep(0.25)
+    rec.reconcile(2, cloud.visible, [("10.0.0.1", 7000)])
+    states = [s for s, _ in inst2.history]
+    assert states[-1] == "TERMINATED"
+    assert states.count("ALLOCATION_FAILED") == 2
+
+    # scale down to 0: the running instance drains then terminates
+    rec.reconcile(0, cloud.visible, [("10.0.0.1", 7000)])
+    assert cloud.terminated == [("10.0.0.1", 7000)]
+    rec.reconcile(0, cloud.visible, [])
+    assert [i.status for i in im.instances()
+            if i.address] == [InstanceStatus.TERMINATED]
+
+    # FSM rejects illegal jumps
+    fresh = im.create_instance()
+    with pytest.raises(InvalidTransitionError):
+        im.transition(fresh, InstanceStatus.RAY_RUNNING)
+
+    # full history is timestamped, first state QUEUED
+    done = [i for i in im.instances() if i.address][0]
+    assert [s for s, _ in done.history] == [
+        "QUEUED", "REQUESTED", "ALLOCATED", "RAY_RUNNING",
+        "RAY_STOPPING", "TERMINATED"]
+
+
+def test_instance_storage_versioned_cas():
+    from ray_tpu.autoscaler_v2 import Instance, InstanceStorage
+
+    st = InstanceStorage()
+    a = Instance(instance_id="a")
+    assert st.upsert(a)
+    _, v = st.get_all()
+    assert st.upsert(Instance(instance_id="b"), expected_version=v)
+    # a stale writer (read before 'b' landed) must lose, not clobber
+    assert not st.upsert(Instance(instance_id="c"), expected_version=v)
+    insts, _ = st.get_all()
+    assert set(insts) == {"a", "b"}
+
+
+def test_autoscaler_v2_provider_failure_keeps_queued():
+    from ray_tpu.autoscaler_v2 import (InstanceManager, InstanceStatus,
+                                       Reconciler)
+
+    cloud = _FakeCloud(fail_launches=1)
+    im = InstanceManager()
+    rec = Reconciler(im, cloud)
+    rec.reconcile(1, 0, [])
+    # launch raised: the instance stays QUEUED for the next pass
+    assert len(im.instances(InstanceStatus.QUEUED)) == 1
+    rec.reconcile(1, 0, [])
+    assert len(im.instances(InstanceStatus.REQUESTED)) == 1
