@@ -58,6 +58,7 @@ from ray_tpu.data.grouped import (  # noqa: F401
     Sum,
 )
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
+from ray_tpu.data import preprocessors  # noqa: F401
 from ray_tpu.data.logical import ActorPoolStrategy, TaskPoolStrategy  # noqa: F401
 
 __all__ = [
@@ -66,7 +67,7 @@ __all__ = [
     "Datasource", "ReadTask",
     "ActorPoolStrategy", "TaskPoolStrategy",
     "AggregateFn", "Sum", "Min", "Max", "Mean", "Count", "Std",
-    "GroupedData",
+    "GroupedData", "preprocessors",
     "range", "range_tensor", "from_items", "from_numpy", "from_arrow",
     "from_pandas", "from_blocks", "from_torch", "from_huggingface",
     "read_datasource", "read_parquet",

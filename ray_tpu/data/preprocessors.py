@@ -1,0 +1,549 @@
+"""Dataset preprocessors: fit statistics once, transform anywhere.
+
+Reference: python/ray/data/preprocessors/ (Preprocessor base in
+preprocessor.py; scalers.py, encoder.py, imputer.py, concatenator.py,
+chain.py, batch_mapper.py, tokenizer.py, hashing.py). Same contract:
+``fit`` folds statistics over the Dataset in one streaming pass,
+``transform`` is a ``map_batches`` that ships only the small fitted
+state to workers, and ``transform_batch`` applies the same math to a
+single in-memory batch (the serving path). Preprocessors pickle, so a
+fitted instance can ride a Train/Serve checkpoint.
+
+Numeric columns are handled as numpy arrays; fits are single-pass
+(Welford for mean/std, streaming min/max, bounded reservoir for the
+quantile-based RobustScaler — documented approximation).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class PreprocessorNotFittedError(RuntimeError):
+    pass
+
+
+class Preprocessor:
+    """Base (reference: preprocessor.py:Preprocessor)."""
+
+    _is_fittable = True
+
+    def __init__(self):
+        self._fitted = False
+
+    # -- subclass hooks ---------------------------------------------------
+    def _fit(self, dataset) -> None:
+        raise NotImplementedError
+
+    def _transform_numpy(self, batch: Dict[str, np.ndarray]
+                         ) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    # -- public surface ---------------------------------------------------
+    def fit(self, dataset) -> "Preprocessor":
+        if self._is_fittable:
+            self._fit(dataset)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, dataset):
+        return self.fit(dataset).transform(dataset)
+
+    def transform(self, dataset):
+        self._check_fitted()
+        return dataset.map_batches(self._transform_numpy,
+                                   batch_format="numpy")
+
+    def transform_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Single-batch path for inference (reference:
+        Preprocessor.transform_batch)."""
+        self._check_fitted()
+        return self._transform_numpy(
+            {k: np.asarray(v) for k, v in batch.items()})
+
+    def _check_fitted(self):
+        if self._is_fittable and not self._fitted:
+            raise PreprocessorNotFittedError(
+                f"{type(self).__name__} must be fit() before transform")
+
+
+# ---------------------------------------------------------------- scalers
+
+
+def _welford_fold(dataset, columns) -> Dict[str, Tuple[float, float]]:
+    """One streaming pass -> {col: (mean, std)} (Chan et al. merge)."""
+    state = {c: None for c in columns}
+    for batch in dataset.iter_batches(batch_format="numpy"):
+        for c in columns:
+            col = np.asarray(batch[c], dtype=np.float64).ravel()
+            nb, mb = len(col), float(col.mean())
+            m2b = float(((col - mb) ** 2).sum())
+            s = state[c]
+            if s is None:
+                state[c] = [nb, mb, m2b]
+            else:
+                na, ma, m2a = s
+                n = na + nb
+                d = mb - ma
+                state[c] = [n, ma + d * nb / n,
+                            m2a + m2b + d * d * na * nb / n]
+    out = {}
+    for c, (n, mean, m2) in state.items():
+        std = float(np.sqrt(m2 / n)) if n > 0 else 0.0
+        out[c] = (mean, std)
+    return out
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std (reference: scalers.py:StandardScaler)."""
+
+    def __init__(self, columns: Sequence[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: Dict[str, Tuple[float, float]] = {}
+
+    def _fit(self, dataset):
+        self.stats_ = _welford_fold(dataset, self.columns)
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            out[c] = (np.asarray(batch[c], np.float64) - mean) \
+                / (std or 1.0)
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) (reference: scalers.py:MinMaxScaler)."""
+
+    def __init__(self, columns: Sequence[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: Dict[str, Tuple[float, float]] = {}
+
+    def _fit(self, dataset):
+        lo = {c: np.inf for c in self.columns}
+        hi = {c: -np.inf for c in self.columns}
+        for batch in dataset.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                col = np.asarray(batch[c], np.float64)
+                lo[c] = min(lo[c], float(col.min()))
+                hi[c] = max(hi[c], float(col.max()))
+        self.stats_ = {c: (lo[c], hi[c]) for c in self.columns}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            span = (hi - lo) or 1.0
+            out[c] = (np.asarray(batch[c], np.float64) - lo) / span
+        return out
+
+
+class MaxAbsScaler(Preprocessor):
+    """x / max|x| (reference: scalers.py:MaxAbsScaler)."""
+
+    def __init__(self, columns: Sequence[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: Dict[str, float] = {}
+
+    def _fit(self, dataset):
+        m = {c: 0.0 for c in self.columns}
+        for batch in dataset.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                m[c] = max(m[c], float(np.abs(
+                    np.asarray(batch[c], np.float64)).max()))
+        self.stats_ = m
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            out[c] = np.asarray(batch[c], np.float64) \
+                / (self.stats_[c] or 1.0)
+        return out
+
+
+class RobustScaler(Preprocessor):
+    """(x - median) / IQR (reference: scalers.py:RobustScaler).
+    Quantiles come from a bounded reservoir sample (100k values/column),
+    exact for datasets under the reservoir size."""
+
+    RESERVOIR = 100_000
+
+    def __init__(self, columns: Sequence[str],
+                 quantile_range: Tuple[float, float] = (0.25, 0.75)):
+        super().__init__()
+        self.columns = list(columns)
+        self.quantile_range = quantile_range
+        self.stats_: Dict[str, Tuple[float, float]] = {}
+
+    def _fit(self, dataset):
+        rng = np.random.default_rng(0)
+        seen = {c: 0 for c in self.columns}
+        res: Dict[str, np.ndarray] = {c: np.empty(0) for c in self.columns}
+        for batch in dataset.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                col = np.asarray(batch[c], np.float64).ravel()
+                if seen[c] < self.RESERVOIR:
+                    take = min(self.RESERVOIR - seen[c], len(col))
+                    res[c] = np.concatenate([res[c], col[:take]])
+                else:  # classic reservoir replacement, batch-at-once
+                    idx = rng.integers(0, seen[c] + len(col), len(col))
+                    repl = idx < self.RESERVOIR
+                    res[c][idx[repl]] = col[repl]
+                seen[c] += len(col)
+        lo_q, hi_q = self.quantile_range
+        for c in self.columns:
+            lo, med, hi = np.quantile(res[c], [lo_q, 0.5, hi_q])
+            self.stats_[c] = (float(med), float(hi - lo))
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            med, iqr = self.stats_[c]
+            out[c] = (np.asarray(batch[c], np.float64) - med) / (iqr or 1.0)
+        return out
+
+
+class Normalizer(Preprocessor):
+    """Row-wise norm across ``columns`` (reference: scalers.py:Normalizer).
+    Stateless: no fit pass."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: Sequence[str], norm: str = "l2"):
+        super().__init__()
+        if norm not in ("l1", "l2", "max"):
+            raise ValueError(f"unknown norm {norm!r}")
+        self.columns = list(columns)
+        self.norm = norm
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        mat = np.stack([np.asarray(batch[c], np.float64)
+                        for c in self.columns], axis=1)
+        if self.norm == "l2":
+            d = np.sqrt((mat ** 2).sum(axis=1))
+        elif self.norm == "l1":
+            d = np.abs(mat).sum(axis=1)
+        else:
+            d = np.abs(mat).max(axis=1)
+        d[d == 0] = 1.0
+        for i, c in enumerate(self.columns):
+            out[c] = mat[:, i] / d
+        return out
+
+
+class PowerTransformer(Preprocessor):
+    """Box-Cox / Yeo-Johnson with a GIVEN power (reference:
+    scalers.py:PowerTransformer — the reference likewise takes ``power``
+    as a parameter rather than estimating it)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: Sequence[str], power: float,
+                 method: str = "yeo-johnson"):
+        super().__init__()
+        if method not in ("yeo-johnson", "box-cox"):
+            raise ValueError(method)
+        self.columns = list(columns)
+        self.power = power
+        self.method = method
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        lam = self.power
+        for c in self.columns:
+            x = np.asarray(batch[c], np.float64)
+            if self.method == "box-cox":
+                out[c] = np.log(x) if lam == 0 else (x ** lam - 1) / lam
+            else:
+                pos = x >= 0
+                y = np.empty_like(x)
+                if lam != 0:
+                    y[pos] = ((x[pos] + 1) ** lam - 1) / lam
+                else:
+                    y[pos] = np.log1p(x[pos])
+                if lam != 2:
+                    y[~pos] = -(((-x[~pos] + 1) ** (2 - lam)) - 1) / (2 - lam)
+                else:
+                    y[~pos] = -np.log1p(-x[~pos])
+                out[c] = y
+        return out
+
+
+# --------------------------------------------------------------- encoders
+
+
+def _unique_fold(dataset, columns) -> Dict[str, List]:
+    uniq: Dict[str, set] = {c: set() for c in columns}
+    for batch in dataset.iter_batches(batch_format="numpy"):
+        for c in columns:
+            uniq[c].update(np.asarray(batch[c]).ravel().tolist())
+    return {c: sorted(v) for c, v in uniq.items()}
+
+
+class OrdinalEncoder(Preprocessor):
+    """Category -> stable integer index (reference: encoder.py:
+    OrdinalEncoder). Unseen values at transform map to -1."""
+
+    def __init__(self, columns: Sequence[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: Dict[str, Dict[Any, int]] = {}
+
+    def _fit(self, dataset):
+        self.stats_ = {c: {v: i for i, v in enumerate(vals)}
+                       for c, vals in
+                       _unique_fold(dataset, self.columns).items()}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            # the table's keys are sorted (built from sorted uniques), so
+            # the category lookup vectorizes as a binary search; unseen
+            # values fall out of the equality check -> -1
+            keys = np.asarray(list(self.stats_[c]))
+            vals = np.asarray(batch[c]).ravel()
+            if len(keys) == 0:
+                out[c] = np.full(len(vals), -1, np.int64)
+                continue
+            idx = np.searchsorted(keys, vals)
+            idx = np.clip(idx, 0, len(keys) - 1)
+            found = keys[idx] == vals
+            out[c] = np.where(found, idx, -1).astype(np.int64)
+        return out
+
+
+class LabelEncoder(OrdinalEncoder):
+    """Single label column -> index (reference: encoder.py:LabelEncoder)."""
+
+    def __init__(self, label_column: str):
+        super().__init__([label_column])
+        self.label_column = label_column
+
+    def inverse_transform_labels(self, idx: np.ndarray) -> List:
+        inv = {i: v for v, i in self.stats_[self.label_column].items()}
+        return [inv.get(int(i)) for i in np.asarray(idx).ravel()]
+
+
+class OneHotEncoder(Preprocessor):
+    """Category -> indicator columns ``{col}_{value}`` (reference:
+    encoder.py:OneHotEncoder). Unseen values encode all-zeros."""
+
+    def __init__(self, columns: Sequence[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: Dict[str, List] = {}
+
+    def _fit(self, dataset):
+        self.stats_ = _unique_fold(dataset, self.columns)
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            vals = np.asarray(batch[c]).ravel()
+            for v in self.stats_[c]:
+                out[f"{c}_{v}"] = (vals == v).astype(np.int64)
+            del out[c]
+        return out
+
+
+class SimpleImputer(Preprocessor):
+    """Fill missing (NaN) values (reference: imputer.py:SimpleImputer).
+    Strategies: mean, most_frequent, constant(fill_value)."""
+
+    def __init__(self, columns: Sequence[str], strategy: str = "mean",
+                 fill_value: Optional[Any] = None):
+        super().__init__()
+        if strategy not in ("mean", "most_frequent", "constant"):
+            raise ValueError(strategy)
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("constant strategy needs fill_value")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: Dict[str, Any] = {}
+
+    @property
+    def _is_fittable(self):  # type: ignore[override]
+        return self.strategy != "constant"
+
+    def _fit(self, dataset):
+        if self.strategy == "mean":
+            sums = {c: [0.0, 0] for c in self.columns}
+            for batch in dataset.iter_batches(batch_format="numpy"):
+                for c in self.columns:
+                    col = np.asarray(batch[c], np.float64)
+                    ok = ~np.isnan(col)
+                    sums[c][0] += float(col[ok].sum())
+                    sums[c][1] += int(ok.sum())
+            self.stats_ = {c: (s / n if n else 0.0)
+                           for c, (s, n) in sums.items()}
+        else:  # most_frequent
+            counts = {c: collections.Counter() for c in self.columns}
+            for batch in dataset.iter_batches(batch_format="numpy"):
+                for c in self.columns:
+                    vals = np.asarray(batch[c]).ravel()
+                    if vals.dtype.kind == "f":
+                        vals = vals[~np.isnan(vals)]
+                    counts[c].update(vals.tolist())
+            self.stats_ = {c: (counts[c].most_common(1)[0][0]
+                               if counts[c] else 0)
+                           for c in self.columns}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            fill = (self.fill_value if self.strategy == "constant"
+                    else self.stats_[c])
+            col = np.asarray(batch[c])
+            if col.dtype.kind == "f":
+                col = col.astype(np.float64).copy()
+                col[np.isnan(col)] = fill
+            else:
+                # categorical path: missing = None / float NaN cells
+                col = col.astype(object).copy()
+                mask = np.asarray(
+                    [v is None or (isinstance(v, float) and np.isnan(v))
+                     for v in col.ravel().tolist()]).reshape(col.shape)
+                col[mask] = fill
+            out[c] = col
+        return out
+
+
+# ------------------------------------------------------------ structural
+
+
+class Concatenator(Preprocessor):
+    """Pack columns into one vector column (reference:
+    concatenator.py:Concatenator)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: Sequence[str],
+                 output_column_name: str = "concat_out",
+                 drop: bool = True):
+        super().__init__()
+        self.columns = list(columns)
+        self.output_column_name = output_column_name
+        self.drop = drop
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        mat = np.stack([np.asarray(batch[c], np.float64)
+                        for c in self.columns], axis=1)
+        out[self.output_column_name] = mat
+        if self.drop:
+            for c in self.columns:
+                out.pop(c, None)
+        return out
+
+
+class BatchMapper(Preprocessor):
+    """Arbitrary user function as a preprocessor (reference:
+    batch_mapper.py:BatchMapper)."""
+
+    _is_fittable = False
+
+    def __init__(self, fn: Callable[[Dict[str, np.ndarray]],
+                                    Dict[str, np.ndarray]]):
+        super().__init__()
+        self.fn = fn
+
+    def _transform_numpy(self, batch):
+        return self.fn(batch)
+
+
+class Tokenizer(Preprocessor):
+    """String column -> list-of-tokens column (reference:
+    tokenizer.py:Tokenizer; default whitespace split)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: Sequence[str],
+                 tokenization_fn: Optional[Callable[[str], List[str]]]
+                 = None):
+        super().__init__()
+        self.columns = list(columns)
+        self.fn = tokenization_fn or (lambda s: s.split())
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            vals = np.asarray(batch[c]).ravel().tolist()
+            # one object cell per ROW — np.asarray would instead build a
+            # 2-D array whenever every row tokenizes to the same length
+            # (or a single-row batch), silently changing the row count
+            col = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                col[i] = self.fn(str(v))
+            out[c] = col
+        return out
+
+
+class FeatureHasher(Preprocessor):
+    """Token lists -> fixed-width hashed count vectors (reference:
+    hashing.py:FeatureHasher). Stateless by construction — the hash IS
+    the vocabulary."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: Sequence[str], num_features: int,
+                 output_column_name: str = "hashed_features"):
+        super().__init__()
+        self.columns = list(columns)
+        self.num_features = int(num_features)
+        self.output_column_name = output_column_name
+
+    def _transform_numpy(self, batch):
+        import zlib
+
+        out = dict(batch)
+        n = len(np.asarray(batch[self.columns[0]]).ravel())
+        mat = np.zeros((n, self.num_features), np.float64)
+        for c in self.columns:
+            col = np.asarray(batch[c]).ravel()
+            for i, tokens in enumerate(col.tolist()):
+                if isinstance(tokens, str):
+                    tokens = [tokens]
+                for tok in tokens:
+                    h = zlib.crc32(str(tok).encode()) % self.num_features
+                    mat[i, h] += 1.0
+        out[self.output_column_name] = mat
+        for c in self.columns:
+            out.pop(c, None)
+        return out
+
+
+class Chain(Preprocessor):
+    """Sequential composition (reference: chain.py:Chain): fit stage k
+    on the data as transformed by stages 0..k-1."""
+
+    def __init__(self, *preprocessors: Preprocessor):
+        super().__init__()
+        self.preprocessors = list(preprocessors)
+
+    def _fit(self, dataset):
+        ds = dataset
+        for p in self.preprocessors:
+            p.fit(ds)
+            ds = p.transform(ds)
+
+    def transform(self, dataset):
+        self._check_fitted()
+        ds = dataset
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def transform_batch(self, batch):
+        self._check_fitted()
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch

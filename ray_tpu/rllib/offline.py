@@ -211,3 +211,40 @@ def train_offline(learner, dataset, *, num_epochs: int = 1,
                 continue
             loss = learner.update(batch)
     return loss
+
+
+def write_sample_batch_json(batches, path: str) -> int:
+    """Persist sample batches as JSON-lines (reference:
+    rllib/offline/json_writer.py — one JSON object per batch, array
+    columns as lists). Returns the number of batches written."""
+    import json
+
+    n = 0
+    with open(path, "w") as f:
+        for batch in batches:
+            obj = {k: np.asarray(v).tolist() for k, v in batch.items()}
+            f.write(json.dumps(obj) + "\n")
+            n += 1
+    return n
+
+
+def read_sample_batch_json(paths):
+    """Load JSON-lines sample batches into a row-per-transition Dataset
+    ready for ``train_offline`` (reference: rllib/offline/json_reader.py
+    feeding the learner; here the Data streaming executor IS the
+    offline pipeline)."""
+    import json
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.read_text(paths)
+
+    def expand(batch):
+        cols: Dict[str, list] = {}
+        for line in np.asarray(batch["text"]).ravel().tolist():
+            obj = json.loads(line)
+            for k, v in obj.items():
+                cols.setdefault(k, []).append(np.asarray(v))
+        return {k: np.concatenate(v, axis=0) for k, v in cols.items()}
+
+    return ds.map_batches(expand, batch_format="numpy")

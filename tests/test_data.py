@@ -751,3 +751,109 @@ def test_dataset_stats_identifies_bottleneck():
     assert "200 in -> 200 out" in report, report
     # in-task timing present for the slow op (4 tasks x >=0.15s sleep)
     assert any("wall" in ln and "cpu" in ln for ln in lines), report
+
+
+def test_preprocessors_scalers_and_encoders():
+    """AIR preprocessors (reference: python/ray/data/preprocessors/):
+    fit folds stats over the Dataset; transform runs as map_batches;
+    transform_batch serves single batches with the same math."""
+    from ray_tpu import data as rd
+    from ray_tpu.data.preprocessors import (Chain, Concatenator,
+                                            LabelEncoder, MinMaxScaler,
+                                            OneHotEncoder,
+                                            PreprocessorNotFittedError,
+                                            SimpleImputer, StandardScaler)
+
+    n = 1000
+    rng = np.random.default_rng(0)
+    xs = (rng.normal(5.0, 2.0, n)).astype(np.float64)
+    ys = rng.uniform(10, 20, n)
+    colors = rng.choice(["red", "green", "blue"], n)
+    ds = rd.from_items([{"x": float(xs[i]), "y": float(ys[i]),
+                         "color": str(colors[i])} for i in range(n)])
+
+    ss = StandardScaler(["x"]).fit(ds)
+    out = np.concatenate([b["x"] for b in
+                          ss.transform(ds).iter_batches(
+                              batch_format="numpy")])
+    assert abs(out.mean()) < 1e-9 and abs(out.std() - 1.0) < 1e-6
+
+    mm = MinMaxScaler(["y"]).fit(ds)
+    out = np.concatenate([b["y"] for b in
+                          mm.transform(ds).iter_batches(
+                              batch_format="numpy")])
+    assert out.min() == 0.0 and out.max() == 1.0
+
+    # one-hot: categorical becomes indicator columns, originals dropped
+    oh = OneHotEncoder(["color"]).fit(ds)
+    b = next(iter(oh.transform(ds).iter_batches(batch_format="numpy")))
+    assert {"color_red", "color_green", "color_blue"} <= set(b)
+    assert "color" not in b
+    row_sums = b["color_red"] + b["color_green"] + b["color_blue"]
+    assert (row_sums == 1).all()
+
+    # label encoding round-trips
+    le = LabelEncoder("color").fit(ds)
+    enc = le.transform_batch({"color": np.asarray(["blue", "red"])})
+    assert le.inverse_transform_labels(enc["color"]) == ["blue", "red"]
+
+    # imputer fills NaN with the fitted mean
+    ds_nan = rd.from_items([{"v": 1.0}, {"v": float("nan")}, {"v": 3.0}])
+    imp = SimpleImputer(["v"], strategy="mean").fit(ds_nan)
+    got = imp.transform_batch({"v": np.asarray([float("nan")])})
+    assert got["v"][0] == 2.0
+
+    # categorical imputation: most_frequent over strings, None filled
+    ds_cat = rd.from_items([{"c": "a"}, {"c": "a"}, {"c": "b"}])
+    imp2 = SimpleImputer(["c"], strategy="most_frequent").fit(ds_cat)
+    got = imp2.transform_batch(
+        {"c": np.asarray(["b", None, float("nan")], dtype=object)})
+    assert got["c"].tolist() == ["b", "a", "a"]
+
+    # ordinal encoding is vectorized; unseen values map to -1
+    from ray_tpu.data.preprocessors import OrdinalEncoder
+    oe = OrdinalEncoder(["color"]).fit(ds)
+    enc = oe.transform_batch(
+        {"color": np.asarray(["blue", "violet", "red"])})
+    assert enc["color"].tolist() == [0, -1, 2]
+
+    # Chain: stage k fits on the output of stages < k, and the fitted
+    # chain serves single batches (the serving path)
+    chain = Chain(StandardScaler(["x"]), MinMaxScaler(["x"]),
+                  Concatenator(["x", "y"], output_column_name="vec"))
+    chain.fit(ds)
+    served = chain.transform_batch(
+        {"x": np.asarray([5.0]), "y": np.asarray([15.0]),
+         "color": np.asarray(["red"])})
+    assert served["vec"].shape == (1, 2)
+    assert "x" not in served
+
+    with pytest.raises(PreprocessorNotFittedError):
+        StandardScaler(["x"]).transform(ds)
+
+
+def test_preprocessors_text_and_hashing():
+    from ray_tpu import data as rd
+    from ray_tpu.data.preprocessors import (FeatureHasher, Normalizer,
+                                            RobustScaler, Tokenizer)
+
+    ds = rd.from_items([{"t": "the quick brown fox"},
+                        {"t": "the lazy dog"}])
+    tok = Tokenizer(["t"])
+    hashed = FeatureHasher(["t"], num_features=16)
+    b = next(iter(hashed.transform(tok.transform(ds)).iter_batches(
+        batch_format="numpy")))
+    assert b["hashed_features"].shape == (2, 16)
+    assert b["hashed_features"][0].sum() == 4  # four tokens hashed
+
+    # robust scaler: outliers do not blow up the scale
+    vals = [float(v) for v in range(100)] + [1e9]
+    ds2 = rd.from_items([{"v": v} for v in vals])
+    rs = RobustScaler(["v"]).fit(ds2)
+    med, iqr = rs.stats_["v"]
+    assert 49 <= med <= 52 and 40 <= iqr <= 60
+
+    nz = Normalizer(["a", "b"], norm="l2")
+    out = nz.transform_batch({"a": np.asarray([3.0]),
+                              "b": np.asarray([4.0])})
+    assert abs(out["a"][0] - 0.6) < 1e-12 and abs(out["b"][0] - 0.8) < 1e-12

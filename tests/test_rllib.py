@@ -827,3 +827,35 @@ def test_marwil_outweighs_bad_demonstrations(rl_ray):
     # BC sees a 50/50 action mix per state: it cannot systematically
     # recover the expert
     assert marwil_acc > bc_acc + 0.2, (marwil_acc, bc_acc)
+
+
+def test_offline_json_sample_batches_roundtrip(rl_ray, tmp_path):
+    """Offline JSON format (reference: rllib/offline/json_reader.py):
+    batches persist as JSON-lines and read back into a Dataset that
+    drives an offline learner."""
+    from ray_tpu.rllib import BCLearner, MLPModule
+    from ray_tpu.rllib.offline import (read_sample_batch_json,
+                                       train_offline,
+                                       write_sample_batch_json)
+
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(512, 4)).astype(np.float32)
+    actions = (obs[:, 0] > 0).astype(np.int32)
+    path = str(tmp_path / "batches.json")
+    n = write_sample_batch_json(
+        [{"obs": obs[:256], "actions": actions[:256]},
+         {"obs": obs[256:], "actions": actions[256:]}], path)
+    assert n == 2
+
+    ds = read_sample_batch_json(path)
+    assert ds.count() == 512
+    got = np.concatenate([b["obs"] for b in
+                          ds.iter_batches(batch_format="numpy")])
+    assert got.shape == (512, 4)
+
+    mod = MLPModule(4, 2, hidden=(32,))
+    bc = BCLearner(mod, lr=1e-2)
+    loss = train_offline(bc, ds, num_epochs=5, batch_size=128)
+    logits, _ = mod.apply_np(bc.get_weights(), obs)
+    acc = float((np.argmax(logits, -1) == actions).mean())
+    assert acc > 0.9, (acc, loss)
