@@ -295,6 +295,13 @@ class NodeServer:
         self._server = RpcServer(self._handle, self._authkey, port=port)
         self.address = self._server.address
 
+        # sender-side transfer flow control (reference: push_manager.h —
+        # cap outbound chunk bytes in flight; requesters queue FIFO-ish
+        # on the condition instead of over-committing sender memory)
+        self._push_cv = threading.Condition()
+        self._push_inflight = 0
+        self._push_waits = 0  # observability: times a chunk had to queue
+
         # object-location publication (batched)
         self._loc_lock = threading.Lock()
         self._loc_pending: List[bytes] = []
@@ -760,7 +767,10 @@ class NodeServer:
             }
 
     def _op_state(self):
-        return self.runtime.state_summary()
+        s = self.runtime.state_summary()
+        s["push_waits"] = self._push_waits  # sender-side backpressure hits
+        s["pulls"] = self.pulls.stats()     # admission-control occupancy
+        return s
 
     def _op_stack_dump(self):
         return self.runtime.stack_dump()
@@ -954,7 +964,27 @@ class NodeServer:
     def _op_fetch_range(self, oid_bytes, offset: int, length: int):
         """One chunk of a payload (the DCN bulk path: a puller runs many
         of these concurrently on separate connections). Serves shm-backed
-        objects without materializing the whole payload."""
+        objects without materializing the whole payload, under the
+        sender-side in-flight byte cap (push_max_inflight_bytes)."""
+        cap = config.push_max_inflight_bytes
+        if cap > 0:
+            with self._push_cv:
+                if self._push_inflight + length > cap \
+                        and self._push_inflight > 0:
+                    self._push_waits += 1
+                while (self._push_inflight + length > cap
+                       and self._push_inflight > 0):
+                    self._push_cv.wait(timeout=1.0)
+                self._push_inflight += length
+            try:
+                return self._fetch_range_inner(oid_bytes, offset, length)
+            finally:
+                with self._push_cv:
+                    self._push_inflight -= length
+                    self._push_cv.notify_all()
+        return self._fetch_range_inner(oid_bytes, offset, length)
+
+    def _fetch_range_inner(self, oid_bytes, offset: int, length: int):
         rt = self.runtime
         oid = ObjectID(oid_bytes)
         with rt._lock:
@@ -1282,6 +1312,20 @@ def main(argv=None):
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    def _dump_stacks(*_a):
+        # ops hatch (mirrors the workers' SIGUSR1 dumps): all-thread
+        # stacks of the NODE SERVER itself, to a file — stderr may be
+        # detached under a supervisor
+        import traceback
+
+        path = f"/tmp/rtpu_node_stacks_{os.getpid()}.txt"
+        with open(path, "w") as f:
+            for tid, fr in sys._current_frames().items():
+                f.write(f"--- thread {tid} ---\n")
+                f.write("".join(traceback.format_stack(fr)))
+
+    signal.signal(signal.SIGUSR2, _dump_stacks)
     stop.wait()
     if agent is not None:
         agent.close()

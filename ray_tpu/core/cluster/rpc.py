@@ -16,8 +16,9 @@ import os
 import socket
 import threading
 import time
-from multiprocessing.connection import Client as _MpClient
+from multiprocessing.connection import Client as _MpClient  # noqa: F401
 from multiprocessing.connection import Listener as _MpListener
+from multiprocessing.connection import answer_challenge, deliver_challenge
 from typing import Any, Callable, List, Optional, Tuple
 
 
@@ -27,6 +28,55 @@ class RpcError(Exception):
 
 class RemoteError(Exception):
     """Application-level error raised by the remote handler."""
+
+
+HANDSHAKE_TIMEOUT_S = 15.0
+
+
+def _timed_handshake(conn, authkey: bytes, *, server_side: bool,
+                     timeout: float = HANDSHAKE_TIMEOUT_S):
+    """Run the HMAC challenge with a hard deadline.
+
+    ``multiprocessing``'s challenge reads have NO timeout; worse, its
+    Listener runs the handshake inside ``accept()``, so one half-open
+    connection (a peer that connected and then stalled or died silently)
+    wedges the single accept loop and every subsequent connection to the
+    server hangs in ``answer_challenge`` forever — observed as node
+    fetch threads stuck mid-connect while pooled connections kept
+    working. A watchdog closes the connection at the deadline, which
+    unblocks the in-flight read with EOF/OSError.
+    """
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(timeout):
+            # closing the fd does NOT unblock a read already parked in
+            # another thread on Linux; shutdown() on the shared file
+            # description does (the read returns EOF)
+            try:
+                s = socket.socket(fileno=os.dup(conn.fileno()))
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                finally:
+                    s.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    threading.Thread(target=watchdog, daemon=True,
+                     name="rpc-handshake-wd").start()
+    try:
+        if server_side:
+            deliver_challenge(conn, authkey)
+            answer_challenge(conn, authkey)
+        else:
+            answer_challenge(conn, authkey)
+            deliver_challenge(conn, authkey)
+    finally:
+        done.set()
 
 
 def pick_port() -> int:
@@ -52,7 +102,10 @@ class RpcServer:
         self._authkey = authkey
         if port == 0:
             port = pick_port()
-        self._listener = _MpListener((host, port), authkey=authkey)
+        # NO authkey on the listener: accept() must return immediately
+        # after the TCP accept; the HMAC handshake runs (bounded) in the
+        # per-connection thread — see _timed_handshake
+        self._listener = _MpListener((host, port))
         self.address: Tuple[str, int] = (host, port)
         self._stop = False
         self._accept_thread = threading.Thread(
@@ -73,6 +126,14 @@ class RpcServer:
 
     def _serve_conn(self, conn):
         ctx: dict = {}
+        try:
+            _timed_handshake(conn, self._authkey, server_side=True)
+        except Exception:  # noqa: BLE001 — bad key / stalled / died
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            return
         try:
             while not self._stop:
                 msg = conn.recv()
@@ -176,7 +237,27 @@ class RpcClient:
         delay = 0.02
         while True:
             try:
-                return _MpClient(self.address, authkey=self._authkey)
+                # connect WITHOUT authkey, then run the bounded
+                # handshake ourselves — a wedged/half-dead server must
+                # not hang this thread forever (see _timed_handshake)
+                conn = _MpClient(self.address)
+                try:
+                    _timed_handshake(conn, self._authkey,
+                                     server_side=False)
+                except Exception as he:
+                    try:
+                        conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    from multiprocessing import AuthenticationError
+                    if isinstance(he, AuthenticationError):
+                        # deterministic: retrying a wrong key only
+                        # hammers the server until the deadline
+                        raise RpcError(
+                            f"authentication rejected by "
+                            f"{self.address}: {he}") from he
+                    raise OSError("authkey handshake failed/timed out")
+                return conn
             except (ConnectionRefusedError, OSError) as e:
                 if time.monotonic() >= deadline:
                     raise RpcError(

@@ -110,6 +110,11 @@ _FLAGS: List[Flag] = [
          "ones use a single fetch call. 0 disables ranged transfer."),
     Flag("fetch_parallelism", int, 4,
          "Concurrent connections per large-object fetch."),
+    Flag("push_max_inflight_bytes", int, 64 << 20,
+         "Sender-side flow control: max bytes of outbound object chunks "
+         "being copied/served concurrently per node; excess chunk "
+         "requests queue (reference: push_manager.h caps chunks in "
+         "flight on the sending side). 0 disables the cap."),
     Flag("gcs_heartbeat_interval_s", float, 0.2,
          "Node -> GCS heartbeat period (reference: "
          "raylet_report_resources_period_milliseconds)."),

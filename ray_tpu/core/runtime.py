@@ -253,6 +253,38 @@ class _ActorState:
         self.resources_acquired = False
 
 
+def _reap_stale_shm_arenas():
+    """Unlink /dev/shm arenas left by DEAD runtimes (reference: the
+    raylet cleans stale plasma files on startup). A SIGKILLed node
+    can't unlink its own arena; the name embeds the creator pid, so a
+    dead pid means garbage. Unlinking is safe even if some zombie
+    still maps the file — the mapping stays valid, only the name goes.
+    """
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith("rtpu_"):
+            continue
+        parts = name.split("_")
+        try:
+            pid = int(parts[1])
+        except (IndexError, ValueError):
+            continue
+        try:
+            os.kill(pid, 0)  # alive (or EPERM: someone else's — keep)
+            continue
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue
+        try:
+            os.unlink(os.path.join("/dev/shm", name))
+        except OSError:
+            pass
+
+
 class Runtime:
     """The driver core client. One per driver process."""
 
@@ -269,6 +301,7 @@ class Runtime:
         self._sock_path = os.path.join("/tmp", self._session + ".sock")
         self._authkey = os.urandom(16)
 
+        _reap_stale_shm_arenas()
         self.store = ShmObjectStore.create(
             "/" + self._session,
             object_store_memory or default_store_capacity(),
@@ -338,8 +371,10 @@ class Runtime:
                 self.log_dir,
                 interval_s=config.log_monitor_interval_s).start()
 
-        self._listener = Listener(self._sock_path, family="AF_UNIX",
-                                  authkey=self._authkey)
+        # no authkey on the listener: the HMAC handshake runs bounded in
+        # a per-connection thread (a child dying mid-handshake must not
+        # wedge the accept loop — see rpc._timed_handshake)
+        self._listener = Listener(self._sock_path, family="AF_UNIX")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="rtpu-accept"
         )
@@ -494,35 +529,52 @@ class Runtime:
         while not self._shutdown:
             try:
                 conn = self._listener.accept()
-                hello = conn.recv()
             except (OSError, EOFError, Exception):
                 if self._shutdown:
                     return
                 continue
-            if hello[0] != "hello":
+            threading.Thread(target=self._greet_conn, args=(conn,),
+                             daemon=True, name="rtpu-greet").start()
+
+    def _greet_conn(self, conn):
+        from ray_tpu.core.cluster.rpc import _timed_handshake
+
+        try:
+            _timed_handshake(conn, self._authkey, server_side=True)
+            hello = conn.recv()
+        except Exception:  # noqa: BLE001 — died mid-handshake
+            try:
                 conn.close()
-                continue
-            _, kind, wid_bytes = hello
-            wid = WorkerID(wid_bytes)
-            with self._lock:
-                w = self._workers.get(wid)
-            if w is None:
-                conn.close()
-                continue
-            if kind == "task":
-                w.task_conn = conn
-                w.reader = threading.Thread(
-                    target=self._worker_reader, args=(w,), daemon=True,
-                    name=f"rtpu-read-{wid.hex()[:6]}",
-                )
-                w.reader.start()
-            else:
-                w.data_conn = conn
-                w.data_thread = threading.Thread(
-                    target=self._data_server, args=(w,), daemon=True,
-                    name=f"rtpu-data-{wid.hex()[:6]}",
-                )
-                w.data_thread.start()
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        if hello[0] != "hello":
+            conn.close()
+            return
+        self._register_conn(conn, hello)
+
+    def _register_conn(self, conn, hello):
+        _, kind, wid_bytes = hello
+        wid = WorkerID(wid_bytes)
+        with self._lock:
+            w = self._workers.get(wid)
+        if w is None:
+            conn.close()
+            return
+        if kind == "task":
+            w.task_conn = conn
+            w.reader = threading.Thread(
+                target=self._worker_reader, args=(w,), daemon=True,
+                name=f"rtpu-read-{wid.hex()[:6]}",
+            )
+            w.reader.start()
+        else:
+            w.data_conn = conn
+            w.data_thread = threading.Thread(
+                target=self._data_server, args=(w,), daemon=True,
+                name=f"rtpu-data-{wid.hex()[:6]}",
+            )
+            w.data_thread.start()
 
     # --------------------------------------------------------- reader threads
 
