@@ -124,6 +124,21 @@ class _TaskSpec:
         self.parent_task: Optional[str] = None
 
 
+def _fd_readable(fd, timeout) -> bool:
+    """poll()-based readiness (select() raises ValueError for fds past
+    FD_SETSIZE=1024 — long-lived runtimes exceed it)."""
+    import select
+
+    p = select.poll()
+    p.register(fd, select.POLLIN | select.POLLERR | select.POLLHUP)
+    import math
+
+    # ceil, not truncate: selectors.py does the same so a 0.5ms wait
+    # doesn't degrade to a non-blocking poll
+    ms = None if timeout is None else max(0, math.ceil(timeout * 1000))
+    return bool(p.poll(ms))
+
+
 class _ForkedProc:
     """Popen-compatible handle for a worker forked by the zygote.
 
@@ -151,10 +166,8 @@ class _ForkedProc:
     def poll(self):
         if self.returncode is not None:
             return self.returncode
-        import select
-
-        r, _, _ = select.select([self._pidfd], [], [], 0)
-        if r:  # pidfd becomes readable when the process exits
+        if _fd_readable(self._pidfd, 0):
+            # pidfd becomes readable when the process exits
             self.returncode = -1
             os.close(self._pidfd)
             self._pidfd = None
@@ -175,12 +188,9 @@ class _ForkedProc:
         self._signal(signal.SIGKILL)
 
     def wait(self, timeout=None):
-        import select
-
         if self.returncode is not None:
             return self.returncode
-        r, _, _ = select.select([self._pidfd], [], [], timeout)
-        if not r:
+        if not _fd_readable(self._pidfd, timeout):
             raise subprocess.TimeoutExpired("forked-worker", timeout)
         self.returncode = -1
         os.close(self._pidfd)
@@ -428,7 +438,7 @@ class Runtime:
         return env
 
     def _start_zygote_locked(self):
-        # bufsize=0: replies are read through select(), which must never
+        # bufsize=0: replies are read through poll(), which must never
         # be defeated by data parked in a userspace buffer
         self._zygote = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main", "--zygote"],
@@ -446,7 +456,6 @@ class Runtime:
         """Ask the zygote for a forked worker; returns the pid or None
         (zygote unavailable — caller cold-spawns)."""
         import json
-        import select
 
         with self._zygote_lock:
             z = self._zygote
@@ -462,16 +471,15 @@ class Runtime:
             try:
                 if not self._zygote_ready:
                     # first use: wait for the warm-import banner
-                    r, _, _ = select.select([z.stdout], [], [], 30.0)
-                    if not r or b"ZYGOTE_READY" not in z.stdout.readline():
+                    if not _fd_readable(z.stdout, 30.0) or \
+                            b"ZYGOTE_READY" not in z.stdout.readline():
                         raise RuntimeError("zygote never became ready")
                     self._zygote_ready = True
                 req = {"wid": worker_id.hex(), "env": extra_env or {},
                        "out": out_path, "err": err_path}
                 z.stdin.write((json.dumps(req) + "\n").encode())
                 z.stdin.flush()
-                r, _, _ = select.select([z.stdout], [], [], 30.0)
-                if not r:
+                if not _fd_readable(z.stdout, 30.0):
                     raise RuntimeError("zygote fork timed out")
                 return int(z.stdout.readline())
             except Exception:  # noqa: BLE001 — zygote wedged: drop it
