@@ -45,6 +45,13 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     attn_impl: str = "auto"  # auto | flash | reference | ring
     remat: bool = True
+    # partial remat: this many TRAILING layers store activations instead
+    # of recomputing (HBM for FLOPs; 0 = classic full per-layer remat).
+    # Caveats: the head/tail split slices the stacked layer params, which
+    # XLA may materialize as a duplicate of the stack — budget for it;
+    # measured neutral-to-NEGATIVE on v5e-lite at 1B (BENCH_NOTES.md),
+    # aimed at HBM-rich parts; sequential forward only (pp raises).
+    remat_store_layers: int = 0
     tie_embeddings: bool = False
     # optional llama3-style long-context rope scaling (the HF
     # rope_scaling dict; see ops/layers.rope_frequencies)
@@ -200,13 +207,29 @@ def forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
                                 scaling=cfg.rope_scaling_dict)
 
     layer_fn = lambda x_, p_: _layer(cfg, x_, p_, cos, sin, mesh=mesh)
-    if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+    ckpt_fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
 
-    def scan_body(x_, p_):
-        return layer_fn(x_, p_), None
+    def scan_ckpt(x_, p_):
+        return ckpt_fn(x_, p_), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    n_store = min(cfg.remat_store_layers, cfg.num_layers) \
+        if cfg.remat else 0
+    if n_store <= 0:
+        x, _ = jax.lax.scan(scan_ckpt, x, params["layers"])
+    else:
+        # Partial remat: the LAST n_store layers keep their internal
+        # activations (no recompute in their backward) — recompute cost
+        # drops by n_store/num_layers of a forward pass, paid in HBM.
+        # Late layers are the right ones to store: their recompute would
+        # otherwise sit on the critical path at the START of backward.
+        split = cfg.num_layers - n_store
+        head = jax.tree_util.tree_map(lambda a: a[:split],
+                                      params["layers"])
+        tail = jax.tree_util.tree_map(lambda a: a[split:],
+                                      params["layers"])
+        x, _ = jax.lax.scan(scan_ckpt, x, head)
+        x, _ = jax.lax.scan(lambda x_, p_: (layer_fn(x_, p_), None),
+                            x, tail)
     return _final_head(cfg, params, x)
 
 
@@ -258,6 +281,11 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
     usual rules); only the decoder blocks pipeline. num_microbatches must
     divide the batch and should be >> pp to amortize the bubble.
     """
+    if cfg.remat_store_layers:
+        raise ValueError(
+            "remat_store_layers applies to the sequential forward only; "
+            "under pipeline parallelism every stage is fully "
+            "rematerialized (a silent no-op here would mislead tuning)")
     from jax.sharding import PartitionSpec as P
 
     shard_map = jax.shard_map
