@@ -59,6 +59,7 @@ from ray_tpu.data.grouped import (  # noqa: F401
 )
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
 from ray_tpu.data import preprocessors  # noqa: F401
+from ray_tpu.data.expr import col, lit  # noqa: F401
 from ray_tpu.data.logical import ActorPoolStrategy, TaskPoolStrategy  # noqa: F401
 
 __all__ = [
@@ -67,7 +68,7 @@ __all__ = [
     "Datasource", "ReadTask",
     "ActorPoolStrategy", "TaskPoolStrategy",
     "AggregateFn", "Sum", "Min", "Max", "Mean", "Count", "Std",
-    "GroupedData", "preprocessors",
+    "GroupedData", "preprocessors", "col", "lit",
     "range", "range_tensor", "from_items", "from_numpy", "from_arrow",
     "from_pandas", "from_blocks", "from_torch", "from_huggingface",
     "read_datasource", "read_parquet",

@@ -99,8 +99,59 @@ class Dataset:
     def flat_map(self, fn: Callable, **kw) -> "Dataset":
         return self._map("FlatMap", "flat_map", fn, **kw)
 
-    def filter(self, fn: Callable, **kw) -> "Dataset":
+    def filter(self, fn: Optional[Callable] = None, *, expr=None,
+               **kw) -> "Dataset":
+        """Keep rows where ``fn(row)`` (or the vectorized ``expr``) is
+        true. Expressions evaluate batch-at-once AND advertise their
+        columns to the optimizer (reference: Dataset.filter(expr=...))."""
+        from ray_tpu.data.expr import Expr
+
+        if isinstance(fn, Expr) and expr is None:
+            fn, expr = None, fn
+        if fn is None and expr is None:
+            raise ValueError("filter() needs a row fn or an expr")
+        if expr is not None:
+            if fn is not None:
+                raise ValueError("pass fn OR expr, not both")
+
+            def mask(batch, _e=expr):
+                m = np.asarray(_e.eval(batch), bool)
+                return {k: np.asarray(v)[m] for k, v in batch.items()}
+
+            ds = self._map(f"Filter[{expr!r}]", "map_batches", mask,
+                           batch_format="numpy", **kw)
+            ds._logical_op.expr_columns = tuple(sorted(expr.columns()))
+            return ds
         return self._map("Filter", "filter", fn, **kw)
+
+    def with_column(self, name: str, expr) -> "Dataset":
+        """Add/replace a column from an expression (reference:
+        Dataset.with_column)."""
+        return self.with_columns({name: expr})
+
+    def with_columns(self, exprs: Dict[str, Any]) -> "Dataset":
+        from ray_tpu.data.expr import Expr
+
+        for k, e in exprs.items():
+            if not isinstance(e, Expr):
+                raise TypeError(f"{k}: expected an Expr, got {type(e)}")
+
+        def add(batch, _es=tuple(exprs.items())):
+            out = dict(batch)
+            n = len(next(iter(batch.values()))) if batch else 0
+            for k, e in _es:
+                v = np.asarray(e.eval(batch))
+                if v.ndim == 0:  # scalar literal: broadcast to the batch
+                    v = np.full(n, v[()])
+                out[k] = v
+            return out
+
+        ds = self._map(f"WithColumns{list(exprs)}", "map_batches", add,
+                       batch_format="numpy")
+        used = frozenset().union(*(e.columns() for e in exprs.values()))
+        ds._logical_op.expr_columns = tuple(sorted(used))
+        ds._logical_op.produces = tuple(exprs)
+        return ds
 
     def add_column(self, col: str, fn: Callable) -> "Dataset":
         def add(batch: Dict[str, np.ndarray], _fn=fn, _col=col):
@@ -119,8 +170,12 @@ class Dataset:
     def select_columns(self, cols: List[str]) -> "Dataset":
         def select(batch: Dict[str, np.ndarray], _cols=tuple(cols)):
             return {k: batch[k] for k in _cols}
-        return self._map("SelectColumns", "map_batches", select,
-                         batch_format="numpy")
+        ds = self._map("SelectColumns", "map_batches", select,
+                       batch_format="numpy")
+        # advertised projection: the optimizer pushes it into
+        # column-prunable reads (optimizer.py: ProjectionPushdown)
+        ds._logical_op.projection = tuple(cols)
+        return ds
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         # Arrow-level rename: zero-copy, and keeps tensor_shape:<name>

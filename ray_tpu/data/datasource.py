@@ -167,10 +167,19 @@ class ParquetDatasource(FileDatasource):
     size_multiplier = 5.0  # columnar compression expands in memory
 
     suffixes = [".parquet"]
+    supports_column_pruning = True
 
     def __init__(self, paths, columns: Optional[List[str]] = None):
         super().__init__(paths)
         self._columns = columns
+
+    def with_columns(self, columns: List[str]) -> "ParquetDatasource":
+        """Pruned clone (projection pushdown target)."""
+        import copy
+
+        out = copy.copy(self)
+        out._columns = list(columns)
+        return out
 
     def read_file(self, path: str):
         import pyarrow.parquet as pq

@@ -1,0 +1,207 @@
+"""Column expressions: declarative row logic the optimizer can read.
+
+Reference: the Ray Data expression surface (ray.data.expressions —
+``col``/``lit`` combining into vectorized predicates and projections).
+A lambda is opaque; an ``Expr`` exposes exactly which columns it
+touches (``columns()``), so plans built from expressions feed the
+projection-pushdown rule (optimizer.py: ProjectionPushdown) and file
+readers prune columns at the source.
+
+Usage::
+
+    from ray_tpu.data.expr import col, lit
+
+    ds.filter(expr=(col("age") >= 18) & (col("country") == "DE"))
+    ds.with_column("usd", col("cents") / 100.0)
+    ds.select_columns(["usd"])   # parquet read prunes to {cents}
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Dict, FrozenSet
+
+import numpy as np
+
+
+class Expr:
+    """A vectorized expression over one batch (dict of numpy columns)."""
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        raise NotImplementedError
+
+    def columns(self) -> FrozenSet[str]:
+        raise NotImplementedError
+
+    # -- operator sugar ---------------------------------------------------
+    def _bin(self, op, other, symbol: str, reflected: bool = False):
+        other = other if isinstance(other, Expr) else Literal(other)
+        return (BinaryOp(op, other, self, symbol) if reflected
+                else BinaryOp(op, self, other, symbol))
+
+    def __add__(self, o):
+        return self._bin(operator.add, o, "+")
+
+    def __radd__(self, o):
+        return self._bin(operator.add, o, "+", True)
+
+    def __sub__(self, o):
+        return self._bin(operator.sub, o, "-")
+
+    def __rsub__(self, o):
+        return self._bin(operator.sub, o, "-", True)
+
+    def __mul__(self, o):
+        return self._bin(operator.mul, o, "*")
+
+    def __rmul__(self, o):
+        return self._bin(operator.mul, o, "*", True)
+
+    def __truediv__(self, o):
+        return self._bin(operator.truediv, o, "/")
+
+    def __rtruediv__(self, o):
+        return self._bin(operator.truediv, o, "/", True)
+
+    def __floordiv__(self, o):
+        return self._bin(operator.floordiv, o, "//")
+
+    def __mod__(self, o):
+        return self._bin(operator.mod, o, "%")
+
+    def __pow__(self, o):
+        return self._bin(operator.pow, o, "**")
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin(operator.eq, o, "==")
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._bin(operator.ne, o, "!=")
+
+    def __lt__(self, o):
+        return self._bin(operator.lt, o, "<")
+
+    def __le__(self, o):
+        return self._bin(operator.le, o, "<=")
+
+    def __gt__(self, o):
+        return self._bin(operator.gt, o, ">")
+
+    def __ge__(self, o):
+        return self._bin(operator.ge, o, ">=")
+
+    def __and__(self, o):
+        return self._bin(np.logical_and, o, "&")
+
+    def __or__(self, o):
+        return self._bin(np.logical_or, o, "|")
+
+    def __invert__(self):
+        return UnaryOp(np.logical_not, self, "~")
+
+    def __neg__(self):
+        return UnaryOp(operator.neg, self, "-")
+
+    def __abs__(self):
+        return UnaryOp(np.abs, self, "abs")
+
+    def abs(self):
+        return UnaryOp(np.abs, self, "abs")
+
+    def is_null(self):
+        return UnaryOp(lambda a: np.asarray(
+            [v is None or (isinstance(v, float) and np.isnan(v))
+             for v in np.asarray(a).ravel().tolist()])
+            if np.asarray(a).dtype == object else np.isnan(a),
+            self, "is_null")
+
+    def isin(self, values):
+        vals = tuple(values)
+        return UnaryOp(lambda a: np.isin(a, np.asarray(vals)),
+                       self, f"isin{vals!r}")
+
+    def cast(self, dtype):
+        return UnaryOp(lambda a, _d=np.dtype(dtype): a.astype(_d),
+                       self, f"cast[{dtype}]")
+
+    # hashability: __eq__ builds an Expr, so default hashing breaks;
+    # identity hash keeps Exprs usable in dicts/sets
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        raise TypeError(
+            "an Expr has no truth value — use & | ~ for boolean logic "
+            "(Python's `and`/`or` cannot be overloaded)")
+
+
+class Column(Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+    def eval(self, batch):
+        return np.asarray(batch[self.name])
+
+    def columns(self):
+        return frozenset({self.name})
+
+    def __repr__(self):
+        return f"col({self.name!r})"
+
+
+class Literal(Expr):
+    def __init__(self, value: Any):
+        self.value = value
+
+    def eval(self, batch):
+        return self.value
+
+    def columns(self):
+        return frozenset()
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+class BinaryOp(Expr):
+    def __init__(self, op: Callable, left: Expr, right: Expr,
+                 symbol: str):
+        self.op = op
+        self.left = left
+        self.right = right
+        self.symbol = symbol
+
+    def eval(self, batch):
+        return self.op(self.left.eval(batch), self.right.eval(batch))
+
+    def columns(self):
+        return self.left.columns() | self.right.columns()
+
+    def __repr__(self):
+        return f"({self.left!r} {self.symbol} {self.right!r})"
+
+
+class UnaryOp(Expr):
+    def __init__(self, op: Callable, operand: Expr, symbol: str):
+        self.op = op
+        self.operand = operand
+        self.symbol = symbol
+
+    def eval(self, batch):
+        return self.op(self.operand.eval(batch))
+
+    def columns(self):
+        return self.operand.columns()
+
+    def __repr__(self):
+        return f"{self.symbol}({self.operand!r})"
+
+
+def col(name: str) -> Column:
+    """Reference a column (reference: ray.data.expressions.col)."""
+    return Column(name)
+
+
+def lit(value: Any) -> Literal:
+    """A constant (reference: ray.data.expressions.lit)."""
+    return Literal(value)

@@ -91,7 +91,62 @@ class LimitPushdown(Rule):
         return self._rewrite(root, fn)
 
 
-_DEFAULT_RULES: List[Type[Rule]] = [MergeLimits, LimitPushdown]
+class ProjectionPushdown(Rule):
+    """Prune file reads to the columns the plan actually consumes
+    (reference: the planner pushing projections into ParquetDatasource).
+
+    Pattern: SelectColumns (op tagged with ``projection``) above a chain
+    of expression-built maps (tagged ``expr_columns`` / ``produces``)
+    above a column-prunable Read. The read is rewired to a pruned clone
+    of the datasource; columns PRODUCED by expressions along the way are
+    excluded from the file read (they don't exist in the file)."""
+
+    def apply(self, root):
+        def fn(node):
+            proj = getattr(node, "projection", None)
+            if not (isinstance(node, L.AbstractMap) and proj):
+                return node
+            needed = set(proj)
+            chain = []
+            cur = node.inputs[0] if node.inputs else None
+            while (isinstance(cur, L.AbstractMap)
+                   and getattr(cur, "expr_columns", None) is not None):
+                needed -= set(getattr(cur, "produces", ()))
+                needed |= set(cur.expr_columns)
+                chain.append(cur)
+                cur = cur.inputs[0] if cur.inputs else None
+            if not needed:
+                # e.g. every selected column is expression-produced: a
+                # zero-column read would yield empty batches
+                return node
+            if not (isinstance(cur, L.Read)
+                    and getattr(cur.datasource,
+                                "supports_column_pruning", False)
+                    and cur.datasource._columns is None):
+                return node
+            import copy
+
+            read2 = copy.copy(cur)
+            read2.datasource = cur.datasource.with_columns(sorted(needed))
+            read2.name = f"{cur.name}[{sorted(needed)}]"
+            # chain members may be memoized clones SHARED with sibling
+            # branches (diamond plans) — rewire fresh copies so the
+            # other branches keep the unpruned read (same hazard
+            # LimitPushdown documents)
+            new_chain = [copy.copy(m) for m in chain]
+            for a, b in zip(new_chain[:-1], new_chain[1:]):
+                a.inputs = [b]
+            if new_chain:
+                node.inputs = [new_chain[0]]
+                new_chain[-1].inputs = [read2]
+            else:
+                node.inputs = [read2]
+            return node
+        return self._rewrite(root, fn)
+
+
+_DEFAULT_RULES: List[Type[Rule]] = [MergeLimits, LimitPushdown,
+                                    ProjectionPushdown]
 _EXTRA_RULES: List[Type[Rule]] = []
 
 
