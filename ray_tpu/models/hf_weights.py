@@ -48,16 +48,17 @@ def _parse_rope_scaling(hf_cfg):
         (k, v) for k, v in scaling.items() if v is not None))
 
 
-def llama_config_from_hf(hf_cfg) -> "Any":
+def llama_config_from_hf(hf_cfg, attn_qkv_bias: bool = False) -> "Any":
     from ray_tpu.models.llama import LlamaConfig
 
     rope_scaling = _parse_rope_scaling(hf_cfg)
-    if getattr(hf_cfg, "attention_bias", False) \
-            or getattr(hf_cfg, "mlp_bias", False):
+    if not attn_qkv_bias and (getattr(hf_cfg, "attention_bias", False)
+                              or getattr(hf_cfg, "mlp_bias", False)):
         raise ValueError(
             "unsupported HF config: attention_bias/mlp_bias checkpoints "
             "carry bias tensors this model has no slots for")
     return LlamaConfig(
+        attn_qkv_bias=attn_qkv_bias,
         vocab_size=hf_cfg.vocab_size,
         hidden_size=hf_cfg.hidden_size,
         intermediate_size=hf_cfg.intermediate_size,
@@ -279,3 +280,79 @@ def mixtral_from_hf(source, dtype=None, capacity_factor=None
         stacked["e_down"].append(np.stack(
             [lin(f"{moe}experts.{e}.w2.weight") for e in range(E)]))
     return cfg, _assemble(cfg, stacked, t, lin, pd)
+
+
+def qwen2_from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
+    """(cfg, params) from a transformers Qwen2ForCausalLM (or checkpoint
+    path/model id). Qwen2 IS the llama block plus additive q/k/v biases
+    (cfg.attn_qkv_bias), so the mapping is llama's + three bias stacks;
+    o_proj/mlp remain bias-free and anything else refuses."""
+    if isinstance(source, str):
+        from transformers import Qwen2ForCausalLM
+
+        source = Qwen2ForCausalLM.from_pretrained(source)
+    hf_cfg = source.config
+    sw = getattr(hf_cfg, "sliding_window", None)
+    if getattr(hf_cfg, "use_sliding_window", False) and sw is not None \
+            and sw < hf_cfg.max_position_embeddings:
+        raise ValueError(
+            f"unsupported HF config: sliding_window={sw} (full causal "
+            f"attention only)")
+    from dataclasses import replace
+
+    cfg = llama_config_from_hf(hf_cfg, attn_qkv_bias=True)
+    if dtype is not None:
+        cfg = replace(cfg, param_dtype=dtype)
+    sd = source.state_dict()
+    bad = [k for k in sd if k.endswith(("o_proj.bias", "gate_proj.bias",
+                                        "up_proj.bias", "down_proj.bias"))]
+    if bad:
+        raise ValueError(
+            f"unsupported checkpoint: unexpected bias {bad[0]} (qwen2 "
+            f"carries biases on q/k/v only)")
+    t, lin = _fetcher(sd)
+    pd = cfg.param_dtype
+    stacked: Dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate",
+        "w_up", "w_down", "bq", "bk", "bv")}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        _stack_attn(stacked, t, lin, p)
+        stacked["bq"].append(t(p + "self_attn.q_proj.bias"))
+        stacked["bk"].append(t(p + "self_attn.k_proj.bias"))
+        stacked["bv"].append(t(p + "self_attn.v_proj.bias"))
+        stacked["w_gate"].append(lin(p + "mlp.gate_proj.weight"))
+        stacked["w_up"].append(lin(p + "mlp.up_proj.weight"))
+        stacked["w_down"].append(lin(p + "mlp.down_proj.weight"))
+    return cfg, _assemble(cfg, stacked, t, lin, pd)
+
+
+def hf_model_type(source) -> str:
+    """The checkpoint's ``model_type`` WITHOUT loading weights (config
+    only for a path/id) — callers can refuse unsupported architectures
+    before paying a multi-GB download/instantiation."""
+    if isinstance(source, str):
+        from transformers import AutoConfig
+
+        return AutoConfig.from_pretrained(source).model_type
+    return source.config.model_type
+
+
+def from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
+    """Architecture-dispatching loader: llama / qwen2 / mixtral / gpt2
+    by the checkpoint's ``model_type`` (reference role: engines resolve
+    HF ids via AutoConfig). Accepts a model instance or a path/id."""
+    if isinstance(source, str):
+        from transformers import AutoConfig
+
+        model_type = AutoConfig.from_pretrained(source).model_type
+    else:
+        model_type = source.config.model_type
+    loader = {"llama": llama_from_hf, "qwen2": qwen2_from_hf,
+              "mixtral": mixtral_from_hf, "gpt2": gpt2_from_hf}.get(
+        model_type)
+    if loader is None:
+        raise ValueError(
+            f"unsupported HF model_type {model_type!r} "
+            f"(implemented: llama, qwen2, mixtral, gpt2)")
+    return loader(source, dtype=dtype)

@@ -44,6 +44,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     attn_impl: str = "auto"  # auto | flash | reference | ring
+    # Qwen2-style additive q/k/v projection biases (the ONLY
+    # architectural delta between Qwen2 and Llama at this level)
+    attn_qkv_bias: bool = False
     remat: bool = True
     # partial remat: this many TRAILING layers store activations instead
     # of recomputing (HBM for FLOPs; 0 = classic full per-layer remat).
@@ -102,6 +105,9 @@ def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
             "w_gate": L + ("embed", "mlp"),
             "w_up": L + ("embed", "mlp"),
             "w_down": L + ("mlp", "embed"),
+            # qkv biases shard with their projections' column split
+            **({"bq": L + ("qkv",), "bk": L + ("qkv",),
+                "bv": L + ("qkv",)} if cfg.attn_qkv_bias else {}),
         },
         "final_norm": ("embed",),
         # tied embeddings reuse params["embed"]; no separate lm_head leaf
@@ -136,6 +142,10 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
         },
         "final_norm": jnp.ones((h,), cfg.param_dtype),
     }
+    if cfg.attn_qkv_bias:
+        params["layers"]["bq"] = jnp.zeros((L, qd), cfg.param_dtype)
+        params["layers"]["bk"] = jnp.zeros((L, kvd), cfg.param_dtype)
+        params["layers"]["bv"] = jnp.zeros((L, kvd), cfg.param_dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = norm_init(
             jax.random.fold_in(key, 99), (h, cfg.vocab_size), h)
@@ -174,6 +184,10 @@ def attention_block(cfg: LlamaConfig, x, p, cos, sin, mesh=None,
                 preferred_element_type=jnp.float32).astype(cfg.dtype)
     v = jnp.dot(h1, p["wv"].astype(cfg.dtype),
                 preferred_element_type=jnp.float32).astype(cfg.dtype)
+    if "bq" in p:  # Qwen2-style qkv biases (structure is trace-static)
+        q = q + p["bq"].astype(cfg.dtype)
+        k = k + p["bk"].astype(cfg.dtype)
+        v = v + p["bv"].astype(cfg.dtype)
     q = q.reshape(b, s, cfg.num_heads, hd)
     k = k.reshape(b, s, cfg.num_kv_heads, hd)
     v = v.reshape(b, s, cfg.num_kv_heads, hd)

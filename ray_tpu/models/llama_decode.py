@@ -64,6 +64,10 @@ def _project_qkv(cfg: LlamaConfig, p, x):
                 preferred_element_type=jnp.float32).astype(cfg.dtype)
     v = jnp.dot(h1, p["wv"].astype(cfg.dtype),
                 preferred_element_type=jnp.float32).astype(cfg.dtype)
+    if "bq" in p:  # Qwen2-style qkv biases
+        q = q + p["bq"].astype(cfg.dtype)
+        k = k + p["bk"].astype(cfg.dtype)
+        v = v + p["bv"].astype(cfg.dtype)
     return (q.reshape(b, s, cfg.num_heads, hd),
             k.reshape(b, s, cfg.num_kv_heads, hd),
             v.reshape(b, s, cfg.num_kv_heads, hd), h1)

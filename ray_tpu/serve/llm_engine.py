@@ -72,9 +72,15 @@ class LLMEngine:
             # (models/hf_weights.py maps the state dict onto our pytree)
             from dataclasses import replace as _replace
 
-            from ray_tpu.models.hf_weights import llama_from_hf
+            from ray_tpu.models.hf_weights import from_hf, hf_model_type
 
-            cfg, hf_params = llama_from_hf(
+            # refuse BEFORE from_hf materializes a multi-GB checkpoint
+            mt = hf_model_type(hf_model)
+            if mt not in ("llama", "qwen2"):
+                raise ValueError(
+                    "the continuous-batching engine serves llama-family "
+                    f"dense checkpoints (llama/qwen2); got {mt!r}")
+            cfg, hf_params = from_hf(
                 hf_model, dtype=cfg_kw.pop("param_dtype", None))
             cfg = _replace(cfg, **cfg_kw)
         else:

@@ -714,3 +714,44 @@ def test_pipeline_deployment_cross_node_stages():
     finally:
         runtime_context.set_core(prev)
         c.shutdown()
+
+
+def test_llm_engine_serves_qwen2_checkpoint(rt, tmp_path):
+    """The engine auto-dispatches on model_type: a Qwen2 checkpoint
+    (llama + qkv biases) decodes token-identically to HF generate()."""
+    import time as _time
+
+    import jax.numpy as jnp
+    import torch
+    from transformers import Qwen2Config as HFConfig, Qwen2ForCausalLM
+
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    torch.manual_seed(0)
+    hf = Qwen2ForCausalLM(HFConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=False)).eval()
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0, 0.5)
+    hf.save_pretrained(str(tmp_path))
+
+    eng = LLMEngine(model_config={"hf_model": str(tmp_path),
+                                  "dtype": "float32",
+                                  "param_dtype": jnp.float32},
+                    num_slots=2, max_len=32, prefill_buckets=[8],
+                    max_new_tokens=6, chunk_steps=2)
+    eng.submit("r", [5, 3, 7], 6)
+    out = {}
+    deadline = _time.time() + 120
+    while "r" not in out and _time.time() < deadline:
+        out.update(eng.collect())
+        _time.sleep(0.01)
+    eng.shutdown()
+    ref = hf.generate(torch.tensor([[5, 3, 7]]), max_new_tokens=6,
+                      do_sample=False)[0, 3:].tolist()
+    assert out["r"]["tokens"] == ref, (out["r"]["tokens"], ref)
