@@ -9,7 +9,8 @@ fans one execution out to a gang of Train workers.
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
 from ray_tpu.data.context import DataContext  # noqa: F401
-from ray_tpu.data.dataset import (  # noqa: F401
+from ray_tpu.data.dataset import (
+    read_delta,  # noqa: F401
     Dataset,
     MaterializedDataset,
     from_arrow,
@@ -74,5 +75,5 @@ __all__ = [
     "read_datasource", "read_parquet",
     "read_csv", "read_json", "read_numpy", "read_text",
     "read_binary_files", "read_tfrecords", "read_webdataset", "read_sql",
-    "read_images", "read_avro", "read_bigquery",
+    "read_images", "read_avro", "read_bigquery", "read_delta",
 ] + list(_CLOUD_SOURCES)

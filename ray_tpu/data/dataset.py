@@ -474,6 +474,28 @@ class Dataset:
     def write_numpy(self, path: str, **kw):
         return self._write(path, "npy", **kw)
 
+    def write_tfrecords(self, path: str, column: str = "bytes"):
+        """Write one TFRecord file per block from a bytes column, with
+        valid masked CRC-32C framing (reference: Dataset.write_tfrecords;
+        interoperable with TensorFlow readers)."""
+        import os as _os
+
+        from ray_tpu.data.datasource import write_tfrecords_file
+
+        _os.makedirs(path, exist_ok=True)
+
+        @ray_tpu.remote
+        def _write_one(blocks, idx, path=path, column=column):
+            out = _os.path.join(path, f"part-{idx:05d}.tfrecords")
+            recs = []
+            for b in blocks:
+                recs.extend(BlockAccessor(b).to_numpy()[column].tolist())
+            return write_tfrecords_file(recs, out)
+
+        refs = [_write_one.remote(bundle.blocks_ref, i)
+                for i, bundle in enumerate(self._execute_bundles())]
+        return sum(ray_tpu.get(refs))
+
     # ---- conversions ----
 
     def to_pandas(self, limit: Optional[int] = None):
@@ -634,3 +656,15 @@ def read_bigquery(project_id: str, dataset: str = None, query: str = None,
     return read_datasource(
         BigQueryDatasource(project_id, dataset=dataset, query=query),
         parallelism=parallelism)
+
+
+def read_delta(table_path: str, *, columns=None,
+               parallelism: int = -1) -> Dataset:
+    """Read the current snapshot of a Delta Lake table — implemented
+    in-tree over the open table format (JSON transaction log + parquet
+    checkpoint replay), no deltalake dependency (reference:
+    read_delta/delta sharing datasources)."""
+    from ray_tpu.data.datasource import DeltaDatasource
+
+    return read_datasource(DeltaDatasource(table_path, columns=columns),
+                           parallelism=parallelism)

@@ -666,7 +666,6 @@ def write_bigquery_block(block: Block, project_id: str, dataset: str
 _CLOUD_SOURCES = {
     "read_lance": "lance",
     "read_iceberg": "pyiceberg",
-    "read_delta": "deltalake",
     "read_mongo": "pymongo",
     "read_databricks_tables": "databricks.sql",
     "read_clickhouse": "clickhouse_connect",
@@ -734,3 +733,187 @@ def _json_safe(v):
     if isinstance(v, bytes):
         return v.decode("utf-8", errors="replace")
     return v
+
+
+class DeltaDatasource(Datasource):
+    """Delta Lake table reader, dependency-free (reference:
+    _internal/datasource/delta_sharing_datasource.py fills this role via
+    the deltalake lib; the table format itself is open: a parquet data
+    set plus a JSON transaction log). Reconstructs the CURRENT snapshot:
+    parquet checkpoint (if any) + JSON commits after it, applying
+    add/remove actions in order. Time travel / deletion vectors /
+    column mapping are out of scope and refuse loudly."""
+
+    def __init__(self, table_path: str,
+                 columns: Optional[List[str]] = None):
+        if "://" in table_path and not table_path.startswith("file://"):
+            raise ValueError(
+                f"read_delta reads local filesystems (got "
+                f"{table_path!r}); mount or sync the table locally")
+        if table_path.startswith("file://"):
+            table_path = table_path[len("file://"):]
+        self._root = table_path.rstrip("/")
+        self._columns = columns
+        self._files = self._live_files()
+
+    def get_name(self):
+        return "Delta"
+
+    # -- log replay -------------------------------------------------------
+    def _log_dir(self):
+        return os.path.join(self._root, "_delta_log")
+
+    def _live_files(self) -> List[str]:
+        import json
+
+        log = self._log_dir()
+        if not os.path.isdir(log):
+            raise FileNotFoundError(
+                f"{self._root} is not a Delta table (no _delta_log)")
+        ckpt_version = -1
+        ckpt_parts: List[str] = []
+        lc = os.path.join(log, "_last_checkpoint")
+        if os.path.exists(lc):
+            meta = json.load(open(lc))
+            ckpt_version = int(meta["version"])
+            parts = int(meta.get("parts") or 1)
+            if parts == 1:
+                ckpt_parts = [os.path.join(
+                    log, f"{ckpt_version:020d}.checkpoint.parquet")]
+            else:
+                ckpt_parts = [os.path.join(
+                    log, f"{ckpt_version:020d}.checkpoint."
+                         f"{i + 1:010d}.{parts:010d}.parquet")
+                    for i in range(parts)]
+        live: Dict[str, None] = {}
+
+        def check_metadata(md):
+            if md and (md.get("configuration") or {}).get(
+                    "delta.columnMapping.mode", "none") != "none":
+                raise ValueError(
+                    "unsupported Delta feature: column mapping")
+
+        def check_protocol(proto):
+            if proto and int(proto.get("minReaderVersion") or 1) > 1:
+                feats = proto.get("readerFeatures") or []
+                raise ValueError(
+                    f"unsupported Delta protocol: minReaderVersion="
+                    f"{proto.get('minReaderVersion')} "
+                    f"(readerFeatures={feats}) — this reader implements "
+                    f"version 1 (plain parquet + log)")
+
+        for part in ckpt_parts:
+            import pyarrow.parquet as pq
+
+            tbl = pq.read_table(part)
+            cols = tbl.to_pydict()
+            # metaData/protocol actions usually live IN the checkpoint
+            # once one exists — gate there too, not just in JSON commits
+            for md in cols.get("metaData") or []:
+                check_metadata(md)
+            for proto in cols.get("protocol") or []:
+                check_protocol(proto)
+            for add in cols.get("add") or []:
+                if add and add.get("path"):
+                    if add.get("deletionVector"):
+                        raise ValueError(
+                            "unsupported Delta feature: deletion vectors")
+                    live[add["path"]] = None
+        commits = sorted(
+            f for f in os.listdir(log)
+            if f.endswith(".json") and f[:20].isdigit()
+            and int(f[:20]) > ckpt_version)
+        for name in commits:
+            with open(os.path.join(log, name)) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    action = json.loads(line)
+                    if "add" in action:
+                        a = action["add"]
+                        if a.get("deletionVector"):
+                            raise ValueError(
+                                "unsupported Delta feature: deletion "
+                                "vectors")
+                        live[a["path"]] = None
+                    elif "remove" in action:
+                        live.pop(action["remove"]["path"], None)
+                    elif "metaData" in action:
+                        check_metadata(action["metaData"])
+                    elif "protocol" in action:
+                        check_protocol(action["protocol"])
+        from urllib.parse import unquote
+
+        return [os.path.join(self._root, unquote(p)) for p in live]
+
+    # -- datasource surface ----------------------------------------------
+    def estimate_inmemory_data_size(self):
+        try:
+            return int(sum(os.path.getsize(p) for p in self._files) * 5.0)
+        except OSError:
+            return None
+
+    def get_read_tasks(self, parallelism: int) -> List["ReadTask"]:
+        groups = [self._files[i::parallelism] for i in range(parallelism)]
+        groups = [g for g in groups if g]
+        out = []
+        for g in groups:
+            def read(paths=tuple(g), cols=self._columns):
+                import pyarrow.parquet as pq
+
+                for p in paths:
+                    yield pq.read_table(p, columns=cols)
+            out.append(ReadTask(read, BlockMetadata(
+                num_rows=None, size_bytes=None, schema=None,
+                input_files=list(g))))
+        return out
+
+
+def _crc32c(data: bytes) -> int:
+    """Software CRC-32C (Castagnoli) — the TFRecord framing checksum."""
+    table = _crc32c_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+_CRC32C_TABLE: Optional[List[int]] = None
+
+
+def _crc32c_table():
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        poly = 0x82F63B78
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            tbl.append(c)
+        _CRC32C_TABLE = tbl
+    return _CRC32C_TABLE
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+def write_tfrecords_file(records, path: str) -> int:
+    """Write raw byte records in TFRecord framing WITH valid masked
+    CRC-32C checksums (interoperable with TensorFlow readers; the
+    in-repo reader skips checksum verification). Returns record count."""
+    import struct
+
+    n = 0
+    with open(path, "wb") as f:
+        for rec in records:
+            rec = bytes(rec)
+            header = struct.pack("<Q", len(rec))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(rec)
+            f.write(struct.pack("<I", _masked_crc(rec)))
+            n += 1
+    return n
