@@ -12,7 +12,8 @@ torch; this is the flagship model the north-star configs name):
 - ``jax.checkpoint`` around each layer (rematerialization: HBM traded for
   FLOPs on the backward pass).
 - attention backend switch: "flash" (Pallas), "reference" (XLA), "ring"
-  (sequence-parallel over the sp axis).
+  (sequence-parallel over the sp axis, KV blocks rotating on the ICI
+  ring), "ulysses" (sequence-parallel via all-to-all head re-sharding).
 """
 
 from __future__ import annotations
@@ -43,7 +44,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    attn_impl: str = "auto"  # auto | flash | reference | ring
+    attn_impl: str = "auto"  # auto | flash | reference | ring | ulysses
     # Qwen2-style additive q/k/v projection biases (the ONLY
     # architectural delta between Qwen2 and Llama at this level)
     attn_qkv_bias: bool = False
@@ -152,27 +153,36 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
     return params
 
 
-def _attend(cfg: LlamaConfig, q, k, v, mesh=None, ring_axis=None):
+def _attend(cfg: LlamaConfig, q, k, v, mesh=None, seq_axis=None):
     impl = cfg.attn_impl
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "reference"
     if impl == "flash":
         return flash_attention(q, k, v, causal=True)
-    if impl == "ring":
-        if ring_axis is not None:
+    if impl in ("ring", "ulysses"):
+        if seq_axis is not None:
             # already INSIDE a shard_map that includes the sp axis (the
-            # pp pipeline program): run the per-shard ring body directly
-            from ray_tpu.ops.ring_attention import ring_attention_local
+            # pp pipeline program): run the per-shard body directly
+            if impl == "ring":
+                from ray_tpu.ops.ring_attention import ring_attention_local
 
-            return ring_attention_local(q, k, v, ring_axis, causal=True)
+                return ring_attention_local(q, k, v, seq_axis, causal=True)
+            from ray_tpu.ops.ulysses import ulysses_attention_local
+
+            return ulysses_attention_local(q, k, v, seq_axis, causal=True)
         if mesh is None:
-            raise ValueError("attn_impl='ring' requires a mesh with an 'sp' axis")
-        return ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+            raise ValueError(
+                f"attn_impl={impl!r} requires a mesh with an 'sp' axis")
+        if impl == "ring":
+            return ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+        from ray_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, mesh, axis_name="sp", causal=True)
     return attention_reference(q, k, v, causal=True)
 
 
 def attention_block(cfg: LlamaConfig, x, p, cos, sin, mesh=None,
-                    ring_axis=None):
+                    seq_axis=None):
     """Pre-norm attention sub-block with residual: x + wo(attend(qkv)).
     Shared by every model in the family (llama dense, mixtral MoE)."""
     b, s, _ = x.shape
@@ -193,7 +203,7 @@ def attention_block(cfg: LlamaConfig, x, p, cos, sin, mesh=None,
     v = v.reshape(b, s, cfg.num_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = _attend(cfg, q, k, v, mesh=mesh, ring_axis=ring_axis)
+    attn = _attend(cfg, q, k, v, mesh=mesh, seq_axis=seq_axis)
     attn = attn.reshape(b, s, cfg.num_heads * hd)
     attn_out = jnp.dot(attn, p["wo"].astype(cfg.dtype),
                        preferred_element_type=jnp.float32).astype(cfg.dtype)
@@ -201,11 +211,11 @@ def attention_block(cfg: LlamaConfig, x, p, cos, sin, mesh=None,
 
 
 def _layer(cfg: LlamaConfig, x, layer_params, cos, sin, mesh=None,
-           ring_axis=None):
+           seq_axis=None):
     """One decoder block. x: [b, s, h]."""
     p = layer_params
     x = attention_block(cfg, x, p, cos, sin, mesh=mesh,
-                        ring_axis=ring_axis)
+                        seq_axis=seq_axis)
     h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
     mlp = swiglu(h2, p["w_gate"].astype(cfg.dtype),
                  p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype))
@@ -304,16 +314,17 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
 
     shard_map = jax.shard_map
 
-    # pp x ring-attention composition: pp OUTER (this shard_map), sp
-    # INNER (ring_attention_local runs per-shard inside it, KV blocks
-    # rotating on the sp sub-axis). Sequences shard over sp; rope
-    # tables enter as sp-sharded inputs so each rank holds its slice.
-    ring = cfg.attn_impl == "ring"
+    # pp x sequence-parallel composition: pp OUTER (this shard_map), sp
+    # INNER (ring_attention_local's KV blocks rotate on the sp sub-axis,
+    # or ulysses_attention_local's all-to-alls run over it). Sequences
+    # shard over sp; rope tables enter as sp-sharded inputs so each rank
+    # holds its slice.
+    seq_par = cfg.attn_impl in ("ring", "ulysses")
     sp = dict(getattr(mesh, "shape", {})).get("sp", 1)
-    if ring and sp <= 1:
+    if seq_par and sp <= 1:
         raise ValueError(
-            "attn_impl='ring' with pipeline parallelism requires a mesh "
-            "with an 'sp' axis (> 1)")
+            f"attn_impl={cfg.attn_impl!r} with pipeline parallelism "
+            "requires a mesh with an 'sp' axis (> 1)")
     pp = dict(getattr(mesh, "shape", {})).get("pp", 1)
     if cfg.num_layers % max(pp, 1):
         raise ValueError(
@@ -331,14 +342,14 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
                                 scaling=cfg.rope_scaling_dict)
     mbs = x.reshape(M, b // M, s, cfg.hidden_size)
 
-    ring_axis = "sp" if ring else None
-    if ring and s % sp:
+    seq_axis = "sp" if seq_par else None
+    if seq_par and s % sp:
         raise ValueError(
             f"sequence length {s} must be divisible by the mesh's "
             f"sp={sp}")
 
     def layer_fn(x_, p_, cos_, sin_):
-        return _layer(cfg, x_, p_, cos_, sin_, ring_axis=ring_axis)
+        return _layer(cfg, x_, p_, cos_, sin_, seq_axis=seq_axis)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
@@ -371,8 +382,8 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
     # rank receives its slice of the rope tables.
     data_axes = tuple(a for a in mesh.axis_names if a in ("dp", "fsdp"))
     mb_spec = P(None, data_axes if data_axes else None,
-                "sp" if ring else None)
-    rope_spec = P("sp" if ring else None)
+                "sp" if seq_par else None)
+    rope_spec = P("sp" if seq_par else None)
     outs = shard_map(
         sharded_pipeline, mesh=mesh,
         in_specs=(layer_spec, mb_spec, rope_spec, rope_spec),

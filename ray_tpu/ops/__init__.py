@@ -9,3 +9,4 @@ from ray_tpu.ops.layers import (  # noqa: F401
     swiglu,
 )
 from ray_tpu.ops.ring_attention import ring_attention, ring_attention_local  # noqa: F401
+from ray_tpu.ops.ulysses import ulysses_attention, ulysses_attention_local  # noqa: F401

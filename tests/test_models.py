@@ -99,6 +99,24 @@ def test_llama_ring_attention_impl(llama_setup):
     np.testing.assert_allclose(ring, base, rtol=1e-4)
 
 
+def test_llama_ulysses_attention_impl(llama_setup):
+    """attn_impl='ulysses' (all-to-all sequence parallelism) over an sp
+    mesh matches the reference impl; tiny's 4 heads over sp=4 puts one
+    head per rank, and kv_heads=2 exercises KV replication."""
+    from dataclasses import replace
+
+    cfg, params, _ = llama_setup
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 33), 0,
+                                cfg.vocab_size)
+    base = float(llama.loss_fn(cfg, params, {"tokens": tokens}))
+    mesh = build_mesh(MeshSpec({"sp": 4}), devices=jax.devices()[:4])
+    cfg_u = replace(cfg, attn_impl="ulysses")
+    f = jax.jit(lambda p, t: llama.loss_fn(cfg_u, p, {"tokens": t},
+                                           mesh=mesh))
+    got = float(f(params, tokens))
+    np.testing.assert_allclose(got, base, rtol=1e-4)
+
+
 def test_llama_8b_config_param_count():
     cfg = llama.LlamaConfig.llama3_8b()
     shapes = llama.init_shapes(cfg)

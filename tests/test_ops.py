@@ -174,3 +174,62 @@ def test_ring_attention_differentiable():
                   argnums=(0, 1, 2))(q, k, v)
     for a, b_ in zip(gg, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-5)
+
+
+# ------------------------------------------------------------------ ulysses
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_full(causal):
+    from ray_tpu.ops.ulysses import ulysses_attention
+
+    mesh = build_mesh(MeshSpec({"sp": 8}))
+    b, s, h, d = 2, 256, 8, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d), jnp.float32)
+    ref = attention_reference(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, mesh, axis_name="sp", causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("kvh", [4, 2])
+def test_ulysses_attention_gqa(kvh):
+    """kvh of 4 and 2 don't divide sp=8, exercising the minimal-KV-
+    replication path (r = n/gcd(kv, n) of 2 and 4); kvh=8 is the aligned
+    case covered above."""
+    from ray_tpu.ops.ulysses import ulysses_attention
+
+    mesh = build_mesh(MeshSpec({"sp": 8}))
+    b, s, h, d = 1, 128, 8, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, kvh, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, kvh, d))
+    ref = attention_reference(q, k, v, causal=True)
+    out = ulysses_attention(q, k, v, mesh, axis_name="sp", causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_attention_differentiable():
+    from ray_tpu.ops.ulysses import ulysses_attention
+
+    mesh = build_mesh(MeshSpec({"sp": 8}))
+    b, s, h, d = 1, 64, 8, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d))
+    gr = jax.grad(lambda *a: attention_reference(*a).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(lambda *a: ulysses_attention(*a, mesh).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gg, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from ray_tpu.ops.ulysses import ulysses_attention
+
+    mesh = build_mesh(MeshSpec({"sp": 8}))
+    q = jnp.zeros((1, 64, 6, 16))
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, q, q, mesh)

@@ -296,6 +296,30 @@ def test_pipeline_parallel_train_step_2x2():
     assert losses[-1] < losses[0], losses
 
 
+def test_pipeline_x_ulysses_matches_sequential():
+    """pp OUTER x sp INNER with Ulysses all-to-all attention on the sp
+    sub-axis reproduces the sequential model's loss."""
+    import jax
+    from dataclasses import replace
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = llama.LlamaConfig.tiny(num_layers=4, remat=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                cfg.vocab_size)
+    mesh = build_mesh(MeshSpec({"pp": 2, "sp": 2, "dp": 2}),
+                      devices=jax.devices()[:8])
+
+    ref = float(llama.loss_fn(cfg, params, {"tokens": tokens}))
+    ucfg = replace(cfg, attn_impl="ulysses")
+    pp_loss = jax.jit(lambda p, t: llama.loss_fn_pp(
+        ucfg, p, {"tokens": t}, mesh, num_microbatches=4))
+    got = float(pp_loss(params, tokens))
+    assert abs(ref - got) < 1e-4, (ref, got)
+
+
 def test_pipeline_x_ring_attention_matches_sequential():
     """pp OUTER x sp INNER (ring attention): the GPipe shard_map program
     with ring_attention_local running on the sp sub-axis must reproduce
