@@ -735,14 +735,51 @@ def _json_safe(v):
     return v
 
 
+_DELTA_PARTITION_TYPES = {
+    "string": "string", "integer": "int32", "long": "int64",
+    "short": "int16", "byte": "int8", "double": "float64",
+    "float": "float32", "boolean": "bool", "date": "date32",
+}
+
+
+def _delta_partition_array(delta_type: str, val: Optional[str], n: int):
+    """Materialize one partition column: n copies of the add action's
+    string-serialized partition value, converted to the schema type."""
+    import pyarrow as pa
+
+    pa_name = _DELTA_PARTITION_TYPES.get(delta_type)
+    if pa_name is None:
+        raise ValueError(
+            f"unsupported Delta partition column type {delta_type!r} "
+            f"(supported: {sorted(_DELTA_PARTITION_TYPES)})")
+    typ = getattr(pa, pa_name)()
+    if val is None:
+        return pa.nulls(n, typ)
+    v: Any = val
+    if delta_type == "boolean":
+        v = val == "true"
+    elif delta_type == "date":
+        import datetime
+
+        v = datetime.date.fromisoformat(val)
+    elif delta_type in ("integer", "long", "short", "byte"):
+        v = int(val)
+    elif delta_type in ("float", "double"):
+        v = float(val)
+    return pa.array([v] * n, type=typ)
+
+
 class DeltaDatasource(Datasource):
     """Delta Lake table reader, dependency-free (reference:
     _internal/datasource/delta_sharing_datasource.py fills this role via
     the deltalake lib; the table format itself is open: a parquet data
     set plus a JSON transaction log). Reconstructs the CURRENT snapshot:
     parquet checkpoint (if any) + JSON commits after it, applying
-    add/remove actions in order. Time travel / deletion vectors /
-    column mapping are out of scope and refuse loudly."""
+    add/remove actions in order. Partition columns (stored only in the
+    add actions' partitionValues, not in the data files) are
+    materialized back into each block with their schema types. Time
+    travel / deletion vectors / column mapping are out of scope and
+    refuse loudly."""
 
     def __init__(self, table_path: str,
                  columns: Optional[List[str]] = None):
@@ -754,7 +791,9 @@ class DeltaDatasource(Datasource):
             table_path = table_path[len("file://"):]
         self._root = table_path.rstrip("/")
         self._columns = columns
-        self._files = self._live_files()
+        # path -> partitionValues; plus the latest metaData's partition
+        # schema {col: delta type}
+        self._files, self._part_schema = self._live_files()
 
     def get_name(self):
         return "Delta"
@@ -763,35 +802,56 @@ class DeltaDatasource(Datasource):
     def _log_dir(self):
         return os.path.join(self._root, "_delta_log")
 
-    def _live_files(self) -> List[str]:
+    def _find_checkpoint(self, log: str):
+        """Latest COMPLETE checkpoint by listing the log dir (the
+        _last_checkpoint hint is best-effort per the protocol — it can be
+        missing or stale while checkpoint files exist, and trusting it
+        alone silently drops every file the checkpoint compacted)."""
+        import re
+
+        single = re.compile(r"^(\d{20})\.checkpoint\.parquet$")
+        multi = re.compile(
+            r"^(\d{20})\.checkpoint\.(\d{10})\.(\d{10})\.parquet$")
+        found: Dict[int, Dict[int, str]] = {}
+        totals: Dict[int, int] = {}
+        for name in os.listdir(log):
+            m = single.match(name)
+            if m:
+                v = int(m.group(1))
+                found.setdefault(v, {})[1] = name
+                totals[v] = 1
+                continue
+            m = multi.match(name)
+            if m:
+                v = int(m.group(1))
+                found.setdefault(v, {})[int(m.group(2))] = name
+                totals[v] = int(m.group(3))
+        for v in sorted(found, reverse=True):
+            parts = found[v]
+            if len(parts) == totals[v]:
+                return v, [os.path.join(log, parts[i + 1])
+                           for i in range(totals[v])]
+        return -1, []
+
+    def _live_files(self):
         import json
 
         log = self._log_dir()
         if not os.path.isdir(log):
             raise FileNotFoundError(
                 f"{self._root} is not a Delta table (no _delta_log)")
-        ckpt_version = -1
-        ckpt_parts: List[str] = []
-        lc = os.path.join(log, "_last_checkpoint")
-        if os.path.exists(lc):
-            meta = json.load(open(lc))
-            ckpt_version = int(meta["version"])
-            parts = int(meta.get("parts") or 1)
-            if parts == 1:
-                ckpt_parts = [os.path.join(
-                    log, f"{ckpt_version:020d}.checkpoint.parquet")]
-            else:
-                ckpt_parts = [os.path.join(
-                    log, f"{ckpt_version:020d}.checkpoint."
-                         f"{i + 1:010d}.{parts:010d}.parquet")
-                    for i in range(parts)]
-        live: Dict[str, None] = {}
+        ckpt_version, ckpt_parts = self._find_checkpoint(log)
+        live: Dict[str, Dict[str, Optional[str]]] = {}
+        meta_holder: Dict[str, Any] = {}
 
         def check_metadata(md):
-            if md and (md.get("configuration") or {}).get(
+            if not md:
+                return
+            if (md.get("configuration") or {}).get(
                     "delta.columnMapping.mode", "none") != "none":
                 raise ValueError(
                     "unsupported Delta feature: column mapping")
+            meta_holder["meta"] = md
 
         def check_protocol(proto):
             if proto and int(proto.get("minReaderVersion") or 1) > 1:
@@ -802,11 +862,22 @@ class DeltaDatasource(Datasource):
                     f"(readerFeatures={feats}) — this reader implements "
                     f"version 1 (plain parquet + log)")
 
+        def apply_add(a):
+            if a.get("deletionVector"):
+                raise ValueError(
+                    "unsupported Delta feature: deletion vectors")
+            live[a["path"]] = a.get("partitionValues") or {}
+
         for part in ckpt_parts:
             import pyarrow.parquet as pq
 
-            tbl = pq.read_table(part)
-            cols = tbl.to_pydict()
+            # project to the action columns consumed — checkpoints also
+            # carry stats/txn/remove for every live file, and reading
+            # those just to discard them stalls the driver on big tables
+            names = pq.read_schema(part).names
+            want = [c for c in ("add", "metaData", "protocol")
+                    if c in names]
+            cols = pq.read_table(part, columns=want).to_pydict()
             # metaData/protocol actions usually live IN the checkpoint
             # once one exists — gate there too, not just in JSON commits
             for md in cols.get("metaData") or []:
@@ -815,10 +886,7 @@ class DeltaDatasource(Datasource):
                 check_protocol(proto)
             for add in cols.get("add") or []:
                 if add and add.get("path"):
-                    if add.get("deletionVector"):
-                        raise ValueError(
-                            "unsupported Delta feature: deletion vectors")
-                    live[add["path"]] = None
+                    apply_add(add)
         commits = sorted(
             f for f in os.listdir(log)
             if f.endswith(".json") and f[:20].isdigit()
@@ -830,12 +898,7 @@ class DeltaDatasource(Datasource):
                         continue
                     action = json.loads(line)
                     if "add" in action:
-                        a = action["add"]
-                        if a.get("deletionVector"):
-                            raise ValueError(
-                                "unsupported Delta feature: deletion "
-                                "vectors")
-                        live[a["path"]] = None
+                        apply_add(action["add"])
                     elif "remove" in action:
                         live.pop(action["remove"]["path"], None)
                     elif "metaData" in action:
@@ -844,12 +907,43 @@ class DeltaDatasource(Datasource):
                         check_protocol(action["protocol"])
         from urllib.parse import unquote
 
-        return [os.path.join(self._root, unquote(p)) for p in live]
+        part_schema = self._partition_schema(meta_holder.get("meta"), live)
+        return ([(os.path.join(self._root, unquote(p)), pv)
+                 for p, pv in live.items()], part_schema)
+
+    @staticmethod
+    def _partition_schema(meta, live) -> Dict[str, str]:
+        """{partition column: delta type} from the latest metaData."""
+        import json
+
+        pcols = (meta or {}).get("partitionColumns") or []
+        if not pcols:
+            if any(pv for _, pv in live.items()):
+                raise ValueError(
+                    "Delta table has partitionValues but no metaData "
+                    "action with partitionColumns was found in the log")
+            return {}
+        schema = json.loads(meta["schemaString"])
+        types = {f["name"]: f["type"] for f in schema.get("fields", [])}
+        out = {}
+        for c in pcols:
+            t = types.get(c)
+            if not isinstance(t, str):
+                raise ValueError(
+                    f"unsupported Delta partition column {c!r}: type "
+                    f"{t!r} is not a primitive")
+            if t not in _DELTA_PARTITION_TYPES:
+                raise ValueError(
+                    f"unsupported Delta partition column type {t!r} "
+                    f"for column {c!r}")
+            out[c] = t
+        return out
 
     # -- datasource surface ----------------------------------------------
     def estimate_inmemory_data_size(self):
         try:
-            return int(sum(os.path.getsize(p) for p in self._files) * 5.0)
+            return int(sum(os.path.getsize(p) for p, _ in self._files)
+                       * 5.0)
         except OSError:
             return None
 
@@ -858,19 +952,72 @@ class DeltaDatasource(Datasource):
         groups = [g for g in groups if g]
         out = []
         for g in groups:
-            def read(paths=tuple(g), cols=self._columns):
+            def read(items=tuple(g), cols=self._columns,
+                     pschema=self._part_schema):
                 import pyarrow.parquet as pq
 
-                for p in paths:
-                    yield pq.read_table(p, columns=cols)
+                for p, pvals in items:
+                    file_cols = (None if cols is None else
+                                 [c for c in cols if c not in pschema])
+                    want_parts = [c for c in pschema
+                                  if cols is None or c in cols]
+                    if cols is not None and not file_cols and want_parts:
+                        # partition-only projection: no parquet columns
+                        # needed, just the row count
+                        import pyarrow as pa
+
+                        n = pq.ParquetFile(p).metadata.num_rows
+                        tbl = pa.table({c: _delta_partition_array(
+                            pschema[c], pvals.get(c), n)
+                            for c in want_parts})
+                        yield tbl
+                        continue
+                    tbl = pq.read_table(p, columns=file_cols)
+                    for c in want_parts:
+                        tbl = tbl.append_column(c, _delta_partition_array(
+                            pschema[c], pvals.get(c), tbl.num_rows))
+                    yield tbl
             out.append(ReadTask(read, BlockMetadata(
                 num_rows=None, size_bytes=None, schema=None,
-                input_files=list(g))))
+                input_files=[p for p, _ in g])))
         return out
 
 
+_CRC32C_FAST = None
+_CRC32C_PROBED = False
+
+
+def _crc32c_fast():
+    """Best importable C implementation of CRC-32C, probed once: the
+    crc32c or google-crc32c extensions if installed."""
+    global _CRC32C_FAST, _CRC32C_PROBED
+    if _CRC32C_PROBED:
+        return _CRC32C_FAST
+    _CRC32C_PROBED = True
+    try:
+        import crc32c as _c
+
+        _CRC32C_FAST = _c.crc32c
+        return _CRC32C_FAST
+    except (ImportError, AttributeError):
+        pass
+    try:
+        import google_crc32c as _g
+
+        _CRC32C_FAST = _g.value
+    except ImportError:
+        _CRC32C_FAST = None
+    return _CRC32C_FAST
+
+
 def _crc32c(data: bytes) -> int:
-    """Software CRC-32C (Castagnoli) — the TFRecord framing checksum."""
+    """CRC-32C (Castagnoli) — the TFRecord framing checksum. Uses a C
+    extension when one is importable; the pure-python table loop is the
+    dependency-free fallback (~MB/s — fine for tests and small writes,
+    install crc32c for bulk exports)."""
+    fast = _crc32c_fast()
+    if fast is not None:
+        return fast(data)
     table = _crc32c_table()
     crc = 0xFFFFFFFF
     for b in data:
