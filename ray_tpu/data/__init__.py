@@ -11,6 +11,7 @@ from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
 from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data.dataset import (
     read_delta,  # noqa: F401
+    read_iceberg,  # noqa: F401
     Dataset,
     MaterializedDataset,
     from_arrow,
@@ -76,4 +77,5 @@ __all__ = [
     "read_csv", "read_json", "read_numpy", "read_text",
     "read_binary_files", "read_tfrecords", "read_webdataset", "read_sql",
     "read_images", "read_avro", "read_bigquery", "read_delta",
+    "read_iceberg",
 ] + list(_CLOUD_SOURCES)

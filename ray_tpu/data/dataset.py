@@ -658,6 +658,21 @@ def read_bigquery(project_id: str, dataset: str = None, query: str = None,
         parallelism=parallelism)
 
 
+def read_iceberg(table_path: str, *, columns=None, snapshot_id=None,
+                 parallelism: int = -1) -> Dataset:
+    """Read a snapshot of an Apache Iceberg table — implemented in-tree
+    over the open table format (JSON metadata + Avro manifest replay +
+    parquet data files), no pyiceberg dependency (reference:
+    _internal/datasource/iceberg_datasource.py). ``snapshot_id``
+    time-travels to any retained snapshot."""
+    from ray_tpu.data.datasource import IcebergDatasource
+
+    return read_datasource(
+        IcebergDatasource(table_path, columns=columns,
+                          snapshot_id=snapshot_id),
+        parallelism=parallelism)
+
+
 def read_delta(table_path: str, *, columns=None,
                parallelism: int = -1) -> Dataset:
     """Read the current snapshot of a Delta Lake table — implemented

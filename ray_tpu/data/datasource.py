@@ -449,6 +449,52 @@ class _AvroReader:
         raise ValueError(f"unsupported avro type {t!r}")
 
 
+def read_avro_rows(path: str) -> List[dict]:
+    """Decode one Avro OCF into plain Python rows (shared by
+    AvroDatasource and the Iceberg manifest reader, whose nested
+    manifest-entry records should not round-trip through Arrow)."""
+    import json
+    import zlib
+
+    with open(path, "rb") as f:
+        data = f.read()
+    r = _AvroReader(data)
+    if r._read(4) != b"Obj\x01":
+        raise ValueError(f"{path} is not an avro container file")
+    meta = {}
+    while True:
+        n = r.long()
+        if n == 0:
+            break
+        if n < 0:
+            n = -n
+            r.long()
+        for _ in range(n):
+            k = r.string()  # key MUST decode before the value
+            meta[k] = r.bytes_()
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = r._read(16)
+
+    rows: List[dict] = []
+    while r._i < len(r._b):
+        count = r.long()
+        size = r.long()
+        payload = r._read(size)
+        if codec == "deflate":
+            payload = zlib.decompress(payload, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        block = _AvroReader(payload)
+        named: dict = {}
+        for _ in range(count):
+            v = block.value(schema, named)
+            rows.append(v if isinstance(v, dict) else {"value": v})
+        if r._read(16) != sync:
+            raise ValueError(f"{path}: bad sync marker (corrupt file)")
+    return rows
+
+
 class AvroDatasource(FileDatasource):
     """Avro object container files (reference:
     _internal/datasource/avro_datasource.py uses fastavro; this image has
@@ -457,45 +503,7 @@ class AvroDatasource(FileDatasource):
     suffixes = [".avro"]
 
     def read_file(self, path: str):
-        import json
-        import zlib
-
-        with open(path, "rb") as f:
-            data = f.read()
-        r = _AvroReader(data)
-        if r._read(4) != b"Obj\x01":
-            raise ValueError(f"{path} is not an avro container file")
-        meta = {}
-        while True:
-            n = r.long()
-            if n == 0:
-                break
-            if n < 0:
-                n = -n
-                r.long()
-            for _ in range(n):
-                k = r.string()  # key MUST decode before the value
-                meta[k] = r.bytes_()
-        schema = json.loads(meta["avro.schema"])
-        codec = meta.get("avro.codec", b"null").decode()
-        sync = r._read(16)
-
-        rows: List[dict] = []
-        while r._i < len(r._b):
-            count = r.long()
-            size = r.long()
-            payload = r._read(size)
-            if codec == "deflate":
-                payload = zlib.decompress(payload, -15)
-            elif codec != "null":
-                raise ValueError(f"unsupported avro codec {codec!r}")
-            block = _AvroReader(payload)
-            named: dict = {}
-            for _ in range(count):
-                v = block.value(schema, named)
-                rows.append(v if isinstance(v, dict) else {"value": v})
-            if r._read(16) != sync:
-                raise ValueError(f"{path}: bad sync marker (corrupt file)")
+        rows = read_avro_rows(path)
         if rows:
             yield BlockAccessor.rows_to_block(rows)
 
@@ -665,7 +673,6 @@ def write_bigquery_block(block: Block, project_id: str, dataset: str
 
 _CLOUD_SOURCES = {
     "read_lance": "lance",
-    "read_iceberg": "pyiceberg",
     "read_mongo": "pymongo",
     "read_databricks_tables": "databricks.sql",
     "read_clickhouse": "clickhouse_connect",
@@ -769,6 +776,17 @@ def _delta_partition_array(delta_type: str, val: Optional[str], n: int):
     return pa.array([v] * n, type=typ)
 
 
+def _delta_map(v) -> dict:
+    """Normalize a Delta action's map field: JSON commits decode as
+    dicts, but parquet checkpoints store map<string,string> which
+    to_pydict yields as a list of (key, value) tuples."""
+    if not v:
+        return {}
+    if isinstance(v, dict):
+        return v
+    return dict(v)
+
+
 class DeltaDatasource(Datasource):
     """Delta Lake table reader, dependency-free (reference:
     _internal/datasource/delta_sharing_datasource.py fills this role via
@@ -812,25 +830,24 @@ class DeltaDatasource(Datasource):
         single = re.compile(r"^(\d{20})\.checkpoint\.parquet$")
         multi = re.compile(
             r"^(\d{20})\.checkpoint\.(\d{10})\.(\d{10})\.parquet$")
-        found: Dict[int, Dict[int, str]] = {}
-        totals: Dict[int, int] = {}
+        # keyed by (version, declared part count) so a complete 2-part
+        # checkpoint is never mixed with / shadowed by an abandoned
+        # 3-part attempt at the same version
+        found: Dict[tuple, Dict[int, str]] = {}
         for name in os.listdir(log):
             m = single.match(name)
             if m:
-                v = int(m.group(1))
-                found.setdefault(v, {})[1] = name
-                totals[v] = 1
+                found.setdefault((int(m.group(1)), 1), {})[1] = name
                 continue
             m = multi.match(name)
             if m:
-                v = int(m.group(1))
-                found.setdefault(v, {})[int(m.group(2))] = name
-                totals[v] = int(m.group(3))
-        for v in sorted(found, reverse=True):
-            parts = found[v]
-            if len(parts) == totals[v]:
+                key = (int(m.group(1)), int(m.group(3)))
+                found.setdefault(key, {})[int(m.group(2))] = name
+        for v, total in sorted(found, reverse=True):
+            parts = found[(v, total)]
+            if len(parts) == total:
                 return v, [os.path.join(log, parts[i + 1])
-                           for i in range(totals[v])]
+                           for i in range(total)]
         return -1, []
 
     def _live_files(self):
@@ -847,8 +864,8 @@ class DeltaDatasource(Datasource):
         def check_metadata(md):
             if not md:
                 return
-            if (md.get("configuration") or {}).get(
-                    "delta.columnMapping.mode", "none") != "none":
+            conf = _delta_map(md.get("configuration"))
+            if conf.get("delta.columnMapping.mode", "none") != "none":
                 raise ValueError(
                     "unsupported Delta feature: column mapping")
             meta_holder["meta"] = md
@@ -866,7 +883,7 @@ class DeltaDatasource(Datasource):
             if a.get("deletionVector"):
                 raise ValueError(
                     "unsupported Delta feature: deletion vectors")
-            live[a["path"]] = a.get("partitionValues") or {}
+            live[a["path"]] = _delta_map(a.get("partitionValues"))
 
         for part in ckpt_parts:
             import pyarrow.parquet as pq
@@ -941,11 +958,7 @@ class DeltaDatasource(Datasource):
 
     # -- datasource surface ----------------------------------------------
     def estimate_inmemory_data_size(self):
-        try:
-            return int(sum(os.path.getsize(p) for p, _ in self._files)
-                       * 5.0)
-        except OSError:
-            return None
+        return _parquet_size_estimate([p for p, _ in self._files])
 
     def get_read_tasks(self, parallelism: int) -> List["ReadTask"]:
         groups = [self._files[i::parallelism] for i in range(parallelism)]
@@ -974,6 +987,10 @@ class DeltaDatasource(Datasource):
                         continue
                     tbl = pq.read_table(p, columns=file_cols)
                     for c in want_parts:
+                        # writers MAY also store partition columns in the
+                        # data files; don't append a duplicate then
+                        if c in tbl.column_names:
+                            continue
                         tbl = tbl.append_column(c, _delta_partition_array(
                             pschema[c], pvals.get(c), tbl.num_rows))
                     yield tbl
@@ -985,6 +1002,176 @@ class DeltaDatasource(Datasource):
 
 _CRC32C_FAST = None
 _CRC32C_PROBED = False
+
+
+def _parquet_fan_out(files: List[str], columns, parallelism: int
+                     ) -> List["ReadTask"]:
+    """Round-robin a known file list into parquet ReadTasks (shared by
+    the table-format readers whose snapshots resolve to plain parquet
+    file sets)."""
+    groups = [files[i::parallelism] for i in range(parallelism)]
+    groups = [g for g in groups if g]
+    out = []
+    for g in groups:
+        def read(paths=tuple(g), cols=columns):
+            import pyarrow.parquet as pq
+
+            for p in paths:
+                yield pq.read_table(p, columns=cols)
+        out.append(ReadTask(read, BlockMetadata(
+            num_rows=None, size_bytes=None, schema=None,
+            input_files=list(g))))
+    return out
+
+
+def _parquet_size_estimate(files: List[str]) -> Optional[int]:
+    try:
+        return int(sum(os.path.getsize(p) for p in files) * 5.0)
+    except OSError:
+        return None
+
+
+def _iceberg_local_path(uri: str, root: str) -> str:
+    """Resolve a location recorded in Iceberg metadata to a local path.
+    Writers record full URIs at write time; strip file:// and fall back
+    to joining relative paths under the table root."""
+    if uri.startswith("file://"):
+        uri = uri[len("file://"):]
+    if "://" in uri:
+        raise ValueError(
+            f"read_iceberg reads local filesystems (metadata references "
+            f"{uri!r}); mount or sync the table locally")
+    if os.path.isabs(uri):
+        return uri
+    return os.path.join(root, uri)
+
+
+class IcebergDatasource(Datasource):
+    """Apache Iceberg table reader, dependency-free (reference:
+    _internal/datasource/iceberg_datasource.py delegates to pyiceberg,
+    which isn't in this image; the format itself is open: JSON table
+    metadata + Avro manifest lists/manifests + parquet data files, all
+    decoded with the in-tree readers). Reconstructs a snapshot: current
+    metadata file -> snapshot -> manifest list (Avro) -> manifests
+    (Avro) -> live parquet data files (entry status != DELETED).
+    ``snapshot_id`` time-travels to any retained snapshot. Row-level
+    deletes (v2 position/equality delete files) and non-parquet data
+    files are out of scope and refuse loudly."""
+
+    def __init__(self, table_path: str,
+                 columns: Optional[List[str]] = None,
+                 snapshot_id: Optional[int] = None):
+        if "://" in table_path and not table_path.startswith("file://"):
+            raise ValueError(
+                f"read_iceberg reads local filesystem tables (got "
+                f"{table_path!r}); mount or sync the table locally, or "
+                "export to parquet and use read_parquet")
+        if table_path.startswith("file://"):
+            table_path = table_path[len("file://"):]
+        self._root = table_path.rstrip("/")
+        self._columns = columns
+        self._files = self._live_files(snapshot_id)
+
+    def get_name(self):
+        return "Iceberg"
+
+    # -- metadata resolution ----------------------------------------------
+
+    def _current_metadata(self) -> str:
+        """Latest metadata JSON: trust metadata/version-hint.text when it
+        resolves, else pick the highest version among *.metadata.json
+        (covers both v<N>.metadata.json and <N>-<uuid>.metadata.json
+        naming)."""
+        import re
+
+        md = os.path.join(self._root, "metadata")
+        if not os.path.isdir(md):
+            raise FileNotFoundError(
+                f"{self._root} is not an Iceberg table (no metadata/ dir)")
+        hint = os.path.join(md, "version-hint.text")
+        if os.path.exists(hint):
+            v = open(hint).read().strip()
+            for name in (f"v{v}.metadata.json", f"{v}.metadata.json"):
+                p = os.path.join(md, name)
+                if os.path.exists(p):
+                    return p
+        best, best_v = None, -1
+        pat = re.compile(r"^v?(\d+)")
+        for name in os.listdir(md):
+            if not name.endswith(".metadata.json"):
+                continue
+            m = pat.match(name)
+            v = int(m.group(1)) if m else 0
+            if v > best_v:
+                best, best_v = name, v
+        if best is None:
+            raise FileNotFoundError(
+                f"{md} contains no *.metadata.json files")
+        return os.path.join(md, best)
+
+    def _live_files(self, snapshot_id: Optional[int]) -> List[str]:
+        import json
+
+        meta = json.load(open(self._current_metadata()))
+        fv = int(meta.get("format-version") or 1)
+        if fv > 2:
+            raise ValueError(
+                f"unsupported Iceberg format-version {fv} (this reader "
+                "implements v1/v2)")
+        snapshots = meta.get("snapshots") or []
+        if snapshot_id is None:
+            snapshot_id = meta.get("current-snapshot-id")
+        if snapshot_id is None or snapshot_id == -1 or not snapshots:
+            return []  # empty table: no snapshot yet
+        snap = next((s for s in snapshots
+                     if s.get("snapshot-id") == snapshot_id), None)
+        if snap is None:
+            raise ValueError(
+                f"snapshot {snapshot_id} not found in "
+                f"{sorted(s.get('snapshot-id') for s in snapshots)}")
+
+        manifests: List[str] = []
+        if snap.get("manifest-list"):
+            for e in read_avro_rows(
+                    _iceberg_local_path(snap["manifest-list"], self._root)):
+                # v2 manifest lists mark delete manifests via content=1
+                if int(e.get("content") or 0) != 0:
+                    raise ValueError(
+                        "unsupported Iceberg feature: row-level delete "
+                        "manifests (merge-on-read v2 tables); compact/"
+                        "rewrite the table to copy-on-write first")
+                manifests.append(e["manifest_path"])
+        else:
+            # v1 inline manifest listing
+            manifests = list(snap.get("manifests") or [])
+
+        live: List[str] = []
+        for mpath in manifests:
+            for entry in read_avro_rows(
+                    _iceberg_local_path(mpath, self._root)):
+                if int(entry.get("status") or 0) == 2:  # DELETED
+                    continue
+                df = entry.get("data_file") or {}
+                if int(df.get("content") or 0) != 0:
+                    raise ValueError(
+                        "unsupported Iceberg feature: delete files "
+                        "(position/equality deletes)")
+                fmt = (df.get("file_format") or "PARQUET").upper()
+                if fmt != "PARQUET":
+                    raise ValueError(
+                        f"unsupported Iceberg data file format {fmt!r} "
+                        "(parquet only)")
+                live.append(
+                    _iceberg_local_path(df["file_path"], self._root))
+        return live
+
+    # -- datasource surface ----------------------------------------------
+
+    def estimate_inmemory_data_size(self):
+        return _parquet_size_estimate(self._files)
+
+    def get_read_tasks(self, parallelism: int) -> List["ReadTask"]:
+        return _parquet_fan_out(self._files, self._columns, parallelism)
 
 
 def _crc32c_fast():
