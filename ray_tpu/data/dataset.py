@@ -121,6 +121,7 @@ class Dataset:
             ds = self._map(f"Filter[{expr!r}]", "map_batches", mask,
                            batch_format="numpy", **kw)
             ds._logical_op.expr_columns = tuple(sorted(expr.columns()))
+            ds._logical_op.filter_expr = expr
             return ds
         return self._map("Filter", "filter", fn, **kw)
 

@@ -168,10 +168,12 @@ class ParquetDatasource(FileDatasource):
 
     suffixes = [".parquet"]
     supports_column_pruning = True
+    supports_predicate_pushdown = True
 
     def __init__(self, paths, columns: Optional[List[str]] = None):
         super().__init__(paths)
         self._columns = columns
+        self._filter = None  # pyarrow.dataset expression
 
     def with_columns(self, columns: List[str]) -> "ParquetDatasource":
         """Pruned clone (projection pushdown target)."""
@@ -181,8 +183,30 @@ class ParquetDatasource(FileDatasource):
         out._columns = list(columns)
         return out
 
+    def with_filter(self, pa_expr) -> "ParquetDatasource":
+        """Filtered clone (predicate pushdown target); multiple pushed
+        filters AND together."""
+        import copy
+
+        out = copy.copy(self)
+        out._filter = (pa_expr if out._filter is None
+                       else out._filter & pa_expr)
+        return out
+
     def read_file(self, path: str):
         import pyarrow.parquet as pq
+        if self._filter is not None:
+            # dataset scanner: row groups whose statistics exclude the
+            # predicate are skipped entirely, surviving ones filter
+            # vectorized before the block materializes
+            import pyarrow.dataset as pads
+
+            scan = pads.dataset(path, format="parquet")
+            for batch in scan.to_batches(columns=self._columns,
+                                         filter=self._filter):
+                if batch.num_rows:
+                    yield pa.Table.from_batches([batch])
+            return
         pf = pq.ParquetFile(path)
         for batch in pf.iter_batches(columns=self._columns):
             yield pa.Table.from_batches([batch])
@@ -1004,29 +1028,43 @@ _CRC32C_FAST = None
 _CRC32C_PROBED = False
 
 
-def _parquet_fan_out(files: List[str], columns, parallelism: int
+def _parquet_fan_out(files: List[tuple], columns, parallelism: int
                      ) -> List["ReadTask"]:
     """Round-robin a known file list into parquet ReadTasks (shared by
     the table-format readers whose snapshots resolve to plain parquet
-    file sets)."""
+    file sets). ``files`` entries are (path, size_bytes, num_rows)
+    tuples — table-format manifests carry exact per-file stats, so use
+    them in block metadata instead of None/re-statting."""
     groups = [files[i::parallelism] for i in range(parallelism)]
     groups = [g for g in groups if g]
     out = []
     for g in groups:
-        def read(paths=tuple(g), cols=columns):
+        def read(paths=tuple(p for p, _, _ in g), cols=columns):
             import pyarrow.parquet as pq
 
             for p in paths:
                 yield pq.read_table(p, columns=cols)
+        sizes = [s for _, s, _ in g]
+        rows = [r for _, _, r in g]
         out.append(ReadTask(read, BlockMetadata(
-            num_rows=None, size_bytes=None, schema=None,
-            input_files=list(g))))
+            num_rows=sum(rows) if all(r is not None for r in rows)
+            else None,
+            size_bytes=sum(sizes) if all(s is not None for s in sizes)
+            else None,
+            schema=None, input_files=[p for p, _, _ in g])))
     return out
 
 
-def _parquet_size_estimate(files: List[str]) -> Optional[int]:
+def _parquet_size_estimate(files: List[str],
+                           sizes: Optional[List[Optional[int]]] = None
+                           ) -> Optional[int]:
+    """On-disk bytes * decode ratio; exact manifest sizes when the
+    caller has them, getsize syscalls otherwise."""
     try:
-        return int(sum(os.path.getsize(p) for p in files) * 5.0)
+        total = sum(s if (sizes and sizes[i] is not None)
+                    else os.path.getsize(files[i])
+                    for i, s in enumerate(sizes or [None] * len(files)))
+        return int(total * 5.0)
     except OSError:
         return None
 
@@ -1131,6 +1169,7 @@ class IcebergDatasource(Datasource):
                 f"{sorted(s.get('snapshot-id') for s in snapshots)}")
 
         manifests: List[str] = []
+        live: List[tuple] = []  # (path, size_bytes, record_count)
         if snap.get("manifest-list"):
             for e in read_avro_rows(
                     _iceberg_local_path(snap["manifest-list"], self._root)):
@@ -1145,7 +1184,6 @@ class IcebergDatasource(Datasource):
             # v1 inline manifest listing
             manifests = list(snap.get("manifests") or [])
 
-        live: List[str] = []
         for mpath in manifests:
             for entry in read_avro_rows(
                     _iceberg_local_path(mpath, self._root)):
@@ -1162,13 +1200,16 @@ class IcebergDatasource(Datasource):
                         f"unsupported Iceberg data file format {fmt!r} "
                         "(parquet only)")
                 live.append(
-                    _iceberg_local_path(df["file_path"], self._root))
+                    (_iceberg_local_path(df["file_path"], self._root),
+                     df.get("file_size_in_bytes"),
+                     df.get("record_count")))
         return live
 
     # -- datasource surface ----------------------------------------------
 
     def estimate_inmemory_data_size(self):
-        return _parquet_size_estimate(self._files)
+        return _parquet_size_estimate([p for p, _, _ in self._files],
+                                      [s for _, s, _ in self._files])
 
     def get_read_tasks(self, parallelism: int) -> List["ReadTask"]:
         return _parquet_fan_out(self._files, self._columns, parallelism)

@@ -109,20 +109,28 @@ class Expr:
         return UnaryOp(np.abs, self, "abs")
 
     def is_null(self):
-        return UnaryOp(lambda a: np.asarray(
+        u = UnaryOp(lambda a: np.asarray(
             [v is None or (isinstance(v, float) and np.isnan(v))
              for v in np.asarray(a).ravel().tolist()])
             if np.asarray(a).dtype == object else np.isnan(a),
             self, "is_null")
+        u.kind = "is_null"
+        return u
 
     def isin(self, values):
         vals = tuple(values)
-        return UnaryOp(lambda a: np.isin(a, np.asarray(vals)),
-                       self, f"isin{vals!r}")
+        u = UnaryOp(lambda a: np.isin(a, np.asarray(vals)),
+                    self, f"isin{vals!r}")
+        u.kind = "isin"
+        u.values = vals
+        return u
 
     def cast(self, dtype):
-        return UnaryOp(lambda a, _d=np.dtype(dtype): a.astype(_d),
-                       self, f"cast[{dtype}]")
+        u = UnaryOp(lambda a, _d=np.dtype(dtype): a.astype(_d),
+                    self, f"cast[{dtype}]")
+        u.kind = "cast"
+        u.np_dtype = np.dtype(dtype)
+        return u
 
     # hashability: __eq__ builds an Expr, so default hashing breaks;
     # identity hash keeps Exprs usable in dicts/sets
@@ -205,3 +213,81 @@ def col(name: str) -> Column:
 def lit(value: Any) -> Literal:
     """A constant (reference: ray.data.expressions.lit)."""
     return Literal(value)
+
+
+# -- pyarrow conversion (predicate pushdown into file scans) -----------------
+
+# Pushdown converts only expressions whose pyarrow semantics match the
+# numpy eval path ROW-FOR-ROW, including on NULLs. Nulls surface as NaN
+# in numpy, so a comparison yields False (row dropped) where pyarrow
+# yields null (row dropped) — equivalent for ==, <, <=, >, >=. NOT
+# equivalent, and therefore excluded:
+#  - "!=": NaN != x is True (kept) but null != x is null (dropped)
+#  - "~":  negation turns dropped-on-both into kept-vs-dropped
+#  - "/":  pyarrow divides integers integrally; numpy truediv floats
+# "&"/"|" are faithful under Kleene logic ONLY over boolean-producing
+# operands (null AND/OR propagation lands on the same kept/dropped
+# outcome as numpy's False); over non-boolean operands numpy coerces
+# truthiness while pyarrow's and_kleene has no integer kernel at all.
+_PA_BINOPS = frozenset({"+", "-", "*", "==", "<", "<=", ">", ">=",
+                        "&", "|"})
+_BOOL_BINOPS = frozenset({"==", "<", "<=", ">", ">=", "&", "|"})
+
+
+def _is_boolean(expr: Expr) -> bool:
+    """Does this expression produce a boolean column (comparison/isin/
+    is_null or a combination of them)?"""
+    if isinstance(expr, BinaryOp):
+        return expr.symbol in _BOOL_BINOPS
+    if isinstance(expr, UnaryOp):
+        return getattr(expr, "kind", expr.symbol) in ("isin", "is_null")
+    return False
+
+
+def to_pyarrow(expr: Expr):
+    """Convert an Expr to a ``pyarrow.dataset`` filter expression, or
+    return None when any sub-expression has no faithful pyarrow
+    equivalent (the caller then keeps the in-memory filter)."""
+    import operator as op
+
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    if isinstance(expr, Column):
+        return pc.field(expr.name)
+    if isinstance(expr, Literal):
+        try:
+            return pc.scalar(expr.value)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError, TypeError):
+            return None
+    if isinstance(expr, BinaryOp):
+        if expr.symbol not in _PA_BINOPS:
+            return None
+        if expr.symbol in ("&", "|") and not (
+                _is_boolean(expr.left) and _is_boolean(expr.right)):
+            return None
+        left = to_pyarrow(expr.left)
+        right = to_pyarrow(expr.right)
+        if left is None or right is None:
+            return None
+        fns = {"+": op.add, "-": op.sub, "*": op.mul, "==": op.eq,
+               "<": op.lt, "<=": op.le, ">": op.gt, ">=": op.ge,
+               "&": op.and_, "|": op.or_}
+        return fns[expr.symbol](left, right)
+    if isinstance(expr, UnaryOp):
+        inner = to_pyarrow(expr.operand)
+        if inner is None:
+            return None
+        kind = getattr(expr, "kind", expr.symbol)
+        if kind == "is_null":
+            # the numpy eval path treats NaN as null; match it
+            return inner.is_null(nan_is_null=True)
+        if kind == "isin":
+            return inner.isin(list(expr.values))
+        if kind == "cast":
+            try:
+                return inner.cast(pa.from_numpy_dtype(expr.np_dtype))
+            except (pa.ArrowNotImplementedError, TypeError):
+                return None
+        return None
+    return None

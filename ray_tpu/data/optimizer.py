@@ -145,7 +145,50 @@ class ProjectionPushdown(Rule):
         return self._rewrite(root, fn)
 
 
+class PredicatePushdown(Rule):
+    """Push expression filters into the file scan (reference: the
+    planner's filter pushdown into ParquetDatasource). Pattern: a
+    ``filter(expr=...)`` map DIRECTLY above a predicate-capable Read.
+    The expression converts to a pyarrow dataset filter (expr.to_pyarrow
+    — None for sub-expressions without a faithful equivalent, which
+    stay as in-memory masks); the filter node is then dropped and the
+    Read replaced with a filtered clone, so row groups prune on
+    statistics and the filter columns need not be materialized at all.
+    Runs BEFORE ProjectionPushdown: a pushed filter's columns drop out
+    of the projection's needed set (pyarrow can filter on columns it
+    does not project). Stacked filters collapse bottom-up, ANDing into
+    the scan."""
+
+    def apply(self, root):
+        def fn(node):
+            fexpr = getattr(node, "filter_expr", None)
+            if not (isinstance(node, L.AbstractMap) and fexpr is not None):
+                return node
+            # a filter the user pinned to a compute strategy/resources
+            # still runs as its own operator
+            if node.compute is not None or getattr(node, "num_chips", 0):
+                return node
+            cur = node.inputs[0] if node.inputs else None
+            if not (isinstance(cur, L.Read)
+                    and getattr(cur.datasource,
+                                "supports_predicate_pushdown", False)):
+                return node
+            from ray_tpu.data.expr import to_pyarrow
+
+            pa_expr = to_pyarrow(fexpr)
+            if pa_expr is None:
+                return node
+            import copy
+
+            read2 = copy.copy(cur)  # input Read may be diamond-shared
+            read2.datasource = cur.datasource.with_filter(pa_expr)
+            read2.name = f"{cur.name}[filter]"
+            return read2
+        return self._rewrite(root, fn)
+
+
 _DEFAULT_RULES: List[Type[Rule]] = [MergeLimits, LimitPushdown,
+                                    PredicatePushdown,
                                     ProjectionPushdown]
 _EXTRA_RULES: List[Type[Rule]] = []
 

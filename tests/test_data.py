@@ -911,8 +911,10 @@ def test_projection_pushdown_prunes_parquet_read(tmp_path):
         return n
 
     read = find_read(optimized)
-    # needs a,d -> d produced from b; filter needs c; 'huge' pruned
-    assert sorted(read.datasource._columns) == ["a", "b", "c"]
+    # needs a,d -> d produced from b; the c==0 filter is pushed into the
+    # SCAN (PredicatePushdown) so c isn't even projected; 'huge' pruned
+    assert sorted(read.datasource._columns) == ["a", "b"]
+    assert read.datasource._filter is not None
 
     # and the full pipeline still computes the right answer
     rows = ds.take_all()
@@ -1373,3 +1375,94 @@ def test_read_delta_checkpoint_map_types(tmp_path):
     rows = rd.read_delta(root).take_all()
     assert len(rows) == 7
     assert all(r["day"] == datetime.date(2026, 7, 1) for r in rows)
+
+
+def test_predicate_pushdown_into_parquet_scan(tmp_path):
+    """filter(expr=...) directly above read_parquet pushes into the
+    pyarrow dataset scanner (row-group statistics pruning); stacked
+    filters AND together; unconvertible expressions stay as in-memory
+    mask operators."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu import data as rd
+    from ray_tpu.data import col
+    from ray_tpu.data import logical as L
+    from ray_tpu.data.optimizer import LogicalOptimizer
+
+    # two row groups with disjoint id ranges: stats prune one entirely
+    pq.write_table(pa.table({"id": list(range(100)),
+                             "val": [i * 2 for i in range(100)]}),
+                   str(tmp_path / "t.parquet"), row_group_size=50)
+
+    ds = rd.read_parquet(str(tmp_path)).filter(expr=col("id") < 10)
+    opt = LogicalOptimizer().optimize(ds._logical_op)
+    assert isinstance(opt, L.Read) and opt.datasource._filter is not None
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == list(range(10))
+
+    # stacked filters collapse and AND
+    ds2 = (rd.read_parquet(str(tmp_path))
+           .filter(expr=col("id") < 10).filter(expr=col("val") > 4))
+    opt2 = LogicalOptimizer().optimize(ds2._logical_op)
+    assert isinstance(opt2, L.Read)
+    assert sorted(r["id"] for r in ds2.take_all()) == [3, 4, 5, 6, 7, 8, 9]
+
+    # "/" has no faithful pyarrow equivalent (int division semantics):
+    # the filter node survives and evaluates in memory
+    ds3 = rd.read_parquet(str(tmp_path)).filter(expr=col("id") / 4 == 1.0)
+    opt3 = LogicalOptimizer().optimize(ds3._logical_op)
+    assert isinstance(opt3, L.AbstractMap)
+    assert [r["id"] for r in ds3.take_all()] == [4]
+
+    # isin / is_null / cast convert
+    ds4 = rd.read_parquet(str(tmp_path)).filter(expr=col("id").isin([3, 7]))
+    assert isinstance(LogicalOptimizer().optimize(ds4._logical_op), L.Read)
+    assert sorted(r["id"] for r in ds4.take_all()) == [3, 7]
+
+
+def test_predicate_pushdown_null_and_boolean_fidelity(tmp_path):
+    """Ops whose pyarrow semantics diverge from the numpy mask on NULLs
+    must NOT push down: `!=` keeps NaN rows in memory but null-drops in
+    a scan; `&` over ints has no pyarrow kernel at all. Both stay as
+    in-memory mask operators and produce the pre-pushdown answers
+    (round-4 review finds)."""
+    import math
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu import data as rd
+    from ray_tpu.data import col
+    from ray_tpu.data import logical as L
+    from ray_tpu.data.optimizer import LogicalOptimizer
+
+    pq.write_table(pa.table({"a": [1.0, None, 5.0],
+                             "f1": [1, 0, 1], "f2": [1, 1, 0]}),
+                   str(tmp_path / "t.parquet"))
+
+    # != : NaN != 5 is True under numpy -> the null row is KEPT
+    ds = rd.read_parquet(str(tmp_path)).filter(expr=col("a") != 5)
+    assert isinstance(LogicalOptimizer().optimize(ds._logical_op),
+                      L.AbstractMap)  # not pushed
+    vals = [r["a"] for r in ds.take_all()]
+    assert len(vals) == 2 and vals[0] == 1.0 and math.isnan(vals[1])
+
+    # ~ : same inversion hazard
+    ds2 = rd.read_parquet(str(tmp_path)).filter(expr=~(col("a") == 5))
+    assert isinstance(LogicalOptimizer().optimize(ds2._logical_op),
+                      L.AbstractMap)
+    assert len(ds2.take_all()) == 2
+
+    # & over ints: numpy coerces truthiness; pyarrow has no int kernel
+    ds3 = rd.read_parquet(str(tmp_path)).filter(expr=col("f1") & col("f2"))
+    assert isinstance(LogicalOptimizer().optimize(ds3._logical_op),
+                      L.AbstractMap)
+    assert [r["f1"] for r in ds3.take_all()] == [1]
+
+    # & over comparisons IS faithful (Kleene null lands on dropped
+    # exactly where numpy's False does) and pushes
+    ds4 = rd.read_parquet(str(tmp_path)).filter(
+        expr=(col("a") >= 1) & (col("f1") == 1))
+    assert isinstance(LogicalOptimizer().optimize(ds4._logical_op), L.Read)
+    assert sorted(r["a"] for r in ds4.take_all()) == [1.0, 5.0]
