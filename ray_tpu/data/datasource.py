@@ -1147,7 +1147,9 @@ class IcebergDatasource(Datasource):
                 f"{md} contains no *.metadata.json files")
         return os.path.join(md, best)
 
-    def _live_files(self, snapshot_id: Optional[int]) -> List[str]:
+    def _live_files(self, snapshot_id: Optional[int]) -> List[tuple]:
+        """Returns (local path, size_bytes, record_count) per live data
+        file, stats straight from the manifest entries."""
         import json
 
         meta = json.load(open(self._current_metadata()))

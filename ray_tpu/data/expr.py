@@ -284,10 +284,9 @@ def to_pyarrow(expr: Expr):
             return inner.is_null(nan_is_null=True)
         if kind == "isin":
             return inner.isin(list(expr.values))
-        if kind == "cast":
-            try:
-                return inner.cast(pa.from_numpy_dtype(expr.np_dtype))
-            except (pa.ArrowNotImplementedError, TypeError):
-                return None
+        # cast is NOT pushed: pyarrow's safe cast raises on float
+        # truncation/NaN where numpy astype silently truncates — a
+        # pushed cast(float->int) filter would crash the scan (or
+        # diverge) instead of matching the in-memory mask
         return None
     return None

@@ -1,0 +1,81 @@
+"""joblib backend over the runtime (reference: python/ray/util/joblib/
+— ``register_ray()`` + ``with joblib.parallel_backend("ray")``).
+
+``register_ray_tpu()`` registers a ``"ray_tpu"`` joblib backend that
+runs joblib's batched calls on the distributed ``Pool`` shim
+(util/multiprocessing.py: pool actors on the cluster), so
+sklearn-style ``Parallel(n_jobs=...)`` code fans out over the runtime
+unchanged. ``n_jobs=-1`` sizes to the cluster's total CPU resources,
+not the local host's.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+
+
+def _cluster_cpu_count() -> int:
+    try:
+        from ray_tpu import state
+
+        total = 0.0
+        for node in state.list_nodes():
+            if node.get("state") == "ALIVE":
+                total += float((node.get("resources") or {}).get("CPU", 0))
+        if total >= 1:
+            return int(total)
+    except Exception:  # noqa: BLE001 — sizing fallback, never fatal
+        pass
+    import os
+
+    return os.cpu_count() or 1
+
+
+def _backend_base():
+    """Build the backend class lazily so importing this module never
+    hard-requires joblib."""
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    class _RayTpuBackend(MultiprocessingBackend):
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 in Parallel has no meaning")
+            if n_jobs is None:
+                return 1
+            if n_jobs < 0:
+                # -1 = every cluster CPU slot (reference: RayBackend
+                # sizing against ray.cluster_resources, not cpu_count)
+                n_jobs = max(_cluster_cpu_count() + 1 + n_jobs, 1)
+            return n_jobs
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **kwargs):
+            from joblib._parallel_backends import (
+                FallbackToBackend,
+                SequentialBackend,
+            )
+
+            n_jobs = self.effective_n_jobs(n_jobs)
+            if n_jobs == 1:
+                raise FallbackToBackend(
+                    SequentialBackend(nesting_level=self.nesting_level))
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            from ray_tpu.util.multiprocessing import Pool
+
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+    return _RayTpuBackend
+
+
+def register_ray_tpu() -> None:
+    """Register the ``"ray_tpu"`` joblib parallel backend."""
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", _backend_base())
+
+
+# reference-compatible alias
+register_ray = register_ray_tpu

@@ -102,14 +102,33 @@ class Pool:
     def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
         return self.apply_async(fn, args, kwds).get()
 
-    def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
+    def apply_async(self, fn, args=(), kwds=None, callback=None,
+                    error_callback=None) -> AsyncResult:
         self._check_open()
         kwds = kwds or {}
         actor = self._actors[self._rr % self._n]
         self._rr += 1
         wrapped = (lambda *a: fn(*a, **kwds)) if kwds else fn
-        return AsyncResult([actor.run_chunk.remote(wrapped, [tuple(args)])],
-                           unpack_single=True)
+        refs = [actor.run_chunk.remote(wrapped, [tuple(args)])]
+        self._outstanding.extend(refs)  # close()+join() must drain these
+        res = AsyncResult(refs, unpack_single=True)
+        if callback is not None or error_callback is not None:
+            # stdlib parity: completion callbacks fire off-thread (the
+            # joblib backend drives its retrieval loop through these)
+            import threading
+
+            def _watch():
+                try:
+                    val = res.get()
+                except Exception as e:  # noqa: BLE001
+                    if error_callback is not None:
+                        error_callback(e)
+                    return
+                if callback is not None:
+                    callback(val)
+
+            threading.Thread(target=_watch, daemon=True).start()
+        return res
 
     def imap(self, fn, iterable, chunksize: Optional[int] = 1):
         self._check_open()

@@ -1415,7 +1415,7 @@ def test_predicate_pushdown_into_parquet_scan(tmp_path):
     assert isinstance(opt3, L.AbstractMap)
     assert [r["id"] for r in ds3.take_all()] == [4]
 
-    # isin / is_null / cast convert
+    # isin converts (cast deliberately does not: safe-cast divergence)
     ds4 = rd.read_parquet(str(tmp_path)).filter(expr=col("id").isin([3, 7]))
     assert isinstance(LogicalOptimizer().optimize(ds4._logical_op), L.Read)
     assert sorted(r["id"] for r in ds4.take_all()) == [3, 7]

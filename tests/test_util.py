@@ -240,3 +240,60 @@ def test_jax_predictor_batch_inference(rt, tmp_path):
     rows = sorted(out.take_all(), key=lambda r: r["data"])
     assert rows[5]["predictions"] == 15.0
     assert len(rows) == 32
+
+
+# ------------------------------------------------------------------ joblib
+
+
+def test_joblib_backend(rt):
+    """register_ray_tpu() makes joblib.Parallel fan out over the
+    distributed Pool shim (reference: ray.util.joblib.register_ray);
+    exceptions propagate; n_jobs=1 falls back to joblib's sequential
+    backend."""
+    import math
+
+    joblib = pytest.importorskip("joblib")
+    from joblib import Parallel, delayed
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = Parallel()(delayed(math.factorial)(i) for i in range(10))
+    assert out == [math.factorial(i) for i in range(10)]
+
+    def boom(i):
+        if i == 3:
+            raise ValueError("kaboom")
+        return i
+
+    with pytest.raises(ValueError, match="kaboom"):
+        with joblib.parallel_backend("ray_tpu", n_jobs=2):
+            Parallel()(delayed(boom)(i) for i in range(6))
+
+    with joblib.parallel_backend("ray_tpu", n_jobs=1):
+        assert Parallel()(delayed(abs)(-i) for i in range(3)) == [0, 1, 2]
+
+
+def test_apply_async_callbacks(rt):
+    """Pool.apply_async callback/error_callback (stdlib parity — the
+    joblib backend drives retrieval through these)."""
+    import threading
+
+    import ray_tpu.util.multiprocessing as mp
+
+    done = threading.Event()
+    got = []
+    with mp.Pool(processes=1) as pool:
+        pool.apply_async(lambda x: x * 7, (6,),
+                         callback=lambda v: (got.append(v), done.set()))
+        assert done.wait(30) and got == [42]
+
+        err = threading.Event()
+        errs = []
+        pool.apply_async(lambda: 1 / 0,
+                         callback=lambda v: errs.append(("ok", v)),
+                         error_callback=lambda e: (errs.append(e),
+                                                   err.set()))
+        assert err.wait(30)
+        assert isinstance(errs[0], Exception)
