@@ -248,3 +248,73 @@ def read_sample_batch_json(paths):
         return {k: np.concatenate(v, axis=0) for k, v in cols.items()}
 
     return ds.map_batches(expand, batch_format="numpy")
+
+
+def write_sample_batch_parquet(batches, path: str) -> int:
+    """Persist sample batches as parquet, one row per TRANSITION with
+    array columns as fixed-width lists (reference:
+    rllib/offline/output_writer + the parquet path of offline_data; the
+    columnar format is what large offline corpora actually ship as).
+    ``path`` is a directory; returns the number of rows written."""
+    import json
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    total = 0
+    shapes: Dict[str, list] = {}
+    for i, batch in enumerate(batches):
+        cols = {}
+        n = len(next(iter(batch.values())))
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            if arr.ndim == 1:
+                cols[k] = pa.array(arr)
+            else:
+                # [n, d...] -> flat list column; the trailing shape goes
+                # to the sidecar manifest so >2D observations (images)
+                # round-trip exactly like the JSON format
+                flat = arr.reshape(n, -1)
+                shapes[k] = list(arr.shape[1:])
+                cols[k] = pa.FixedSizeListArray.from_arrays(
+                    pa.array(flat.ravel()), flat.shape[1])
+        table = pa.table(cols)
+        pq.write_table(table, os.path.join(path, f"batch-{i:06d}.parquet"))
+        total += n
+    with open(os.path.join(path, "_shapes.json"), "w") as f:
+        json.dump(shapes, f)
+    return total
+
+
+def read_sample_batch_parquet(paths):
+    """Load parquet sample batches into a row-per-transition Dataset for
+    ``train_offline`` — nested list columns stack back to [n, d] float
+    arrays; the streaming executor is the offline pipeline (reference:
+    rllib/offline/json_reader.py's role, columnar)."""
+    import json
+    import os
+
+    from ray_tpu import data as rdata
+
+    shapes: Dict[str, list] = {}
+    for root in ([paths] if isinstance(paths, str) else paths):
+        m = os.path.join(root, "_shapes.json")
+        if os.path.isdir(root) and os.path.exists(m):
+            shapes.update(json.load(open(m)))
+    ds = rdata.read_parquet(paths)
+
+    def to_arrays(batch):
+        out = {}
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            if arr.dtype == object:  # list column -> stacked array
+                arr = np.stack([np.asarray(x) for x in arr.ravel()])
+            shp = shapes.get(k)
+            if shp and list(arr.shape[1:]) != shp:
+                arr = arr.reshape((arr.shape[0], *shp))
+            out[k] = arr
+        return out
+
+    return ds.map_batches(to_arrays, batch_format="numpy")

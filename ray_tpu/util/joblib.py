@@ -55,12 +55,14 @@ def _backend_base():
                 SequentialBackend,
             )
 
+            # connect BEFORE sizing: n_jobs=-1 must see the cluster's
+            # CPU total, not the local host's
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
             n_jobs = self.effective_n_jobs(n_jobs)
             if n_jobs == 1:
                 raise FallbackToBackend(
                     SequentialBackend(nesting_level=self.nesting_level))
-            if not ray_tpu.is_initialized():
-                ray_tpu.init()
             from ray_tpu.util.multiprocessing import Pool
 
             self._pool = Pool(processes=n_jobs)

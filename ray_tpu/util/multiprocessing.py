@@ -69,6 +69,7 @@ class Pool:
                 for i in range(0, len(items), chunksize)]
 
     def _submit(self, fn, chunks: List[List[tuple]]) -> List[Any]:
+        self._prune_outstanding()
         refs = []
         for chunk in chunks:
             actor = self._actors[self._rr % self._n]
@@ -76,6 +77,15 @@ class Pool:
             refs.append(actor.run_chunk.remote(fn, chunk))
         self._outstanding.extend(refs)
         return refs
+
+    def _prune_outstanding(self):
+        """Drop completed refs so a long-lived pool doesn't pin every
+        past result in the object store (join() only needs pending)."""
+        if self._outstanding:
+            _, pending = ray_tpu.wait(self._outstanding,
+                                      num_returns=len(self._outstanding),
+                                      timeout=0)
+            self._outstanding = pending
 
     def _check_open(self):
         if self._closed:
@@ -109,6 +119,7 @@ class Pool:
         actor = self._actors[self._rr % self._n]
         self._rr += 1
         wrapped = (lambda *a: fn(*a, **kwds)) if kwds else fn
+        self._prune_outstanding()
         refs = [actor.run_chunk.remote(wrapped, [tuple(args)])]
         self._outstanding.extend(refs)  # close()+join() must drain these
         res = AsyncResult(refs, unpack_single=True)

@@ -859,3 +859,46 @@ def test_offline_json_sample_batches_roundtrip(rl_ray, tmp_path):
     logits, _ = mod.apply_np(bc.get_weights(), obs)
     acc = float((np.argmax(logits, -1) == actions).mean())
     assert acc > 0.9, (acc, loss)
+
+
+def test_offline_parquet_sample_batches_roundtrip(rl_ray, tmp_path):
+    """Offline parquet format: transitions persist as columnar rows
+    (fixed-size list obs) and read back into a Dataset that drives an
+    offline learner to the same accuracy as the JSON path."""
+    from ray_tpu.rllib import BCLearner, MLPModule
+    from ray_tpu.rllib.offline import (read_sample_batch_parquet,
+                                       train_offline,
+                                       write_sample_batch_parquet)
+
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(512, 4)).astype(np.float32)
+    actions = (obs[:, 0] > 0).astype(np.int32)
+    path = str(tmp_path / "pq")
+    n = write_sample_batch_parquet(
+        [{"obs": obs[:256], "actions": actions[:256]},
+         {"obs": obs[256:], "actions": actions[256:]}], path)
+    assert n == 512
+
+    ds = read_sample_batch_parquet(path)
+    assert ds.count() == 512
+    got = np.concatenate([b["obs"] for b in
+                          ds.iter_batches(batch_format="numpy")])
+    assert got.shape == (512, 4) and got.dtype == np.float32
+
+    # >2D (image) observations round-trip with their exact shape via
+    # the sidecar manifest (round-4 review find: reshape(n, -1) lost it)
+    imgs = rng.normal(size=(8, 5, 6, 2)).astype(np.float32)
+    p2 = str(tmp_path / "pq_img")
+    write_sample_batch_parquet([{"obs": imgs,
+                                 "actions": np.zeros(8, np.int32)}], p2)
+    back = np.concatenate([b["obs"] for b in read_sample_batch_parquet(
+        p2).iter_batches(batch_format="numpy")])
+    assert back.shape == (8, 5, 6, 2)
+    np.testing.assert_allclose(back, imgs)
+
+    mod = MLPModule(4, 2, hidden=(32,))
+    bc = BCLearner(mod, lr=1e-2)
+    train_offline(bc, ds, num_epochs=5, batch_size=128)
+    logits, _ = mod.apply_np(bc.get_weights(), obs)
+    acc = float((np.argmax(logits, -1) == actions).mean())
+    assert acc > 0.9, acc
