@@ -327,6 +327,76 @@ def qwen2_from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
     return cfg, _assemble(cfg, stacked, t, lin, pd)
 
 
+def gemma_from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
+    """(cfg, params) from a transformers GemmaForCausalLM (or checkpoint
+    path/model id). Gemma's deltas from the llama block, all absorbed
+    here: GeGLU gate activation (cfg.mlp_act="gelu_tanh"), embeddings
+    scaled by sqrt(hidden) at lookup (cfg.embed_scale), (1+w) RMSNorm —
+    folded into the stored norm weights so the model code stays llama's
+    — tied lm_head, and an explicit head_dim (256 on gemma-7b).
+    Reference serves gemma via external engines; here it rides the same
+    train/decode paths as llama."""
+    import math as _math
+
+    if isinstance(source, str):
+        from transformers import GemmaForCausalLM
+
+        source = GemmaForCausalLM.from_pretrained(source)
+    hf_cfg = source.config
+    from dataclasses import replace as _replace
+
+    from ray_tpu.models.llama import LlamaConfig
+
+    act = getattr(hf_cfg, "hidden_activation", None) or getattr(
+        hf_cfg, "hidden_act", "gelu_pytorch_tanh")
+    try:
+        # "gelu" is transformers' EXACT erf GELU, not the tanh approx —
+        # conflating them breaks parity at ~1e-3
+        mlp_act = {"gelu_pytorch_tanh": "gelu_tanh", "gelu": "gelu"}[act]
+    except KeyError:
+        raise ValueError(
+            f"unsupported gemma hidden activation {act!r}") from None
+    cfg = LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=getattr(hf_cfg, "num_key_value_heads", None)
+        or hf_cfg.num_attention_heads,
+        head_dim=getattr(hf_cfg, "head_dim", None),
+        max_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        rms_norm_eps=float(hf_cfg.rms_norm_eps),
+        tie_embeddings=True,  # gemma always ties lm_head to embeddings
+        mlp_act=mlp_act,
+        embed_scale=float(_math.sqrt(hf_cfg.hidden_size)),
+    )
+    if dtype is not None:
+        cfg = _replace(cfg, param_dtype=dtype)
+    state_dict = source.state_dict()
+    t, lin = _fetcher(state_dict)
+    _refuse_proj_bias(state_dict)
+    stacked: Dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate",
+        "w_up", "w_down")}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        _stack_attn(stacked, t, lin, p)
+        stacked["w_gate"].append(lin(p + "mlp.gate_proj.weight"))
+        stacked["w_up"].append(lin(p + "mlp.up_proj.weight"))
+        stacked["w_down"].append(lin(p + "mlp.down_proj.weight"))
+    params = _assemble(cfg, stacked, t, lin, dtype or cfg.param_dtype)
+    # gemma RMSNorm computes normed * (1 + w): fold the +1 in here so
+    # ops/layers.rms_norm (normed * w) is exact
+    import jax.numpy as jnp
+
+    params["layers"]["attn_norm"] = params["layers"]["attn_norm"] + 1
+    params["layers"]["mlp_norm"] = params["layers"]["mlp_norm"] + 1
+    params["final_norm"] = params["final_norm"] + 1
+    return cfg, params
+
+
 def hf_model_type(source) -> str:
     """The checkpoint's ``model_type`` WITHOUT loading weights (config
     only for a path/id) — callers can refuse unsupported architectures
@@ -349,6 +419,7 @@ def from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
     else:
         model_type = source.config.model_type
     loader = {"llama": llama_from_hf, "qwen2": qwen2_from_hf,
+              "gemma": gemma_from_hf,
               "mixtral": mixtral_from_hf, "gpt2": gpt2_from_hf}.get(
         model_type)
     if loader is None:

@@ -48,6 +48,11 @@ class LlamaConfig:
     # Qwen2-style additive q/k/v projection biases (the ONLY
     # architectural delta between Qwen2 and Llama at this level)
     attn_qkv_bias: bool = False
+    # Gemma deltas: GeGLU gate activation ("gelu_tanh"), and embeddings
+    # scaled by sqrt(hidden) at lookup. Gemma's (1+w) RMSNorm needs no
+    # knob — the +1 folds into the stored norm weights at load time.
+    mlp_act: str = "silu"  # silu | gelu_tanh
+    embed_scale: float = 1.0
     remat: bool = True
     # partial remat: this many TRAILING layers store activations instead
     # of recomputing (HBM for FLOPs; 0 = classic full per-layer remat).
@@ -218,7 +223,8 @@ def _layer(cfg: LlamaConfig, x, layer_params, cos, sin, mesh=None,
                         seq_axis=seq_axis)
     h2 = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
     mlp = swiglu(h2, p["w_gate"].astype(cfg.dtype),
-                 p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype))
+                 p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype),
+                 act=cfg.mlp_act)
     return x + mlp
 
 
@@ -226,6 +232,8 @@ def forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
             mesh=None) -> jax.Array:
     """tokens [b, s] int32 → logits [b, s, vocab] float32."""
     x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
     cos, sin = rope_frequencies(cfg.head_dim_, tokens.shape[1],
                                 cfg.rope_theta, dtype=cfg.dtype,
                                 scaling=cfg.rope_scaling_dict)
@@ -337,6 +345,8 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
     M = num_microbatches
     assert b % M == 0, f"batch {b} must divide into {M} microbatches"
     x = params["embed"].astype(cfg.dtype)[inputs]
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
     cos, sin = rope_frequencies(cfg.head_dim_, s, cfg.rope_theta,
                                 dtype=cfg.dtype,
                                 scaling=cfg.rope_scaling_dict)

@@ -11,6 +11,7 @@ ops underpin the model zoo (models/llama.py etc.).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -153,15 +154,26 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
 
 
 def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
-           w_down: jax.Array) -> jax.Array:
-    """SwiGLU MLP: down( silu(x @ gate) * (x @ up) ).
+           w_down: jax.Array, act: str = "silu") -> jax.Array:
+    """Gated MLP: down( act(x @ gate) * (x @ up) ).
 
+    ``act`` selects the gate nonlinearity: "silu" (llama's SwiGLU),
+    "gelu_tanh" (gemma's GeGLU — HF hidden_act gelu_pytorch_tanh), or
+    "gelu" (exact erf GELU). Unknown names raise — a typo'd activation
+    must not silently train the wrong model.
     All matmuls in input dtype (bf16 on TPU) with fp32 accumulation via
     preferred_element_type.
     """
+    try:
+        act_fn = {"silu": jax.nn.silu,
+                  "gelu_tanh": partial(jax.nn.gelu, approximate=True),
+                  "gelu": partial(jax.nn.gelu, approximate=False)}[act]
+    except KeyError:
+        raise ValueError(f"unknown gated-MLP activation {act!r} "
+                         "(silu | gelu_tanh | gelu)") from None
     gate = jnp.dot(x, w_gate, preferred_element_type=jnp.float32)
     up = jnp.dot(x, w_up, preferred_element_type=jnp.float32)
-    h = (jax.nn.silu(gate) * up).astype(x.dtype)
+    h = (act_fn(gate) * up).astype(x.dtype)
     return jnp.dot(h, w_down, preferred_element_type=jnp.float32).astype(x.dtype)
 
 

@@ -63,6 +63,7 @@ class LLMEngine:
         cfg_kw = dict(model_config or {})
         hf_model = cfg_kw.pop("hf_model", None)
         preset = cfg_kw.pop("preset", "tiny")
+        quantize = cfg_kw.pop("quantize", None)
         for key in ("dtype", "param_dtype"):
             if isinstance(cfg_kw.get(key), str):
                 cfg_kw[key] = getattr(jnp, cfg_kw[key])
@@ -76,10 +77,10 @@ class LLMEngine:
 
             # refuse BEFORE from_hf materializes a multi-GB checkpoint
             mt = hf_model_type(hf_model)
-            if mt not in ("llama", "qwen2"):
+            if mt not in ("llama", "qwen2", "gemma"):
                 raise ValueError(
                     "the continuous-batching engine serves llama-family "
-                    f"dense checkpoints (llama/qwen2); got {mt!r}")
+                    f"dense checkpoints (llama/qwen2/gemma); got {mt!r}")
             cfg, hf_params = from_hf(
                 hf_model, dtype=cfg_kw.pop("param_dtype", None))
             cfg = _replace(cfg, **cfg_kw)
@@ -100,6 +101,19 @@ class LLMEngine:
         self._mesh = mesh
         self._params = (hf_params if hf_params is not None else
                         llama.init_params(cfg, jax.random.PRNGKey(0)))
+        if quantize is not None:
+            # weight-only int8 serving: decode is HBM-bound on weight
+            # reads, so halving them targets decode throughput (on-chip
+            # numbers in BENCH_NOTES.md round 4)
+            if quantize != "int8":
+                raise ValueError(
+                    f"unsupported quantize={quantize!r} (only 'int8')")
+            if mesh is not None or tp > 1:
+                raise ValueError(
+                    "quantize='int8' currently serves single-chip "
+                    "(tp=1); drop quantize or tp")
+            self._params = jax.jit(
+                llama_decode.quantize_decode_params)(self._params)
         if mesh is not None:
             # shard NOW and drop the unsharded copy — keeping both would
             # hold 1x + 1/tp weights on chip 0, defeating TP's HBM saving

@@ -55,8 +55,12 @@ def _backend_base():
                 SequentialBackend,
             )
 
-            # connect BEFORE sizing: n_jobs=-1 must see the cluster's
-            # CPU total, not the local host's
+            # literal 1/None falls back to sequential WITHOUT paying
+            # cluster startup; only negative n_jobs needs the cluster
+            # connected first so sizing sees cluster CPUs, not the host
+            if n_jobs in (None, 1):
+                raise FallbackToBackend(
+                    SequentialBackend(nesting_level=self.nesting_level))
             if not ray_tpu.is_initialized():
                 ray_tpu.init()
             n_jobs = self.effective_n_jobs(n_jobs)
