@@ -17,8 +17,16 @@ extracted by each node's runtime-env agent, then applied per worker
   after the task; actor-scoped application persists for the actor's
   lifetime (the worker is dedicated to it).
 
-pip/conda/container isolation is intentionally out of scope: workers
-share one pool and one interpreter (and this image installs nothing).
+pip environments (reference: _private/runtime_env/pip.py) install into
+a per-requirements-hash virtualenv (--system-site-packages) created
+lazily node-side by the first worker that needs it; the venv's
+site-packages is prepended to sys.path for the task/actor and removed
+after. This provides package AVAILABILITY isolation (each env sees its
+own installed versions first); it does not re-launch the interpreter,
+so a package already imported by the worker keeps its version — the
+documented difference from the reference's per-env worker processes.
+conda/container isolation stays out of scope (nothing installable in
+this image beyond local wheels).
 """
 
 from __future__ import annotations
@@ -163,6 +171,114 @@ def ensure_extracted(cache_root: str, pkg_hash: str,
     return dest
 
 
+def _pip_env_key(packages, options) -> str:
+    blob = "\n".join(sorted(packages)) + "\0" + " ".join(options)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def normalize_pip(spec) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """runtime_env["pip"] forms: a list of requirement strings, or a
+    dict {"packages": [...], "pip_install_options": [...]}."""
+    if isinstance(spec, (list, tuple)):
+        return tuple(str(p) for p in spec), ()
+    if isinstance(spec, dict):
+        return (tuple(str(p) for p in spec.get("packages") or ()),
+                tuple(str(o) for o in
+                      spec.get("pip_install_options") or ()))
+    raise ValueError(
+        f"runtime_env['pip'] must be a list of requirements or a dict "
+        f"with 'packages'; got {type(spec).__name__}")
+
+
+def ensure_pip_env(cache_root: str, packages, options) -> str:
+    """Create (once per node+requirements hash) a virtualenv with the
+    requested packages; returns its site-packages dir. Concurrency-safe
+    via an O_EXCL lock file + .done marker."""
+    import glob
+    import subprocess
+    import time
+
+    key = _pip_env_key(packages, options)
+    dest = os.path.join(cache_root, "pip", key)
+    done = os.path.join(dest, ".done")
+
+    def site_packages() -> str:
+        hits = glob.glob(os.path.join(dest, "lib", "python*",
+                                      "site-packages"))
+        if not hits:
+            raise FileNotFoundError(f"pip env {key} has no site-packages")
+        return hits[0]
+
+    def lock_holder_dead(path) -> bool:
+        """True when the pid written into the lock file no longer runs —
+        a SIGKILLed installer must not brick this env forever."""
+        try:
+            pid = int(open(path).read().strip() or 0)
+        except (OSError, ValueError):
+            return False  # unreadable/mid-write: treat as live for now
+        if pid <= 0:
+            return False
+        try:
+            os.kill(pid, 0)
+            return False
+        except ProcessLookupError:
+            return True
+        except PermissionError:
+            return False
+
+    if os.path.exists(done):
+        return site_packages()
+    os.makedirs(os.path.join(cache_root, "pip"), exist_ok=True)
+    lock = os.path.join(cache_root, "pip", f"{key}.lock")
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(fd, str(os.getpid()).encode())
+    except FileExistsError:
+        # another worker is installing: wait for its .done
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if os.path.exists(done):
+                return site_packages()
+            if not os.path.exists(lock):  # holder failed cleanly: retry
+                return ensure_pip_env(cache_root, packages, options)
+            if lock_holder_dead(lock):  # holder SIGKILLed: break the lock
+                import shutil
+
+                shutil.rmtree(dest, ignore_errors=True)
+                try:
+                    os.remove(lock)
+                except OSError:
+                    pass
+                return ensure_pip_env(cache_root, packages, options)
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"pip env {key} install did not finish within 600s "
+            f"(holder of {lock} may be stuck)")
+    try:
+        if not os.path.exists(done):
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 dest], check=True, capture_output=True)
+            py = os.path.join(dest, "bin", "python")
+            proc = subprocess.run(
+                [py, "-m", "pip", "install", "--disable-pip-version-check",
+                 *options, *packages],
+                capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip install failed for runtime_env packages "
+                    f"{list(packages)}:\n{proc.stderr[-2000:]}")
+            with open(done, "w") as f:
+                f.write("\n".join(packages))
+        return site_packages()
+    finally:
+        os.close(fd)
+        try:
+            os.remove(lock)
+        except OSError:
+            pass
+
+
 def apply(runtime_env: Optional[dict], fetch: Callable[[str], bytes],
           cache_root: Optional[str] = None):
     """Worker-side: apply env_vars, working_dir, py_modules.
@@ -182,32 +298,63 @@ def apply(runtime_env: Optional[dict], fetch: Callable[[str], bytes],
         "RTPU_PKG_DIR", "/tmp/ray_tpu_pkgs")
     os.makedirs(cache_root, exist_ok=True)
     saved_env = None
-    env_vars = runtime_env.get("env_vars")
-    if env_vars:
-        saved_env = {k: os.environ.get(k) for k in env_vars}
-        os.environ.update({k: str(v) for k, v in env_vars.items()})
     saved_cwd = None
     saved_path: Optional[list] = None
-    wd_hash = runtime_env.get("working_dir_pkg")
-    mod_hashes = runtime_env.get("py_modules_pkgs") or []
-    if wd_hash or mod_hashes:
-        saved_path = list(sys.path)
-    if wd_hash:
-        wd = ensure_extracted(cache_root, wd_hash, fetch)
-        saved_cwd = os.getcwd()
-        os.chdir(wd)
-        sys.path.insert(0, wd)
-    for h in mod_hashes:
-        sys.path.insert(0, ensure_extracted(cache_root, h, fetch))
-    if saved_env is None and saved_cwd is None and saved_path is None:
+    pip_sp: Optional[str] = None
+    try:
+        env_vars = runtime_env.get("env_vars")
+        if env_vars:
+            saved_env = {k: os.environ.get(k) for k in env_vars}
+            os.environ.update({k: str(v) for k, v in env_vars.items()})
+        wd_hash = runtime_env.get("working_dir_pkg")
+        mod_hashes = runtime_env.get("py_modules_pkgs") or []
+        pip_spec = runtime_env.get("pip")
+        if wd_hash or mod_hashes or pip_spec:
+            saved_path = list(sys.path)
+        if pip_spec:
+            packages, options = normalize_pip(pip_spec)
+            if packages:
+                pip_sp = ensure_pip_env(cache_root, packages, options)
+                sys.path.insert(0, pip_sp)
+        if wd_hash:
+            wd = ensure_extracted(cache_root, wd_hash, fetch)
+            saved_cwd = os.getcwd()
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+        for h in mod_hashes:
+            sys.path.insert(0, ensure_extracted(cache_root, h, fetch))
+    except BaseException:
+        # half-applied env must not leak into the pooled worker (e.g.
+        # env_vars applied, then the pip install fails)
+        restore((saved_env, saved_cwd, saved_path, pip_sp))
+        raise
+    if (saved_env is None and saved_cwd is None and saved_path is None
+            and pip_sp is None):
         return None
-    return (saved_env, saved_cwd, saved_path)
+    return (saved_env, saved_cwd, saved_path, pip_sp)
 
 
 def restore(state) -> None:
     if state is None:
         return
-    saved_env, saved_cwd, saved_path = state
+    saved_env, saved_cwd, saved_path, pip_sp = state
+    if pip_sp:
+        # sys.path restore alone is not isolation: modules already
+        # imported from the env's site-packages live on in sys.modules
+        # and would satisfy env-less imports on this pooled worker
+        prefix = pip_sp + os.sep
+        for name, mod in list(sys.modules.items()):
+            f = getattr(mod, "__file__", None)
+            if f and f.startswith(prefix):
+                del sys.modules[name]
+                continue
+            paths = getattr(mod, "__path__", None)
+            if paths is not None:
+                try:
+                    if any(str(p).startswith(prefix) for p in paths):
+                        del sys.modules[name]
+                except TypeError:
+                    pass
     if saved_env:
         for k, v in saved_env.items():
             if v is None:

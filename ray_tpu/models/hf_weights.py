@@ -389,8 +389,6 @@ def gemma_from_hf(source, dtype=None) -> Tuple[Any, Dict[str, Any]]:
     params = _assemble(cfg, stacked, t, lin, dtype or cfg.param_dtype)
     # gemma RMSNorm computes normed * (1 + w): fold the +1 in here so
     # ops/layers.rms_norm (normed * w) is exact
-    import jax.numpy as jnp
-
     params["layers"]["attn_norm"] = params["layers"]["attn_norm"] + 1
     params["layers"]["mlp_norm"] = params["layers"]["mlp_norm"] + 1
     params["final_norm"] = params["final_norm"] + 1

@@ -270,6 +270,11 @@ def write_sample_batch_parquet(batches, path: str) -> int:
         n = len(next(iter(batch.values())))
         for k, v in batch.items():
             arr = np.asarray(v)
+            shp = list(arr.shape[1:])
+            if shapes.setdefault(k, shp) != shp:
+                raise ValueError(
+                    f"column {k!r} has inconsistent trailing shapes "
+                    f"across batches: {shapes[k]} vs {shp}")
             if arr.ndim == 1:
                 cols[k] = pa.array(arr)
             else:
@@ -277,11 +282,6 @@ def write_sample_batch_parquet(batches, path: str) -> int:
                 # to the sidecar manifest so >2D observations (images)
                 # round-trip exactly like the JSON format
                 flat = arr.reshape(n, -1)
-                shp = list(arr.shape[1:])
-                if shapes.setdefault(k, shp) != shp:
-                    raise ValueError(
-                        f"column {k!r} has inconsistent trailing shapes "
-                        f"across batches: {shapes[k]} vs {shp}")
                 cols[k] = pa.FixedSizeListArray.from_arrays(
                     pa.array(flat.ravel()), flat.shape[1])
         table = pa.table(cols)

@@ -102,9 +102,13 @@ class LLMEngine:
         self._params = (hf_params if hf_params is not None else
                         llama.init_params(cfg, jax.random.PRNGKey(0)))
         if quantize is not None:
-            # weight-only int8 serving: decode is HBM-bound on weight
-            # reads, so halving them targets decode throughput (on-chip
-            # numbers in BENCH_NOTES.md round 4)
+            # weight-only int8 serving. Measured on v5e-lite at 1B
+            # (BENCH_NOTES.md round 4): throughput-NEUTRAL on decode
+            # (ITL 15.6 vs 15.5 ms — XLA does not realize the halved
+            # weight reads at this scale) and slightly slower prefill;
+            # the win is HBM CAPACITY — weights shrink 2x, so a chip
+            # serves ~2x the model (8B int8 in ~8 GB) or frees HBM for
+            # longer KV caches. Opt-in accordingly.
             if quantize != "int8":
                 raise ValueError(
                     f"unsupported quantize={quantize!r} (only 'int8')")

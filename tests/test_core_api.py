@@ -209,3 +209,63 @@ def test_free_reclaims_store_and_errors_gets(rt):
         rt.get(ref, timeout=5)
     # freeing twice (or freeing an unresolved id) is a no-op
     assert rt.free(ref) == 0
+
+
+def _build_test_wheel(dirpath, name="rtpu_testpkg", version="1.0",
+                      value=41):
+    """Hand-build a minimal wheel (a wheel is just a zip with dist-info)
+    so the pip runtime-env path is testable with zero network."""
+    import os
+    import zipfile
+
+    whl = os.path.join(dirpath, f"{name}-{version}-py3-none-any.whl")
+    di = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}/__init__.py", f"VALUE = {value}\n")
+        z.writestr(f"{di}/METADATA",
+                   f"Metadata-Version: 2.1\nName: {name}\n"
+                   f"Version: {version}\n")
+        z.writestr(f"{di}/WHEEL",
+                   "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib:"
+                   " true\nTag: py3-none-any\n")
+        z.writestr(f"{di}/RECORD", "")
+    return whl
+
+
+def test_runtime_env_pip_local_wheel(rt, tmp_path):
+    """runtime_env={'pip': ...}: the first worker builds a per-hash venv
+    (--no-index against a local wheel here — zero network), the task
+    imports the package, and a task WITHOUT the env cannot — package
+    availability is env-scoped, not leaked into the pool. (The venv
+    lands in the node-side package cache; the find-links path makes the
+    requirements hash unique per run, so this exercises a REAL
+    install.)"""
+    _build_test_wheel(str(tmp_path), value=41)
+
+    pipenv = {"pip": {"packages": ["rtpu_testpkg"],
+                      "pip_install_options": [
+                          "--no-index", "--find-links", str(tmp_path)]}}
+
+    @rt.remote(runtime_env=pipenv)
+    def with_env():
+        import rtpu_testpkg
+
+        return rtpu_testpkg.VALUE + 1
+
+    @rt.remote
+    def without_env():
+        try:
+            import rtpu_testpkg  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    # enough submissions that EVERY pooled worker runs the env at least
+    # once — isolation must not depend on scheduling luck (the restore
+    # purges env-imported modules from sys.modules, not just sys.path)
+    assert rt.get([with_env.remote() for _ in range(8)]) == [42] * 8
+    assert rt.get([without_env.remote() for _ in range(8)]) \
+        == ["isolated"] * 8
+    # cached second use: no reinstall (the .done marker short-circuits)
+    assert rt.get(with_env.remote()) == 42
