@@ -242,13 +242,17 @@ def ensure_pip_env(cache_root: str, packages, options) -> str:
             if not os.path.exists(lock):  # holder failed cleanly: retry
                 return ensure_pip_env(cache_root, packages, options)
             if lock_holder_dead(lock):  # holder SIGKILLed: break the lock
+                # atomic rename elects exactly ONE breaker — concurrent
+                # waiters acting on the same stale pid must not rmtree a
+                # new installer's in-progress venv
+                try:
+                    os.rename(lock, f"{lock}.stale.{os.getpid()}")
+                except OSError:
+                    time.sleep(0.2)
+                    continue  # someone else broke it; wait normally
                 import shutil
 
                 shutil.rmtree(dest, ignore_errors=True)
-                try:
-                    os.remove(lock)
-                except OSError:
-                    pass
                 return ensure_pip_env(cache_root, packages, options)
             time.sleep(0.2)
         raise TimeoutError(

@@ -9,11 +9,14 @@ are actors collecting vectorized numpy rollouts in parallel.
 
 Algorithm families: PPO (on-policy, clipped), IMPALA (async actor-learner
 with V-trace), DQN (double DQN + optional prioritized replay), SAC
-(continuous control), and offline BC/CQL over ``ray_tpu.data`` Datasets.
+(continuous control), DreamerV3 (model-based: RSSM world model +
+imagination actor-critic), and offline BC/CQL/MARWIL over
+``ray_tpu.data`` Datasets.
 """
 
 from ray_tpu.rllib.algorithm import AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.dreamer import DreamerV3, DreamerV3Config  # noqa: F401
 from ray_tpu.rllib.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput  # noqa: F401
 from ray_tpu.rllib.catalog import Box, Catalog, Discrete  # noqa: F401

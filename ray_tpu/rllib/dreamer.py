@@ -1,0 +1,638 @@
+"""DreamerV3: model-based RL — learn a world model, act in imagination.
+
+Reference: rllib/algorithms/dreamerv3/ (Hafner et al. 2023,
+arXiv:2301.04104). This is a compact TPU-first implementation of the
+algorithm's core: an RSSM world model (GRU deterministic state +
+categorical stochastic latents with unimix), symlog observation/KL
+losses with free bits and KL balancing, twohot symlog reward and critic
+heads, imagination rollouts from replayed posterior states, λ-returns
+over predicted continues, percentile-EMA return normalization for the
+REINFORCE actor. Both updates (world model, actor-critic) are single
+jitted programs; the recurrent policy steps through one small jitted
+act function during collection.
+
+Deliberate simplifications vs the paper at this scale (documented, not
+hidden): vector observations only (MLP encoder/decoder — the CNN path
+lives in rl_module.CNNModule and can slot in), no slow-critic EMA
+regularizer, and collection runs in-process because the policy is
+recurrent (the learner dominates compute; the env is a vectorized
+host loop).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.envs import make_env
+
+
+def symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.expm1(jnp.abs(x))
+
+
+class TwoHot:
+    """Twohot encoding over symlog-spaced bins (the paper's robust
+    regression head for rewards and values)."""
+
+    def __init__(self, low=-15.0, high=15.0, n=41):
+        import jax.numpy as jnp
+
+        self.bins = jnp.linspace(low, high, n)
+        self.n = n
+
+    def encode(self, y):
+        """y [...] real -> [... , n] twohot weights of symlog(y)."""
+        import jax.numpy as jnp
+
+        y = symlog(y)
+        y = jnp.clip(y, self.bins[0], self.bins[-1])
+        idx = jnp.clip(jnp.searchsorted(self.bins, y, side="right") - 1,
+                       0, self.n - 2)  # left bin of the bracket
+        left = self.bins[idx]
+        right = self.bins[idx + 1]
+        w_right = jnp.clip((y - left) / (right - left), 0.0, 1.0)
+        one = jnp.eye(self.n)
+        return (one[idx] * (1.0 - w_right)[..., None]
+                + one[idx + 1] * w_right[..., None])
+
+    def decode(self, logits):
+        """[..., n] logits -> [...] real expectation in symexp space."""
+        import jax
+
+        probs = jax.nn.softmax(logits, axis=-1)
+        return symexp((probs * self.bins).sum(-1))
+
+
+def _linear(key, din, dout, scale=1.0):
+    import jax
+    import jax.numpy as jnp
+
+    w = jax.random.truncated_normal(key, -2, 2, (din, dout)) \
+        * scale / np.sqrt(din)
+    return {"w": w.astype(jnp.float32), "b": jnp.zeros((dout,))}
+
+
+def _apply_linear(p, x):
+    import jax.numpy as jnp
+
+    return jnp.dot(x, p["w"]) + p["b"]
+
+
+def _norm_silu(x):
+    """LayerNorm + SiLU — the paper's block activation."""
+    import jax
+    import jax.numpy as jnp
+
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return jax.nn.silu((x - mean) * jax.lax.rsqrt(var + 1e-5))
+
+
+def _mlp(params, x):
+    for p in params:
+        x = _norm_silu(_apply_linear(p, x))
+    return x
+
+
+class DreamerV3Learner:
+    """World model + actor-critic, each updated by one jitted program."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *, deter=128,
+                 stoch_vars=8, stoch_classes=8, units=128, lr=4e-4,
+                 ac_lr=1e-4, gamma=0.99, lam=0.95, horizon=10,
+                 entropy=1e-3, unimix=0.01, free_bits=1.0,
+                 imag_starts=64, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.deter = deter
+        self.V, self.K = stoch_vars, stoch_classes
+        self.z_dim = stoch_vars * stoch_classes
+        self.units = units
+        self.gamma, self.lam = gamma, lam
+        self.horizon = horizon
+        self.entropy = entropy
+        self.unimix = unimix
+        self.free_bits = free_bits
+        self.imag_starts = imag_starts
+        self.twohot = TwoHot()
+
+        keys = iter(jax.random.split(jax.random.PRNGKey(seed), 24))
+        U, D, Z, A = units, deter, self.z_dim, num_actions
+        nb = self.twohot.n
+        self.wm_params = {
+            "enc": [_linear(next(keys), obs_dim, U),
+                    _linear(next(keys), U, U)],
+            "in": _linear(next(keys), Z + A, U),     # GRU input embed
+            "gru": _linear(next(keys), U + D, 3 * D),
+            "prior": [_linear(next(keys), D, U)],
+            "prior_out": _linear(next(keys), U, Z),
+            "post": [_linear(next(keys), D + U, U)],
+            "post_out": _linear(next(keys), U, Z),
+            "dec": [_linear(next(keys), D + Z, U),
+                    _linear(next(keys), U, U)],
+            "dec_out": _linear(next(keys), U, obs_dim),
+            "rew": [_linear(next(keys), D + Z, U)],
+            "rew_out": _linear(next(keys), U, nb, scale=0.0),
+            "cont": [_linear(next(keys), D + Z, U)],
+            "cont_out": _linear(next(keys), U, 1),
+        }
+        self.ac_params = {
+            "actor": [_linear(next(keys), D + Z, U),
+                      _linear(next(keys), U, U)],
+            "actor_out": _linear(next(keys), U, A, scale=0.01),
+            "critic": [_linear(next(keys), D + Z, U),
+                       _linear(next(keys), U, U)],
+            "critic_out": _linear(next(keys), U, nb, scale=0.0),
+        }
+        self.wm_tx = optax.chain(optax.clip_by_global_norm(100.0),
+                                 optax.adam(lr))
+        self.ac_tx = optax.chain(optax.clip_by_global_norm(100.0),
+                                 optax.adam(ac_lr))
+        self.wm_opt = self.wm_tx.init(self.wm_params)
+        self.ac_opt = self.ac_tx.init(self.ac_params)
+        # percentile EMA for return normalization (paper eq. 9)
+        self.ret_lo = jnp.asarray(0.0)
+        self.ret_hi = jnp.asarray(0.0)
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1))
+        self._act = jax.jit(self._act_impl)
+
+    # ---- RSSM pieces -----------------------------------------------------
+
+    def _uni_logits(self, logits):
+        """Unimix: 1% uniform mixed into the categorical (paper §B)."""
+        import jax
+        import jax.numpy as jnp
+
+        logits = logits.reshape(logits.shape[:-1] + (self.V, self.K))
+        probs = jax.nn.softmax(logits, -1)
+        probs = (1 - self.unimix) * probs + self.unimix / self.K
+        return jnp.log(probs)
+
+    def _sample_z(self, logits, key):
+        """Straight-through one-hot sample from V independent
+        categoricals; returns flat [., V*K]."""
+        import jax
+        import jax.numpy as jnp
+
+        idx = jax.random.categorical(key, logits, axis=-1)
+        hot = jax.nn.one_hot(idx, self.K)
+        probs = jax.nn.softmax(logits, -1)
+        hot = probs + jax.lax.stop_gradient(hot - probs)
+        return hot.reshape(hot.shape[:-2] + (self.z_dim,))
+
+    def _gru(self, wm, h, x):
+        import jax
+        import jax.numpy as jnp
+
+        x = _norm_silu(_apply_linear(wm["in"], x))
+        gates = _apply_linear(wm["gru"], jnp.concatenate([x, h], -1))
+        reset, cand, update = jnp.split(gates, 3, -1)
+        reset = jax.nn.sigmoid(reset)
+        cand = jnp.tanh(reset * cand)
+        update = jax.nn.sigmoid(update - 1.0)
+        return update * cand + (1 - update) * h
+
+    def _prior(self, wm, h):
+        return self._uni_logits(_apply_linear(wm["prior_out"],
+                                              _mlp(wm["prior"], h)))
+
+    def _post(self, wm, h, emb):
+        import jax.numpy as jnp
+
+        x = _mlp(wm["post"], jnp.concatenate([h, emb], -1))
+        return self._uni_logits(_apply_linear(wm["post_out"], x))
+
+    def _wm_step(self, wm, h, z, a_onehot, emb, is_first, key):
+        """One posterior RSSM step with episode-boundary reset."""
+        import jax.numpy as jnp
+
+        mask = (1.0 - is_first)[..., None]
+        h = h * mask
+        z = z * mask
+        a_onehot = a_onehot * mask
+        h = self._gru(wm, h, jnp.concatenate([z, a_onehot], -1))
+        post_logits = self._post(wm, h, emb)
+        z_new = self._sample_z(post_logits, key)
+        return h, z_new, post_logits
+
+    # ---- world-model update ---------------------------------------------
+
+    def _kl(self, lhs, rhs):
+        """KL(cat(lhs) || cat(rhs)) summed over latent vars."""
+        import jax
+        import jax.numpy as jnp
+
+        lp = jax.nn.log_softmax(lhs, -1)
+        rp = jax.nn.log_softmax(rhs, -1)
+        return (jnp.exp(lp) * (lp - rp)).sum(-1).sum(-1)
+
+    def _wm_loss(self, wm, batch, key):
+        import jax
+        import jax.numpy as jnp
+
+        obs = batch["obs"]            # [B, L, obs_dim]
+        acts = batch["actions"]       # [B, L] int32 (action TAKEN at t)
+        rews = batch["rewards"]       # [B, L]
+        cont = 1.0 - batch["dones"]   # [B, L]
+        first = batch["is_first"]     # [B, L]
+        B, L = obs.shape[:2]
+        emb = _mlp(wm["enc"], symlog(obs))
+        a_prev = jnp.concatenate(
+            [jnp.zeros((B, 1, self.num_actions)),
+             jax.nn.one_hot(acts[:, :-1], self.num_actions)], axis=1)
+
+        def step(carry, inp):
+            h, z, k = carry
+            emb_t, a_t, first_t = inp
+            k, sub = jax.random.split(k)
+            h, z, post_logits = self._wm_step(wm, h, z, a_t, emb_t,
+                                              first_t, sub)
+            prior_logits = self._prior(wm, h)
+            return (h, z, k), (h, z, post_logits, prior_logits)
+
+        h0 = jnp.zeros((B, self.deter))
+        z0 = jnp.zeros((B, self.z_dim))
+        (_, _, _), (hs, zs, post_l, prior_l) = jax.lax.scan(
+            step, (h0, z0, key),
+            (emb.transpose(1, 0, 2), a_prev.transpose(1, 0, 2),
+             first.transpose(1, 0)))
+        hs = hs.transpose(1, 0, 2)            # [B, L, D]
+        zs = zs.transpose(1, 0, 2)
+        post_l = post_l.transpose(1, 0, 2, 3)
+        prior_l = prior_l.transpose(1, 0, 2, 3)
+
+        feat = jnp.concatenate([hs, zs], -1)
+        recon = _apply_linear(wm["dec_out"], _mlp(wm["dec"], feat))
+        rew_logits = _apply_linear(wm["rew_out"], _mlp(wm["rew"], feat))
+        cont_logit = _apply_linear(wm["cont_out"],
+                                   _mlp(wm["cont"], feat))[..., 0]
+
+        recon_loss = ((recon - symlog(obs)) ** 2).sum(-1)
+        rew_target = self.twohot.encode(rews)
+        rew_loss = -(rew_target
+                     * jax.nn.log_softmax(rew_logits, -1)).sum(-1)
+        cont_loss = (jnp.maximum(cont_logit, 0) - cont_logit * cont
+                     + jnp.log1p(jnp.exp(-jnp.abs(cont_logit))))
+        # KL balancing (paper eq. 5): dyn pushes the prior toward the
+        # posterior, rep regularizes the posterior; both free-bits clipped
+        dyn = self._kl(jax.lax.stop_gradient(post_l), prior_l)
+        rep = self._kl(post_l, jax.lax.stop_gradient(prior_l))
+        kl = (0.5 * jnp.maximum(dyn, self.free_bits)
+              + 0.1 * jnp.maximum(rep, self.free_bits))
+        loss = (recon_loss + rew_loss + cont_loss + kl).mean()
+        return loss, (hs, zs)
+
+    # ---- actor-critic update --------------------------------------------
+
+    def _imagine(self, wm, ac, h, z, key):
+        """Roll the prior forward ``horizon`` steps with actor actions.
+        World-model params are constants here (REINFORCE needs no
+        gradient through the dynamics)."""
+        import jax
+        import jax.numpy as jnp
+
+        def step(carry, _):
+            h, z, k = carry
+            feat = jnp.concatenate([h, z], -1)
+            logits = _apply_linear(ac["actor_out"],
+                                   _mlp(ac["actor"], feat))
+            k, k1, k2 = jax.random.split(k, 3)
+            a = jax.random.categorical(k1, logits, axis=-1)
+            a_hot = jax.nn.one_hot(a, self.num_actions)
+            h2 = self._gru(wm, h, jnp.concatenate([z, a_hot], -1))
+            z2 = self._sample_z(self._prior(wm, h2), k2)
+            return (h2, z2, k), (feat, a)
+
+        (hH, zH, _), (feats, acts) = jax.lax.scan(
+            step, (h, z, key), None, length=self.horizon)
+        last_feat = jnp.concatenate([hH, zH], -1)
+        return feats, acts, last_feat  # feats [H, N, F], acts [H, N]
+
+    def _ac_loss(self, ac, wm, states, key, ret_lo, ret_hi):
+        import jax
+        import jax.numpy as jnp
+
+        h, z = states
+        feats, acts, last_feat = self._imagine(
+            wm, ac, h, z, key)
+        all_feats = jnp.concatenate([feats, last_feat[None]], 0)
+        # predictions along the imagined trajectory (constants for the
+        # actor's REINFORCE gradient)
+        sg = jax.lax.stop_gradient
+        # pre-action-state convention, matching EXACTLY how the heads
+        # are trained on auto-reset real data: rew(feat_t) ~ reward of
+        # the transition taken FROM t, cont(feat_t) ~ that transition
+        # survives. (The paper's arrival convention needs terminal
+        # observations, which auto-reset vector envs swallow.)
+        rew_logits = _apply_linear(wm["rew_out"],
+                                   _mlp(wm["rew"], all_feats[:-1]))
+        rewards = self.twohot.decode(rew_logits)          # [H, N]
+        cont = jax.nn.sigmoid(_apply_linear(
+            wm["cont_out"], _mlp(wm["cont"], all_feats[:-1]))[..., 0])
+        v_logits = _apply_linear(ac["critic_out"],
+                                 _mlp(ac["critic"], all_feats))
+        values = self.twohot.decode(v_logits)             # [H+1, N]
+
+        disc = self.gamma * cont
+        # λ-returns, backward
+        def back(acc, inp):
+            r, d, v_next = inp
+            ret = r + d * ((1 - self.lam) * v_next + self.lam * acc)
+            return ret, ret
+
+        _, rets = jax.lax.scan(
+            back, values[-1],
+            (rewards[::-1], disc[::-1], values[1:][::-1]))
+        rets = rets[::-1]                                  # [H, N]
+        rets = sg(rets)
+
+        # trajectory weights: don't learn past predicted terminations
+        weights = sg(jnp.concatenate(
+            [jnp.ones_like(disc[:1]), jnp.cumprod(disc[:-1], 0)], 0))
+
+        # percentile-EMA return normalization (paper: scale by
+        # max(1, per95-per5))
+        lo = jnp.percentile(rets, 5.0)
+        hi = jnp.percentile(rets, 95.0)
+        new_lo = 0.99 * ret_lo + 0.01 * lo
+        new_hi = 0.99 * ret_hi + 0.01 * hi
+        scale = jnp.maximum(1.0, new_hi - new_lo)
+
+        actor_logits = _apply_linear(ac["actor_out"],
+                                     _mlp(ac["actor"], sg(feats)))
+        logp = jax.nn.log_softmax(actor_logits, -1)
+        lp_a = jnp.take_along_axis(logp, acts[..., None], -1)[..., 0]
+        adv = sg((rets - values[:-1]) / scale)
+        ent = -(jnp.exp(logp) * logp).sum(-1)
+        actor_loss = -(weights * (lp_a * adv + self.entropy * ent)).mean()
+
+        target = self.twohot.encode(rets)
+        critic_ce = -(target * jax.nn.log_softmax(
+            v_logits[:-1], -1)).sum(-1)
+        critic_loss = (weights * critic_ce).mean()
+        return actor_loss + critic_loss, (new_lo, new_hi,
+                                          rets.mean(), ent.mean())
+
+    # ---- combined jitted update -----------------------------------------
+
+    def _update_impl(self, wm_params, ac_params, wm_opt, ac_opt, batch,
+                     key, ret_lo, ret_hi):
+        import jax
+
+        k1, k2 = jax.random.split(key)
+        (wm_loss, (hs, zs)), wm_grads = jax.value_and_grad(
+            self._wm_loss, has_aux=True)(wm_params, batch, k1)
+        upd, wm_opt = self.wm_tx.update(wm_grads, wm_opt, wm_params)
+        import optax
+
+        wm_params = optax.apply_updates(wm_params, upd)
+
+        # imagination starts: a random subsample of the batch's
+        # posterior states (capping the AC program's width — the paper
+        # uses every state, which at B*L starts dominates update cost)
+        sg = jax.lax.stop_gradient
+        h = sg(hs).reshape(-1, self.deter)
+        z = sg(zs).reshape(-1, self.z_dim)
+        if self.imag_starts and self.imag_starts < h.shape[0]:
+            k2, ksub = jax.random.split(k2)
+            pick = jax.random.choice(ksub, h.shape[0],
+                                     (self.imag_starts,), replace=False)
+            h, z = h[pick], z[pick]
+        (ac_loss, (ret_lo, ret_hi, ret_mean, ent)), ac_grads = \
+            jax.value_and_grad(self._ac_loss, has_aux=True)(
+                ac_params, wm_params, (h, z), k2, ret_lo, ret_hi)
+        upd, ac_opt = self.ac_tx.update(ac_grads, ac_opt, ac_params)
+        ac_params = optax.apply_updates(ac_params, upd)
+        return (wm_params, ac_params, wm_opt, ac_opt, ret_lo, ret_hi,
+                {"wm_loss": wm_loss, "ac_loss": ac_loss,
+                 "imag_return": ret_mean, "entropy": ent})
+
+    def update(self, batch: Dict[str, np.ndarray], key) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        (self.wm_params, self.ac_params, self.wm_opt, self.ac_opt,
+         self.ret_lo, self.ret_hi, metrics) = self._update(
+            self.wm_params, self.ac_params, self.wm_opt, self.ac_opt,
+            batch, key, self.ret_lo, self.ret_hi)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # ---- acting ----------------------------------------------------------
+
+    def _act_impl(self, wm, ac, h, z, a_prev, obs, is_first, key,
+                  greedy):
+        import jax
+        import jax.numpy as jnp
+
+        emb = _mlp(wm["enc"], symlog(obs))
+        a_hot = jax.nn.one_hot(a_prev, self.num_actions)
+        k1, k2 = jax.random.split(key)
+        h, z, _ = self._wm_step(wm, h, z, a_hot, emb, is_first, k1)
+        logits = _apply_linear(ac["actor_out"], _mlp(
+            ac["actor"], jnp.concatenate([h, z], -1)))
+        a = jnp.where(greedy, jnp.argmax(logits, -1),
+                      jax.random.categorical(k2, logits, -1))
+        return h, z, a.astype(jnp.int32)
+
+    def act(self, state, obs, is_first, key, greedy=False):
+        h, z, a_prev = state
+        h, z, a = self._act(self.wm_params, self.ac_params, h, z,
+                            a_prev, obs, is_first, key, greedy)
+        return (h, z, a), np.asarray(a)
+
+    def init_state(self, n: int):
+        import jax.numpy as jnp
+
+        return (jnp.zeros((n, self.deter)), jnp.zeros((n, self.z_dim)),
+                jnp.zeros((n,), jnp.int32))
+
+
+class _SeqReplay:
+    """Per-env contiguous streams; samples length-L windows (is_first
+    flags let the RSSM reset across episode boundaries inside a
+    window)."""
+
+    def __init__(self, num_envs: int, obs_dim: int, capacity: int = 4096):
+        self.cap = capacity
+        self.n = num_envs
+        self.obs = np.zeros((num_envs, capacity, obs_dim), np.float32)
+        self.act = np.zeros((num_envs, capacity), np.int32)
+        self.rew = np.zeros((num_envs, capacity), np.float32)
+        self.done = np.zeros((num_envs, capacity), np.float32)
+        self.first = np.zeros((num_envs, capacity), np.float32)
+        self.ptr = 0
+        self.full = False
+
+    def add(self, obs, act, rew, done, first):
+        i = self.ptr % self.cap
+        self.obs[:, i] = obs
+        self.act[:, i] = act
+        self.rew[:, i] = rew
+        self.done[:, i] = done
+        self.first[:, i] = first
+        self.ptr += 1
+        if self.ptr >= self.cap:
+            self.full = True
+
+    def __len__(self):
+        return min(self.ptr, self.cap)
+
+    def sample(self, rng, batch: int, length: int) -> Dict[str, np.ndarray]:
+        size = len(self)
+        assert size >= length
+        envs = rng.integers(0, self.n, batch)
+        # windows must not straddle the ring's write head
+        if self.full:
+            offs = rng.integers(0, size - length, batch)
+            starts = (self.ptr + offs) % self.cap
+        else:
+            starts = rng.integers(0, size - length + 1, batch)
+        idx = (starts[:, None] + np.arange(length)[None]) % self.cap
+        out = {"obs": self.obs[envs[:, None], idx],
+               "actions": self.act[envs[:, None], idx],
+               "rewards": self.rew[envs[:, None], idx],
+               "dones": self.done[envs[:, None], idx],
+               "is_first": self.first[envs[:, None], idx]}
+        # the window's first element always resets the RSSM state (we
+        # don't know the state before the window)
+        out["is_first"][:, 0] = 1.0
+        return out
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 4e-4
+        self.num_envs_per_runner = 8
+
+    def build(self) -> "DreamerV3":
+        return DreamerV3(self)
+
+
+class DreamerV3:
+    """Algorithm driver: collect with the recurrent policy, train the
+    world model + imagination actor-critic (train() = one iteration of
+    ``steps_per_iter`` env batches with one update each)."""
+
+    def __init__(self, config: DreamerV3Config):
+        import jax
+
+        kw = config.train_kwargs
+        self.env = make_env(config.env_name, config.num_envs_per_runner,
+                            seed=config.seed)
+        self.learner = DreamerV3Learner(
+            self.env.obs_dim, self.env.num_actions,
+            lr=config.lr, ac_lr=kw.get("ac_lr", 1e-4),
+            gamma=config.gamma, horizon=kw.get("horizon", 10),
+            entropy=kw.get("entropy", 1e-3),
+            deter=kw.get("deter", 128), units=kw.get("units", 128),
+            stoch_vars=kw.get("stoch_vars", 8),
+            stoch_classes=kw.get("stoch_classes", 8),
+            imag_starts=kw.get("imag_starts", 64),
+            seed=config.seed)
+        self.replay = _SeqReplay(config.num_envs_per_runner,
+                                 self.env.obs_dim,
+                                 capacity=kw.get("replay_capacity", 4096))
+        self.batch_size = kw.get("batch_size", 8)
+        self.seq_len = kw.get("seq_len", 16)
+        self.learning_starts = kw.get("learning_starts", 128)
+        self.steps_per_iter = kw.get("steps_per_iter", 64)
+        self.updates_per_step = kw.get("updates_per_step", 1)
+        self.update_every = kw.get("update_every", 1)  # env steps/update
+        self._since_update = 0
+        self.rng = np.random.default_rng(config.seed)
+        self._key = jax.random.PRNGKey(config.seed)
+        self._obs = self.env.reset()
+        self._state = self.learner.init_state(self.env.n)
+        self._first = np.ones(self.env.n, np.float32)
+        self.env_steps = 0
+        self.iteration = 0
+        self._ep_ret = np.zeros(self.env.n)
+        self._recent: list = []
+
+    def _next_key(self):
+        import jax
+
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        metrics: Dict[str, Any] = {}
+        for _ in range(self.steps_per_iter):
+            state, acts = self.learner.act(
+                self._state, jnp.asarray(self._obs),
+                jnp.asarray(self._first), self._next_key())
+            obs2, rews, terminated, truncated = self.env.step(acts)
+            reset = terminated | truncated
+            # the continue head's target is 1-terminated ONLY: a time
+            # limit is not death, and the following is_first already
+            # resets the RSSM across the auto-reset boundary
+            self.replay.add(self._obs, acts, rews,
+                            terminated.astype(np.float32), self._first)
+            self._ep_ret += rews
+            for i in np.nonzero(reset)[0]:
+                self._recent.append(self._ep_ret[i])
+                self._ep_ret[i] = 0.0
+            self._first = reset.astype(np.float32)
+            self._obs = obs2
+            self._state = state
+            self.env_steps += self.env.n
+            self._since_update += 1
+            if (len(self.replay) * self.env.n >= self.learning_starts
+                    and len(self.replay) >= self.seq_len
+                    and self._since_update >= self.update_every):
+                self._since_update = 0
+                for _ in range(self.updates_per_step):
+                    batch = self.replay.sample(self.rng, self.batch_size,
+                                               self.seq_len)
+                    metrics = self.learner.update(batch, self._next_key())
+        self.iteration += 1
+        self._recent = self._recent[-100:]
+        out = {"iteration": self.iteration, "env_steps": self.env_steps,
+               "episode_return_mean": (float(np.mean(self._recent))
+                                       if self._recent else 0.0)}
+        out.update(metrics)
+        return out
+
+    def evaluate(self, num_episodes: int = 8) -> float:
+        import jax.numpy as jnp
+
+        # evaluate on a fresh copy of the training env class
+        env = type(self.env)(num_episodes, seed=1234)
+        obs = env.reset()
+        state = self.learner.init_state(num_episodes)
+        first = np.ones(num_episodes, np.float32)
+        rets = np.zeros(num_episodes)
+        alive = np.ones(num_episodes, bool)
+        for _ in range(env.max_steps):
+            state, acts = self.learner.act(
+                state, jnp.asarray(obs), jnp.asarray(first),
+                self._next_key(), greedy=True)
+            obs, rews, terminated, truncated = env.step(acts)
+            done = terminated | truncated
+            rets += rews * alive
+            first = done.astype(np.float32)
+            alive &= ~done
+            if not alive.any():
+                break
+        return float(rets.mean())
+
+    def stop(self):
+        pass
