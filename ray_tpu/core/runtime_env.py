@@ -192,15 +192,26 @@ def normalize_pip(spec) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
 
 def ensure_pip_env(cache_root: str, packages, options) -> str:
     """Create (once per node+requirements hash) a virtualenv with the
-    requested packages; returns its site-packages dir. Concurrency-safe
-    via an O_EXCL lock file + .done marker."""
+    requested packages; returns its site-packages dir.
+
+    Concurrency: installers compete for an O_EXCL lock file carrying the
+    holder's pid; the .done marker caches success. A SIGKILLed holder's
+    lock is broken by renaming it aside (atomic election) — the breaker
+    then LOOPS BACK to compete for a fresh lock like everyone else, so
+    dest is only ever rebuilt by a process that holds the lock (no
+    window where a breaker can rmtree a new installer's in-progress
+    venv)."""
     import glob
+    import shutil
     import subprocess
     import time
 
     key = _pip_env_key(packages, options)
-    dest = os.path.join(cache_root, "pip", key)
+    pip_root = os.path.join(cache_root, "pip")
+    dest = os.path.join(pip_root, key)
     done = os.path.join(dest, ".done")
+    lock = os.path.join(pip_root, f"{key}.lock")
+    os.makedirs(pip_root, exist_ok=True)
 
     def site_packages() -> str:
         hits = glob.glob(os.path.join(dest, "lib", "python*",
@@ -226,40 +237,37 @@ def ensure_pip_env(cache_root: str, packages, options) -> str:
         except PermissionError:
             return False
 
-    if os.path.exists(done):
-        return site_packages()
-    os.makedirs(os.path.join(cache_root, "pip"), exist_ok=True)
-    lock = os.path.join(cache_root, "pip", f"{key}.lock")
-    try:
-        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        os.write(fd, str(os.getpid()).encode())
-    except FileExistsError:
-        # another worker is installing: wait for its .done
-        deadline = time.monotonic() + 600
-        while time.monotonic() < deadline:
+    deadline = time.monotonic() + 600
+    while True:
+        if os.path.exists(done):
+            return site_packages()
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            if lock_holder_dead(lock):
+                # atomic rename elects ONE breaker; it merely clears the
+                # dead lock and loops back to compete — dest is touched
+                # only under a held lock
+                stale = f"{lock}.stale.{os.getpid()}"
+                try:
+                    os.rename(lock, stale)
+                    os.remove(stale)
+                except OSError:
+                    pass
+                continue
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pip env {key} install did not finish within 600s "
+                    f"(holder of {lock} may be stuck)")
+            time.sleep(0.2)
+            continue
+        try:
+            os.write(fd, str(os.getpid()).encode())
             if os.path.exists(done):
                 return site_packages()
-            if not os.path.exists(lock):  # holder failed cleanly: retry
-                return ensure_pip_env(cache_root, packages, options)
-            if lock_holder_dead(lock):  # holder SIGKILLed: break the lock
-                # atomic rename elects exactly ONE breaker — concurrent
-                # waiters acting on the same stale pid must not rmtree a
-                # new installer's in-progress venv
-                try:
-                    os.rename(lock, f"{lock}.stale.{os.getpid()}")
-                except OSError:
-                    time.sleep(0.2)
-                    continue  # someone else broke it; wait normally
-                import shutil
-
-                shutil.rmtree(dest, ignore_errors=True)
-                return ensure_pip_env(cache_root, packages, options)
-            time.sleep(0.2)
-        raise TimeoutError(
-            f"pip env {key} install did not finish within 600s "
-            f"(holder of {lock} may be stuck)")
-    try:
-        if not os.path.exists(done):
+            # a previous holder may have died mid-install: rebuild from
+            # scratch (we hold the lock, nobody else is writing here)
+            shutil.rmtree(dest, ignore_errors=True)
             subprocess.run(
                 [sys.executable, "-m", "venv", "--system-site-packages",
                  dest], check=True, capture_output=True)
@@ -274,13 +282,13 @@ def ensure_pip_env(cache_root: str, packages, options) -> str:
                     f"{list(packages)}:\n{proc.stderr[-2000:]}")
             with open(done, "w") as f:
                 f.write("\n".join(packages))
-        return site_packages()
-    finally:
-        os.close(fd)
-        try:
-            os.remove(lock)
-        except OSError:
-            pass
+            return site_packages()
+        finally:
+            os.close(fd)
+            try:
+                os.remove(lock)
+            except OSError:
+                pass
 
 
 def apply(runtime_env: Optional[dict], fetch: Callable[[str], bytes],

@@ -1,16 +1,17 @@
 """Dashboard-lite: a single-page cluster overview over the state API.
 
 Reference: the Ray dashboard (python/ray/dashboard/) — here a stdlib HTTP
-server with these routes: ``/`` renders an auto-refreshing HTML overview
-(including inline-SVG TIME-SERIES sparklines of cluster metrics — the
-role of the reference's embedded Grafana panels, dependency-free),
-``/api/state`` returns the raw state_summary JSON, and
+server with these routes: ``/`` serves a client-rendered single-file
+app — tabs (overview/nodes/actors/tasks/objects), canvas TIME-SERIES
+charts of the sampled cluster metrics (the role of the reference's
+embedded Grafana panels), a 2s fetch loop, zero dependencies and no
+build step: the analogue of the reference's React dashboard/client
+build; ``/api/state`` returns the raw state_summary JSON and
 ``/api/metrics/history`` the sampled series.
 """
 
 from __future__ import annotations
 
-import html
 import json
 import threading
 import time
@@ -72,54 +73,163 @@ class _History:
                     "series": {k: list(v)
                                for k, v in self._series.items()}}
 
-    def sparklines_html(self) -> str:
-        snap = self.snapshot()
-        out = []
-        for name, ys in sorted(snap["series"].items()):
-            if len(ys) < 2:
-                continue
-            lo, hi = min(ys), max(ys)
-            span = (hi - lo) or 1.0
-            w, h = 240, 36
-            n = len(ys)
-            pts = " ".join(
-                f"{i * w / (n - 1):.1f},"
-                f"{h - 3 - (y - lo) / span * (h - 6):.1f}"
-                for i, y in enumerate(ys))
-            out.append(
-                f"<div class=spark><span>{html.escape(name)}: "
-                f"{ys[-1]:g}</span><svg width={w} height={h}>"
-                f"<polyline points='{pts}' fill='none' "
-                f"stroke='#7fd4ff' stroke-width='1.5'/></svg></div>")
-        return "".join(out) or "<i>collecting…</i>"
-
-
-_history: Optional[_History] = None
-
-_PAGE = """<!doctype html>
+_APP = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
-<meta http-equiv="refresh" content="2">
+<meta charset="utf-8">
 <style>
- body {{ font-family: monospace; margin: 2em; background: #111;
-        color: #ddd; }}
- h1 {{ color: #7fd4ff; }} h2 {{ color: #9f9; margin-bottom: 4px; }}
- table {{ border-collapse: collapse; }}
- td, th {{ border: 1px solid #444; padding: 3px 10px; text-align: left; }}
- .dead {{ color: #f77; }}
- .spark {{ display: inline-block; margin: 0 14px 8px 0; }}
- .spark span {{ display: block; color: #9f9; font-size: 12px; }}
- .spark svg {{ background: #181818; border: 1px solid #333; }}
+ :root { --bg:#101216; --panel:#181b21; --line:#2a2f38; --fg:#d7dce3;
+         --accent:#7fd4ff; --good:#8fe08f; --bad:#f08f8f; --dim:#8b93a1; }
+ body { font-family: ui-monospace, monospace; margin:0; background:var(--bg);
+        color:var(--fg); }
+ header { display:flex; align-items:baseline; gap:18px; padding:14px 22px;
+          border-bottom:1px solid var(--line); flex-wrap:wrap; }
+ header h1 { margin:0; font-size:18px; color:var(--accent); }
+ .chip { background:var(--panel); border:1px solid var(--line);
+         border-radius:6px; padding:4px 10px; font-size:12px; }
+ .chip b { color:var(--good); }
+ nav { display:flex; gap:4px; padding:10px 22px 0; }
+ nav button { background:var(--panel); color:var(--dim); border:1px solid
+   var(--line); border-bottom:none; border-radius:6px 6px 0 0;
+   padding:6px 16px; cursor:pointer; font:inherit; }
+ nav button.on { color:var(--fg); background:var(--bg);
+   border-color:var(--accent); }
+ main { padding:16px 22px; }
+ table { border-collapse:collapse; width:100%; font-size:13px; }
+ td,th { border:1px solid var(--line); padding:4px 10px; text-align:left; }
+ th { color:var(--accent); background:var(--panel); }
+ .dead { color:var(--bad); } .alive { color:var(--good); }
+ .charts { display:grid; grid-template-columns:repeat(auto-fill,
+   minmax(270px,1fr)); gap:14px; margin-top:10px; }
+ .chart { background:var(--panel); border:1px solid var(--line);
+   border-radius:6px; padding:8px; }
+ .chart .t { font-size:12px; color:var(--good); margin-bottom:4px; }
+ .chart .v { float:right; color:var(--dim); }
+ canvas { width:100%; height:64px; display:block; }
+ h2 { color:var(--good); font-size:14px; margin:18px 0 6px; }
+ pre { background:var(--panel); border:1px solid var(--line); padding:8px;
+   border-radius:6px; overflow:auto; }
+ #err { color:var(--bad); padding:4px 22px; }
 </style></head><body>
-<h1>ray_tpu</h1>
-<h2>metrics</h2><div>{sparklines}</div>
-<h2>resources</h2><pre>{resources}</pre>
-<h2>tasks</h2><pre>{tasks}</pre>
-<h2>objects</h2><pre>{objects}</pre>
-<h2>nodes ({n_nodes})</h2><table><tr><th>id</th><th>address</th>
-<th>state</th><th>resources</th></tr>{node_rows}</table>
-<h2>actors ({n_actors})</h2><table><tr><th>id</th><th>name</th>
-<th>state</th></tr>{actor_rows}</table>
-</body></html>"""
+<header><h1>ray_tpu</h1><div id=chips></div></header>
+<div id=err></div>
+<nav id=tabs></nav>
+<main id=main></main>
+<script>
+"use strict";
+const TABS = ["overview", "nodes", "actors", "tasks", "objects"];
+let tab = "overview", S = null, H = null;
+
+const esc = s => String(s).replace(/[&<>"]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+
+function chips() {
+  if (!S) return "";
+  const nodes = S.nodes || [];
+  const alive = nodes.filter(n => n.state === "ALIVE").length;
+  const cr = S.cluster_resources || {}, ar = S.available_resources || {};
+  const t = S.tasks || {}, o = S.objects || {};
+  return [
+    `nodes <b>${alive}</b>/${nodes.length}`,
+    `CPU <b>${ar.CPU ?? "?"}</b>/${cr.CPU ?? "?"} free`,
+    `tasks ${Object.entries(t).map(([k, v]) => `${esc(k)} <b>${v}</b>`)
+        .join(" ") || "-"}`,
+    `objects <b>${o.tracked ?? "-"}</b>` +
+      (o.store_bytes_in_use != null ?
+        ` (${(o.store_bytes_in_use / 1048576).toFixed(1)} MB)` : ""),
+    `actors <b>${(S.actors || []).length}</b>`,
+  ].map(c => `<span class=chip>${c}</span>`).join(" ");
+}
+
+function kvTable(obj) {
+  if (obj == null) return "<i>none</i>";
+  if (typeof obj !== "object") return `<pre>${esc(obj)}</pre>`;
+  const rows = Object.entries(obj).map(([k, v]) =>
+    `<tr><td>${esc(k)}</td><td>${esc(
+      typeof v === "object" ? JSON.stringify(v) : v)}</td></tr>`);
+  return `<table><tr><th>key</th><th>value</th></tr>${rows.join("")}</table>`;
+}
+
+function listTable(rows, cols) {
+  if (!rows || !rows.length) return "<i>none</i>";
+  const head = cols.map(c => `<th>${esc(c)}</th>`).join("");
+  const body = rows.map(r => "<tr>" + cols.map(c => {
+    let v = r[c]; if (v == null) v = "";
+    if (typeof v === "object") v = JSON.stringify(v);
+    v = String(v);
+    const cls = c === "state" ?
+      (v === "ALIVE" || v === "RUNNING" ? "alive" : "dead") : "";
+    return `<td class="${cls}">${esc(v.length > 90 ?
+      v.slice(0, 90) + "…" : v)}</td>`;
+  }).join("") + "</tr>").join("");
+  return `<table><tr>${head}</tr>${body}</table>`;
+}
+
+function drawChart(cv, xs) {
+  const dpr = window.devicePixelRatio || 1;
+  const w = cv.clientWidth * dpr, h = cv.clientHeight * dpr;
+  cv.width = w; cv.height = h;
+  const g = cv.getContext("2d");
+  g.clearRect(0, 0, w, h);
+  if (xs.length < 2) return;
+  const lo = Math.min(...xs), hi = Math.max(...xs), span = (hi - lo) || 1;
+  g.strokeStyle = "#7fd4ff"; g.lineWidth = 1.5 * dpr; g.beginPath();
+  xs.forEach((v, i) => {
+    const x = i / (xs.length - 1) * (w - 4) + 2;
+    const y = h - 3 - (v - lo) / span * (h - 6);
+    i ? g.lineTo(x, y) : g.moveTo(x, y);
+  });
+  g.stroke();
+}
+
+function render() {
+  document.getElementById("chips").innerHTML = chips();
+  document.getElementById("tabs").innerHTML = TABS.map(t =>
+    `<button class="${t === tab ? "on" : ""}"
+      onclick="setTab('${t}')">${t}</button>`).join("");
+  const m = document.getElementById("main");
+  if (!S) { m.innerHTML = "<i>loading…</i>"; return; }
+  if (tab === "overview") {
+    const series = H && H.series ? Object.entries(H.series) : [];
+    m.innerHTML = `
+      <div class=charts>${series.map(([name, xs], i) => `
+        <div class=chart><div class=t>${esc(name)}
+          <span class=v>${xs.length ? esc(
+            (+xs[xs.length - 1]).toPrecision(4)) : ""}</span></div>
+        <canvas id=c${i}></canvas></div>`).join("") ||
+        "<i>sampler warming up…</i>"}</div>
+      <h2>resources</h2>${kvTable({total: S.cluster_resources,
+                                   available: S.available_resources})}`;
+    series.forEach(([_, xs], i) =>
+      drawChart(document.getElementById("c" + i), xs.map(Number)));
+  } else if (tab === "nodes") {
+    m.innerHTML = listTable(S.nodes, ["node_id", "address", "state",
+                                      "resources"]);
+  } else if (tab === "actors") {
+    m.innerHTML = listTable(S.actors, ["actor_id", "name", "state"]);
+  } else if (tab === "tasks") {
+    m.innerHTML = kvTable(S.tasks);
+  } else if (tab === "objects") {
+    m.innerHTML = kvTable(S.objects);
+  }
+}
+window.setTab = t => { tab = t; render(); };
+
+async function tick() {
+  try {
+    const [s, h] = await Promise.all([
+      fetch("/api/state").then(r => r.json()),
+      fetch("/api/metrics/history").then(r => r.json())]);
+    S = s; H = h;
+    document.getElementById("err").textContent = "";
+  } catch (e) {
+    document.getElementById("err").textContent =
+      "state unavailable: " + e;
+  }
+  render();
+}
+tick();
+setInterval(tick, 2000);
+</script></body></html>"""
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -127,47 +237,29 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self):
-        from ray_tpu import state
-
         hist = _history  # read once: stop_dashboard() may null the global
         if self.path.startswith("/api/metrics/history"):
             snap = hist.snapshot() if hist else {}
             self._reply(200, json.dumps(snap).encode(),
                         "application/json")
             return
-        try:
-            s = state.state_summary()
-        except Exception as e:  # noqa: BLE001
-            self._reply(500, f"state unavailable: {e!r}".encode(),
-                        "text/plain")
-            return
         if self.path.startswith("/api"):
+            from ray_tpu import state
+
+            try:
+                s = state.state_summary()
+            except Exception as e:  # noqa: BLE001
+                self._reply(500, f"state unavailable: {e!r}".encode(),
+                            "text/plain")
+                return
             self._reply(200, json.dumps(s, default=str).encode(),
                         "application/json")
             return
-        node_rows = "".join(
-            f"<tr><td>{n['node_id'][:12]}</td>"
-            f"<td>{html.escape(str(n['address']))}</td>"
-            f"<td class={'dead' if n['state'] != 'ALIVE' else 'ok'}>"
-            f"{n['state']}</td>"
-            f"<td>{html.escape(str(n['resources']))}</td></tr>"
-            for n in s["nodes"])
-        actor_rows = "".join(
-            f"<tr><td>{a.get('actor_id', '')[:12]}</td>"
-            f"<td>{html.escape(str(a.get('name') or ''))}</td>"
-            f"<td>{a.get('state', '')}</td></tr>"
-            for a in s["actors"])
-        page = _PAGE.format(
-            sparklines=(hist.sparklines_html() if hist
-                        else "<i>sampler off</i>"),
-            resources=html.escape(
-                f"total: {s['cluster_resources']}\n"
-                f"avail: {s['available_resources']}"),
-            tasks=html.escape(str(s["tasks"])),
-            objects=html.escape(str(s["objects"])),
-            n_nodes=len(s["nodes"]), node_rows=node_rows,
-            n_actors=len(s["actors"]), actor_rows=actor_rows)
-        self._reply(200, page.encode(), "text/html")
+        # client-rendered single-file app (the reference ships a React
+        # build, dashboard/client/; this is the no-build-step analogue:
+        # fetch /api/state + /api/metrics/history every 2s, render tabs
+        # and canvas time-series without page reloads)
+        self._reply(200, _APP.encode(), "text/html")
 
     def _reply(self, code: int, body: bytes, ctype: str):
         self.send_response(code)

@@ -53,6 +53,11 @@ class LlamaConfig:
     # knob — the +1 folds into the stored norm weights at load time.
     mlp_act: str = "silu"  # silu | gelu_tanh
     embed_scale: float = 1.0
+    # serving prefill attention: None = auto (Pallas flash on single-
+    # chip TPU, fp32 reference elsewhere). The engine forces False under
+    # tensor parallelism — a pallas_call inside a GSPMD-sharded jit
+    # cannot be auto-partitioned like plain XLA ops.
+    prefill_flash: Optional[bool] = None
     remat: bool = True
     # partial remat: this many TRAILING layers store activations instead
     # of recomputing (HBM for FLOPs; 0 = classic full per-layer remat).

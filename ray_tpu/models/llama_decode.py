@@ -123,12 +123,22 @@ def quantize_decode_params(params: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def _gqa_repeat(cfg: LlamaConfig, k):
-    """[.., KVH, hd] -> [.., H, hd] by repeating kv heads."""
-    rep = cfg.num_heads // cfg.num_kv_heads
-    if rep == 1:
-        return k
-    return jnp.repeat(k, rep, axis=-2)
+def _prefill_attention(cfg: LlamaConfig, q, k, v):
+    """Causal prefill attention: the Pallas flash kernel on TPU (GQA
+    handled in-kernel, no repeated-KV materialization, no [b,H,P,P]
+    score tensor), the fp32 reference path elsewhere. The kernel needs
+    the sequence divisible by its block size, which holds for the
+    power-of-two buckets but NOT the engine's max_len-1 overflow
+    bucket — that one (and any other ragged length) silently takes the
+    reference path instead of crashing at trace time."""
+    from ray_tpu.ops.attention import attention_reference, flash_attention
+
+    use_flash = cfg.prefill_flash
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash and q.shape[1] % 128 == 0:
+        return flash_attention(q, k, v, causal=True)
+    return attention_reference(q, k, v, causal=True)
 
 
 def prefill(cfg: LlamaConfig, params, tokens: jax.Array
@@ -153,16 +163,7 @@ def prefill(cfg: LlamaConfig, params, tokens: jax.Array
         q, k, v, _ = _project_qkv(cfg, p, x)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        kf = _gqa_repeat(cfg, k)
-        vf = _gqa_repeat(cfg, v)
-        # causal attention [b, H, s, s] in fp32
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
-                            preferred_element_type=jnp.float32)
-        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim_))
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+        attn = _prefill_attention(cfg, q, k, v)
         attn = attn.reshape(b, s, cfg.num_heads * cfg.head_dim_)
         x = x + jnp.dot(attn, _w(p, "wo", cfg.dtype),
                         preferred_element_type=jnp.float32).astype(cfg.dtype)
@@ -203,15 +204,7 @@ def prefill_batch(cfg: LlamaConfig, params, tokens: jax.Array,
         q, k, v, _ = _project_qkv(cfg, p, x)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        kf = _gqa_repeat(cfg, k)
-        vf = _gqa_repeat(cfg, v)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
-                            preferred_element_type=jnp.float32)
-        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim_))
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+        attn = _prefill_attention(cfg, q, k, v)
         attn = attn.reshape(b, s, cfg.num_heads * cfg.head_dim_)
         x = x + jnp.dot(attn, _w(p, "wo", cfg.dtype),
                         preferred_element_type=jnp.float32).astype(cfg.dtype)

@@ -540,6 +540,8 @@ class DreamerV3:
             lr=config.lr, ac_lr=kw.get("ac_lr", 1e-4),
             gamma=config.gamma, horizon=kw.get("horizon", 10),
             entropy=kw.get("entropy", 1e-3),
+            lam=kw.get("lam", 0.95), unimix=kw.get("unimix", 0.01),
+            free_bits=kw.get("free_bits", 1.0),
             deter=kw.get("deter", 128), units=kw.get("units", 128),
             stoch_vars=kw.get("stoch_vars", 8),
             stoch_classes=kw.get("stoch_classes", 8),

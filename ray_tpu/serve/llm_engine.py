@@ -99,6 +99,14 @@ class LLMEngine:
                     f"tp={tp} needs {tp} devices, found {len(devs)}")
             mesh = build_mesh(MeshSpec({"tp": tp}), devices=devs[:tp])
         self._mesh = mesh
+        if mesh is not None and cfg.prefill_flash is not False:
+            # pallas prefill cannot ride GSPMD sharding; TP serving
+            # ALWAYS uses the plain-XLA attention, overriding even an
+            # explicit prefill_flash=True (LlamaConfig documents this)
+            from dataclasses import replace as _rp
+
+            cfg = _rp(cfg, prefill_flash=False)
+            self._cfg = cfg
         self._params = (hf_params if hf_params is not None else
                         llama.init_params(cfg, jax.random.PRNGKey(0)))
         if quantize is not None:

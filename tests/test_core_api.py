@@ -269,3 +269,26 @@ def test_runtime_env_pip_local_wheel(rt, tmp_path):
         == ["isolated"] * 8
     # cached second use: no reinstall (the .done marker short-circuits)
     assert rt.get(with_env.remote()) == 42
+
+
+def test_pip_env_breaks_dead_holders_lock(tmp_path):
+    """A SIGKILLed installer's lock (pid no longer running) must not
+    brick the env: the next caller breaks it and installs (round-4
+    review find — also exercises install-under-held-lock rebuilds)."""
+    import os
+
+    from ray_tpu.core.runtime_env import _pip_env_key, ensure_pip_env
+
+    _build_test_wheel(str(tmp_path), value=7)
+    packages = ("rtpu_testpkg",)
+    options = ("--no-index", "--find-links", str(tmp_path))
+    cache = str(tmp_path / "cache")
+    os.makedirs(os.path.join(cache, "pip"))
+    lock = os.path.join(cache, "pip",
+                        f"{_pip_env_key(packages, options)}.lock")
+    with open(lock, "w") as f:
+        f.write("999999999")  # definitely-dead pid
+    sp = ensure_pip_env(cache, packages, options)
+    assert os.path.isdir(sp) and not os.path.exists(lock)
+    assert os.path.exists(os.path.join(sp, "rtpu_testpkg",
+                                       "__init__.py"))
