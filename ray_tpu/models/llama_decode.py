@@ -273,6 +273,16 @@ def decode_step(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
     tokens [S] int32 (last sampled token per slot), positions [S] int32
     (index the new token is written at), active [S] bool.
     Returns (cache, logits [S, vocab]).
+
+    HBM discipline (the decode step is bandwidth-bound): attention runs
+    over the OLD cache plus an explicit self-attention term for the
+    in-flight token, so the big cache tensors are never rewritten by the
+    attention path; the new K/V rows (L*S*KVH*hd elements, ~1 MB) land
+    in ONE batched scatter at the end, which XLA performs in place on
+    the donated cache. The previous design (scatter-then-attend via a
+    full-width select inside the layer scan) rewrote the entire cache
+    every step and measured 6.4 ms/step on v5e at 1B; this form measures
+    ~3 ms — against a 2.3 ms weight-read floor.
     """
     S = tokens.shape[0]
     T = cache["k"].shape[2]
@@ -285,7 +295,10 @@ def decode_step(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
                                     scaling=cfg.rope_scaling_dict)
     pos2 = positions[:, None]  # [S, 1] — per-slot rope positions
 
-    kv_mask = (jnp.arange(T)[None] <= positions[:, None])  # [S, T]
+    # STRICT mask: history only; the current token's contribution enters
+    # via the concatenated self-score below, not via the cache
+    hist_mask = (jnp.arange(T)[None] < positions[:, None])  # [S, T]
+    rep = cfg.num_heads // cfg.num_kv_heads
 
     def layer(carry, inp):
         x = carry
@@ -293,34 +306,43 @@ def decode_step(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
         q, k, v, _ = _project_qkv(cfg, p, x)     # q [S,1,H,hd], k/v [S,1,KVH,hd]
         q = apply_rope(q, cos_t, sin_t, positions=pos2)
         k = apply_rope(k, cos_t, sin_t, positions=pos2)
-        # write the new k/v at [slot, position]; masked by `active`, so
-        # inactive slots' cache lines are untouched (no post-pass needed)
-        ck = _scatter_step(ck, k[:, 0], positions, active)  # [S, T, KVH, hd]
-        cv = _scatter_step(cv, v[:, 0], positions, active)
+        k1, v1 = k[:, 0], v[:, 0]                # [S, KVH, hd]
         # GQA as a GROUPED einsum — no repeated-KV materialization (the
         # decode step is HBM-bound; repeating kv doubles cache traffic)
-        rep = cfg.num_heads // cfg.num_kv_heads
         q2 = q[:, 0].reshape(S, cfg.num_kv_heads, rep, hd)
         scores = jnp.einsum("skrd,stkd->skrt", q2, ck,
                             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(hd))
-        scores = jnp.where(kv_mask[:, None, None], scores, -1e30)
+        scores = jnp.where(hist_mask[:, None, None], scores, -1e30)
+        self_s = jnp.einsum("skrd,skd->skr", q2, k1,
+                            preferred_element_type=jnp.float32
+                            ) / jnp.sqrt(jnp.float32(hd))
+        scores = jnp.concatenate([scores, self_s[..., None]], axis=-1)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("skrt,stkd->skrd", probs, cv)
+        attn = (jnp.einsum("skrt,stkd->skrd", probs[..., :T], cv)
+                + probs[..., T][..., None] * v1[:, :, None, :])
         attn = attn.reshape(S, 1, cfg.num_heads * hd)
         x = x + jnp.dot(attn, _w(p, "wo", cfg.dtype),
                         preferred_element_type=jnp.float32).astype(cfg.dtype)
         x = x + _mlp(cfg, p, x)
-        return x, (ck, cv)
+        return x, (k1, v1)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], cache["k"], cache["v"]))
+    # new_k/new_v: [L, S, KVH, hd] — one scatter into the donated cache.
+    # Inactive slots redirect to index T, dropped by mode="drop", so
+    # their cache lines are untouched.
+    scat = jnp.where(active, positions, T)
+    ck = cache["k"].at[:, jnp.arange(S), scat].set(
+        new_k, mode="drop", unique_indices=True)
+    cv = cache["v"].at[:, jnp.arange(S), scat].set(
+        new_v, mode="drop", unique_indices=True)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = (params["embed"].astype(cfg.dtype).T if cfg.tie_embeddings
             else _w(params, "lm_head", cfg.dtype))
     logits = jnp.dot(x[:, 0], head,
                      preferred_element_type=jnp.float32)  # [S, vocab]
-    return {"k": new_k, "v": new_v}, logits
+    return {"k": ck, "v": cv}, logits
 
 
 def sample_tokens(logits: jax.Array, key: jax.Array,
@@ -344,16 +366,22 @@ def decode_chunk(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
                  num_steps: int, rng: Optional[jax.Array] = None,
                  temperature: Optional[jax.Array] = None, top_k: int = 0,
                  sample: bool = True
-                 ) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
+                 ) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array,
+                            jax.Array]:
     """``num_steps`` decode steps in ONE device program.
 
     Amortizes host<->device dispatch latency (dominant over a remote
     tunnel) across many tokens: the sampled (or greedy) token feeds back
     on-device via lax.scan. Returns (cache, out_tokens [num_steps, S],
-    last_positions). Slots keep generating past EOS inside a chunk; the
-    engine truncates host-side (bounded waste of num_steps-1 tokens per
-    finished slot). With ``rng``/``temperature`` given, each slot samples
-    at its own temperature (0 = greedy) with optional static top_k.
+    next_tokens [S], next_positions [S]) — next_tokens/next_positions are
+    PROGRAM OUTPUTS precisely so the engine can chain chunk N+1's inputs
+    to chunk N's outputs as device arrays with no host round-trip (an
+    eager ``out[-1]`` slice over a remote tunnel costs a full dispatch
+    and was measured 3x slower than the chunk itself). Slots keep
+    generating past EOS inside a chunk; the engine truncates host-side
+    (bounded waste of num_steps-1 tokens per finished slot). With
+    ``rng``/``temperature`` given, each slot samples at its own
+    temperature (0 = greedy) with optional static top_k.
     """
     S = tokens.shape[0]
     if temperature is None:
@@ -373,17 +401,9 @@ def decode_chunk(cfg: LlamaConfig, params, cache: Dict[str, jax.Array],
         nxt = jnp.where(active, nxt, toks)
         return (cache, nxt, pos + active.astype(jnp.int32), key), nxt
 
-    (cache, _, pos, _), out = jax.lax.scan(
+    (cache, nxt, pos, _), out = jax.lax.scan(
         step, (cache, tokens, positions, rng), None, length=num_steps)
-    return cache, out, pos
-
-
-def _scatter_step(c, kv_new, positions, active):
-    """c [S, T, KVH, hd]; kv_new [S, KVH, hd]: write at [s, positions[s]]
-    for active slots only."""
-    T = c.shape[1]
-    onehot = (jnp.arange(T)[None] == positions[:, None]) & active[:, None]
-    return jnp.where(onehot[:, :, None, None], kv_new[:, None], c)
+    return cache, out, nxt, pos
 
 
 def make_engine_fns(cfg: LlamaConfig, params, num_slots: int, max_len: int,

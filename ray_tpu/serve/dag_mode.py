@@ -127,7 +127,7 @@ class _Generate:
                                    jnp.asarray([0], np.int32),
                                    jnp.asarray([True]))
         first = int(np.asarray(jnp.argmax(logits[0])))
-        self._cache, out, _ = self._chunk(
+        self._cache, out, _, _ = self._chunk(
             self._cache, jnp.asarray([first], jnp.int32),
             jnp.asarray([len(toks)], jnp.int32), jnp.asarray([True]),
             self._max_new)

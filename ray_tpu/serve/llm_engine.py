@@ -1,40 +1,38 @@
 """Continuous-batching LLM engine: the TPU-native Serve replica body.
 
 Static-shape design (see models/llama_decode.py): a fixed set of sequence
-slots shares one decode program; new requests join between decode steps by
+slots shares one decode program; new requests join between decode chunks by
 prefilling (bucketed prompt padding → a handful of prefill compilations)
 into a free slot. This is continuous batching in the vLLM sense — requests
 enter and leave the running batch at token granularity — built the TPU way
-(static shapes, two compiled programs, no paging).
+(static shapes, a handful of compiled programs).
+
+Decode is a PIPELINED ON-DEVICE LOOP (the round-5 redesign): each dispatch
+runs k decode steps in one program whose sampled tokens feed back through
+the program's own outputs, so chunk N+1 chains to chunk N entirely on
+device — the host never syncs between chunks. Generated tokens stream back
+through async device→host copies reaped one pipeline-depth behind the
+dispatch frontier. Steady-state cost per token is therefore the DEVICE
+step time (~3.4 ms at 1B on v5e — near the ~2.3 ms HBM weight-read
+floor), not the dispatch round-trip (~100 ms over a remote tunnel), which
+previously dominated ITL. Admission sampling (the prompt's first token)
+runs on device too; its value is reaped asynchronously like chunk tokens.
 
 Runs inside a Serve ReplicaActor via the submit/collect mailbox: ``submit``
 enqueues and returns immediately; a background thread drives the engine;
 ``collect`` drains finished generations. The router polls collect() so the
 replica's actor queue never blocks behind a generation (reference
-analogue: serve.llm / vLLM engine loop on GPU).
+analogue: serve.llm / vLLM engine loop on GPU; resident-loop philosophy:
+/root/reference/python/ray/dag/compiled_dag_node.py:482).
 """
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
 from typing import Any, Dict, List, Optional
-
-
-def _sample_np(logits, rng, temperature: float, top_k: int) -> int:
-    """Host-side single-row sampler (admission first-token path)."""
-    import numpy as np
-
-    z = np.asarray(logits, np.float64)
-    if top_k > 0:
-        kth = np.sort(z)[-top_k]
-        z = np.where(z < kth, -np.inf, z)
-    z = z / max(temperature, 1e-6)
-    z -= z.max()
-    p = np.exp(z)
-    p /= p.sum()
-    return int(rng.choice(len(p), p=p))
 
 
 def _bucket(n: int, buckets: List[int]) -> int:
@@ -54,9 +52,10 @@ class LLMEngine:
                  max_new_tokens: int = 32, eos_id: int = -1,
                  greedy: bool = True, chunk_steps: int = 8,
                  tp: int = 1, mesh=None, top_k: int = 0,
-                 sampling_seed: int = 0):
+                 sampling_seed: int = 0, pipeline_depth: int = 2):
         import jax
         import jax.numpy as jnp
+        import numpy as np
 
         from ray_tpu.models import llama, llama_decode
 
@@ -112,11 +111,11 @@ class LLMEngine:
         if quantize is not None:
             # weight-only int8 serving. Measured on v5e-lite at 1B
             # (BENCH_NOTES.md round 4): throughput-NEUTRAL on decode
-            # (ITL 15.6 vs 15.5 ms — XLA does not realize the halved
-            # weight reads at this scale) and slightly slower prefill;
-            # the win is HBM CAPACITY — weights shrink 2x, so a chip
-            # serves ~2x the model (8B int8 in ~8 GB) or frees HBM for
-            # longer KV caches. Opt-in accordingly.
+            # (XLA does not realize the halved weight reads at this
+            # scale) and slightly slower prefill; the win is HBM
+            # CAPACITY — weights shrink 2x, so a chip serves ~2x the
+            # model (8B int8 in ~8 GB) or frees HBM for longer KV
+            # caches. Opt-in accordingly.
             if quantize != "int8":
                 raise ValueError(
                     f"unsupported quantize={quantize!r} (only 'int8')")
@@ -149,7 +148,9 @@ class LLMEngine:
         self._seed = int(sampling_seed)
         self._jnp = jnp
 
-        (self._prefill_batch, self._insert_many, self._decode,
+        # the single-step decode program is unused since the pipelined
+        # loop runs k==1 through the chunk program (one fewer compile)
+        (self._prefill_batch, self._insert_many, _,
          self._decode_chunk) = \
             llama_decode.make_engine_fns(cfg, self._params, num_slots,
                                          max_len, mesh=mesh)
@@ -158,20 +159,50 @@ class LLMEngine:
         self._admit_batch = max(1, min(8, num_slots))
         self._cache = llama_decode.init_cache(cfg, num_slots, max_len,
                                               mesh=mesh)
-        # Tokens decoded per host sync. Over a high-latency link (the axon
-        # tunnel is ~100ms/roundtrip) chunking is the difference between 9
-        # and ~200 tok/s; new requests still join every chunk boundary.
-        # Normalized to a power of two: chunk lengths are compile-time
-        # static and bucketed, so only log2 programs ever exist.
+        # Tokens decoded per dispatched program. Chunks chain on device,
+        # so throughput is chunk-size-insensitive once the pipeline is
+        # deep enough to cover the dispatch round-trip; larger chunks
+        # mainly reduce host work. Normalized to a power of two: chunk
+        # lengths are compile-time static and bucketed, so only log2
+        # programs ever exist.
         chunk_steps = max(1, int(chunk_steps))
         self._chunk_steps = 1 << (chunk_steps.bit_length() - 1)
+        # in-flight device work the host has dispatched but not reaped;
+        # depth 2 keeps the device busy across one readback round-trip
+        self._depth = max(1, int(pipeline_depth))
+        self._inflight: "collections.deque[tuple]" = collections.deque()
+
+        # on-device chain state: the last sampled token + next write
+        # position per slot, produced by one program and consumed by the
+        # next without ever visiting the host
+        self._chain_toks = jnp.zeros((num_slots,), jnp.int32)
+        self._chain_pos = jnp.zeros((num_slots,), jnp.int32)
+        self._zero_key = jnp.zeros((2,), jnp.uint32)
+
+        # jitted helpers: splice admitted slots into the chain state and
+        # pick the prompt's first token on device (no host round-trip in
+        # the admission path either)
+        def _merge(toks, pos, firsts, slots, valid, new_pos):
+            idx = jnp.where(valid, slots, toks.shape[0])
+            return (toks.at[idx].set(firsts, mode="drop"),
+                    pos.at[idx].set(new_pos, mode="drop"))
+
+        self._merge_j = jax.jit(_merge)
+        self._argmax_j = jax.jit(
+            lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32))
+        tk = self._top_k
+        self._sample_j = jax.jit(
+            lambda lg, key, temps: llama_decode.sample_tokens(
+                lg, key, temps, tk))
 
         # slot bookkeeping (host side)
         self._free = list(range(num_slots))
         self._slot_req: Dict[int, str] = {}
         self._slot_tokens: Dict[int, List[int]] = {}
         self._slot_budget: Dict[int, int] = {}
-        self._slot_pos: Dict[int, int] = {}
+        self._slot_pos: Dict[int, int] = {}     # next write pos (speculative)
+        self._slot_plen: Dict[int, int] = {}    # prompt length
+        self._sched: Dict[int, int] = {}        # tokens dispatched (incl 1st)
         self._slot_start: Dict[int, float] = {}
         self._slot_ttft: Dict[int, float] = {}
         self._slot_temp: Dict[int, float] = {}
@@ -182,6 +213,7 @@ class LLMEngine:
         self._done: Dict[str, Any] = {}
         self._done_lock = threading.Lock()
         self._steps = 0
+        self._key_ctr = 0
         self._stop = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="llm-engine")
@@ -251,14 +283,13 @@ class LLMEngine:
 
     def cancel(self, req_id: str) -> None:
         """Abort a request: the ENGINE THREAD notices the cancel mark at
-        its next tick — a generating slot stops at the next step boundary
-        with its result discarded, a queued request is dropped at
-        admission, and a finished-but-uncollected result is removed.
-        (Only marking here avoids racing slot reuse: clamping a slot's
-        budget from this thread could hit a slot already recycled to a
-        different request.) Mark-and-pop happen under one lock with the
-        finish path's check-and-insert, so a result can never slip into
-        the mailbox after its cancel."""
+        its next tick — a generating slot is finished immediately with
+        its result discarded (tokens still in the device pipeline for it
+        are dropped at reap by the slot→request match), a queued request
+        is dropped at admission, and a finished-but-uncollected result is
+        removed. Mark-and-pop happen under one lock with the finish
+        path's check-and-insert, so a result can never slip into the
+        mailbox after its cancel."""
         with self._done_lock:
             if self._done.pop(req_id, None) is None:
                 self._cancelled[req_id] = time.monotonic()
@@ -266,20 +297,32 @@ class LLMEngine:
     def stats(self) -> dict:
         return {"active": self._num_slots - len(self._free),
                 "queued": self._in.qsize(), "steps": self._steps,
-                "slots": self._num_slots}
+                "slots": self._num_slots,
+                "inflight_chunks": len(self._inflight)}
 
     def shutdown(self):
         self._stop = True
 
     # ---- engine loop -------------------------------------------------------
 
+    def _next_key(self):
+        """Legacy uint32[2] PRNG key built host-side (a PRNGKey() eager
+        op would cost a device dispatch per sampled tick)."""
+        import numpy as np
+
+        self._key_ctr += 1
+        return self._jnp.asarray(np.array(
+            [self._seed & 0xFFFFFFFF, self._key_ctr & 0xFFFFFFFF],
+            np.uint32))
+
     def _admit(self) -> bool:
         """Prefill waiting requests into free slots; returns True if any.
 
         Requests are admitted in batches: up to ``_admit_batch`` waiting
-        prompts run through ONE batched prefill + insert program, so a
-        burst pays one host↔device round-trip instead of one per prompt
-        (the round-trip dominates TTFT over a high-latency link).
+        prompts run through ONE batched prefill + insert + first-token
+        sample, all on device; the first token's value is reaped
+        asynchronously with the decode pipeline, so admission never
+        blocks the engine thread on a device round-trip.
         """
         import numpy as np
 
@@ -331,19 +374,32 @@ class LLMEngine:
                 last = np.zeros((B,), np.int32)
                 slots = np.zeros((B,), np.int32)
                 valid = np.zeros((B,), bool)
-                for i, (_, toks, _, _, _, _, slot) in enumerate(batch):
+                temps = np.zeros((B,), np.float32)
+                plens = np.zeros((B,), np.int32)
+                for i, (_, toks, _, _, temp, _, slot) in enumerate(batch):
                     rows[i, :len(toks)] = toks
                     last[i] = len(toks) - 1
                     slots[i], valid[i] = slot, True
+                    temps[i] = temp
+                    plens[i] = len(toks)
                 logits, kv = self._prefill_batch(jnp.asarray(rows),
                                                  jnp.asarray(last))
+                slots_d = jnp.asarray(slots)
+                valid_d = jnp.asarray(valid)
                 self._cache = self._insert_many(
-                    self._cache, kv, jnp.asarray(slots),
-                    jnp.asarray(valid))
-                firsts = np.asarray(jnp.argmax(logits, axis=-1))
-                np_logits = None
-                if any(b[4] > 0 for b in batch):
-                    np_logits = np.asarray(logits, np.float64)
+                    self._cache, kv, slots_d, valid_d)
+                if temps.any():
+                    firsts = self._sample_j(logits, self._next_key(),
+                                            jnp.asarray(temps))
+                else:
+                    firsts = self._argmax_j(logits)
+                self._chain_toks, self._chain_pos = self._merge_j(
+                    self._chain_toks, self._chain_pos, firsts,
+                    slots_d, valid_d, jnp.asarray(plens))
+                try:
+                    firsts.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — optional fast path
+                    pass
             except Exception as e:  # noqa: BLE001 — fail THESE requests
                 for req_id, _, _, _, _, _, slot in batch:
                     self._free.append(slot)
@@ -351,58 +407,59 @@ class LLMEngine:
                         self._done[req_id] = ValueError(
                             f"request rejected: {e!r}")
                 continue
-            now = time.monotonic()
-            self._admit_count = getattr(self, "_admit_count", 0) + 1
-            rng = np.random.default_rng(
-                (self._seed << 24) ^ (self._admit_count << 8)
-                ^ self._steps)
-            for i, (req_id, toks, max_new, t0, temp, stop, slot) in \
-                    enumerate(batch):
-                first = int(firsts[i])
-                if temp > 0 and np_logits is not None:
-                    first = int(_sample_np(np_logits[i], rng, temp,
-                                           self._top_k))
+            entries = []
+            for req_id, toks, max_new, t0, temp, stop, slot in batch:
                 self._slot_temp[slot] = temp
                 self._slot_stop[slot] = stop
                 self._slot_req[slot] = req_id
-                self._slot_tokens[slot] = [first]
+                self._slot_tokens[slot] = []
                 self._slot_budget[slot] = max_new
                 self._slot_pos[slot] = len(toks)
+                self._slot_plen[slot] = len(toks)
+                self._sched[slot] = 1
                 self._slot_start[slot] = t0
-                self._slot_ttft[slot] = now - t0
+                entries.append((req_id, slot))
                 admitted = True
-                self._maybe_finish(slot, first)
+            self._inflight.append(("admit", {"firsts": firsts,
+                                             "batch": entries}))
         return admitted
 
     def _maybe_finish(self, slot: int, last_token: int) -> bool:
         toks = self._slot_tokens[slot]
         if (last_token == self._eos
                 or last_token in self._slot_stop.get(slot, ())
-                or len(toks) >= self._slot_budget[slot]):
+                or len(toks) >= self._slot_budget[slot]
+                or self._slot_plen[slot] + len(toks) >= self._max_len - 1):
             req_id = self._slot_req.pop(slot)
+            ttft = self._slot_ttft.get(
+                slot, time.monotonic() - self._slot_start[slot])
             with self._done_lock:
                 if self._cancelled.pop(req_id, None) is not None:
                     pass  # aborted: drop silently
                 else:
                     self._done[req_id] = {
                         "tokens": list(toks),
-                        "ttft_s": self._slot_ttft[slot],
+                        "ttft_s": ttft,
                         "latency_s": (time.monotonic()
                                       - self._slot_start[slot]),
                     }
-            for d in (self._slot_tokens, self._slot_budget, self._slot_pos,
-                      self._slot_start, self._slot_ttft, self._slot_temp,
-                      self._slot_stop):
-                d.pop(slot, None)
-            self._free.append(slot)
+            self._drop_slot(slot)
             return True
         return False
 
+    def _drop_slot(self, slot: int):
+        for d in (self._slot_tokens, self._slot_budget, self._slot_pos,
+                  self._slot_plen, self._sched, self._slot_start,
+                  self._slot_ttft, self._slot_temp, self._slot_stop):
+            d.pop(slot, None)
+        self._free.append(slot)
+
     def _precompile(self):
-        """Compile every program this engine can ever run — single-step
-        decode, each power-of-two chunk bucket, and each prefill bucket —
-        at startup, so no request stalls behind a first-occurrence XLA
-        compile mid-serve."""
+        """Compile every program this engine can ever run — each
+        power-of-two chunk bucket in both greedy and sampling variants,
+        and each prefill bucket with its admission helpers — at startup,
+        so no request stalls behind a first-occurrence XLA compile
+        mid-serve."""
         import numpy as np
 
         jnp = self._jnp
@@ -410,73 +467,168 @@ class LLMEngine:
         toks = jnp.zeros((S,), jnp.int32)
         poss = jnp.zeros((S,), jnp.int32)
         act = jnp.zeros((S,), bool)  # inactive: cache unchanged
-        self._cache, logits = self._decode(self._cache, toks, poss, act)
-        # warm the EAGER argmax op the k==1 decode path uses (eager ops
-        # compile like jit programs on first use)
-        np.asarray(jnp.argmax(logits, axis=-1))
-        import jax as _jax
-
         zero_t = jnp.zeros((S,), jnp.float32)
-        key0 = _jax.random.PRNGKey(0)
-        k = 2
+        key0 = self._zero_key
+        k = 1
         while k <= self._chunk_steps:
-            self._cache, out, _ = self._decode_chunk(
-                self._cache, toks, poss, act, k, key0, zero_t, 0, False)
-            np.asarray(out[0, 0])
-            self._cache, out, _ = self._decode_chunk(
-                self._cache, toks, poss, act, k, key0, zero_t,
-                self._top_k, True)
-            np.asarray(out[0, 0])
+            self._cache, out, self._chain_toks, self._chain_pos = \
+                self._decode_chunk(self._cache, toks, poss, act, k,
+                                   key0, zero_t, 0, False)
+            np.asarray(out)
+            self._cache, out, self._chain_toks, self._chain_pos = \
+                self._decode_chunk(self._cache, toks, poss, act, k,
+                                   key0, zero_t, self._top_k, True)
+            np.asarray(out)
             k *= 2
         sizes = sorted({1, self._admit_batch})
         for b in self._buckets:
             for B in sizes:
                 # admission path per (batch-size, bucket): prefill_batch +
-                # insert_many + the eager argmax — ALL compile per shape,
-                # and any one left cold lands its compile inside a TTFT
+                # insert_many + sample/argmax + merge — ALL compile per
+                # shape, and any one left cold lands its compile inside
+                # a TTFT
                 lg, kvb = self._prefill_batch(
-                    jnp.zeros((B, b), jnp.int32), jnp.zeros((B,), jnp.int32))
-                np.asarray(jnp.argmax(lg, axis=-1))
-                self._cache = self._insert_many(
-                    self._cache, kvb, jnp.zeros((B,), jnp.int32),
-                    jnp.zeros((B,), bool))
+                    jnp.zeros((B, b), jnp.int32),
+                    jnp.zeros((B,), jnp.int32))
+                sl = jnp.zeros((B,), jnp.int32)
+                vl = jnp.zeros((B,), bool)
+                f1 = self._argmax_j(lg)
+                f2 = self._sample_j(lg, key0, jnp.zeros((B,), jnp.float32))
+                self._chain_toks, self._chain_pos = self._merge_j(
+                    self._chain_toks, self._chain_pos, f1, sl, vl,
+                    jnp.zeros((B,), jnp.int32))
+                self._chain_toks, self._chain_pos = self._merge_j(
+                    self._chain_toks, self._chain_pos, f2, sl, vl,
+                    jnp.zeros((B,), jnp.int32))
+                self._cache = self._insert_many(self._cache, kvb, sl, vl)
         np.asarray(self._cache["k"][0, 0, 0, 0, 0])
+
+    def _reset_device_state(self):
+        """Recover from a failed device program: donation may have
+        consumed the cache buffer mid-flight, so rebuild everything the
+        dispatch chain touches."""
+        from ray_tpu.models import llama_decode
+
+        jnp = self._jnp
+        self._inflight.clear()
+        self._cache = llama_decode.init_cache(
+            self._cfg, self._num_slots, self._max_len, mesh=self._mesh)
+        self._chain_toks = jnp.zeros((self._num_slots,), jnp.int32)
+        self._chain_pos = jnp.zeros((self._num_slots,), jnp.int32)
 
     def _run(self):
         import numpy as np
 
         jnp = self._jnp
-        S = self._num_slots
         try:
             self._precompile()
         except Exception:  # noqa: BLE001 — lazily compile instead
             pass
         while not self._stop:
             try:
-                self._tick(np, jnp, S)
+                self._tick(np, jnp)
             except Exception as e:  # noqa: BLE001 — fail in-flight, live on
                 failed = list(self._slot_req.items())
                 with self._done_lock:
                     for slot, req_id in failed:
-                        self._done[req_id] = RuntimeError(
-                            f"engine step failed: {e!r}")
+                        # cancelled requests get NO result even on engine
+                        # failure (cancel()'s contract), and their mark is
+                        # consumed so the req_id can be reused
+                        if self._cancelled.pop(req_id, None) is None:
+                            self._done[req_id] = RuntimeError(
+                                f"engine step failed: {e!r}")
                 for slot, _ in failed:
                     self._slot_req.pop(slot, None)
-                    for d in (self._slot_tokens, self._slot_budget,
-                              self._slot_pos, self._slot_start,
-                              self._slot_ttft, self._slot_temp,
-                              self._slot_stop):
-                        d.pop(slot, None)
-                    self._free.append(slot)
+                    self._drop_slot(slot)
+                self._reset_device_state()
 
-    def _tick(self, np, jnp, S):
-        # engine-thread cancel handling: clamp budgets here, where slot
-        # bookkeeping is single-threaded, so a cancel can never clamp a
-        # recycled slot belonging to another request
+    def _dispatch(self, np, jnp) -> bool:
+        """Dispatch one decode chunk over the eligible slots; the chunk's
+        inputs are the previous chunk's DEVICE outputs (plus any
+        admission merges), so this enqueues work without waiting."""
+        elig = [s for s in self._slot_req
+                if self._sched[s] < self._slot_budget[s]
+                and self._slot_pos[s] < self._max_len - 1]
+        if not elig:
+            return False
+        S = self._num_slots
+        act = np.zeros((S,), bool)
+        temps = np.zeros((S,), np.float32)
+        for s in elig:
+            act[s] = True
+            temps[s] = self._slot_temp.get(s, 0.0)
+        # With requests waiting (the pool is saturated — _admit just
+        # drained the queue into any free slots), chunk toward the
+        # earliest KNOWN finish (token budgets are known up front) so the
+        # waiter is admitted promptly; chunk lengths round DOWN to a
+        # power of two (static jit arg; only the precompiled buckets may
+        # run). An unpredictable mid-chunk EOS delays admission by one
+        # chunk plus the pipeline depth at most.
+        k = self._chunk_steps
+        if not self._in.empty():
+            to_finish = min(self._slot_budget[s] - self._sched[s]
+                            for s in elig)
+            k = max(1, min(k, to_finish))
+        k = min(k, max(1, self._max_len - 1
+                       - max(self._slot_pos[s] for s in elig)))
+        k = 1 << (k.bit_length() - 1)
+        sampling = bool(temps.any())
+        key = self._next_key() if sampling else self._zero_key
+        (self._cache, out, self._chain_toks, self._chain_pos) = \
+            self._decode_chunk(
+                self._cache, self._chain_toks, self._chain_pos,
+                jnp.asarray(act), k, key, jnp.asarray(temps),
+                self._top_k if sampling else 0, sampling)
+        try:
+            out.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — optional fast path
+            pass
+        self._inflight.append(("chunk", {
+            "out": out, "slots": {s: self._slot_req[s] for s in elig}}))
+        for s in elig:
+            self._slot_pos[s] += k
+            self._sched[s] += k
+        return True
+
+    def _reap(self, np):
+        """Block on the OLDEST in-flight record (its async copy typically
+        already landed) and fold its tokens into the slot bookkeeping.
+        The slot→request match drops tokens for slots recycled since the
+        record was dispatched."""
+        kind, rec = self._inflight.popleft()
+        if kind == "admit":
+            firsts = np.asarray(rec["firsts"])
+            now = time.monotonic()
+            for i, (req_id, slot) in enumerate(rec["batch"]):
+                if self._slot_req.get(slot) != req_id:
+                    continue
+                self._slot_ttft[slot] = now - self._slot_start[slot]
+                tok = int(firsts[i])
+                self._slot_tokens[slot].append(tok)
+                self._maybe_finish(slot, tok)
+            return
+        out = np.asarray(rec["out"])  # [k, S]
+        self._steps += out.shape[0]
+        for slot, req_id in rec["slots"].items():
+            if self._slot_req.get(slot) != req_id:
+                continue
+            for step in range(out.shape[0]):
+                tok = int(out[step, slot])
+                self._slot_tokens[slot].append(tok)
+                if self._maybe_finish(slot, tok):
+                    break
+
+    def _tick(self, np, jnp):
+        # engine-thread cancel handling: finish marked slots immediately
+        # (result discarded; tokens still in the device pipeline for the
+        # slot are dropped at reap by the request match). Doing this
+        # here, where slot bookkeeping is single-threaded, means a cancel
+        # can never touch a slot recycled to another request.
         if self._cancelled:
             for slot, rid in list(self._slot_req.items()):
                 if rid in self._cancelled:
                     self._slot_budget[slot] = 0
+                    self._maybe_finish(slot, -1)
             # prune marks for ids this engine never saw (e.g. a failed
             # submit still cancels in the router's cleanup path)
             cutoff = time.monotonic() - 600.0
@@ -485,78 +637,12 @@ class LLMEngine:
                     if t < cutoff:
                         del self._cancelled[rid]
         self._admit()
-        active_slots = sorted(self._slot_req)
-        if not active_slots:
-            time.sleep(0.002)
-            return
-        toks = np.zeros((S,), np.int32)
-        poss = np.zeros((S,), np.int32)
-        act = np.zeros((S,), bool)
-        for s in active_slots:
-            toks[s] = self._slot_tokens[s][-1]
-            poss[s] = self._slot_pos[s]
-            act[s] = True
-        # Chunked decode by default. With requests waiting (the pool is
-        # saturated — _admit just drained the queue into any free slots),
-        # chunk toward the earliest KNOWN finish (token budgets are known
-        # up front). Chunk lengths round DOWN to a power of two (static
-        # jit arg; only the precompiled buckets may run), so the waiter is
-        # admitted within at most two ticks of the earliest finish; an
-        # unpredictable mid-chunk EOS delays it by one chunk at most.
-        k = self._chunk_steps
-        if not self._in.empty():
-            to_finish = min(self._slot_budget[s] - len(self._slot_tokens[s])
-                            for s in active_slots)
-            k = max(1, min(k, to_finish))
-        k = min(k, max(1, self._max_len - 1 - max(
-            self._slot_pos[s] for s in active_slots)))
-        k = 1 << (k.bit_length() - 1)
-        import jax as _jax
-
-        temps = np.zeros((S,), np.float32)
-        for s_ in active_slots:
-            temps[s_] = self._slot_temp.get(s_, 0.0)
-        # all-greedy ticks (the default mode) skip the per-tick PRNGKey
-        # dispatch — its value is dead in the argmax branch, and this
-        # loop is latency-critical over the tunnel
-        sampling = bool(temps.any())
-        if sampling:
-            rng_key = _jax.random.PRNGKey(
-                (self._seed << 20) ^ self._steps)
-        else:
-            if not hasattr(self, "_zero_key"):
-                self._zero_key = _jax.random.PRNGKey(0)
-            rng_key = self._zero_key
-        if k > 1:
-            # all-greedy ticks run the sample=False program variant —
-            # no categorical draw, no top-k sort on the hot loop
-            self._cache, out, _ = self._decode_chunk(
-                self._cache, jnp.asarray(toks), jnp.asarray(poss),
-                jnp.asarray(act), k, rng_key, jnp.asarray(temps),
-                self._top_k if sampling else 0, sampling)
-            steps_tokens = np.asarray(out)          # [k, S]
-        else:
-            self._cache, logits = self._decode(
-                self._cache, jnp.asarray(toks), jnp.asarray(poss),
-                jnp.asarray(act))
-            # writable COPY: jax's __array__ view is read-only
-            greedy_row = np.array(jnp.argmax(logits, axis=-1))
-            if temps.any():
-                nrng = np.random.default_rng(self._seed + self._steps)
-                np_logits = np.asarray(logits, np.float64)
-                for s_ in active_slots:
-                    if temps[s_] > 0:
-                        greedy_row[s_] = _sample_np(
-                            np_logits[s_], nrng, float(temps[s_]),
-                            self._top_k)
-            steps_tokens = greedy_row[None]          # [1, S]
-        self._steps += steps_tokens.shape[0]
-        for s in active_slots:
-            for step in range(steps_tokens.shape[0]):
-                tok = int(steps_tokens[step, s])
-                self._slot_tokens[s].append(tok)
-                self._slot_pos[s] += 1
-                if self._slot_pos[s] >= self._max_len - 1:
-                    self._slot_budget[s] = len(self._slot_tokens[s])
-                if self._maybe_finish(s, tok):
-                    break
+        dispatched = self._dispatch(np, jnp)
+        # keep at most `depth` records in flight; when nothing was
+        # dispatched, drain the pipeline so finished slots free up
+        if self._inflight and (len(self._inflight) > self._depth
+                               or not dispatched):
+            self._reap(np)
+        if not dispatched and not self._inflight:
+            if self._in.empty():
+                time.sleep(0.002)
