@@ -3,11 +3,13 @@ decode over a STATIC slot cache.
 
 TPU-first design (none of this is in the reference — it serves via torch):
 the serving cache is a fixed tensor ``[layers, slots, max_len, kv_heads,
-head_dim]``. Every shape is static, so XLA compiles exactly two programs —
-one prefill per bucket size, one decode step — and reuses them for the
-lifetime of the server. Slot admission/eviction is pure bookkeeping on the
-host; no recompilation, no paging gathers (vLLM-style paged KV is a
-GPU-ism; on TPU the win is static shapes feeding the MXU).
+head_dim]``. Every shape is static, so XLA compiles a handful of programs —
+one prefill per bucket size, one decode chunk per size — and reuses them
+for the lifetime of the server. Slot admission/eviction is pure
+bookkeeping on the host. This dense path is the fastest at short
+contexts (contiguous cache reads); models/llama_paged.py adds the paged
+variant (page pool + block tables + prefix cache) for long/ragged
+contexts and shared prompts.
 
 Used by serve/llm_engine.py (continuous batching: new sequences join the
 decode batch between steps by prefilling into a free slot).

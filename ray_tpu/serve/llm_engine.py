@@ -148,17 +148,7 @@ class LLMEngine:
         self._seed = int(sampling_seed)
         self._jnp = jnp
 
-        # the single-step decode program is unused since the pipelined
-        # loop runs k==1 through the chunk program (one fewer compile)
-        (self._prefill_batch, self._insert_many, _,
-         self._decode_chunk) = \
-            llama_decode.make_engine_fns(cfg, self._params, num_slots,
-                                         max_len, mesh=mesh)
-        # burst admission: up to this many prompts prefill in ONE batched
-        # program call (2 compiled batch sizes: 1 and this max)
-        self._admit_batch = max(1, min(8, num_slots))
-        self._cache = llama_decode.init_cache(cfg, num_slots, max_len,
-                                              mesh=mesh)
+        self._init_programs()
         # Tokens decoded per dispatched program. Chunks chain on device,
         # so throughput is chunk-size-insensitive once the pipeline is
         # deep enough to cover the dispatch round-trip; larger chunks
@@ -218,6 +208,25 @@ class LLMEngine:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="llm-engine")
         self._thread.start()
+
+    def _init_programs(self):
+        """Build the compiled-program set and device cache state.
+        PagedLLMEngine overrides this (and the admission/dispatch
+        internals) to swap the dense slot cache for the page pool."""
+        from ray_tpu.models import llama_decode
+
+        # the single-step decode program is unused since the pipelined
+        # loop runs k==1 through the chunk program (one fewer compile)
+        (self._prefill_batch, self._insert_many, _,
+         self._decode_chunk) = \
+            llama_decode.make_engine_fns(self._cfg, self._params,
+                                         self._num_slots, self._max_len,
+                                         mesh=self._mesh)
+        # burst admission: up to this many prompts prefill in ONE batched
+        # program call (2 compiled batch sizes: 1 and this max)
+        self._admit_batch = max(1, min(8, self._num_slots))
+        self._cache = llama_decode.init_cache(
+            self._cfg, self._num_slots, self._max_len, mesh=self._mesh)
 
     # ---- mailbox (called from the actor's request thread) ------------------
 
@@ -542,6 +551,27 @@ class LLMEngine:
                     self._drop_slot(slot)
                 self._reset_device_state()
 
+    def _prepare_dispatch(self, elig: List[int], k: int) -> List[int]:
+        """Hook: reserve whatever the chunk needs for ``k`` more tokens
+        per slot; returns the subset actually dispatchable now (the
+        paged engine grows block tables here and stalls slots the page
+        pool cannot cover)."""
+        return elig
+
+    def _dispatch_stalled(self, elig: List[int]) -> None:
+        """Hook: called when _prepare_dispatch returned no slots."""
+
+    def _run_chunk(self, jnp, act, k, key, temps, sampling):
+        """Hook: invoke the decode-chunk program (the paged engine adds
+        its block-table argument); must update the cache + chain state
+        and return the [k, S] token output array."""
+        (self._cache, out, self._chain_toks, self._chain_pos) = \
+            self._decode_chunk(
+                self._cache, self._chain_toks, self._chain_pos,
+                act, k, key, temps,
+                self._top_k if sampling else 0, sampling)
+        return out
+
     def _dispatch(self, np, jnp) -> bool:
         """Dispatch one decode chunk over the eligible slots; the chunk's
         inputs are the previous chunk's DEVICE outputs (plus any
@@ -551,12 +581,6 @@ class LLMEngine:
                 and self._slot_pos[s] < self._max_len - 1]
         if not elig:
             return False
-        S = self._num_slots
-        act = np.zeros((S,), bool)
-        temps = np.zeros((S,), np.float32)
-        for s in elig:
-            act[s] = True
-            temps[s] = self._slot_temp.get(s, 0.0)
         # With requests waiting (the pool is saturated — _admit just
         # drained the queue into any free slots), chunk toward the
         # earliest KNOWN finish (token budgets are known up front) so the
@@ -572,20 +596,27 @@ class LLMEngine:
         k = min(k, max(1, self._max_len - 1
                        - max(self._slot_pos[s] for s in elig)))
         k = 1 << (k.bit_length() - 1)
+        ready = self._prepare_dispatch(elig, k)
+        if not ready:
+            self._dispatch_stalled(elig)
+            return False
+        S = self._num_slots
+        act = np.zeros((S,), bool)
+        temps = np.zeros((S,), np.float32)
+        for s in ready:
+            act[s] = True
+            temps[s] = self._slot_temp.get(s, 0.0)
         sampling = bool(temps.any())
         key = self._next_key() if sampling else self._zero_key
-        (self._cache, out, self._chain_toks, self._chain_pos) = \
-            self._decode_chunk(
-                self._cache, self._chain_toks, self._chain_pos,
-                jnp.asarray(act), k, key, jnp.asarray(temps),
-                self._top_k if sampling else 0, sampling)
+        out = self._run_chunk(jnp, jnp.asarray(act), k, key,
+                              jnp.asarray(temps), sampling)
         try:
             out.copy_to_host_async()
         except Exception:  # noqa: BLE001 — optional fast path
             pass
         self._inflight.append(("chunk", {
-            "out": out, "slots": {s: self._slot_req[s] for s in elig}}))
-        for s in elig:
+            "out": out, "slots": {s: self._slot_req[s] for s in ready}}))
+        for s in ready:
             self._slot_pos[s] += k
             self._sched[s] += k
         return True
