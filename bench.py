@@ -127,12 +127,20 @@ def bench_train_step(attn_impl: str, batch: int = 8, seq: int = 2048,
         cfg = llama.LlamaConfig.tiny(attn_impl="reference")
         batch, seq, steps = 2, 128, 3
     else:
+        # scan_layers=False: the unrolled layer loop avoids the scan
+        # backward's stacked-gradient buffer re-copies; save_qkv remat
+        # keeps the post-rope projections so backward skips their
+        # recompute. Together: 855→782 ms at 1B (BENCH_NOTES r5).
         cfg = llama.LlamaConfig.llama3_1b_proxy(
-            param_dtype=jnp.bfloat16, attn_impl=attn_impl)
+            param_dtype=jnp.bfloat16, attn_impl=attn_impl,
+            scan_layers=False, remat_policy="save_qkv")
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     n_params = llama.num_params(params)
-    tx = optax.adamw(3e-4, weight_decay=0.01)
+    # bf16 first moment frees ~1.75 GB of optimizer HBM (funds the
+    # save_qkv activations) and is speed- and loss-neutral (r4 notes)
+    tx = optax.adamw(3e-4, weight_decay=0.01,
+                     mu_dtype=jnp.bfloat16 if on_tpu else None)
     opt_state = tx.init(params)
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
@@ -176,7 +184,7 @@ def bench_layer_8b(seq: int, batch: int = 4, steps: int = 10):
 
     cfg = llama.LlamaConfig.llama3_8b(
         num_layers=1, vocab_size=256, param_dtype=jnp.bfloat16,
-        attn_impl="flash")
+        attn_impl="flash", scan_layers=False, remat_policy="save_qkv")
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     n_params = llama.num_params(params)
     tokens = jax.random.randint(
