@@ -1867,8 +1867,14 @@ class Runtime:
             w = state.worker
         self._mark_actor_dead(state, ActorDiedError("actor was killed via kill()"))
         if w is not None and w.proc is not None:
+            # ray.kill semantics are FORCEFUL (no exit handlers), so
+            # escalate to SIGKILL — SIGTERM alone is not a kill for
+            # processes that trap it (train workers route SIGTERM to the
+            # preemption flag, and a worker blocked in a cross-process
+            # collective never reaches a python signal handler at all)
             try:
                 w.proc.terminate()
+                w.proc.kill()
             except OSError:
                 pass
 

@@ -277,16 +277,13 @@ def forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
 
     layer_fn = lambda x_, p_: _layer(cfg, x_, p_, cos, sin, mesh=mesh)
     if cfg.remat:
+        # policy values are validated in __post_init__
         if cfg.remat_policy == "save_qkv":
             pol = jax.checkpoint_policies.save_only_these_names(
                 "q_rope", "k_rope", "v_proj")
             ckpt_fn = jax.checkpoint(layer_fn, policy=pol)
-        elif cfg.remat_policy == "full":
-            ckpt_fn = jax.checkpoint(layer_fn)
         else:
-            raise ValueError(
-                f"unknown remat_policy {cfg.remat_policy!r} "
-                "(full | save_qkv)")
+            ckpt_fn = jax.checkpoint(layer_fn)
     else:
         ckpt_fn = layer_fn
 

@@ -182,6 +182,11 @@ def forward(cfg: MixtralConfig, params, tokens: jax.Array, mesh=None
                                 cfg.rope_theta, dtype=cfg.dtype,
                                 scaling=cfg.rope_scaling_dict)
 
+    if cfg.remat_policy != "full" or not cfg.scan_layers:
+        raise ValueError(
+            "remat_policy/scan_layers are dense-Llama knobs; the MoE "
+            "forward always scans under full remat — drop them rather "
+            "than read tuning signal from a no-op")
     layer_fn = lambda x_, p_: _layer(cfg, x_, p_, cos, sin, mesh=mesh)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)

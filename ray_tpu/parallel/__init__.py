@@ -15,8 +15,10 @@ from ray_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXES,
     MODEL_AXES,
     MeshSpec,
+    build_hybrid_mesh,
     build_mesh,
     data_shard_axes,
+    hybrid_mesh,
     local_mesh,
 )
 from ray_tpu.parallel.sharding import (  # noqa: F401

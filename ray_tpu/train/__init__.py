@@ -28,10 +28,12 @@ from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
 )
 from ray_tpu.train.result import Result  # noqa: F401
 from ray_tpu.train.session import (  # noqa: F401
+    PreemptedError,
     TrainContext,
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    preempted,
     report,
 )
 from ray_tpu.train.storage import StorageContext  # noqa: F401
@@ -43,6 +45,7 @@ __all__ = [
     "Checkpoint", "CheckpointManager", "CheckpointConfig",
     "FailureConfig", "RunConfig", "ScalingConfig",
     "DataParallelTrainer", "JaxTrainer", "Result",
+    "PreemptedError", "preempted",
     "TrainContext", "get_checkpoint", "get_context", "get_dataset_shard",
     "report", "StorageContext", "WorkerGroup",
 ]

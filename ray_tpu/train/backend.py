@@ -117,8 +117,13 @@ def _join_host_collective_group(world_size: int, rank: int, group_name: str):
 
 class _JaxBackend(Backend):
     def on_start(self, worker_group: WorkerGroup, cfg: JaxConfig):
+        from ray_tpu.train.session import _install_preemption_handler
+
         worker_group.execute(_setup_jax_platform, cfg.platform,
                              cfg.cpu_devices_per_worker)
+        # TPU maintenance events arrive as SIGTERM: give every gang
+        # worker a grace window to checkpoint (session.preempted())
+        worker_group.execute(_install_preemption_handler)
         if cfg.distributed and len(worker_group) > 1:
             coordinator = worker_group.execute_single(
                 0, _pick_coordinator, cfg.coordinator_port)

@@ -64,14 +64,29 @@ class BackendExecutor:
         """
         assert self.worker_group is not None
         refs = [w.next_result.remote() for w in self.worker_group.workers]
-        try:
-            results: List[TrainingResult] = ray_tpu.get(refs)
-        except Exception as e:
-            raise TrainingWorkerError(f"training worker died: {e}") from e
-        errors = [r.error for r in results if r.error is not None]
-        if errors:
-            raise TrainingWorkerError(
-                f"train loop failed on a worker: {errors[0]!r}") from errors[0]
+        # Harvest as results land and FAIL FAST on the first error: when
+        # one rank raises (user exception, PreemptedError after a
+        # maintenance SIGTERM, actor death), its gang peers are typically
+        # blocked inside a cross-process collective and will never report
+        # — waiting for all refs would deadlock the driver. Teardown
+        # (executor.shutdown on the error path) unblocks them by killing
+        # the group.
+        results: List[Optional[TrainingResult]] = [None] * len(refs)
+        pending = list(refs)
+        index = {r: i for i, r in enumerate(refs)}
+        while pending:
+            done_refs, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in done_refs:
+                try:
+                    res: TrainingResult = ray_tpu.get(ref)
+                except Exception as e:
+                    raise TrainingWorkerError(
+                        f"training worker died: {e}") from e
+                if res.error is not None:
+                    raise TrainingWorkerError(
+                        f"train loop failed on a worker: {res.error!r}"
+                    ) from res.error
+                results[index[ref]] = res
         if all(r.done for r in results):
             return None
         # Mixed done/not-done means a worker returned early from its loop —

@@ -60,6 +60,11 @@ class FailureConfig:
     FailureConfig; executor restart in train/_internal/backend_executor.py)."""
 
     max_failures: int = 0
+    # Preemptions (PreemptedError after a SIGTERM maintenance event) are
+    # scheduled, not faults: they restart the gang WITHOUT consuming
+    # max_failures, bounded by this cap so a mis-signalled fleet cannot
+    # restart-loop forever.
+    max_preemptions: int = 16
 
 
 @dataclass

@@ -22,6 +22,7 @@ from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.checkpoint_manager import CheckpointManager
 from ray_tpu.train.config import RunConfig, ScalingConfig
 from ray_tpu.train.result import Result
+from ray_tpu.train.session import PreemptedError
 from ray_tpu.train.storage import StorageContext
 
 logger = logging.getLogger(__name__)
@@ -56,6 +57,7 @@ class DataParallelTrainer:
                                      self.run_config.checkpoint_config)
         max_failures = self.run_config.failure_config.max_failures
         failures = 0
+        preemptions = 0
         latest_metrics: Dict[str, Any] = {}
         history: list = []
         last_error: Optional[BaseException] = None
@@ -99,12 +101,24 @@ class DataParallelTrainer:
                 last_error = None
                 break
             except TrainingWorkerError as e:
-                failures += 1
                 last_error = e
-                logger.warning("training failed (%d/%d): %s",
-                               failures, max_failures, e)
-                if max_failures >= 0 and failures > max_failures:
-                    break
+                if isinstance(e.__cause__, PreemptedError):
+                    # scheduled eviction, not a fault: restart from the
+                    # latest checkpoint without consuming max_failures
+                    preemptions += 1
+                    logger.warning(
+                        "gang preempted (%d/%d); restarting from latest "
+                        "checkpoint", preemptions,
+                        self.run_config.failure_config.max_preemptions)
+                    if preemptions > \
+                            self.run_config.failure_config.max_preemptions:
+                        break
+                else:
+                    failures += 1
+                    logger.warning("training failed (%d/%d): %s",
+                                   failures, max_failures, e)
+                    if max_failures >= 0 and failures > max_failures:
+                        break
             finally:
                 executor.shutdown()
 

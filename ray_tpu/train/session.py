@@ -155,6 +155,53 @@ def _set_session(s: Optional[_TrainSession]):
     _session = s
 
 
+# ---- preemption (TPU maintenance events arrive as SIGTERM) -----------------
+
+_preempt_event = threading.Event()
+
+
+class PreemptedError(RuntimeError):
+    """Raised by a train loop that observed preemption (after saving its
+    checkpoint). The trainer treats it as a gang-restart signal that does
+    NOT consume the failure budget — preemptions are scheduled events,
+    not faults (reference analogue: spot/maintenance handling in
+    cluster autoscaling; TPU docs deliver maintenance events as SIGTERM
+    with a grace window)."""
+
+
+def preempted() -> bool:
+    """True once a preemption signal (SIGTERM) reached this worker.
+    Poll at step boundaries: save a checkpoint, then raise
+    PreemptedError so the gang restarts cleanly on fresh resources."""
+    return _preempt_event.is_set()
+
+
+def _flag_preemption():
+    """Mark this worker preempted (what the SIGTERM handler does; also
+    the hook for environments that deliver maintenance events through a
+    channel other than signals)."""
+    _preempt_event.set()
+
+
+def _install_preemption_handler():
+    """Worker-side: route SIGTERM to a flag instead of sudden death so
+    the train loop gets its grace window (forceful teardown uses
+    SIGKILL — runtime kill_actor — which cannot be trapped). Installed
+    by the Jax backend on gang start; runs in the worker's main thread.
+
+    The flag is cleared BEFORE the handler goes in: a SIGTERM landing in
+    between must stick (a drain racing gang start), while a stale flag
+    from a previous gang on a reused process must not."""
+    import signal
+
+    _preempt_event.clear()
+    try:
+        signal.signal(signal.SIGTERM, lambda signum, frame:
+                      _flag_preemption())
+    except ValueError:
+        pass  # not the main thread: _flag_preemption() remains the hook
+
+
 def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None,
            *, checkpoint_dir: Optional[str] = None):
     """Report metrics (and optionally a just-written checkpoint dir) to the

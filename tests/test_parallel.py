@@ -355,3 +355,42 @@ def test_pipeline_x_ring_attention_matches_sequential():
     errs = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
                         g_ref, g_pp)
     assert max(jax.tree.leaves(errs)) < 1e-3, errs
+
+
+def test_build_hybrid_mesh_two_pseudo_slices():
+    """dp-over-DCN x fsdp-over-ICI composition: axis order/shape, slice
+    grouping (each dp row = one contiguous pseudo-slice), and a psum
+    across the full mesh."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import build_hybrid_mesh
+
+    mesh = build_hybrid_mesh({"fsdp": 4}, {"dp": 2})
+    assert mesh.axis_names == ("dp", "fsdp")
+    assert mesh.devices.shape == (2, 4)
+    devs = jax.devices()
+    # pseudo-slices are contiguous groups of prod(ici) devices
+    assert list(mesh.devices[0]) == devs[:4]
+    assert list(mesh.devices[1]) == devs[4:8]
+
+    # an axis present in BOTH specs composes dcn-outer
+    mesh2 = build_hybrid_mesh({"dp": 2, "tp": 2}, {"dp": 2})
+    assert mesh2.axis_names == ("dp", "tp")
+    assert mesh2.devices.shape == (4, 2)
+    # dp index 0,1 -> slice 0; dp index 2,3 -> slice 1
+    assert list(mesh2.devices[:2].ravel()) == devs[:4]
+
+    x = jnp.arange(8.0)
+    y = jax.shard_map(
+        lambda a: jax.lax.psum(a, ("dp", "fsdp")), mesh=mesh,
+        in_specs=P(("dp", "fsdp")), out_specs=P())(x)
+    assert float(np.asarray(y)[0]) == 28.0
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        build_hybrid_mesh({"fsdp": 4}, {"dp": 3})

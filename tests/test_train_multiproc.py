@@ -308,3 +308,111 @@ def test_multiproc_gang_through_cluster_plane(run_cfg):
     finally:
         c.shutdown()
         runtime_context.set_core(prev)
+
+
+def _preemptible_gang_loop(config):
+    """Like _fsdp_gang_loop but the failure is a PREEMPTION: rank 1
+    receives SIGTERM (the TPU maintenance-event delivery) mid-run, the
+    backend-installed handler converts it to a flag, and the loop raises
+    train.PreemptedError at the next step boundary — after the step's
+    checkpoint already persisted."""
+    import os as _os
+    import pickle
+    import signal
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import MeshSpec, build_mesh, named_sharding
+    from ray_tpu.parallel.sharding import shard_pytree_like
+
+    ctx = train.get_context()
+    rank = ctx.get_world_rank()
+    mesh = build_mesh(MeshSpec({"fsdp": jax.device_count()}))
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    param_sh = shard_pytree_like(llama.logical_axes_without_layer(cfg), mesh)
+    params = jax.device_put(params, param_sh)
+    tx = optax.adamw(1e-2, weight_decay=0.0)
+    opt_state = tx.init(params)
+
+    start_step = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            with open(_os.path.join(d, "state.pkl"), "rb") as f:
+                state = pickle.load(f)
+        start_step = state["step"] + 1
+        params = jax.device_put(
+            jax.tree.map(jnp.asarray, state["params"]), param_sh)
+        opt_state = tx.init(params)
+
+    batch_sh = named_sharding(mesh, "batch", None)
+    global_batch, seq = 2 * jax.device_count(), 33
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(cfg, p, {"tokens": tokens}, mesh=mesh)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step_fn = jax.jit(train_step, donate_argnums=(0, 1))
+    rng = np.random.default_rng(7)
+    for step in range(start_step, int(config["steps"])):
+        # the maintenance event: observed at a step boundary, AFTER the
+        # previous step's checkpoint persisted
+        if train.preempted():
+            raise train.PreemptedError(f"maintenance event at step {step}")
+        host_tokens = rng.integers(
+            0, cfg.vocab_size, (global_batch, seq)).astype(np.int32)
+        tokens = jax.make_array_from_callback(
+            (global_batch, seq), batch_sh, lambda idx: host_tokens[idx])
+        params, opt_state, loss = step_fn(params, opt_state, tokens)
+        loss_val = float(jax.device_get(loss))
+        from jax.experimental import multihost_utils
+
+        host_params = multihost_utils.process_allgather(params, tiled=True)
+
+        if (step == int(config["preempt_at"]) and rank == 1
+                and not _os.path.exists(config["sentinel"])):
+            with open(config["sentinel"], "w") as f:
+                f.write("preempted")
+            _os.kill(_os.getpid(), signal.SIGTERM)  # delivery, not death
+
+        if rank == 0:
+            with tempfile.TemporaryDirectory() as d:
+                with open(_os.path.join(d, "state.pkl"), "wb") as f:
+                    pickle.dump({"step": step, "params": host_params}, f)
+                train.report({"step": step, "loss": loss_val},
+                             checkpoint=train.Checkpoint.from_directory(d))
+        else:
+            train.report({"step": step, "loss": loss_val})
+
+
+def test_multiproc_gang_preemption_sigterm_resumes(rt, run_cfg, tmp_path):
+    """SIGTERM mid-run = TPU maintenance event: the worker checkpoints at
+    the boundary, raises PreemptedError, and the gang restarts and
+    resumes WITHOUT consuming the failure budget (max_failures=0)."""
+    sentinel = str(tmp_path / "preempted-once")
+    trainer = JaxTrainer(
+        _preemptible_gang_loop,
+        train_loop_config={"steps": 6, "preempt_at": 2,
+                           "sentinel": sentinel},
+        jax_config=_gang_config(),
+        scaling_config=ScalingConfig(num_workers=N_PROCS),
+        # max_failures=0: ONLY the preemption path can restart the gang
+        run_config=run_cfg(failure_config=FailureConfig(max_failures=0)),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert os.path.exists(sentinel), "the preemption never fired"
+    steps = [row["step"] for row in result.metrics_history]
+    assert steps[-1] == 5, f"training did not complete: {steps}"
+    # resumed from the step-2 checkpoint (not from scratch)
+    assert 0 in steps and steps.count(0) == 1, steps
