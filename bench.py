@@ -774,6 +774,64 @@ def bench_core(rows: list):
     ray_tpu.shutdown()
 
 
+def bench_scalability(rows: list):
+    """The reference's single-node scalability envelope
+    (release/benchmarks/single_node.py; BASELINE.md durations measured
+    on m4.16xlarge): 10k-object-arg task, 3k-return task, ray.get over
+    10k store objects, and 1M tasks queued on one node. Durations —
+    vs_baseline is baseline/ours (>1 = faster). These are exactly where
+    queue and refcount data structures break; the regression guard pins
+    them via BASELINE.json."""
+    import ray_tpu
+
+    nw = 2 if (os.cpu_count() or 1) <= 2 else 4
+    ray_tpu.init(num_workers=nw, object_store_memory=2048 << 20)
+    try:
+        @ray_tpu.remote
+        def noop(*a):
+            return None
+
+        @ray_tpu.remote
+        def ret_n(n):
+            return tuple(range(n))
+
+        def dur_row(metric, dt, base):
+            rows.append({"metric": metric, "value": round(dt, 3),
+                         "unit": "s (lower is better)",
+                         "vs_baseline": round(base / dt, 3)})
+
+        args = [ray_tpu.put(1) for _ in range(10_000)]
+        t0 = time.perf_counter()
+        ray_tpu.get(noop.remote(*args), timeout=600)
+        dur_row("single_node_task_with_10k_args_s",
+                time.perf_counter() - t0, 18.38)
+        del args
+
+        t0 = time.perf_counter()
+        refs = ret_n.options(num_returns=3000).remote(3000)
+        ray_tpu.get(list(refs), timeout=600)
+        dur_row("single_node_task_returning_3k_objects_s",
+                time.perf_counter() - t0, 5.74)
+
+        objs = [ray_tpu.put(b"x" * 100) for _ in range(10_000)]
+        t0 = time.perf_counter()
+        ray_tpu.get(objs, timeout=600)
+        dur_row("single_node_get_10k_objects_s",
+                time.perf_counter() - t0, 23.41)
+        del objs
+
+        t0 = time.perf_counter()
+        refs = [noop.remote() for _ in range(1_000_000)]
+        # resolve in slabs: one get over 1M refs would build a single
+        # million-entry wait set; the reference resolves in batches too
+        for i in range(0, 1_000_000, 100_000):
+            ray_tpu.get(refs[i:i + 100_000], timeout=1200)
+        dur_row("single_node_1m_queued_tasks_s",
+                time.perf_counter() - t0, 186.3)
+    finally:
+        ray_tpu.shutdown()
+
+
 def bench_many_nodes(rows: list):
     """Scale rows on a 16-node local cluster of REAL node-server
     processes: scheduling throughput for a 10k-task wave, actor-fleet
@@ -838,6 +896,12 @@ def main():
         bench_core(rows)
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "core_microbench", "value": -1,
+                     "unit": f"error: {e}"})
+
+    try:
+        bench_scalability(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "scalability_bench", "value": -1,
                      "unit": f"error: {e}"})
 
     try:
@@ -989,6 +1053,14 @@ def main():
              "serve_decode_tokens_per_sec", True),
             ("serve_ttft_p50_ms_loaded", "serve_ttft_p50_ms", False),
             ("serve_itl_p50_ms", "serve_itl_p50_ms", False),
+            ("single_node_task_with_10k_args_s",
+             "single_node_task_with_10k_args_s", False),
+            ("single_node_task_returning_3k_objects_s",
+             "single_node_task_returning_3k_objects_s", False),
+            ("single_node_get_10k_objects_s",
+             "single_node_get_10k_objects_s", False),
+            ("single_node_1m_queued_tasks_s",
+             "single_node_1m_queued_tasks_s", False),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)

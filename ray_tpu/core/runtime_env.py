@@ -225,10 +225,19 @@ def ensure_pip_env(cache_root: str, packages, options) -> str:
         a SIGKILLed installer must not brick this env forever."""
         try:
             pid = int(open(path).read().strip() or 0)
-        except (OSError, ValueError):
-            return False  # unreadable/mid-write: treat as live for now
+        except OSError:
+            return False  # already reclaimed by a competing breaker
+        except ValueError:
+            pid = 0
         if pid <= 0:
-            return False
+            # empty/garbled lock: the installer died between O_EXCL
+            # create and writing its pid. Mid-write is indistinguishable,
+            # so require the file to be old enough that any live writer
+            # would long since have finished its two-line write.
+            try:
+                return time.time() - os.path.getmtime(path) > 30.0
+            except OSError:
+                return False
         try:
             os.kill(pid, 0)
             return False

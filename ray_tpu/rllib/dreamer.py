@@ -498,7 +498,10 @@ class _SeqReplay:
         envs = rng.integers(0, self.n, batch)
         # windows must not straddle the ring's write head
         if self.full:
-            offs = rng.integers(0, size - length, batch)
+            # inclusive bound: offset size-length is the newest valid
+            # non-straddling window, and excluding it degenerates to an
+            # empty range when capacity == length
+            offs = rng.integers(0, size - length + 1, batch)
             starts = (self.ptr + offs) % self.cap
         else:
             starts = rng.integers(0, size - length + 1, batch)

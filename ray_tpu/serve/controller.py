@@ -41,6 +41,10 @@ class _DeploymentInfo:
         self.version = 0
         self.next_id = 0
         self.deleting = False
+        # long-poll snapshot id: bumps on ANY change a router cares
+        # about (running replica set, config/redeploy, deletion)
+        self.snapshot = 1
+        self._last_running_fp: tuple = ()
         # autoscaling state: router load reports + pending decision
         self.loads: Dict[str, tuple] = {}   # router_id -> (load, ts)
         self.desired_since: Optional[tuple] = None  # (desired, since_ts)
@@ -59,6 +63,10 @@ class ServeController:
     def __init__(self):
         self._deployments: Dict[str, _DeploymentInfo] = {}
         self._lock = threading.Lock()
+        # long-poll push channel (reference: serve/_private/long_poll.py
+        # LongPollHost): topology changes notify blocked listeners
+        self._lp_cond = threading.Condition(self._lock)
+        self._get_replicas_calls = 0  # pull-RPC counter (tests pin ~0)
         self._stop = False
         self._loop = threading.Thread(target=self._control_loop, daemon=True,
                                       name="serve-controller")
@@ -81,6 +89,7 @@ class ServeController:
                 info.deleting = False
                 for r in list(info.replicas.values()):
                     self._stop_replica(info, r)
+                self._bump_locked(info)
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
@@ -115,12 +124,71 @@ class ServeController:
     def get_replicas(self, name: str):
         """(version, [(replica_id, actor_name)]) for router refresh."""
         with self._lock:
+            self._get_replicas_calls += 1
             info = self._deployments.get(name)
             if info is None:
                 return (0, [])
-            return (info.version,
-                    [(r.replica_id, r.handle)
-                     for r in info.replicas.values() if r.state == "RUNNING"])
+            return (info.version, self._running_list(info))
+
+    @staticmethod
+    def _running_list(info: "_DeploymentInfo"):
+        return [(r.replica_id, r.handle)
+                for r in info.replicas.values() if r.state == "RUNNING"]
+
+    def get_replicas_snapshot(self, name: str):
+        """(snapshot, version, replicas) — the long-poll seed."""
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                return (0, 0, [])
+            return (info.snapshot, info.version, self._running_list(info))
+
+    def listen_for_change(self, keys: Dict[str, int],
+                          timeout_s: float = 30.0):
+        """Long-poll host (reference: serve/_private/long_poll.py:64
+        LongPollHost.listen_for_change): block until any watched key's
+        snapshot exceeds the caller's, then return {key: (snapshot,
+        payload)} for the changed keys; {} on timeout (caller re-arms).
+        Keys are "replicas:<deployment>" (payload (version, [(rid,
+        actor_name)])) or "config:<deployment>" (payload config dict).
+        A deployment the caller has seen (last snapshot > 0) that no
+        longer exists yields payload None — the listener's exit signal.
+        Requires the controller actor's max_concurrency > number of
+        concurrent listeners (get_or_create_controller sets it)."""
+        deadline = time.monotonic() + max(0.0, min(float(timeout_s), 60.0))
+        with self._lp_cond:
+            while True:
+                out: Dict[str, tuple] = {}
+                for key, last in keys.items():
+                    kind, _, name = key.partition(":")
+                    info = self._deployments.get(name)
+                    if info is None:
+                        if int(last) > 0:
+                            out[key] = (int(last) + 1, None)
+                        continue
+                    if info.snapshot > int(last):
+                        if kind == "config":
+                            payload: Any = dict(info.config)
+                        else:
+                            payload = (info.version,
+                                       self._running_list(info))
+                        out[key] = (info.snapshot, payload)
+                if out:
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                self._lp_cond.wait(remaining)
+
+    def _bump_locked(self, info: "_DeploymentInfo"):
+        info.snapshot += 1
+        self._lp_cond.notify_all()
+
+    def control_plane_stats(self) -> Dict[str, Any]:
+        """Counters for tests/observability: pull-RPC volume should stay
+        flat while the long-poll channel is healthy."""
+        with self._lock:
+            return {"get_replicas_calls": self._get_replicas_calls}
 
     def get_deployment_config(self, name: str) -> Optional[dict]:
         with self._lock:
@@ -177,9 +245,23 @@ class ServeController:
             try:
                 self._reconcile()
                 self._health_check()
+                self._notify_topology_changes()
             except Exception:  # noqa: BLE001 — the loop must survive
                 pass
             time.sleep(0.1)
+
+    def _notify_topology_changes(self):
+        """Push side of the long-poll channel: one fingerprint sweep per
+        control-loop tick catches every running-set transition (replica
+        became RUNNING, died, was rolled) wherever it happened."""
+        with self._lp_cond:
+            for info in self._deployments.values():
+                fp = tuple(sorted(
+                    r.replica_id for r in info.replicas.values()
+                    if r.state == "RUNNING"))
+                if fp != info._last_running_fp:
+                    info._last_running_fp = fp
+                    self._bump_locked(info)
 
     def _autoscale(self, info: "_DeploymentInfo") -> None:
         """Load-based target adjustment (reference:
@@ -235,9 +317,11 @@ class ServeController:
                     for v in victims:
                         self._stop_replica(info, v)
             if info.deleting and info.target == 0:
-                with self._lock:
+                with self._lp_cond:
                     if not info.replicas:
                         self._deployments.pop(info.name, None)
+                        # listeners see info=None → exit signal
+                        self._lp_cond.notify_all()
 
     def _start_replica(self, info: _DeploymentInfo):
         import cloudpickle
@@ -344,7 +428,15 @@ def get_or_create_controller():
     except Exception:  # noqa: BLE001
         from ray_tpu.api import remote
 
-        cls = remote(num_cpus=0.05, name=CONTROLLER_NAME)(ServeController)
+        # max_concurrency: long-poll listeners (one per router: proxies,
+        # drivers, replicas holding handles) each BLOCK one executor
+        # slot in listen_for_change; serial execution would head-of-line
+        # block deploys and load reports behind them. 128 bounds the
+        # fleet size this control plane serves crisply — beyond that,
+        # listener queuing degrades push latency toward the 10 s poll
+        # timeout (scale the constant with the deployment fan-out).
+        cls = remote(num_cpus=0.05, name=CONTROLLER_NAME,
+                     max_concurrency=128)(ServeController)
         try:
             return cls.remote()
         except ValueError:

@@ -46,7 +46,10 @@ class Router:
         # multiplexing: model id -> replica id that last loaded it
         self._mux_affinity: Dict[str, str] = {}
         self._version = -1
+        self._snapshot = 0
+        self._deployment_gone = False
         self._last_refresh = 0.0
+        self._topology_thread: Optional[threading.Thread] = None
         cfg = ray_tpu.get(controller.get_deployment_config.remote(name),
                           timeout=30) or {}
         self._max_batch = int(cfg.get("max_batch_size", 0))
@@ -69,6 +72,93 @@ class Router:
             # would overwrite another router's load report
             self._router_id = f"router-{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
             self._ensure_report_thread()
+        self._ensure_topology_thread()
+
+    def _ensure_topology_thread(self):
+        """(Re)start the long-poll topology listener. Replica-set and
+        config changes PUSH from the controller (reference:
+        serve/_private/long_poll.py client loop) — the router issues no
+        steady-state get_replicas polls at all."""
+        if self._deployment_gone:
+            return
+        with self._lock:
+            t = self._topology_thread
+            if t is not None and t.is_alive():
+                return
+            self._stop_reporting = False
+            self._topology_thread = threading.Thread(
+                target=self._topology_loop, daemon=True,
+                name="serve-topology-listen")
+            self._topology_thread.start()
+
+    def _topology_loop(self):
+        key = f"replicas:{self._name}"
+        consecutive_failures = 0
+        # Worker processes talk to their owner over ONE serialized data
+        # connection: a get() blocking 10 s on the long-poll ref would
+        # head-of-line block every other RPC the replica makes (measured:
+        # the controller's health checks then time out and it kills the
+        # replica). In worker context the poll ref is therefore drained
+        # with non-blocking wait() probes against the LOCAL owner —
+        # ~100 ms extra latency for in-replica routers, zero controller
+        # load either way. Driver routers (the proxies, user drivers)
+        # block directly: instant push.
+        from ray_tpu.core import runtime_context
+
+        core = runtime_context.get_core_or_none()
+        in_worker = type(core).__module__.endswith("worker_proc")
+        while not self._stop_reporting:
+            ref = None
+            try:
+                ref = self._controller.listen_for_change.remote(
+                    {key: self._snapshot}, 10.0)
+                if in_worker:
+                    deadline = time.monotonic() + 12.0
+                    while (not self._stop_reporting
+                           and time.monotonic() < deadline):
+                        ready, _ = ray_tpu.wait([ref], num_returns=1,
+                                                timeout=0)
+                        if ready:
+                            break
+                        time.sleep(0.05)
+                    else:
+                        continue  # re-arm (server timeout imminent)
+                    res = ray_tpu.get(ref, timeout=5)
+                else:
+                    res = ray_tpu.get(ref, timeout=25)
+                consecutive_failures = 0
+            except Exception:  # noqa: BLE001 — controller restart/outage
+                consecutive_failures += 1
+                if consecutive_failures >= 12:  # ~2 min of outage
+                    return
+                time.sleep(1.0)
+                continue
+            finally:
+                # refs have no implicit reclamation in this runtime; an
+                # unfreed poll result every ~10 s would grow the object
+                # table forever (same rule as the report loop's prev_ref)
+                if ref is not None:
+                    try:
+                        ray_tpu.free(ref)
+                    except Exception:  # noqa: BLE001
+                        pass
+            if not res or key not in res:
+                continue  # timed out server-side: re-arm
+            snap, payload = res[key]
+            if payload is None:
+                # deployment deleted: end this router's loops
+                self._deployment_gone = True
+                self._stop_reporting = True
+                return
+            version, replicas = payload
+            with self._lock:
+                self._snapshot = int(snap)
+                self._version = version
+                self._replicas = replicas
+                self._last_refresh = time.monotonic()
+                live = {rid for rid, _ in replicas}
+                for rid in live:
+                    self._inflight.setdefault(rid, 0)
 
     def _ensure_report_thread(self):
         """(Re)start load reporting. A router whose loop exited — deleted
@@ -89,7 +179,6 @@ class Router:
 
     def _report_load_loop(self):
         prev_ref = None
-        last_exist_check = time.monotonic()
         consecutive_failures = 0
         try:
             while not self._stop_reporting:
@@ -112,29 +201,15 @@ class Router:
                     consecutive_failures += 1
                     if consecutive_failures >= 60:
                         return
-                # a router for a deleted/redeployed deployment must not
-                # fire RPCs forever: poll existence at low frequency and
-                # exit when the controller no longer knows the deployment
-                if time.monotonic() - last_exist_check > 10.0:
-                    last_exist_check = time.monotonic()
-                    cfg_ref = None
-                    try:
-                        cfg_ref = (self._controller
-                                   .get_deployment_config.remote(self._name))
-                        # short timeout: the controller prunes load
-                        # reports after 3s of silence — a long block here
-                        # would blind the autoscaler mid-poll
-                        cfg = ray_tpu.get(cfg_ref, timeout=2.0)
-                        if cfg is None:
-                            return
-                    except Exception:  # noqa: BLE001
-                        pass
-                    finally:
-                        if cfg_ref is not None:
-                            try:
-                                ray_tpu.free(cfg_ref)
-                            except Exception:  # noqa: BLE001
-                                pass
+                # deletion is PUSHED: the long-poll listener flags
+                # _deployment_gone, so no periodic existence RPC here.
+                # Keep the listener alive — it gives up after ~13 s of
+                # controller outage, and without it a later deletion
+                # would never reach this loop (report_load to an unknown
+                # deployment is a silent no-op, not an error)
+                if self._deployment_gone:
+                    return
+                self._ensure_topology_thread()
                 time.sleep(0.5)
         finally:
             if prev_ref is not None:
@@ -151,17 +226,29 @@ class Router:
     # ------------------------------------------------------------- replicas
 
     def _refresh(self, force: bool = False):
+        """Pull fallback only: the long-poll listener keeps the replica
+        set fresh, so non-forced refreshes are no-ops once seeded.
+        Forced pulls remain for replica-death recovery (don't wait a
+        push round-trip to stop routing at a corpse)."""
         now = time.monotonic()
-        if not force and now - self._last_refresh < 1.0 and self._replicas:
+        if not force and self._replicas:
+            self._ensure_topology_thread()  # revive after outage exit
             return
-        version, replicas = ray_tpu.get(
-            self._controller.get_replicas.remote(self._name), timeout=30)
+        snap, version, replicas = ray_tpu.get(
+            self._controller.get_replicas_snapshot.remote(self._name),
+            timeout=30)
         with self._lock:
             self._last_refresh = now
-            self._version = version
-            self._replicas = replicas
-            for rid, _ in replicas:
-                self._inflight.setdefault(rid, 0)
+            # the push channel may have delivered a NEWER snapshot while
+            # this pull was in flight — never let a stale pull overwrite
+            # it (the suppressed push would not be redelivered)
+            if int(snap) >= self._snapshot:
+                self._snapshot = int(snap)
+                self._version = version
+                self._replicas = replicas
+                for rid, _ in replicas:
+                    self._inflight.setdefault(rid, 0)
+        self._ensure_topology_thread()
 
     def _pick(self, model_id: Optional[str] = None) -> Tuple[str, Any]:
         """Power-of-two-choices on local in-flight counts; with a

@@ -57,6 +57,7 @@ class Pool:
         self._rr = 0
         self._closed = False
         self._outstanding: List[Any] = []
+        self._cb_queue = None  # lazy shared callback-drainer thread
 
     # -- helpers -------------------------------------------------------------
 
@@ -124,22 +125,46 @@ class Pool:
         self._outstanding.extend(refs)  # close()+join() must drain these
         res = AsyncResult(refs, unpack_single=True)
         if callback is not None or error_callback is not None:
-            # stdlib parity: completion callbacks fire off-thread (the
-            # joblib backend drives its retrieval loop through these)
+            # stdlib parity: completion callbacks fire off-thread on ONE
+            # shared result-handler thread (like stdlib Pool's
+            # _handle_results), not a thread per AsyncResult — a large
+            # joblib Parallel(n_jobs=N) run would otherwise hold one
+            # live watcher thread per in-flight task
+            self._callback_drainer().put((res, callback, error_callback))
+        return res
+
+    def _callback_drainer(self):
+        if self._cb_queue is None:
+            import queue as _q
             import threading
 
-            def _watch():
-                try:
-                    val = res.get()
-                except Exception as e:  # noqa: BLE001
-                    if error_callback is not None:
-                        error_callback(e)
-                    return
-                if callback is not None:
-                    callback(val)
+            self._cb_queue = _q.Queue()
+            q = self._cb_queue  # capture: terminate() nulls the attr
 
-            threading.Thread(target=_watch, daemon=True).start()
-        return res
+            def drain():
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    res, cb, ecb = item
+                    try:
+                        val = res.get()
+                    except Exception as e:  # noqa: BLE001
+                        if ecb is not None:
+                            try:
+                                ecb(e)
+                            except Exception:  # noqa: BLE001
+                                pass
+                        continue
+                    if cb is not None:
+                        try:
+                            cb(val)
+                        except Exception:  # noqa: BLE001
+                            pass
+
+            threading.Thread(target=drain, daemon=True,
+                             name="rtpu-pool-callbacks").start()
+        return self._cb_queue
 
     def imap(self, fn, iterable, chunksize: Optional[int] = 1):
         self._check_open()
@@ -163,6 +188,9 @@ class Pool:
 
     def terminate(self):
         self.close()
+        if self._cb_queue is not None:
+            self._cb_queue.put(None)  # stop the callback drainer
+            self._cb_queue = None
         for a in self._actors:
             try:
                 ray_tpu.kill(a)
