@@ -51,6 +51,11 @@ class SubprocessNodeProvider(NodeProvider):
              "--object-store-memory", str(self._mem)],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
 
+    def non_terminated_nodes(self) -> List:
+        """Provider ("cloud") view for the v2 reconciler: launched
+        subprocesses still running."""
+        return [p for p in self.procs if p.poll() is None]
+
     def terminate_node(self, address: Tuple[str, int]) -> None:
         # ask the node to drain and exit; its process follows
         try:

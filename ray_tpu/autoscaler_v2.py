@@ -262,24 +262,44 @@ class Reconciler:
 class AutoscalerV2:
     """Live loop: feeds the reconciler GCS + provider views (the v2
     analogue of AutoscalerMonitor; reference: autoscaler/v2/monitor.py).
-    Demand policy is the v1 monitor's (sustained queueing grows the
-    target, sustained idleness shrinks it) — v2's contribution is the
-    audited instance lifecycle underneath it."""
+    Demand policy is the v1 monitor's (sustained task queueing OR a
+    pending placement group grows the target, sustained idleness
+    shrinks it) — v2's contribution is the audited instance lifecycle
+    underneath it.
 
-    def __init__(self, gcs_address, provider, *, min_nodes: int = 1,
+    Nodes present at the first tick (the head and any statically
+    launched peers) are OUT of scope: they are never matched to
+    instance records, never terminated, and don't count against the
+    provider's cloud view — the autoscaler manages only the dynamic
+    fleet, like the reference's head-node exclusion."""
+
+    def __init__(self, gcs_address, provider, *, min_nodes: int = 0,
                  max_nodes: int = 4, tick_s: float = 0.5,
+                 scale_up_after_ticks: int = 2,
+                 scale_down_after_ticks: int = 10,
+                 request_timeout_s: float = 30.0,
                  authkey: Optional[bytes] = None):
-        from ray_tpu.core.cluster.rpc import RpcClient, cluster_authkey
+        from ray_tpu.core.cluster.rpc import (ClientCache, RpcClient,
+                                              cluster_authkey)
 
-        self._gcs = RpcClient(tuple(gcs_address),
-                              authkey or cluster_authkey())
+        self._authkey = authkey or cluster_authkey()
+        self._gcs = RpcClient(tuple(gcs_address), self._authkey)
+        self._nodes = ClientCache(self._authkey)
         self.provider = provider
         self.im = InstanceManager()
-        self.reconciler = Reconciler(self.im, provider)
+        self.reconciler = Reconciler(self.im, provider,
+                                     request_timeout_s=request_timeout_s)
         self._min = min_nodes
         self._max = max_nodes
         self._desired = min_nodes
+        self._up_after = scale_up_after_ticks
+        self._down_after = scale_down_after_ticks
+        self._busy_ticks = 0
+        self._idle_ticks = 0
+        self._static: Optional[set] = None
+        self._static_cloud = 0
         self._tick_s = tick_s
+        self.events: List[dict] = []
         self._stop = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="autoscaler-v2")
@@ -293,12 +313,74 @@ class AutoscalerV2:
                 pass
             time.sleep(self._tick_s)
 
+    def _demand(self, addrs) -> Tuple[int, int, int]:
+        """(queued tasks beyond worker slots, pending placement groups,
+        nodes probed ok) across the cluster — the scale-up signals. The
+        probe count matters: a tick where probes failed (node booting,
+        fork storm) must count as INCONCLUSIVE, not idle, or transient
+        RPC hiccups drain the fleet under live demand."""
+        from ray_tpu.core.cluster.rpc import RpcError
+
+        queued = pending_pgs = ok = 0
+        for addr in addrs:
+            try:
+                client = self._nodes.get(addr)
+                s = client.call(("state",))
+                slots = max(1, len(s["workers"]))
+                queued += (s["tasks"]["queued"]
+                           + max(0, s["tasks"]["running"] - slots))
+                table = client.call(("pg", "table"))
+                pending_pgs += sum(1 for pg in table.values()
+                                   if pg["state"] == "PENDING")
+                ok += 1
+            except (RpcError, Exception):  # noqa: BLE001 — node draining
+                continue
+        return queued, pending_pgs, ok
+
     def _tick(self):
         view = self._gcs.call(("list_nodes", True))
         addrs = [tuple(n["address"]) for n in view["nodes"]]
-        cloud = len(self.provider.non_terminated_nodes()) \
-            if hasattr(self.provider, "non_terminated_nodes") else len(addrs)
-        self.reconciler.reconcile(self._desired, cloud, addrs)
+        if self._static is None:
+            self._static = set(addrs)
+            # the provider's pre-existing fleet is likewise out of
+            # scope: counting it as "cloud" would satisfy pending
+            # requests that were never actually delivered (breaking
+            # the ALLOCATION_FAILED retry path)
+            self._static_cloud = (
+                len(self.provider.non_terminated_nodes())
+                if hasattr(self.provider, "non_terminated_nodes") else 0)
+        dyn_addrs = [a for a in addrs if a not in self._static]
+
+        queued, pending_pgs, ok = self._demand(addrs)
+        busy = queued > 0 or pending_pgs > 0
+        if busy and self._desired < self._max:
+            self._busy_ticks += 1
+            self._idle_ticks = 0
+        elif not busy and ok == len(addrs):
+            # idleness must be PROVEN on every node this tick
+            self._idle_ticks += 1
+            self._busy_ticks = 0
+        if self._busy_ticks >= self._up_after:
+            self._desired = min(self._max, self._desired + 1)
+            self._busy_ticks = 0
+            self.events.append({"action": "target_up",
+                                "desired": self._desired,
+                                "queued": queued,
+                                "pending_pgs": pending_pgs,
+                                "ts": time.time()})
+        if (self._idle_ticks >= self._down_after
+                and self._desired > self._min):
+            self._desired -= 1
+            self._idle_ticks = 0
+            self.events.append({"action": "target_down",
+                                "desired": self._desired,
+                                "ts": time.time()})
+
+        cloud = (max(0, len(self.provider.non_terminated_nodes())
+                     - self._static_cloud)
+                 if hasattr(self.provider, "non_terminated_nodes")
+                 else len(dyn_addrs))
+        self.reconciler.reconcile(self._desired, cloud, dyn_addrs)
 
     def set_desired(self, n: int):
         self._desired = max(self._min, min(self._max, n))

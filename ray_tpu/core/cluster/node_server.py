@@ -278,6 +278,10 @@ class NodeServer:
         self.gcs.call(("ping",))
         self._peers = ClientCache(self._authkey)
         self._stop = False
+        # True when this server IS the process (python -m ...node_server):
+        # a shutdown_node drain then exits the process so the
+        # autoscaler's cloud view sees the node release promptly
+        self._owns_process = False
 
         # node workers log to the session files (served via the get_log
         # op); no local monitor thread — the driver pulls, it isn't pushed
@@ -1242,6 +1246,10 @@ class NodeServer:
             bundles, strategy, name = args
             pg = rt.create_placement_group(bundles, strategy, name)
             return pg.id.binary()
+        if op == "table":
+            # no pg-id operand — must dispatch before the id parse below
+            # (the autoscaler polls this for pending-PG demand)
+            return rt.placement_group_table()
         pg_id = PlacementGroupID(args[0])
         if op == "wait":
             return rt.wait_placement_group(pg_id, args[1])
@@ -1257,7 +1265,15 @@ class NodeServer:
     # -- lifecycle
 
     def _op_shutdown_node(self):
-        threading.Thread(target=self.close, daemon=True).start()
+        def drain_and_exit():
+            self.close()
+            if self._owns_process:
+                # a drained node must actually release its process (the
+                # autoscaler's cloud view polls liveness): lingering
+                # non-daemon helper threads would otherwise pin it
+                os._exit(0)
+
+        threading.Thread(target=drain_and_exit, daemon=True).start()
         return True
 
     def close(self):
@@ -1302,6 +1318,7 @@ def main(argv=None):
     node = NodeServer(_parse_addr(args.gcs), num_workers=args.num_workers,
                       object_store_memory=args.object_store_memory,
                       resources=resources, port=args.port)
+    node._owns_process = True
     agent = None
     if args.head:
         from ray_tpu.job.agent import JobAgent

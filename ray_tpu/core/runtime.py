@@ -74,13 +74,31 @@ class _ObjectEntry:
         self.callbacks: List[Callable[[], None]] = []
 
 
+def _task_env_key(options) -> Optional[str]:
+    """Key of the pip env a task/actor is pinned to, or None.
+
+    The key is the requirements hash runtime_env.ensure_pip_env caches
+    venvs under — tasks with the same requirements share a worker pool
+    AND a venv build."""
+    renv = (options or {}).get("runtime_env") or {}
+    pip = renv.get("pip")
+    if not pip:
+        return None
+    from ray_tpu.core.runtime_env import _pip_env_key, normalize_pip
+
+    packages, pip_opts = normalize_pip(pip)
+    if not packages:
+        return None
+    return _pip_env_key(packages, pip_opts)
+
+
 class _TaskSpec:
     __slots__ = (
         "task_id", "fn_id", "args_payload", "deps", "return_ids", "options",
         "actor_id", "method", "pending_deps", "request", "pg_wire",
         "acquired_bundle", "blocked_released", "nested_deps", "cancelled",
         "retries_left", "args_pinned", "dep_pins", "submitted_ts",
-        "dispatched_ts", "parent_task", "oom_kills",
+        "dispatched_ts", "parent_task", "oom_kills", "env_key",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -122,6 +140,9 @@ class _TaskSpec:
         # for nested submissions, None for driver-originated work
         # (reference: tracing_helper.py's trace-context injection)
         self.parent_task: Optional[str] = None
+        # pip-env tasks dispatch only to workers running that env's own
+        # interpreter (per-env pools — true module-version isolation)
+        self.env_key: Optional[str] = _task_env_key(options)
 
 
 def _fd_readable(fd, timeout) -> bool:
@@ -202,12 +223,16 @@ class _Worker:
     __slots__ = (
         "worker_id", "proc", "task_conn", "data_conn", "ready", "alive",
         "registered_fns", "actor_id", "inflight", "reader", "data_thread",
-        "send_lock", "blocked", "oom_killed",
+        "send_lock", "blocked", "oom_killed", "env_key",
     )
 
     def __init__(self, worker_id, proc):
         self.worker_id = worker_id
         self.proc = proc
+        # pip-env workers run the env's OWN interpreter (per-env pools,
+        # reference: raylet/worker_pool.h:153 env-keyed pools); None =
+        # the general pool
+        self.env_key: Optional[str] = None
         self.task_conn = None
         self.data_conn = None
         self.ready = False
@@ -338,6 +363,15 @@ class Runtime:
         self._fn_cache: Dict[int, Tuple[bytes, bytes]] = {}  # id(fn) -> (fn_id, pickled)
         self._workers: Dict[WorkerID, _Worker] = {}
         self._idle: deque = deque()
+        # per-pip-env worker pools (reference: worker_pool.h env-keyed
+        # pools): env tasks dispatch only to these; spawned on demand
+        # with the venv's own interpreter
+        self._env_idle: Dict[str, deque] = {}
+        self._env_queue: Dict[str, deque] = {}
+        self._env_spawning: Dict[str, int] = {}
+        # consecutive pre-READY deaths per env (a broken env must fail
+        # its tasks after a few respawns, not crash-loop forever)
+        self._env_spawn_fails: Dict[str, int] = {}
         self._task_queue: deque = deque()
         self._actors: Dict[ActorID, _ActorState] = {}
         self._named_actors: Dict[str, ActorID] = {}
@@ -491,8 +525,15 @@ class Runtime:
                 return None
 
     def _spawn_worker(self, tpu: bool = False,
-                      extra_env: Optional[Dict[str, str]] = None) -> _Worker:
+                      extra_env: Optional[Dict[str, str]] = None,
+                      python_exe: Optional[str] = None,
+                      env_key: Optional[str] = None) -> _Worker:
         worker_id = WorkerID.from_random()
+        if env_key is not None:
+            # the worker knows its own env so per-task application can
+            # skip re-activating it (its interpreter IS the env)
+            extra_env = dict(extra_env or {})
+            extra_env["RTPU_WORKER_PIP_KEY"] = env_key
         out_path = err_path = None
         if config.worker_log_redirect:
             from ray_tpu.core.log_monitor import worker_log_paths
@@ -500,7 +541,7 @@ class Runtime:
             out_path, err_path = worker_log_paths(self.log_dir,
                                                   worker_id.hex())
         proc = None
-        if not tpu and self._zygote is not None:
+        if not tpu and python_exe is None and self._zygote is not None:
             # fast path: fork from the warm template. TPU workers need a
             # fresh interpreter (PJRT plugin registration is env-driven
             # at startup), so they always cold-spawn.
@@ -515,9 +556,22 @@ class Runtime:
             if out_path is not None:
                 out = open(out_path, "ab", buffering=0)
                 err = open(err_path, "ab", buffering=0)
+            if python_exe is not None:
+                # a venv interpreter must still find this framework: the
+                # venv is --system-site-packages, but ray_tpu may be
+                # imported from a source tree — pin it onto PYTHONPATH
+                import ray_tpu as _pkg
+
+                repo_root = os.path.dirname(
+                    os.path.dirname(os.path.abspath(_pkg.__file__)))
+                pp = env.get("PYTHONPATH", "")
+                if repo_root not in pp.split(os.pathsep):
+                    env["PYTHONPATH"] = (repo_root + os.pathsep + pp
+                                         if pp else repo_root)
             try:
                 proc = subprocess.Popen(
-                    [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                    [python_exe or sys.executable, "-m",
+                     "ray_tpu.core.worker_main"],
                     env=env, stdin=subprocess.DEVNULL, stdout=out,
                     stderr=err,
                 )
@@ -528,6 +582,7 @@ class Runtime:
                 if err is not None:
                     err.close()
         w = _Worker(worker_id, proc)
+        w.env_key = env_key
         with self._lock:
             self._workers[worker_id] = w
             self._spawning += 1
@@ -596,10 +651,18 @@ class Runtime:
                         w.ready = True
                         self._spawning -= 1
                         # Workers pre-claimed for an actor never join the
-                        # general idle pool.
+                        # general idle pool; env workers join their env's
+                        # pool.
                         if w.actor_id is None:
-                            self._idle.append(w)
-                    self._dispatch()
+                            if w.env_key is not None:
+                                self._env_idle.setdefault(
+                                    w.env_key, deque()).append(w)
+                            else:
+                                self._idle.append(w)
+                    if w.env_key is not None:
+                        self._dispatch_env(w.env_key)
+                    else:
+                        self._dispatch()
                 elif tag == protocol.MSG_DONE:
                     self._on_task_done(w, msg[1], msg[2])
                 elif tag == protocol.MSG_ERROR:
@@ -629,6 +692,19 @@ class Runtime:
                 self._idle.remove(w)
             except ValueError:
                 pass
+            if w.env_key is not None:
+                try:
+                    self._env_idle.get(w.env_key, deque()).remove(w)
+                except ValueError:
+                    pass
+                if not w.ready:
+                    # died before READY: likely a broken env (a pinned
+                    # package shadowing a framework dep). Bound respawns
+                    # or a crash-looping env would retry forever.
+                    n = self._env_spawn_fails.get(w.env_key, 0) + 1
+                    self._env_spawn_fails[w.env_key] = n
+                else:
+                    self._env_spawn_fails.pop(w.env_key, None)
             inflight = list(w.inflight.values())
             w.inflight.clear()
             actor_id = w.actor_id
@@ -706,6 +782,12 @@ class Runtime:
             self._retry_pending_pgs()
         if actor_id is not None:
             self._handle_actor_worker_death(actor_id)
+        elif w.env_key is not None:
+            # env pools replace on demand (in _dispatch_env — which also
+            # fails the queue out once the env proves crash-looping);
+            # never backfill the GENERAL pool for an env worker
+            if not self._shutdown:
+                self._dispatch_env(w.env_key)
         else:
             # replace pool capacity
             if not self._shutdown:
@@ -1117,7 +1199,121 @@ class Runtime:
 
         return config.max_dispatch_batch
 
+    def _route_env_specs(self):
+        """Move pip-env tasks from the general queue into their env's
+        queue (dispatched by _dispatch_env to env-keyed workers only —
+        they never touch the general pool)."""
+        routed: List[_TaskSpec] = []
+        with self._lock:
+            if not any(s.env_key for s in self._task_queue):
+                return
+            keep: deque = deque()
+            for s in self._task_queue:
+                (routed if s.env_key else keep).append(s)
+            self._task_queue = keep
+            keys = set()
+            for s in routed:
+                self._env_queue.setdefault(s.env_key, deque()).append(s)
+                keys.add(s.env_key)
+        for key in keys:
+            self._dispatch_env(key)
+
+    def _dispatch_env(self, key: str):
+        """Dispatch queued env tasks onto idle env workers, spawning the
+        env's worker (venv build + cold start with the venv interpreter)
+        when none exists."""
+        while True:
+            renv = None
+            send = None
+            failed = None
+            with self._lock:
+                q = self._env_queue.get(key)
+                idle = self._env_idle.get(key)
+                while idle and not idle[0].alive:
+                    idle.popleft()
+                if not q:
+                    return
+                if idle:
+                    spec = q[0]
+                    if not self._try_acquire_spec_locked(spec):
+                        return
+                    q.popleft()
+                    w = idle.popleft()
+                    w.inflight[spec.task_id.binary()] = spec
+                    send = (w, spec)
+                else:
+                    failed = None
+                    have = any(x.alive and x.env_key == key
+                               and x.actor_id is None
+                               for x in self._workers.values())
+                    if not have and not self._env_spawning.get(key):
+                        if self._env_spawn_fails.get(key, 0) >= 3:
+                            # crash-looping env: fail its queue out
+                            failed = list(q)
+                            q.clear()
+                        else:
+                            self._env_spawning[key] = 1
+                            renv = q[0].options.get("runtime_env")
+            if send is not None:
+                self._send_task_batch(send[0], [send[1]])
+                continue
+            if failed:
+                err = RuntimeError(
+                    f"pip env {key} workers crashed repeatedly before "
+                    "becoming ready — the env is likely broken (a "
+                    "pinned package shadowing a framework dependency?)")
+                for spec in failed:
+                    self._store_error(spec.return_ids, err)
+                return
+            if renv is not None:
+                threading.Thread(target=self._spawn_env_worker,
+                                 args=(key, renv), daemon=True).start()
+            return
+
+    def _spawn_env_worker(self, key: str, runtime_env: dict):
+        """Background: build (or reuse) the venv, then cold-spawn a
+        worker running ITS interpreter. Build failures fail every task
+        queued for the env — there is no worker that could ever run
+        them."""
+        from ray_tpu.core import runtime_env as _re
+
+        try:
+            packages, pip_opts = _re.normalize_pip(runtime_env["pip"])
+            cache_root = os.environ.get("RTPU_PKG_DIR",
+                                        "/tmp/ray_tpu_pkgs")
+            site = _re.ensure_pip_env(cache_root, packages, pip_opts)
+            # <venv>/lib/pythonX.Y/site-packages -> <venv>/bin/python
+            venv_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(site)))
+            py = os.path.join(venv_root, "bin", "python")
+            self._spawn_worker(python_exe=py, env_key=key)
+        except Exception as e:  # noqa: BLE001 — fail the env's tasks
+            with self._lock:
+                q = self._env_queue.pop(key, deque())
+            for spec in q:
+                self._release_spec_locked_safe(spec)
+                self._store_error(spec.return_ids, RuntimeError(
+                    f"pip runtime_env setup failed: {e!r}"))
+        finally:
+            with self._lock:
+                self._env_spawning[key] = 0
+
+    def _release_spec_locked_safe(self, spec):
+        with self._lock:
+            try:
+                self._release_spec_locked(spec)
+            except Exception:  # noqa: BLE001
+                pass
+
     def _dispatch(self):
+        self._route_env_specs()
+        # env queues also drain on GENERAL events (resource release,
+        # completions): an env task that failed resource acquisition
+        # with an idle env worker would otherwise never be retried
+        with self._lock:
+            env_keys = [k for k, q in self._env_queue.items() if q]
+        for k in env_keys:
+            self._dispatch_env(k)
         while True:
             batch = []
             with self._lock:
@@ -1140,7 +1336,8 @@ class Runtime:
                 # (busy workers rejoin soon), so one early-finishing worker
                 # cannot swallow work the others would run in parallel.
                 pool = sum(1 for x in self._workers.values()
-                           if x.alive and x.actor_id is None) or 1
+                           if x.alive and x.actor_id is None
+                           and x.env_key is None) or 1
                 cap = max(1, min(
                     self.MAX_DISPATCH_BATCH,
                     -(-len(self._task_queue) // pool),
@@ -1446,10 +1643,31 @@ class Runtime:
             if state is not None:
                 self._dispatch_actor(state)
             return
+        if w.env_key is not None:
+            retire_env = False
+            with self._lock:
+                q = self._env_queue.get(w.env_key)
+                idle = self._env_idle.setdefault(w.env_key, deque())
+                if (not q) and idle and not w.inflight:
+                    # keep ONE warm worker per env; retire the surplus
+                    retire_env = True
+                    self._workers.pop(w.worker_id, None)
+                    w.alive = False
+                elif w.alive and not w.inflight and w not in idle:
+                    idle.append(w)
+            if retire_env:
+                try:
+                    self._send_msg(w, (protocol.MSG_SHUTDOWN,))
+                except Exception:  # noqa: BLE001
+                    pass
+            else:
+                self._dispatch_env(w.env_key)
+            return
         retire = False
         with self._lock:
             pool = sum(1 for x in self._workers.values()
-                       if x.alive and x.actor_id is None)
+                       if x.alive and x.actor_id is None
+                       and x.env_key is None)
             if (not self._task_queue and pool > self.num_workers
                     and not w.inflight):
                 # Surplus worker from blocked-get scale-up: retire it so the
@@ -1638,6 +1856,38 @@ class Runtime:
 
     def _start_actor(self, state: _ActorState):
         needs_tpu = bool(state.chips) or state.opts.get("num_tpus", 0) > 0
+        env_key = _task_env_key(state.opts)
+        if env_key is not None and not needs_tpu:
+            # pip-env actor: a DEDICATED worker running the venv's own
+            # interpreter (never a pooled one — its module versions
+            # must come from the env). Venv build is cached; the actor
+            # start queue thread absorbs the one-time cost.
+            from ray_tpu.core import runtime_env as _re
+
+            renv = state.opts.get("runtime_env") or {}
+            packages, pip_opts = _re.normalize_pip(renv["pip"])
+            cache_root = os.environ.get("RTPU_PKG_DIR",
+                                        "/tmp/ray_tpu_pkgs")
+            site = _re.ensure_pip_env(cache_root, packages, pip_opts)
+            venv_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(site)))
+            w = self._spawn_worker(
+                python_exe=os.path.join(venv_root, "bin", "python"),
+                env_key=env_key)
+            with self._lock:
+                w.actor_id = state.actor_id
+                state.worker = w
+                died = state.dead
+            if died:
+                if w.proc is not None:
+                    try:
+                        w.proc.terminate()
+                    except OSError:
+                        pass
+                return
+            self._when_worker_ready(
+                w, lambda: self._send_create_actor(w, state))
+            return
         w = None
         if not needs_tpu:
             # Prefer an idle pooled worker; else spawn fresh (+ replace pool).

@@ -19,12 +19,15 @@ extracted by each node's runtime-env agent, then applied per worker
 
 pip environments (reference: _private/runtime_env/pip.py) install into
 a per-requirements-hash virtualenv (--system-site-packages) created
-lazily node-side by the first worker that needs it; the venv's
-site-packages is prepended to sys.path for the task/actor and removed
-after. This provides package AVAILABILITY isolation (each env sees its
-own installed versions first); it does not re-launch the interpreter,
-so a package already imported by the worker keeps its version — the
-documented difference from the reference's per-env worker processes.
+lazily node-side by the first worker that needs it. Tasks/actors pinned
+to a pip env run on PER-ENV WORKER PROCESSES launched with the venv's
+OWN interpreter (core/runtime.py env-keyed pools — the reference's
+worker_pool.h:153 design): module versions are truly isolated, because
+an env worker never imports outside its venv's resolution order and a
+pooled worker never imports from a venv. The sys.path-activation path
+below remains only for foreign-env application (a worker of env A told
+to run env B — possible through nested submissions), where the
+documented already-imported-module caveat still applies.
 conda/container isolation stays out of scope (nothing installable in
 this image beyond local wheels).
 """
@@ -281,6 +284,22 @@ def ensure_pip_env(cache_root: str, packages, options) -> str:
                 [sys.executable, "-m", "venv", "--system-site-packages",
                  dest], check=True, capture_output=True)
             py = os.path.join(dest, "bin", "python")
+            # --system-site-packages resolves to the BASE prefix; when
+            # THIS interpreter is itself a venv (common in container
+            # images), its own site-packages — the framework's deps —
+            # would be invisible to env workers running <venv>/bin/python.
+            # Link every parent site dir via a .pth: processed after the
+            # env's own site-packages dir, so env-pinned versions still
+            # win.
+            parents = [p for p in sys.path
+                       if p.endswith(("site-packages", "dist-packages"))
+                       and os.path.isdir(p)]
+            if parents:
+                for sp_dir in glob.glob(os.path.join(
+                        dest, "lib", "python*", "site-packages")):
+                    with open(os.path.join(
+                            sp_dir, "_rtpu_parent_paths.pth"), "w") as f:
+                        f.write("\n".join(parents) + "\n")
             proc = subprocess.run(
                 [py, "-m", "pip", "install", "--disable-pip-version-check",
                  *options, *packages],
@@ -301,8 +320,14 @@ def ensure_pip_env(cache_root: str, packages, options) -> str:
 
 
 def apply(runtime_env: Optional[dict], fetch: Callable[[str], bytes],
-          cache_root: Optional[str] = None):
+          cache_root: Optional[str] = None,
+          own_pip_key: Optional[str] = None):
     """Worker-side: apply env_vars, working_dir, py_modules.
+
+    ``own_pip_key``: the pip-env key this worker's interpreter IS (env
+    workers run their venv's python). A task pinned to the same env
+    needs no sys.path surgery or post-task module purge — that is the
+    point of per-env worker processes.
 
     Returns opaque state for ``restore`` (None when nothing applied).
     """
@@ -334,7 +359,7 @@ def apply(runtime_env: Optional[dict], fetch: Callable[[str], bytes],
             saved_path = list(sys.path)
         if pip_spec:
             packages, options = normalize_pip(pip_spec)
-            if packages:
+            if packages and _pip_env_key(packages, options) != own_pip_key:
                 pip_sp = ensure_pip_env(cache_root, packages, options)
                 sys.path.insert(0, pip_sp)
         if wd_hash:

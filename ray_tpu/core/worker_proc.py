@@ -521,12 +521,15 @@ class WorkerCore:
 
     def _apply_runtime_env(self, runtime_env):
         """env_vars + working_dir + py_modules; packages fetched from the
-        core over REQ_PKG and cached under RTPU_PKG_DIR."""
+        core over REQ_PKG and cached under RTPU_PKG_DIR. Workers spawned
+        FOR a pip env (their interpreter is the venv) skip re-activating
+        it — and their env's modules persist across tasks."""
         from ray_tpu.core import runtime_env as _re
 
         if not runtime_env:
             return None
-        return _re.apply(runtime_env, fetch=self._fetch_package)
+        return _re.apply(runtime_env, fetch=self._fetch_package,
+                         own_pip_key=os.environ.get("RTPU_WORKER_PIP_KEY"))
 
     def _fetch_package(self, pkg_hash: str):
         _, data = self._request(protocol.REQ_PKG, pkg_hash)

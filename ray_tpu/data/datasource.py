@@ -174,6 +174,7 @@ class ParquetDatasource(FileDatasource):
         super().__init__(paths)
         self._columns = columns
         self._filter = None  # pyarrow.dataset expression
+        self._expr = None    # framework Expr (row-group stat pruning)
 
     def with_columns(self, columns: List[str]) -> "ParquetDatasource":
         """Pruned clone (projection pushdown target)."""
@@ -183,9 +184,89 @@ class ParquetDatasource(FileDatasource):
         out._columns = list(columns)
         return out
 
-    def with_filter(self, pa_expr) -> "ParquetDatasource":
+    def with_filter(self, pa_expr, expr=None) -> "ParquetDatasource":
         """Filtered clone (predicate pushdown target); multiple pushed
-        filters AND together."""
+        filters AND together. ``expr`` is the framework Expr used for
+        row-group statistics pruning (the pyarrow expression alone is
+        opaque to interval analysis)."""
+        import copy
+
+        out = copy.copy(self)
+        out._filter = (pa_expr if out._filter is None
+                       else out._filter & pa_expr)
+        if expr is not None:
+            out._expr = (expr if getattr(out, "_expr", None) is None
+                         else out._expr & expr)
+        return out
+
+    def read_file(self, path: str):
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(path)
+        if self._filter is None:
+            for batch in pf.iter_batches(columns=self._columns):
+                yield pa.Table.from_batches([batch])
+            return
+        # explicit row-group statistics pruning: a group whose min/max
+        # bounds PROVE the predicate empty is never read off disk
+        # (reference: fragment-metadata pruning in
+        # _internal/datasource/parquet_datasource.py); survivors filter
+        # vectorized per batch before the block materializes
+        expr = getattr(self, "_expr", None)
+        n_groups = pf.metadata.num_row_groups
+        kept = list(range(n_groups))
+        if expr is not None:
+            from ray_tpu.data.expr import row_group_may_match
+
+            kept = []
+            for i in range(n_groups):
+                rg = pf.metadata.row_group(i)
+                stats = {}
+                for j in range(rg.num_columns):
+                    c = rg.column(j)
+                    if (c.statistics is not None
+                            and c.statistics.has_min_max):
+                        stats[c.path_in_schema] = (c.statistics.min,
+                                                   c.statistics.max)
+                if row_group_may_match(expr, stats):
+                    kept.append(i)
+        # observability (tests + stats debugging; one process-local scan)
+        self.last_scan_row_groups = (n_groups, len(kept))
+        if not kept:
+            return
+        # the residual filter may reference columns the projection
+        # pruned (the scanner-based predicate needs them only
+        # transiently): read the union, filter, then re-project
+        read_cols = self._columns
+        if read_cols is not None:
+            if expr is not None:
+                read_cols = sorted(set(read_cols) | set(expr.columns()))
+            else:
+                read_cols = None  # unknown filter columns: read all
+        for batch in pf.iter_batches(row_groups=kept, columns=read_cols):
+            t = pa.Table.from_batches([batch]).filter(self._filter)
+            if self._columns is not None and t.column_names != self._columns:
+                t = t.select(self._columns)
+            if t.num_rows:
+                yield t
+
+
+class _ScannedTextDatasource(FileDatasource):
+    """Shared base for row-oriented text formats (CSV/JSON) with
+    EARLY-SKIP predicate pushdown: there are no statistics to prune on,
+    but a pushed filter applies per record batch inside the scanner —
+    non-matching rows are dropped before any block materializes or
+    crosses the object store (reference: the planner pushes filters
+    only into parquet; this extends the same rule to text scans)."""
+
+    format: str = ""
+    supports_predicate_pushdown = True
+
+    def __init__(self, paths):
+        super().__init__(paths)
+        self._filter = None
+
+    def with_filter(self, pa_expr, expr=None):
         import copy
 
         out = copy.copy(self)
@@ -193,39 +274,37 @@ class ParquetDatasource(FileDatasource):
                        else out._filter & pa_expr)
         return out
 
-    def read_file(self, path: str):
-        import pyarrow.parquet as pq
-        if self._filter is not None:
-            # dataset scanner: row groups whose statistics exclude the
-            # predicate are skipped entirely, surviving ones filter
-            # vectorized before the block materializes
-            import pyarrow.dataset as pads
+    def _read_table(self, path: str):
+        raise NotImplementedError
 
-            scan = pads.dataset(path, format="parquet")
-            for batch in scan.to_batches(columns=self._columns,
-                                         filter=self._filter):
-                if batch.num_rows:
-                    yield pa.Table.from_batches([batch])
+    def read_file(self, path: str):
+        if self._filter is None:
+            yield self._read_table(path)
             return
-        pf = pq.ParquetFile(path)
-        for batch in pf.iter_batches(columns=self._columns):
-            yield pa.Table.from_batches([batch])
+        import pyarrow.dataset as pads
+
+        scan = pads.dataset(path, format=self.format)
+        for batch in scan.to_batches(filter=self._filter):
+            if batch.num_rows:
+                yield pa.Table.from_batches([batch])
 
 
-class CSVDatasource(FileDatasource):
+class CSVDatasource(_ScannedTextDatasource):
     suffixes = [".csv"]
+    format = "csv"
 
-    def read_file(self, path: str):
+    def _read_table(self, path: str):
         import pyarrow.csv as pacsv
-        yield pacsv.read_csv(path)
+        return pacsv.read_csv(path)
 
 
-class JSONDatasource(FileDatasource):
+class JSONDatasource(_ScannedTextDatasource):
     suffixes = [".json", ".jsonl"]
+    format = "json"
 
-    def read_file(self, path: str):
+    def _read_table(self, path: str):
         import pyarrow.json as pajson
-        yield pajson.read_json(path)
+        return pajson.read_json(path)
 
 
 class NumpyDatasource(FileDatasource):

@@ -290,3 +290,101 @@ def to_pyarrow(expr: Expr):
         # diverge) instead of matching the in-memory mask
         return None
     return None
+
+
+# -- row-group statistics pruning (parquet predicate pushdown) ---------------
+
+def _interval_eval(expr: Expr, stats) -> "bool | None":
+    """Tri-state evaluation of a boolean expr against row-group
+    statistics {column: (min, max)}: True = every non-null row matches,
+    False = NO row can match, None = unknown. Conservative by
+    construction — anything unmodellable is None (keep the group).
+    Null semantics: every supported operator drops nulls (the reason
+    "!="/"~" are never pushed down, see _PA_BINOPS), so min/max bounds
+    over the non-null values are sufficient to prove emptiness."""
+
+    def col_lit(b: BinaryOp):
+        if isinstance(b.left, Column) and isinstance(b.right, Literal):
+            return b.left.name, b.right.value, False
+        if isinstance(b.right, Column) and isinstance(b.left, Literal):
+            return b.right.name, b.left.value, True
+        return None
+
+    if isinstance(expr, BinaryOp):
+        if expr.symbol == "&":
+            a = _interval_eval(expr.left, stats)
+            b = _interval_eval(expr.right, stats)
+            if a is False or b is False:
+                return False
+            if a is True and b is True:
+                return True
+            return None
+        if expr.symbol == "|":
+            a = _interval_eval(expr.left, stats)
+            b = _interval_eval(expr.right, stats)
+            if a is True or b is True:
+                return True
+            if a is False and b is False:
+                return False
+            return None
+        if expr.symbol in ("==", "<", "<=", ">", ">="):
+            cl = col_lit(expr)
+            if cl is None:
+                return None
+            name, v, flipped = cl
+            if name not in stats:
+                return None
+            mn, mx = stats[name]
+            sym = expr.symbol
+            if flipped:  # lit OP col  ->  col OP' lit
+                sym = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                       "==": "=="}[sym]
+            try:
+                if sym == "==":
+                    if v < mn or v > mx:
+                        return False
+                    if mn == mx == v:
+                        return True
+                elif sym == "<":
+                    if mn >= v:
+                        return False
+                    if mx < v:
+                        return True
+                elif sym == "<=":
+                    if mn > v:
+                        return False
+                    if mx <= v:
+                        return True
+                elif sym == ">":
+                    if mx <= v:
+                        return False
+                    if mn > v:
+                        return True
+                elif sym == ">=":
+                    if mx < v:
+                        return False
+                    if mn >= v:
+                        return True
+            except TypeError:
+                return None  # incomparable types: keep the group
+            return None
+    if isinstance(expr, UnaryOp):
+        kind = getattr(expr, "kind", expr.symbol)
+        if kind == "isin" and isinstance(expr.operand, Column):
+            name = expr.operand.name
+            if name not in stats:
+                return None
+            mn, mx = stats[name]
+            try:
+                if all(v < mn or v > mx for v in expr.values):
+                    return False
+            except TypeError:
+                return None
+            return None
+    return None
+
+
+def row_group_may_match(expr: Expr, stats) -> bool:
+    """False ONLY when the statistics PROVE the predicate matches no row
+    of the group — the parquet scan then skips the group entirely."""
+    return _interval_eval(expr, stats) is not False

@@ -181,7 +181,8 @@ class PredicatePushdown(Rule):
             import copy
 
             read2 = copy.copy(cur)  # input Read may be diamond-shared
-            read2.datasource = cur.datasource.with_filter(pa_expr)
+            read2.datasource = cur.datasource.with_filter(pa_expr,
+                                                          expr=fexpr)
             read2.name = f"{cur.name}[filter]"
             return read2
         return self._rewrite(root, fn)

@@ -37,6 +37,7 @@ from ray_tpu.tune.trial import (  # noqa: F401
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
     BOHBSearcher,
+    GPSearcher,
     Searcher,
     TPESearcher,
 )
@@ -54,4 +55,5 @@ __all__ = [
     "MedianStoppingRule", "PopulationBasedTraining",
     "ResourceChangingScheduler", "evenly_distribute_cpus",
     "Searcher", "BasicVariantGenerator", "TPESearcher", "BOHBSearcher",
+    "GPSearcher",
 ]

@@ -141,26 +141,46 @@ class Pool:
             self._cb_queue = _q.Queue()
             q = self._cb_queue  # capture: terminate() nulls the attr
 
-            def drain():
-                while True:
-                    item = q.get()
-                    if item is None:
-                        return
-                    res, cb, ecb = item
-                    try:
-                        val = res.get()
-                    except Exception as e:  # noqa: BLE001
-                        if ecb is not None:
-                            try:
-                                ecb(e)
-                            except Exception:  # noqa: BLE001
-                                pass
-                        continue
-                    if cb is not None:
+            def fire(res, cb, ecb):
+                try:
+                    val = res.get()
+                except Exception as e:  # noqa: BLE001
+                    if ecb is not None:
                         try:
-                            cb(val)
+                            ecb(e)
                         except Exception:  # noqa: BLE001
                             pass
+                    return
+                if cb is not None:
+                    try:
+                        cb(val)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def drain():
+                # COMPLETION-order dispatch (stdlib _handle_results
+                # semantics): poll readiness across all watched results
+                # instead of blocking on the oldest — a slow task must
+                # not head-of-line block a fast task's callback (which
+                # may even be what unblocks the slow one).
+                entries: list = []
+                while True:
+                    try:
+                        item = q.get(timeout=0.05 if entries else None)
+                    except _q.Empty:
+                        item = False  # poll round
+                    if item is None:
+                        return
+                    if item is not False:
+                        entries.append(item)
+                        continue
+                    still = []
+                    for ent in entries:
+                        if ent[0].ready():
+                            fire(*ent)
+                        else:
+                            still.append(ent)
+                    entries = still
 
             threading.Thread(target=drain, daemon=True,
                              name="rtpu-pool-callbacks").start()

@@ -996,3 +996,114 @@ def test_autoscaler_v2_provider_failure_keeps_queued():
     assert len(im.instances(InstanceStatus.QUEUED)) == 1
     rec.reconcile(1, 0, [])
     assert len(im.instances(InstanceStatus.REQUESTED)) == 1
+
+
+def test_autoscaler_v2_end_to_end_real_nodes():
+    """Autoscaler v2 drives REAL local node_server processes through the
+    full instance FSM (VERDICT r4 item 9): a pending placement-group
+    demand scales up; the first launch is dropped by a flaky provider
+    and recovers through ALLOCATION_FAILED -> requeue; idleness scales
+    back down and the node process exits."""
+    import ray_tpu
+    from ray_tpu.autoscaler import SubprocessNodeProvider
+    from ray_tpu.autoscaler_v2 import AutoscalerV2, InstanceStatus
+    from ray_tpu.core import runtime_context
+    from ray_tpu.core.cluster.fixture import Cluster
+    from ray_tpu.core.cluster.rpc import RpcClient
+
+    class FlakyProvider(SubprocessNodeProvider):
+        """Swallows the first launch: the cloud never delivers it, so
+        the REQUESTED record must time out into ALLOCATION_FAILED and
+        the retry path must produce the node."""
+
+        def __init__(self, *a, fail_first: int = 1, **kw):
+            super().__init__(*a, **kw)
+            self.fails_left = fail_first
+            self.launch_calls = 0
+
+        def launch_node(self):
+            self.launch_calls += 1
+            if self.fails_left > 0:
+                self.fails_left -= 1
+                return  # accepted... and lost by the "cloud"
+            super().launch_node()
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=1, num_workers_per_node=1,
+                node_resources=[{"CPU": 1}])
+    monitor = None
+    provider = None
+    try:
+        c.wait_for_nodes(1)
+        c.connect()
+        os.environ["RTPU_CLUSTER_AUTHKEY"] = c.authkey.hex()
+        provider = FlakyProvider(c.gcs_address, num_workers=1)
+        monitor = AutoscalerV2(
+            c.gcs_address, provider, min_nodes=0, max_nodes=1,
+            tick_s=0.25, scale_up_after_ticks=2,
+            scale_down_after_ticks=8, request_timeout_s=2.0,
+            authkey=c.authkey)
+
+        # a PG demanding more CPU than the head provides stays PENDING
+        from ray_tpu.util import placement_group, remove_placement_group
+
+        pg = placement_group([{"CPU": 1}] * 3, strategy="PACK")
+
+        gcs = RpcClient(c.gcs_address, c.authkey)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if len(gcs.call(("list_nodes", True))["nodes"]) >= 2:
+                break
+            time.sleep(0.25)
+        assert len(gcs.call(("list_nodes", True))["nodes"]) >= 2, (
+            f"no scale-up: {monitor.events} "
+            f"{[(i.instance_id[:6], i.status) for i in monitor.im.instances()]}")
+        # the flaky first launch went through the failure FSM
+        assert provider.launch_calls >= 2, provider.launch_calls
+        failed = [s for inst in monitor.im.instances()
+                  for s, _ in inst.history
+                  if s == InstanceStatus.ALLOCATION_FAILED]
+        assert failed, "first launch never went through ALLOCATION_FAILED"
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and not monitor.im.instances(InstanceStatus.RAY_RUNNING)):
+            time.sleep(0.25)
+        assert monitor.im.instances(InstanceStatus.RAY_RUNNING), (
+            [i.status for i in monitor.im.instances()], monitor.events,
+            [i.history for i in monitor.im.instances()])
+        # the blocked demand is withdrawn; a fresh SPREAD PG now lands
+        # across head + the autoscaled node
+        remove_placement_group(pg)
+        pg2 = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+        assert pg2.wait(timeout_seconds=60), "PG not placed on new node"
+        remove_placement_group(pg2)
+
+        # drain: target shrinks, the dynamic node is terminated, its
+        # process exits
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if (len(gcs.call(("list_nodes", True))["nodes"]) == 1
+                    and not provider.non_terminated_nodes()):
+                break
+            time.sleep(0.5)
+        assert len(gcs.call(("list_nodes", True))["nodes"]) == 1, \
+            f"no scale-down: {monitor.events}"
+        assert not provider.non_terminated_nodes()
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and not monitor.im.instances(InstanceStatus.TERMINATED)):
+            time.sleep(0.25)
+        term = monitor.im.instances(InstanceStatus.TERMINATED)
+        assert term, [i.status for i in monitor.im.instances()]
+        gcs.close()
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        if provider is not None:
+            for p in provider.procs:
+                if p.poll() is None:
+                    p.kill()
+        os.environ.pop("RTPU_CLUSTER_AUTHKEY", None)
+        c.shutdown()
+        runtime_context.set_core(prev)
