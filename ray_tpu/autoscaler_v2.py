@@ -353,10 +353,14 @@ class AutoscalerV2:
 
         queued, pending_pgs, ok = self._demand(addrs)
         busy = queued > 0 or pending_pgs > 0
-        if busy and self._desired < self._max:
-            self._busy_ticks += 1
+        if busy:
+            # ANY demand resets idleness — even at max capacity, where
+            # no further scale-up is possible (a loaded-at-max fleet
+            # must not drift toward scale-down between bursts)
             self._idle_ticks = 0
-        elif not busy and ok == len(addrs):
+            if self._desired < self._max:
+                self._busy_ticks += 1
+        elif ok == len(addrs):
             # idleness must be PROVEN on every node this tick
             self._idle_ticks += 1
             self._busy_ticks = 0

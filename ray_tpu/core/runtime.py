@@ -75,21 +75,22 @@ class _ObjectEntry:
 
 
 def _task_env_key(options) -> Optional[str]:
-    """Key of the pip env a task/actor is pinned to, or None.
-
-    The key is the requirements hash runtime_env.ensure_pip_env caches
-    venvs under — tasks with the same requirements share a worker pool
-    AND a venv build."""
+    """Key of the isolated env a task/actor is pinned to ("<kind>:<content
+    hash>"), or None. Tasks with the same key share a worker pool AND an
+    env build; the kind's EnvProvider (runtime_env.register_env_provider
+    — pip built-in, conda/image_uri pluggable) supplies the interpreter
+    the pool's workers run."""
     renv = (options or {}).get("runtime_env") or {}
-    pip = renv.get("pip")
-    if not pip:
-        return None
-    from ray_tpu.core.runtime_env import _pip_env_key, normalize_pip
+    from ray_tpu.core.runtime_env import resolve_env_provider
 
-    packages, pip_opts = normalize_pip(pip)
-    if not packages:
+    res = resolve_env_provider(renv)
+    if res is None:
         return None
-    return _pip_env_key(packages, pip_opts)
+    kind, provider, spec = res
+    key = provider.env_key(spec)
+    if not key:
+        return None
+    return f"{kind}:{key}"
 
 
 class _TaskSpec:
@@ -1243,10 +1244,16 @@ class Runtime:
                     send = (w, spec)
                 else:
                     failed = None
-                    have = any(x.alive and x.env_key == key
-                               and x.actor_id is None
-                               for x in self._workers.values())
-                    if not have and not self._env_spawning.get(key):
+                    alive_env = sum(1 for x in self._workers.values()
+                                    if x.alive and x.env_key == key
+                                    and x.actor_id is None)
+                    # grow the env pool with demand (bounded by the
+                    # general pool size) — one worker per env would
+                    # serialize a deep env queue while the node idles
+                    cap = max(1, self.num_workers)
+                    want = min(len(q), cap)
+                    if (not self._env_spawning.get(key)
+                            and alive_env < want):
                         if self._env_spawn_fails.get(key, 0) >= 3:
                             # crash-looping env: fail its queue out
                             failed = list(q)
@@ -1278,32 +1285,22 @@ class Runtime:
         from ray_tpu.core import runtime_env as _re
 
         try:
-            packages, pip_opts = _re.normalize_pip(runtime_env["pip"])
-            cache_root = os.environ.get("RTPU_PKG_DIR",
-                                        "/tmp/ray_tpu_pkgs")
-            site = _re.ensure_pip_env(cache_root, packages, pip_opts)
-            # <venv>/lib/pythonX.Y/site-packages -> <venv>/bin/python
-            venv_root = os.path.dirname(os.path.dirname(
-                os.path.dirname(site)))
-            py = os.path.join(venv_root, "bin", "python")
-            self._spawn_worker(python_exe=py, env_key=key)
+            kind, provider, spec = _re.resolve_env_provider(runtime_env)
+            prep = provider.prepare(spec)
+            self._spawn_worker(python_exe=prep.python_exe, env_key=key,
+                               extra_env=prep.env_vars or None)
         except Exception as e:  # noqa: BLE001 — fail the env's tasks
             with self._lock:
                 q = self._env_queue.pop(key, deque())
+            # queued env specs were never resource-acquired (acquisition
+            # happens at dispatch), so there is NOTHING to release here —
+            # releasing would credit the pool for grants never taken
             for spec in q:
-                self._release_spec_locked_safe(spec)
                 self._store_error(spec.return_ids, RuntimeError(
-                    f"pip runtime_env setup failed: {e!r}"))
+                    f"runtime_env setup failed: {e!r}"))
         finally:
             with self._lock:
                 self._env_spawning[key] = 0
-
-    def _release_spec_locked_safe(self, spec):
-        with self._lock:
-            try:
-                self._release_spec_locked(spec)
-            except Exception:  # noqa: BLE001
-                pass
 
     def _dispatch(self):
         self._route_env_specs()
@@ -1865,15 +1862,11 @@ class Runtime:
             from ray_tpu.core import runtime_env as _re
 
             renv = state.opts.get("runtime_env") or {}
-            packages, pip_opts = _re.normalize_pip(renv["pip"])
-            cache_root = os.environ.get("RTPU_PKG_DIR",
-                                        "/tmp/ray_tpu_pkgs")
-            site = _re.ensure_pip_env(cache_root, packages, pip_opts)
-            venv_root = os.path.dirname(os.path.dirname(
-                os.path.dirname(site)))
-            w = self._spawn_worker(
-                python_exe=os.path.join(venv_root, "bin", "python"),
-                env_key=env_key)
+            kind, provider, spec = _re.resolve_env_provider(renv)
+            prep = provider.prepare(spec)
+            w = self._spawn_worker(python_exe=prep.python_exe,
+                                   env_key=env_key,
+                                   extra_env=prep.env_vars or None)
             with self._lock:
                 w.actor_id = state.actor_id
                 state.worker = w

@@ -28,8 +28,12 @@ pooled worker never imports from a venv. The sys.path-activation path
 below remains only for foreign-env application (a worker of env A told
 to run env B — possible through nested submissions), where the
 documented already-imported-module caveat still applies.
-conda/container isolation stays out of scope (nothing installable in
-this image beyond local wheels).
+conda/image_uri isolation has a pluggable design: an ``EnvProvider``
+maps a runtime_env kind to the interpreter its dedicated workers exec
+(register_env_provider); pip ships built-in, conda/container providers
+plug in where the host supplies the environment runtime (nothing
+installable in this image — using those kinds without a provider is a
+loud gated error, tested with a stub provider).
 """
 
 from __future__ import annotations
@@ -359,7 +363,8 @@ def apply(runtime_env: Optional[dict], fetch: Callable[[str], bytes],
             saved_path = list(sys.path)
         if pip_spec:
             packages, options = normalize_pip(pip_spec)
-            if packages and _pip_env_key(packages, options) != own_pip_key:
+            key = f"pip:{_pip_env_key(packages, options)}"
+            if packages and key != own_pip_key:
                 pip_sp = ensure_pip_env(cache_root, packages, options)
                 sys.path.insert(0, pip_sp)
         if wd_hash:
@@ -414,3 +419,98 @@ def restore(state) -> None:
             pass
     if saved_path is not None:
         sys.path[:] = saved_path
+
+
+# ---- env providers: pluggable interpreter-level isolation ------------------
+
+class EnvProvider:
+    """Provision an isolated interpreter for a runtime_env kind
+    (reference roles: _private/runtime_env/{pip,conda,image_uri}.py —
+    each plugin materializes an environment and the worker launches
+    inside it). ``prepare`` may block (builds cache-once); it returns
+    how to launch a worker for the env. Register concrete providers
+    with ``register_env_provider``; tasks/actors whose runtime_env
+    carries the kind then run on dedicated workers launched through it
+    (core/runtime.py env-keyed pools)."""
+
+    kind: str = ""
+
+    def env_key(self, spec) -> str:
+        """Stable content key: equal specs share a worker pool."""
+        raise NotImplementedError
+
+    def prepare(self, spec) -> "PreparedEnv":
+        """Materialize the env (idempotent; may block on first build)."""
+        raise NotImplementedError
+
+
+class PreparedEnv:
+    """How to launch a worker inside an env: the interpreter to exec and
+    extra process environment."""
+
+    def __init__(self, python_exe: str,
+                 env_vars: Optional[Dict[str, str]] = None):
+        self.python_exe = python_exe
+        self.env_vars = dict(env_vars or {})
+
+
+class PipEnvProvider(EnvProvider):
+    """The built-in provider: per-requirements-hash virtualenvs."""
+
+    kind = "pip"
+
+    def __init__(self, cache_root: Optional[str] = None):
+        self._cache_root = cache_root
+
+    def _root(self) -> str:
+        return self._cache_root or os.environ.get(
+            "RTPU_PKG_DIR", "/tmp/ray_tpu_pkgs")
+
+    def env_key(self, spec) -> str:
+        packages, options = normalize_pip(spec)
+        return _pip_env_key(packages, options)
+
+    def prepare(self, spec) -> PreparedEnv:
+        packages, options = normalize_pip(spec)
+        site = ensure_pip_env(self._root(), packages, options)
+        venv_root = os.path.dirname(os.path.dirname(os.path.dirname(site)))
+        return PreparedEnv(os.path.join(venv_root, "bin", "python"))
+
+
+_ENV_PROVIDERS: Dict[str, EnvProvider] = {"pip": PipEnvProvider()}
+
+# runtime_env kinds that NEED a provider; absent one, using them is a
+# loud gated error, not a silent no-op (conda/image_uri have nothing
+# installable in this image — the interface is how a deployment with a
+# conda binary or a container runtime plugs in)
+_PROVIDER_KINDS = ("pip", "conda", "image_uri")
+
+
+def register_env_provider(provider: EnvProvider) -> None:
+    """Install (or replace) the provider for ``provider.kind``."""
+    if not provider.kind:
+        raise ValueError("provider.kind must be a non-empty string")
+    _ENV_PROVIDERS[provider.kind] = provider
+
+
+def resolve_env_provider(runtime_env: Optional[dict]):
+    """(kind, provider, spec) for the isolation-bearing part of a
+    runtime_env, or None. Raises for a kind with no provider."""
+    if not runtime_env:
+        return None
+    present = [k for k in _PROVIDER_KINDS if runtime_env.get(k)]
+    if not present:
+        return None
+    if len(present) > 1:
+        raise ValueError(
+            f"runtime_env carries multiple isolation kinds {present}; "
+            "pick one of pip/conda/image_uri")
+    kind = present[0]
+    provider = _ENV_PROVIDERS.get(kind)
+    if provider is None:
+        raise ValueError(
+            f"runtime_env[{kind!r}] requires a registered EnvProvider "
+            "(ray_tpu.core.runtime_env.register_env_provider); none is "
+            "installed — conda/container isolation needs the host to "
+            "supply the environment runtime")
+    return kind, provider, runtime_env[kind]

@@ -22,6 +22,7 @@ from ray_tpu.data.dataset import (
     range,
     range_tensor,
     from_huggingface,
+    read_huggingface,
     from_torch,
     read_avro,
     read_bigquery,
@@ -73,6 +74,7 @@ __all__ = [
     "GroupedData", "preprocessors", "col", "lit",
     "range", "range_tensor", "from_items", "from_numpy", "from_arrow",
     "from_pandas", "from_blocks", "from_torch", "from_huggingface",
+    "read_huggingface",
     "read_datasource", "read_parquet",
     "read_csv", "read_json", "read_numpy", "read_text",
     "read_binary_files", "read_tfrecords", "read_webdataset", "read_sql",

@@ -591,6 +591,17 @@ def read_json(paths, *, parallelism: int = -1) -> Dataset:
     return read_datasource(JSONDatasource(paths), parallelism=parallelism)
 
 
+def read_huggingface(path, *, split=None, parallelism: int = -1) -> Dataset:
+    """Read a dataset saved by HF ``datasets``' ``save_to_disk`` (arrow
+    shards; DatasetDict needs ``split=``) as a DISTRIBUTED read — the
+    local-format sibling of ``from_huggingface`` (which converts an
+    in-memory Dataset). No hub client or network involved."""
+    from ray_tpu.data.datasource import HuggingFaceDatasource
+
+    return read_datasource(HuggingFaceDatasource(path, split=split),
+                           parallelism=parallelism)
+
+
 def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
     return read_datasource(NumpyDatasource(paths), parallelism=parallelism)
 

@@ -1373,3 +1373,56 @@ def write_tfrecords_file(records, path: str) -> int:
             f.write(struct.pack("<I", _masked_crc(rec)))
             n += 1
     return n
+
+
+class HuggingFaceDatasource(FileDatasource):
+    """Distributed reader for the HF ``datasets`` LOCAL on-disk format
+    (``Dataset.save_to_disk``: arrow shard files + state.json listing
+    them; DatasetDict adds one subdirectory per split). Shards split
+    across read tasks, so a big saved dataset streams without the
+    driver materializing it — unlike ``from_huggingface``, which
+    converts an in-memory Dataset (reference:
+    _internal/datasource/huggingface_datasource.py; no network or hub
+    client needed for this path)."""
+
+    suffixes = [".arrow"]
+
+    def __init__(self, path, split: Optional[str] = None):
+        import json
+
+        path = os.path.abspath(os.fspath(path))
+        if os.path.isdir(path):
+            if os.path.exists(os.path.join(path, "dataset_dict.json")):
+                splits = sorted(
+                    d for d in os.listdir(path)
+                    if os.path.exists(os.path.join(path, d, "state.json")))
+                if split is None:
+                    raise ValueError(
+                        f"{path} holds a DatasetDict with splits "
+                        f"{splits}; pass split=...")
+                if split not in splits:
+                    raise ValueError(
+                        f"split {split!r} not in {splits} at {path}")
+                path = os.path.join(path, split)
+            state = os.path.join(path, "state.json")
+            if os.path.exists(state):
+                with open(state) as f:
+                    files = [os.path.join(path, e["filename"])
+                             for e in json.load(f)["_data_files"]]
+                self._paths = files
+                return
+        super().__init__(path)
+
+    def read_file(self, path: str):
+        import pyarrow.ipc as ipc
+
+        # save_to_disk shards are Arrow STREAMING format; memory-map so
+        # a shard larger than the block target still reads lazily
+        with pa.memory_map(path) as source:
+            try:
+                reader = ipc.open_stream(source)
+            except pa.ArrowInvalid:
+                reader = ipc.open_file(source)  # the random-access variant
+            for batch in reader:
+                if batch.num_rows:
+                    yield pa.Table.from_batches([batch])

@@ -170,6 +170,13 @@ class Pool:
                     except _q.Empty:
                         item = False  # poll round
                     if item is None:
+                        # shutdown sentinel: a final blocking sweep so
+                        # close()+join() never loses a callback parked
+                        # between polls (join already awaited the refs;
+                        # after terminate the gets raise into the error
+                        # callbacks)
+                        for ent in entries:
+                            fire(*ent)
                         return
                     if item is not False:
                         entries.append(item)

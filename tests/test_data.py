@@ -1547,3 +1547,30 @@ def test_csv_json_predicate_pushdown_early_skip(tmp_path):
     assert isinstance(optj, L.Read), "JSON filter did not push down"
     assert sorted(r["id"] for r in dj.take_all()) == [3, 10, 17, 24, 31,
                                                       38, 45]
+
+
+def test_read_huggingface_local_format(rt, tmp_path):
+    """Distributed read of the HF datasets save_to_disk layout (arrow
+    shards + state.json; DatasetDict splits) — the local-format sibling
+    of from_huggingface, zero network."""
+    import datasets as hfds
+
+    from ray_tpu import data as rd
+
+    d = hfds.Dataset.from_dict({"a": list(range(100)),
+                                "b": [f"s{i}" for i in range(100)]})
+    d.save_to_disk(str(tmp_path / "flat"), num_shards=3)
+    ds = rd.read_huggingface(str(tmp_path / "flat"))
+    rows = ds.take_all()
+    assert sorted(r["a"] for r in rows) == list(range(100))
+    assert {r["b"] for r in rows if r["a"] == 7} == {"s7"}
+
+    dd = hfds.DatasetDict({"train": d.select(range(80)),
+                           "test": d.select(range(80, 100))})
+    dd.save_to_disk(str(tmp_path / "dict"))
+    assert rd.read_huggingface(str(tmp_path / "dict"),
+                               split="test").count() == 20
+    import pytest
+
+    with pytest.raises(ValueError):
+        rd.read_huggingface(str(tmp_path / "dict"))  # split required
