@@ -270,7 +270,7 @@ def bench_moe_train(batch: int = 8, seq: int = 1024, steps: int = 8):
     return batch * seq / dt
 
 
-def bench_serve_ttft(n_requests: int = 16):
+def bench_serve_ttft(n_requests: int = 16, quantize=None):
     """Serve LLM engine on the chip: p50 TTFT + decode throughput.
 
     Drives the continuous-batching engine directly (the TPU lives in this
@@ -282,10 +282,12 @@ def bench_serve_ttft(n_requests: int = 16):
     from ray_tpu.serve.llm_engine import LLMEngine
 
     on_tpu = jax.default_backend() == "tpu"
+    mc = ({"preset": "llama3_1b_proxy", "param_dtype": "bfloat16"}
+          if on_tpu else {"preset": "tiny"})
+    if quantize:
+        mc["quantize"] = quantize
     engine = LLMEngine(
-        model_config=({"preset": "llama3_1b_proxy",
-                       "param_dtype": "bfloat16"} if on_tpu
-                      else {"preset": "tiny"}),
+        model_config=mc,
         # 16 slots so the 16-request burst admits without queueing for a
         # slot (KV for 16x512 at 1B scale is a few hundred MB of HBM);
         # batched prefill admits the burst in 2 program calls
@@ -980,6 +982,18 @@ def main():
             rows.append(_row("decode_hbm_bw_utilization",
                              weight_bytes / max(step_s, 1e-9)
                              / _chip_peak_hbm(), "fraction"))
+            # int8 weight-only decode: on the pipelined engine the
+            # dequant fuses and the halved weight reads land (r5)
+            try:
+                (_, int8_tok_s, int8_itl, _, _, _) = bench_serve_ttft(
+                    quantize="int8")
+                rows.append(_row("serve_int8_itl_p50_ms", int8_itl,
+                                 "ms"))
+                rows.append(_row("serve_int8_decode_tokens_per_sec",
+                                 int8_tok_s, "tokens/s"))
+            except Exception as e:  # pragma: no cover
+                rows.append({"metric": "serve_int8_itl_p50_ms",
+                             "value": -1, "unit": f"error: {e}"})
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "serve_ttft_p50_ms", "value": -1,
                      "unit": f"error: {e}"})
@@ -1066,6 +1080,9 @@ def main():
              "single_node_1m_queued_tasks_s", False),
             ("many_nodes_actors_per_sec",
              "many_nodes_actors_per_sec", True),
+            ("serve_int8_itl_p50_ms", "serve_int8_itl_p50_ms", False),
+            ("serve_int8_decode_tokens_per_sec",
+             "serve_int8_decode_tokens_per_sec", True),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)
