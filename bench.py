@@ -169,7 +169,7 @@ def bench_train_step(attn_impl: str, batch: int = 8, seq: int = 2048,
     return tok_s, mfu, loss, n_params, dt
 
 
-def bench_layer_8b(seq: int, batch: int = 4, steps: int = 10):
+def bench_layer_8b(seq: int, batch: int = 4, steps: int = 16):
     """One Llama-3-8B-DIM transformer layer, fwd+bwd on the chip.
 
     A single v5e chip (16 GiB) cannot hold the full 8B model, so the
