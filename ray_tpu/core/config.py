@@ -148,6 +148,12 @@ _FLAGS: List[Flag] = [
          "instead of cold interpreter starts (~300ms). TPU workers always "
          "cold-spawn (reference: PrestartWorkers, "
          "raylet/worker_pool.h:344)."),
+    Flag("worker_ready_timeout_s", float, 300.0,
+         "A spawned worker that neither connects (MSG_READY) nor exits "
+         "within this window is presumed wedged: killed and handled as "
+         "a pre-ready death (env pools count it toward their "
+         "crash-loop bound). Raise on hosts with very slow cold "
+         "starts."),
     Flag("gcs_wal_fsync", bool, False,
          "fsync the GCS write-ahead log on every append. Default off: "
          "durability then covers GCS process crashes (the common failure), "

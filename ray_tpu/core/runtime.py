@@ -587,7 +587,30 @@ class Runtime:
         with self._lock:
             self._workers[worker_id] = w
             self._spawning += 1
+        # a worker that dies (or wedges) BEFORE connecting has no reader
+        # thread to observe its death: without this watcher it would leak
+        # self._spawning forever and close the dispatch/scale-up gates
+        # (env pools additionally need the death to drive their
+        # crash-loop bound)
+        threading.Thread(target=self._watch_until_ready, args=(w,),
+                         daemon=True,
+                         name=f"rtpu-spawn-{worker_id.hex()[:6]}").start()
         return w
+
+    def _watch_until_ready(self, w: _Worker):
+        deadline = time.monotonic() + config.worker_ready_timeout_s
+        while (not self._shutdown and w.alive and not w.ready
+               and time.monotonic() < deadline):
+            if w.proc is not None and w.proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        if not self._shutdown and w.alive and not w.ready:
+            if w.proc is not None:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+            self._on_worker_death(w)
 
     def _accept_loop(self):
         while not self._shutdown:
@@ -651,6 +674,11 @@ class Runtime:
                     with self._lock:
                         w.ready = True
                         self._spawning -= 1
+                        if w.env_key is not None:
+                            # a successful startup clears the env's
+                            # crash-loop strikes: only CONSECUTIVE
+                            # pre-ready deaths fail the queue out
+                            self._env_spawn_fails.pop(w.env_key, None)
                         # Workers pre-claimed for an actor never join the
                         # general idle pool; env workers join their env's
                         # pool.
@@ -1301,6 +1329,10 @@ class Runtime:
         finally:
             with self._lock:
                 self._env_spawning[key] = 0
+        # pre-ready death (broken env, bogus provider exe) is observed by
+        # the shared _watch_until_ready watcher every spawn starts — it
+        # feeds _on_worker_death, which drives this env's crash-loop
+        # bound / respawn via _dispatch_env
 
     def _dispatch(self):
         self._route_env_specs()

@@ -436,3 +436,32 @@ def test_pip_env_pool_grows_with_demand(rt, tmp_path):
     assert [v for v, _ in out] == [3, 3, 3, 3]
     assert len({p for _, p in out}) >= 2, "env pool never grew"
     assert wall < 3.5, f"env tasks serialized: {wall:.1f}s"
+
+
+def test_env_worker_crash_loop_fails_tasks(rt):
+    """An env whose workers die before READY (broken interpreter /
+    shadowed framework dep) must fail its queued tasks after bounded
+    respawns — never hang the caller or retry forever."""
+    from ray_tpu.core import runtime_env as renv_mod
+
+    class BrokenProvider(renv_mod.EnvProvider):
+        kind = "conda"
+
+        def env_key(self, spec):
+            return f"broken-{spec}"
+
+        def prepare(self, spec):
+            return renv_mod.PreparedEnv("/bin/false")  # dies instantly
+
+    renv_mod.register_env_provider(BrokenProvider())
+    try:
+        @rt.remote(runtime_env={"conda": "deadenv"})
+        def doomed():
+            return 1
+
+        import pytest
+
+        with pytest.raises(Exception, match="crashed repeatedly|setup failed"):
+            rt.get(doomed.remote(), timeout=120)
+    finally:
+        renv_mod._ENV_PROVIDERS.pop("conda", None)
