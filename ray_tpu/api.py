@@ -145,8 +145,23 @@ def free(refs, *, local_only: bool = False) -> int:
     """Eagerly delete objects from the store (reference:
     ray._private.internal_api.free). Complements the pin+spill lifetime
     model when the caller knows an object is dead: storage (shm or spill
-    file) is reclaimed immediately and subsequent ``get``s raise
-    ObjectLostError — freed objects are never lineage-reconstructed.
+    file) is reclaimed immediately, the id's lineage entry is
+    invalidated, and subsequent ``get``s raise ObjectLostError — free
+    means dead, reconstruction is never attempted for a freed id.
+
+    That makes ``free`` the exception to the recovery rule: objects a
+    TASK produced that are lost to LRU eviction, a missing/corrupt spill
+    file, or worker death are otherwise transparently recomputed from
+    recorded lineage (resubmitting the producing task, recursively
+    rebuilding lost upstream deps) up to ``max_reconstructions``
+    attempts per object. Not recoverable — ``get`` raises
+    ObjectLostError naming the producing task and the attempt history
+    where one exists: ``ray_tpu.put`` objects (no producing task),
+    freed ids, and ids whose lineage was evicted past the
+    ``lineage_max_bytes`` budget. Deterministic loss for tests is
+    injected via ``ray_tpu.core.fault_injection`` (``RTPU_FAULT_<SITE>``
+    env vars or the ``fault_injection`` config flag).
+
     Returns the number of objects actually freed. ``local_only`` is
     accepted for API parity (deletion always covers the owning core)."""
     del local_only

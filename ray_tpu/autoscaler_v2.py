@@ -20,11 +20,14 @@ validated + timestamped, which is what makes scale-up failures
 from __future__ import annotations
 
 import enum
+import logging
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 
 class InstanceStatus(str, enum.Enum):
@@ -333,7 +336,17 @@ class AutoscalerV2:
                 pending_pgs += sum(1 for pg in table.values()
                                    if pg["state"] == "PENDING")
                 ok += 1
-            except (RpcError, Exception):  # noqa: BLE001 — node draining
+            except (RpcError, ConnectionError, TimeoutError, OSError,
+                    EOFError):
+                # node draining/booting — the probe is inconclusive,
+                # which the ok-count already accounts for
+                continue
+            except Exception:  # noqa: BLE001
+                # NOT a transport error: a malformed state payload or a
+                # bug must be visible, not silently read as "draining"
+                logger.warning(
+                    "autoscaler demand probe failed unexpectedly on %s",
+                    addr, exc_info=True)
                 continue
         return queued, pending_pgs, ok
 

@@ -98,6 +98,7 @@ class ClusterCore:
         self._view: Optional[dict] = None
         self._view_time = 0.0
         self._death_seq = 0
+        self._freed_seq = 0  # cursor into the GCS "freed" channel
         self._monitor_stop = False
         # owner identity: this driver registers with the GCS and
         # heartbeats; if it dies, nodes reclaim its objects and its
@@ -177,9 +178,39 @@ class ClusterCore:
                 deaths = self.gcs.call(("deaths_since", self._death_seq))
             except (RpcError, Exception):  # noqa: BLE001
                 continue
+            self._drain_freed_channel()
             for seq, node_id in deaths:
                 self._death_seq = max(self._death_seq, seq)
                 self._on_node_death(node_id)
+
+    def _drain_freed_channel(self):
+        """Apply freed-id broadcasts: a worker-originated free on any
+        node must invalidate THIS driver's lineage for those ids ("free
+        means dead" — reconstruction must never resurrect them, and the
+        dead entries must stop counting against the lineage budget).
+        freed_check at reconstruction time remains the authority; this
+        is the eager path."""
+        try:
+            msgs = self.gcs.call(("poll", "freed", self._freed_seq, 0.0))
+        except (RpcError, OSError):
+            return
+        if not msgs:
+            return
+        from ray_tpu.core.runtime import note_freed
+
+        with self._lock:
+            for seq, oid_list in msgs:
+                self._freed_seq = max(self._freed_seq, seq)
+                note_freed(self._freed, oid_list)
+                for b in oid_list:
+                    self._drop_lineage_locked(b)
+
+    def _drop_lineage_locked(self, oid_b: bytes):
+        old = self._lineage.pop(oid_b, None)
+        if old is not None:
+            self._lineage_bytes -= (len(old[1][1])
+                                    if old[1][0] == "inline" else 64)
+        self._reconstructions.pop(oid_b, None)
 
     def _on_node_death(self, node_id: bytes):
         view = self.gcs.call(("list_nodes", False))
@@ -983,10 +1014,7 @@ class ClusterCore:
                 # (router load reports) must not grow _ref_node unboundedly
                 self._ref_node.pop(b, None)
             for b in freed:
-                old = self._lineage.pop(b, None)
-                if old is not None:
-                    self._lineage_bytes -= (len(old[1][1])
-                                            if old[1][0] == "inline" else 64)
+                self._drop_lineage_locked(b)
         return len(freed)
 
     # ---- runtime_env packages: content-addressed blobs in the GCS KV,

@@ -558,6 +558,12 @@ class GcsServer:
 
         with self._lock:
             note_freed(self._freed, oid_bytes_list, cap=1_000_000)
+            # broadcast on the "freed" channel: every driver must
+            # invalidate its lineage for these ids ("free means dead"),
+            # not just discover the tombstone lazily at reconstruction
+            # time — a dead entry would otherwise sit charged against
+            # the lineage byte budget until evicted
+            self._publish_locked("freed", list(oid_bytes_list))
         return True
 
     def _op_freed_check(self, oid_bytes: bytes) -> bool:

@@ -177,6 +177,14 @@ _FLAGS: List[Flag] = [
          "If > 0, each task execution exits the worker with this "
          "probability before running (chaos; reference: WorkerKillerActor "
          "test_utils.py:1597)."),
+    Flag("fault_injection", str, "",
+         "Deterministic fault plan: comma-separated "
+         "'<site>=<action>[:<times>[:<match>]]' specs armed at named "
+         "sites (see ray_tpu/core/fault_injection.py for the site and "
+         "action tables). Equivalent per-site env form: "
+         "RTPU_FAULT_<SITE>=<action>[:<times>[:<match>]]. Unlike the "
+         "probabilistic testing_* knobs above, these target a chosen "
+         "object/task and fire an exact number of times."),
 ]
 
 _BY_NAME: Dict[str, Flag] = {f.name: f for f in _FLAGS}

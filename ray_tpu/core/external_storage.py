@@ -92,6 +92,30 @@ def size(path: str):
         return None
 
 
+def corrupt(path: str, nbytes: int = 64) -> bool:
+    """Overwrite the head of a spill file with garbage in place (fault
+    injection: a torn write / bad sector stand-in). The file keeps its
+    size so only content validation — not existence checks — can tell.
+    Returns False when the file is missing or the backend can't seek."""
+    junk = b"\xde\xad\xbe\xef" * (nbytes // 4 + 1)
+    try:
+        if is_uri(path):
+            fs, p = _fs_and_path(path)
+            data = bytearray(fs.cat_file(p))
+            n = min(len(data), nbytes)
+            data[:n] = junk[:n]
+            with fs.open(p, "wb") as f:
+                f.write(bytes(data))
+        else:
+            with open(path, "r+b") as f:
+                end = f.seek(0, 2)
+                f.seek(0)
+                f.write(junk[:min(end, nbytes)])
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def delete(path: str):
     try:
         if is_uri(path):

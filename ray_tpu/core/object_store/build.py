@@ -32,19 +32,22 @@ def _compile(out: str, extra: list) -> None:
     os.replace(tmp, out)
 
 
-def ensure_built(sanitize: str = "") -> str:
+def ensure_built(sanitize: str = "", force: bool = False) -> str:
     """Build the store library if missing or stale; return its path.
 
     ``sanitize`` in {"thread", "address"} builds/returns the
     instrumented variant (separate .so — normal users never pay the
-    sanitizer tax)."""
+    sanitizer tax). ``force`` recompiles even when the cached binary
+    looks fresh — the loader uses it when a prebuilt .so turns out to
+    be ABI-incompatible with the host (e.g. built against a newer
+    glibc than the one present)."""
     if sanitize:
         lib = os.path.join(_DIR, f"_shm_store_{sanitize}.so")
         flags = _SAN_FLAGS[sanitize]
     else:
         lib, flags = _LIB, ["-O2"]
     with _lock:
-        if os.path.exists(lib) and \
+        if not force and os.path.exists(lib) and \
                 os.path.getmtime(lib) >= os.path.getmtime(_SRC):
             return lib
         _compile(lib, flags)

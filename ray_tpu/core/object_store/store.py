@@ -22,7 +22,15 @@ from ray_tpu.exceptions import ObjectStoreFullError, ObjectTimeoutError
 def _load_lib() -> ctypes.CDLL:
     # RTPU_STORE_LIB: sanitizer harness loads an instrumented build
     # (tests/test_store_sanitize.py; build.py --sanitize={thread,address})
-    lib = ctypes.CDLL(os.environ.get("RTPU_STORE_LIB") or ensure_built())
+    override = os.environ.get("RTPU_STORE_LIB")
+    try:
+        lib = ctypes.CDLL(override or ensure_built())
+    except OSError:
+        if override:
+            raise
+        # a shipped/cached binary can be ABI-incompatible with this host
+        # (built against a newer glibc); recompile from source and retry
+        lib = ctypes.CDLL(ensure_built(force=True))
     lib.rtpu_store_create.restype = ctypes.c_void_p
     lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
     lib.rtpu_store_connect.restype = ctypes.c_void_p

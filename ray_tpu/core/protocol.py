@@ -149,11 +149,30 @@ def spilled_unpack(path_and_size) -> Any:
     python/ray/_private/external_storage.py:451). Local files hold the
     same container format as a shm object and are mmap'd so large
     tensors stay file-backed until touched; fsspec URIs (s3://...) read
-    through the filesystem driver."""
+    through the filesystem driver.
+
+    A missing or undecodable spill file means the value is LOST (disk
+    reclaimed, torn write, bucket eviction) — that surfaces as
+    ObjectLostError, the same signal as a shm-store miss, so the owner
+    can attempt lineage reconstruction of the producing task."""
     from ray_tpu.core import external_storage as _ext
+    from ray_tpu.exceptions import ObjectLostError
 
     path = path_and_size[0] if isinstance(path_and_size, tuple) else path_and_size
-    return serialization.unpack(memoryview(_ext.read_buffer(path)))
+    try:
+        buf = _ext.read_buffer(path)
+    except Exception as e:  # noqa: BLE001 — missing file / backend error
+        raise ObjectLostError(
+            f"spill file {path} is unreadable ({type(e).__name__}: {e})"
+        ) from None
+    try:
+        return serialization.unpack(memoryview(buf))
+    except ObjectLostError:
+        raise
+    except Exception as e:  # noqa: BLE001 — truncated/overwritten file
+        raise ObjectLostError(
+            f"spill file {path} is corrupt ({type(e).__name__}: {e})"
+        ) from None
 
 
 class _Pin:
@@ -183,7 +202,11 @@ def shm_unpack(store, oid: ObjectID, timeout_ms: int = 0) -> Any:
 
     Callers only reach this once the owner reports the object sealed, so a
     miss means it was LRU-evicted -> ObjectLostError (the reference raises
-    the same; reconstruction via lineage is a later milestone).
+    the same). The owning Runtime catches that signal for task-produced
+    objects and resubmits the producing task from its lineage table (up to
+    config.max_reconstructions attempts, budgeted by
+    config.lineage_max_bytes); put/freed/lineage-evicted objects stay
+    lost and the error propagates to the caller.
     """
     import ctypes
     import weakref

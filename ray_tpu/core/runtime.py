@@ -24,11 +24,12 @@ import subprocess
 import sys
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from multiprocessing.connection import Listener
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu.core import external_storage, protocol, serialization
+from ray_tpu.core import external_storage, fault_injection, protocol, \
+    serialization
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import (
     ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID,
@@ -72,6 +73,31 @@ class _ObjectEntry:
         self.event = threading.Event()
         self.payload = None  # protocol.Payload once ready
         self.callbacks: List[Callable[[], None]] = []
+
+
+class _Lineage:
+    """Resubmittable description of a task, kept per return id so a lost
+    object can be recomputed (reference: object_recovery_manager.h +
+    task_manager lineage pinning). One instance is shared by all of the
+    task's return ids; ``holders`` counts the table entries still
+    pointing at it so the retained args container (shm payloads stay
+    pinned for replay) releases exactly once."""
+
+    __slots__ = ("task_id_hex", "fn_id", "args_payload", "deps_b",
+                 "nested_b", "return_ids_b", "options", "cost", "holders",
+                 "args_pinned")
+
+    def __init__(self):
+        self.args_pinned = False
+
+
+class _DepsLost(Exception):
+    """Raised by dependency inlining when a dep's backing value vanished
+    between resolution and dispatch; carries the lost oid bytes."""
+
+    def __init__(self, oids: List[bytes]):
+        super().__init__(f"{len(oids)} task dependencies lost")
+        self.oids = oids
 
 
 def _task_env_key(options) -> Optional[str]:
@@ -381,6 +407,19 @@ class Runtime:
         # eagerly-freed object ids: insertion-ordered so the tombstone cap
         # evicts oldest-first (dict preserves insertion order)
         self._freed: Dict[bytes, None] = {}
+        # Lineage reconstruction (reference: object_recovery_manager.h):
+        # per-return-id task descriptions, byte-bounded by
+        # config.lineage_max_bytes (oldest-evicted); lost task returns
+        # are recomputed by resubmitting the recorded task, up to
+        # config.max_reconstructions attempts per object. ray.put and
+        # freed objects are never recorded/recovered.
+        self._lineage: "OrderedDict[bytes, _Lineage]" = OrderedDict()
+        self._lineage_bytes = 0
+        self._reconstructions: Dict[bytes, int] = {}
+        self._recon_history: Dict[bytes, List[str]] = {}
+        # return ids with a reconstruction resubmission in flight (their
+        # entries are reset: event cleared, payload None)
+        self._recovering: Dict[bytes, None] = {}
         # First-return-id -> spec, for ray.cancel lookup; entries drop when
         # the task finishes (done/error/cancel paths).
         self._cancellable: Dict[bytes, _TaskSpec] = {}
@@ -884,6 +923,7 @@ class Runtime:
         with self._lock:
             e.payload = payload
             e.event.set()
+            self._recovering.pop(oid.binary(), None)
             callbacks, e.callbacks = e.callbacks, []
         # Pin tracked shm containers against LRU eviction (spill handles
         # pressure). Only self-named containers (container id == entry id)
@@ -954,9 +994,9 @@ class Runtime:
         """Eagerly delete objects (reference: internal_api.free) —
         complements the pin+spill lifetime model for workloads that know
         an object is dead. Unresolved ids are skipped; subsequent gets of
-        a freed id surface ObjectLostError (lineage reconstruction is
-        deliberately not attempted: free means dead). Returns the count
-        actually freed."""
+        a freed id surface ObjectLostError, and the id's lineage entry is
+        invalidated so reconstruction is never attempted (free means
+        dead). Returns the count actually freed."""
         from ray_tpu.exceptions import ObjectLostError
 
         freed_ids: List[bytes] = []
@@ -1005,6 +1045,7 @@ class Runtime:
                 self._store_error(
                     [oid], ObjectLostError(f"object {oid} was freed"))
             self._cancellable.pop(oid_b, None)
+            self._drop_lineage(oid_b)
             freed_ids.append(oid_b)
         return freed_ids if return_ids else len(freed_ids)
 
@@ -1065,6 +1106,14 @@ class Runtime:
             self.store.delete(oid)
         except Exception:  # noqa: BLE001
             pass
+        if fault_injection.enabled():
+            # 'spill' fault site: lose the file the moment the payload
+            # moved to disk (torn write / reclaimed scratch volume)
+            action = fault_injection.fire("spill", oid.hex())
+            if action == "delete":
+                external_storage.delete(path)
+            elif action == "corrupt":
+                external_storage.corrupt(path)
         return size
 
     def _store_error(self, oids: List[ObjectID], err: BaseException):
@@ -1072,6 +1121,233 @@ class Runtime:
         for oid in oids:
             self._cancellable.pop(oid.binary(), None)
             self._store_payload(oid, payload)
+
+    # ---------------------------------------------------------------- lineage
+
+    def _record_lineage(self, spec: _TaskSpec):
+        """Keep enough of a plain task's description to resubmit it if a
+        return is lost. Shm args containers are retained (one _pin_args
+        ref) for the lineage entry's lifetime and charged at their full
+        size, so the lineage_max_bytes budget — and store pressure via
+        _try_free_space — bounds what replayability costs."""
+        p = spec.args_payload
+        lin = _Lineage()
+        lin.task_id_hex = spec.task_id.hex()
+        lin.fn_id = spec.fn_id
+        lin.args_payload = p
+        lin.deps_b = [d.binary() for d in spec.deps]
+        lin.nested_b = [d.binary() for d in spec.nested_deps]
+        lin.return_ids_b = [r.binary() for r in spec.return_ids]
+        lin.options = dict(spec.options)
+        cost = 64
+        if p is not None and p[0] == "inline":
+            cost += len(p[1])
+        elif p is not None and p[0] == "shm":
+            self._pin_args(p[1])
+            lin.args_pinned = True
+            try:
+                mv = self.store.get(ObjectID(p[1]), timeout_ms=0)
+                cost += mv.nbytes
+                del mv
+                self.store.release(ObjectID(p[1]))
+            except Exception:  # noqa: BLE001
+                cost += 64
+        lin.cost = cost
+        lin.holders = len(lin.return_ids_b)
+        to_unpin: List[bytes] = []
+        with self._lock:
+            for rid_b in lin.return_ids_b:
+                old = self._lineage.pop(rid_b, None)
+                if old is not None:
+                    self._lineage_bytes -= old.cost
+                    if self._drop_lineage_holder_locked(old):
+                        to_unpin.append(old.args_payload[1])
+                self._lineage[rid_b] = lin
+                self._lineage_bytes += lin.cost
+            to_unpin.extend(self._evict_lineage_locked())
+        for oid_b in to_unpin:
+            self._unpin_args(oid_b)
+
+    def _drop_lineage_holder_locked(self, lin: _Lineage) -> bool:
+        """Returns True when the caller must release the entry's retained
+        args container (last holder gone)."""
+        lin.holders -= 1
+        return lin.holders == 0 and lin.args_pinned
+
+    def _evict_lineage_locked(self) -> List[bytes]:
+        """Enforce the byte budget; returns args containers to unpin."""
+        to_unpin: List[bytes] = []
+        while self._lineage_bytes > config.lineage_max_bytes and self._lineage:
+            rid_b, old = self._lineage.popitem(last=False)
+            self._lineage_bytes -= old.cost
+            if self._drop_lineage_holder_locked(old):
+                to_unpin.append(old.args_payload[1])
+        return to_unpin
+
+    def _drop_lineage(self, oid_b: bytes):
+        """Invalidate one return id's lineage (free means dead)."""
+        with self._lock:
+            lin = self._lineage.pop(oid_b, None)
+            unpin = False
+            if lin is not None:
+                self._lineage_bytes -= lin.cost
+                unpin = self._drop_lineage_holder_locked(lin)
+            self._reconstructions.pop(oid_b, None)
+            self._recon_history.pop(oid_b, None)
+        if unpin:
+            self._unpin_args(lin.args_payload[1])
+
+    def _payload_lost(self, payload) -> bool:
+        """True when a resolved payload's backing value is gone (shm
+        container evicted / spill file deleted). Inline payloads and
+        None (entry reset for an in-flight reconstruction) are not
+        lost."""
+        if payload is None:
+            return False
+        kind, data = payload
+        if kind == "shm":
+            return not self.store.contains(ObjectID(data))
+        if kind == "spilled":
+            path = data[0] if isinstance(data, tuple) else data
+            return external_storage.size(path) is None
+        return False
+
+    def _object_available(self, oid_b: bytes) -> bool:
+        with self._lock:
+            e = self._objects.get(ObjectID(oid_b))
+            if e is None:
+                return False
+            if not e.event.is_set():
+                return True  # pending: a producer/reconstruction resolves it
+            payload = e.payload
+        return not self._payload_lost(payload)
+
+    def _lost_error(self, oid_b: bytes, cause=None) -> ObjectLostError:
+        """The enriched terminal error for an unrecoverable object:
+        names the producing task (when lineage knows it) and the
+        reconstruction attempt history."""
+        oid = ObjectID(oid_b)
+        with self._lock:
+            freed = oid_b in self._freed
+            lin = self._lineage.get(oid_b)
+            history = list(self._recon_history.get(oid_b, ()))
+            n = self._reconstructions.get(oid_b, 0)
+        if freed:
+            why = "it was freed (free means dead)"
+        elif lin is None:
+            why = ("no lineage is recorded (ray_tpu.put values and "
+                   "lineage-evicted task returns are not reconstructable)")
+        elif n >= max(0, config.max_reconstructions):
+            why = (f"the reconstruction budget is exhausted "
+                   f"(max_reconstructions={config.max_reconstructions})")
+        else:
+            why = "reconstruction failed"
+        msg = f"object {oid} is lost and cannot be reconstructed: {why}"
+        if cause is not None:
+            msg += f" [loss: {str(cause)[:200]}]"
+        return ObjectLostError(msg, task_id=lin.task_id_hex if lin else "",
+                               attempts=history)
+
+    def _recover_object(self, oid_b: bytes, cause=None, depth: int = 0
+                        ) -> bool:
+        """Attempt lineage reconstruction of a lost object by
+        resubmitting its producing task (recursively recovering lost
+        upstream deps). Returns True when the object's entry WILL
+        resolve again — a resubmission is in flight, possibly started by
+        another thread, possibly resolving to an error — so the caller
+        should re-wait on the entry. Returns False when the object is
+        unrecoverable and the entry is untouched (caller raises
+        _lost_error)."""
+        if depth > 10:
+            return False
+        reset_ids: List[bytes] = []
+        with self._lock:
+            if oid_b in self._freed:
+                return False
+            e = self._objects.get(ObjectID(oid_b))
+            if e is not None and not e.event.is_set():
+                return True  # already being reproduced
+            lin = self._lineage.get(oid_b)
+            if lin is None:
+                return False
+            # find which of the task's returns are actually lost; a
+            # concurrent recovery may already have replaced the value
+            lost = [rid_b for rid_b in lin.return_ids_b
+                    if (re := self._objects.get(ObjectID(rid_b))) is not None
+                    and re.event.is_set() and self._payload_lost(re.payload)]
+            if oid_b not in lost:
+                if cause is None:
+                    return True  # probe says alive: concurrent recovery won
+                # the caller OBSERVED a failed decode — trust it over the
+                # existence probe (a corrupt spill file still stats fine)
+                lost.append(oid_b)
+            n = self._reconstructions.get(oid_b, 0)
+            if n >= config.max_reconstructions:
+                return False
+            self._reconstructions[oid_b] = n + 1
+            self._recon_history.setdefault(oid_b, []).append(
+                f"attempt {n + 1}: resubmitted task {lin.task_id_hex[:16]} "
+                f"({type(cause).__name__ if cause is not None else 'loss'})")
+            spilled_cleanup = []
+            for rid_b in lost:
+                re_ = self._objects[ObjectID(rid_b)]
+                if re_.payload is not None and re_.payload[0] == "spilled":
+                    spilled_cleanup.append(re_.payload[1])
+                re_.payload = None
+                re_.event.clear()
+                self._recovering[rid_b] = None
+                reset_ids.append(rid_b)
+        with self._spill_lock:
+            for rid_b in reset_ids:
+                self._pinned.pop(rid_b, None)
+        for data in spilled_cleanup:
+            path = data[0] if isinstance(data, tuple) else data
+            external_storage.delete(path)
+            if isinstance(data, tuple):
+                with self._spill_lock:
+                    self._spilled_bytes -= data[1]
+        # upstream deps must be readable before the task re-runs
+        for dep_b in list(lin.deps_b) + list(lin.nested_b):
+            if not self._object_available(dep_b):
+                if not self._recover_object(dep_b, cause, depth + 1):
+                    self._finish_failed_recovery(
+                        reset_ids, self._lost_error(
+                            oid_b, cause=ObjectLostError(
+                                f"upstream dependency "
+                                f"{ObjectID(dep_b)} is unrecoverable")))
+                    return True
+        try:
+            task_id = make_task_id(self.job_id)
+            spec = _TaskSpec(task_id, lin.fn_id, lin.args_payload,
+                             [ObjectID(b) for b in lin.deps_b],
+                             [ObjectID(b) for b in lin.return_ids_b],
+                             dict(lin.options))
+            spec.nested_deps = [ObjectID(b) for b in lin.nested_b]
+            spec.request, spec.pg_wire = self._prepare_request(
+                spec.options, is_actor=False)
+            self._cancellable[lin.return_ids_b[0]] = spec
+            self._enqueue(spec)
+        except BaseException as err:  # noqa: BLE001 — e.g. PG removed
+            self._finish_failed_recovery(
+                reset_ids, self._lost_error(oid_b, cause=err))
+        return True
+
+    def _finish_failed_recovery(self, reset_ids: List[bytes],
+                                err: ObjectLostError):
+        """Resolve reset entries to the terminal error so waiters wake
+        instead of hanging on a reconstruction that cannot happen."""
+        self._store_error([ObjectID(b) for b in reset_ids], err)
+
+    def _apply_get_fault(self, oid: ObjectID):
+        """'get' fault site: lose the object deterministically just
+        before a driver-side read decodes it."""
+        action = fault_injection.fire("get", oid.hex())
+        if action == "evict":
+            fault_injection.evict_object(self, oid)
+        elif action == "delete_spill":
+            fault_injection.delete_spill_file(self, oid)
+        elif action == "corrupt_spill":
+            fault_injection.corrupt_spill_file(self, oid)
 
     # ------------------------------------------------------------- scheduler
 
@@ -1090,6 +1366,7 @@ class Runtime:
         for rid in return_ids:
             self._entry(rid)
         self._cancellable[return_ids[0].binary()] = spec
+        self._record_lineage(spec)
         self._enqueue(spec)
         return [ObjectRef(rid, core=self) for rid in return_ids]
 
@@ -1520,10 +1797,20 @@ class Runtime:
     def _inline_values_for(self, deps: List[ObjectID],
                            spec: Optional[_TaskSpec] = None
                            ) -> Dict[bytes, Any]:
+        """Raises _DepsLost (when dispatching a spec) if a dep's backing
+        value vanished between resolution and dispatch — the dispatcher
+        then reconstructs the deps and requeues the spec instead of
+        shipping a read that is known to fail worker-side."""
         out: Dict[bytes, Any] = {}
+        lost: List[bytes] = []
         for dep in deps:
             e = self._objects[dep]
-            kind, data = e.payload
+            payload = e.payload
+            if payload is None:
+                # entry reset: its reconstruction is already in flight
+                lost.append(dep.binary())
+                continue
+            kind, data = payload
             if kind == "shm":
                 # Pin the container for the task's flight time: with only
                 # the tracking pin, spill could delete it between dispatch
@@ -1541,14 +1828,23 @@ class Runtime:
                     # re-read and ship the current descriptor in-message
                     with self._lock:
                         refreshed = self._objects[dep].payload
-                    out[dep.binary()] = (None if refreshed[0] == "shm"
-                                         else refreshed)
+                    if refreshed is None or refreshed[0] == "shm":
+                        # not a spill race: the container is truly gone
+                        lost.append(dep.binary())
+                    else:
+                        out[dep.binary()] = refreshed
                 else:
                     out[dep.binary()] = None  # worker reads shm directly
+            elif (kind == "spilled" and spec is not None
+                  and self._payload_lost(payload)):
+                lost.append(dep.binary())
             else:
                 # inline and spilled payload descriptors travel in-message
                 # (the worker opens spill files itself — same host)
-                out[dep.binary()] = e.payload
+                out[dep.binary()] = payload
+        if lost and spec is not None:
+            self._release_spec_deps(spec)  # pins taken before the loss hit
+            raise _DepsLost(lost)
         return out
 
     def _release_spec_deps(self, spec: _TaskSpec):
@@ -1581,20 +1877,60 @@ class Runtime:
             except Exception:  # noqa: BLE001
                 pass
 
+    def _requeue_lost_dep_spec(self, w: _Worker, spec: _TaskSpec,
+                               lost_oids: List[bytes]):
+        """A dep's value vanished between resolution and dispatch: pull
+        the spec back off the worker, kick off reconstruction of the
+        lost deps, and requeue it (it re-waits on the reset entries).
+        Unrecoverable deps fail the task with the enriched error."""
+        with self._lock:
+            w.inflight.pop(spec.task_id.binary(), None)
+            self._release_spec_locked(spec)
+        self._release_spec_deps(spec)
+        for oid_b in lost_oids:
+            if not self._recover_object(oid_b):
+                self._release_spec_args(spec)
+                self._store_error(spec.return_ids, self._lost_error(oid_b))
+                return
+        if spec.actor_id is None:
+            # re-derive the resource request released above; actor-call
+            # specs carry none (the actor's worker holds its resources)
+            spec.request, spec.pg_wire = self._prepare_request(
+                spec.options, is_actor=False)
+        self._enqueue(spec)
+
     def _send_task_batch(self, w: _Worker, batch: List[_TaskSpec]):
         try:
             entries = []
+            sent = []
             for spec in batch:
                 # unconditional: the OOM kill policy sorts on this
                 spec.dispatched_ts = time.time()
                 self._ensure_fn_on_worker(w, spec.fn_id)
-                inline_values = self._inline_values_for(spec.deps, spec)
+                try:
+                    inline_values = self._inline_values_for(spec.deps, spec)
+                except _DepsLost as lost:
+                    self._requeue_lost_dep_spec(w, spec, lost.oids)
+                    continue
                 entries.append((
                     spec.task_id.binary(), spec.fn_id, spec.args_payload,
                     inline_values, [r.binary() for r in spec.return_ids],
                     spec.options.get("runtime_env"),
                 ))
-            self._send_msg(w, (protocol.MSG_TASK_BATCH, entries))
+                sent.append(spec)
+            if entries:
+                self._send_msg(w, (protocol.MSG_TASK_BATCH, entries))
+            if fault_injection.enabled() and w.proc is not None:
+                # 'dispatch' fault site: the worker dies right after
+                # receiving the batch (keyed by function id)
+                for spec in sent:
+                    key = spec.fn_id.hex() if spec.fn_id else ""
+                    if fault_injection.fire("dispatch", key) == "kill_worker":
+                        try:
+                            os.kill(w.proc.pid, signal.SIGKILL)
+                        except ProcessLookupError:
+                            pass
+                        break
         except (OSError, EOFError, BrokenPipeError):
             self._on_worker_death(w)
 
@@ -1602,7 +1938,11 @@ class Runtime:
         try:
             # unconditional: the OOM kill policy sorts on this
             spec.dispatched_ts = time.time()
-            inline_values = self._inline_values_for(spec.deps, spec)
+            try:
+                inline_values = self._inline_values_for(spec.deps, spec)
+            except _DepsLost as lost:
+                self._requeue_lost_dep_spec(w, spec, lost.oids)
+                return
             self._send_msg(w, (
                 protocol.MSG_ACTOR_CALL, spec.task_id.binary(),
                 spec.actor_id.binary(), spec.method, spec.args_payload,
@@ -1720,17 +2060,36 @@ class Runtime:
     def get_objects(self, refs: List[ObjectRef], timeout: Optional[float] = None
                     ) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
-        out = []
-        for ref in refs:
-            e = self._entry(ref.id)
-            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        return [protocol.raise_if_error(self._get_one(ref, deadline))
+                for ref in refs]
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float]):
+        """Resolve + decode one object, transparently reconstructing a
+        lost value from lineage: on ObjectLostError the producing task is
+        resubmitted (recursively recovering lost upstream deps) and the
+        wait restarts, up to config.max_reconstructions attempts."""
+        e = self._entry(ref.id)
+        oid_b = ref.id.binary()
+        while True:
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
             if not e.event.wait(remaining):
                 raise GetTimeoutError(f"get() timed out waiting for {ref}")
-            out.append(protocol.raise_if_error(self._decode_entry(e)))
-        return out
+            if fault_injection.enabled():
+                self._apply_get_fault(ref.id)
+            try:
+                return self._decode_entry(e)
+            except ObjectLostError as err:
+                if not self._recover_object(oid_b, err):
+                    raise self._lost_error(oid_b, err) from None
 
     def _decode_entry(self, e: _ObjectEntry):
-        kind, data = e.payload
+        payload = e.payload
+        if payload is None:
+            # entry reset by a concurrent reconstruction between our
+            # event.wait and this read; callers re-wait
+            raise ObjectLostError("object is being reconstructed")
+        kind, data = payload
         if kind == "inline":
             return serialization.unpack(data)
         if kind == "spilled":
@@ -1739,7 +2098,7 @@ class Runtime:
             return protocol.shm_unpack(self.store, ObjectID(data))
         except ObjectLostError:
             # raced a concurrent spill: the payload may have moved to disk
-            kind2, data2 = e.payload
+            kind2, data2 = e.payload if e.payload is not None else (None, None)
             if kind2 == "spilled":
                 return protocol.spilled_unpack(data2)
             raise
@@ -1792,6 +2151,19 @@ class Runtime:
         def resolve():
             try:
                 v = self._decode_entry(e)
+            except ObjectLostError as exc:
+                oid_b = ref.id.binary()
+                if self._recover_object(oid_b, exc):
+                    # re-arm for the reconstructed value
+                    with self._lock:
+                        if not e.event.is_set():
+                            e.callbacks.append(resolve)
+                            return
+                    resolve()
+                else:
+                    loop.call_soon_threadsafe(
+                        fut.set_exception, self._lost_error(oid_b, exc))
+                return
             except BaseException as exc:  # noqa: BLE001
                 loop.call_soon_threadsafe(fut.set_exception, exc)
                 return
@@ -2445,6 +2817,7 @@ class Runtime:
         spec.request, spec.pg_wire = self._prepare_request(
             options, is_actor=False)
         self._cancellable[return_ids[0].binary()] = spec
+        self._record_lineage(spec)
         self._enqueue(spec)
 
     def _apply_worker_actor_call(self, actor_id_b, method, args_payload,
@@ -2515,11 +2888,27 @@ class Runtime:
                 self._mark_worker_blocked(w, cur_task)
             try:
                 for b, e in zip(oid_bytes_list, entries):
-                    remaining = None if deadline is None else max(
-                        0.0, deadline - time.monotonic())
-                    if not e.event.wait(remaining):
-                        raise GetTimeoutError("get() timed out in worker request")
-                    payloads[b] = e.payload
+                    while True:
+                        remaining = None if deadline is None else max(
+                            0.0, deadline - time.monotonic())
+                        if not e.event.wait(remaining):
+                            raise GetTimeoutError(
+                                "get() timed out in worker request")
+                        payload = e.payload
+                        if payload is None:
+                            # reset mid-reconstruction: wait for the
+                            # recomputed value
+                            continue
+                        if self._payload_lost(payload):
+                            if self._recover_object(b):
+                                continue
+                            # unrecoverable: ship the enriched error so
+                            # the worker's read raises it
+                            payload = protocol.serialize_value(
+                                protocol.ErrorValue(self._lost_error(b)),
+                                store=None)
+                        payloads[b] = payload
+                        break
             finally:
                 self._unmark_worker_blocked(w, cur_task)
             return ("ok", payloads)

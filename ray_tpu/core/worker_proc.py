@@ -501,6 +501,13 @@ class WorkerCore:
 
                 if random.random() < config.testing_kill_worker_prob:
                     os._exit(1)
+            from ray_tpu.core import fault_injection
+
+            if fault_injection.enabled() and fault_injection.fire(
+                    "task", fn_id.hex() if fn_id else "") == "exit":
+                # deterministic 'task' fault site (env-armed: workers
+                # inherit RTPU_FAULT_TASK from the driver)
+                os._exit(1)
             self.current_task_id = TaskID(task_id_b)
             saved_env = None
             try:

@@ -37,7 +37,28 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 
 
 class ObjectLostError(RayTpuError):
-    """The object's value was evicted and could not be reconstructed."""
+    """The object's value was lost and could not be reconstructed.
+
+    Task returns are normally recomputed transparently from lineage (up
+    to ``config.max_reconstructions`` attempts); this error surfaces only
+    for unrecoverable objects — ``ray_tpu.put`` values, eagerly freed
+    ids, lineage-evicted entries — or once the reconstruction budget is
+    exhausted. When the producing task is known, ``task_id`` carries its
+    hex id and ``attempts`` the reconstruction history (one string per
+    attempt, e.g. why it was retried or why it stopped).
+    """
+
+    def __init__(self, message: str = "", task_id: str = "",
+                 attempts=None):
+        self.task_id = task_id
+        self.attempts = list(attempts or [])
+        if task_id:
+            message += f" (producing task {task_id}"
+            if self.attempts:
+                message += ("; reconstruction attempts: "
+                            + "; ".join(self.attempts))
+            message += ")"
+        super().__init__(message)
 
 
 class WorkerCrashedError(RayTpuError):

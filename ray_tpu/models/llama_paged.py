@@ -5,7 +5,9 @@ Extends the static-slot design (models/llama_decode.py) the way vLLM's
 PagedAttention extends dense slot caches on GPU — re-thought for TPU
 static shapes:
 
-- The cache is a POOL: ``[layers, num_pages, page_size, kv_heads, hd]``.
+- The cache is a POOL: ``[L, P, KVH, page, hd]`` (layers, num_pages,
+  kv_heads, page_size, head_dim — (page, hd) minor so the Pallas
+  kernel's page blocks satisfy TPU tiling).
   A sequence owns an ordered page list (its block table, host-side).
   HBM cost tracks ACTUAL tokens in flight, not slots × max_len, so one
   chip holds far longer contexts; identical prompt prefixes share pages
@@ -280,12 +282,14 @@ def paged_decode_chunk(cfg: LlamaConfig, params,
     return cache, out, nxt, pos
 
 
-def make_paged_engine_fns(cfg: LlamaConfig, params, num_slots: int,
-                          page_size: int, num_pages: int, maxp: int,
-                          mesh=None, use_kernel: Optional[bool] = None):
+def make_paged_engine_fns(cfg: LlamaConfig, params, mesh=None,
+                          use_kernel: Optional[bool] = None):
     """Jitted paged-engine programs (params as jit ARGUMENTS — a closure
     would bake the weights into the HLO as literals; see
-    llama_decode.make_engine_fns).
+    llama_decode.make_engine_fns). Pool geometry (num_pages, page_size,
+    slot count) lives in the cache/block-table ARRAYS the returned
+    programs take, not here — the jitted programs specialize on those
+    shapes at first call.
 
     use_kernel: None → Pallas page-gather on a bare TPU, XLA gather under
     a mesh (GSPMD cannot shard a Pallas call) or off-TPU.

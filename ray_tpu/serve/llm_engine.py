@@ -324,6 +324,12 @@ class LLMEngine:
             [self._seed & 0xFFFFFFFF, self._key_ctr & 0xFFFFFFFF],
             np.uint32))
 
+    def _has_parked_requests(self) -> bool:
+        """Whether admission is holding requests outside ``_in`` (the
+        paged engine parks pool-exhausted requests for head-of-line
+        retry); saturation-sensitive decode chunking consults this."""
+        return False
+
     def _admit(self) -> bool:
         """Prefill waiting requests into free slots; returns True if any.
 
@@ -589,7 +595,7 @@ class LLMEngine:
         # run). An unpredictable mid-chunk EOS delays admission by one
         # chunk plus the pipeline depth at most.
         k = self._chunk_steps
-        if not self._in.empty():
+        if not self._in.empty() or self._has_parked_requests():
             to_finish = min(self._slot_budget[s] - self._sched[s]
                             for s in elig)
             k = max(1, min(k, to_finish))

@@ -170,8 +170,7 @@ class PagedLLMEngine(LLMEngine):
         self._alloc = _PageAllocator(num_pages, ps)
         self._prefill_chunk, self._decode_chunk = \
             llama_paged.make_paged_engine_fns(
-                self._cfg, self._params, self._num_slots, ps,
-                num_pages, self._maxp, mesh=self._mesh,
+                self._cfg, self._params, mesh=self._mesh,
                 use_kernel=self._use_kernel)
         self._cache = llama_paged.init_paged_cache(
             self._cfg, num_pages, ps, mesh=self._mesh)
@@ -189,6 +188,10 @@ class PagedLLMEngine(LLMEngine):
         self._bt_dev = None
         # paged admission is per-request (block tables are per-slot)
         self._admit_batch = 1
+        # pool-exhausted requests park here and retry HEAD-of-line, so a
+        # large request is never starved by a stream of smaller admits
+        # that would keep overtaking it at the back of ``_in``
+        self._retry: "collections.deque[tuple]" = collections.deque()
 
     def _reset_device_state(self):
         from ray_tpu.models import llama_paged
@@ -225,11 +228,14 @@ class PagedLLMEngine(LLMEngine):
 
         jnp = self._jnp
         admitted = False
-        while self._free and not self._in.empty():
-            try:
-                item = self._in.get_nowait()
-            except _q.Empty:
-                break
+        while self._free and (self._retry or not self._in.empty()):
+            if self._retry:
+                item = self._retry.popleft()
+            else:
+                try:
+                    item = self._in.get_nowait()
+                except _q.Empty:
+                    break
             req_id, toks, max_new, t0, temp, stop = item
             with self._done_lock:
                 if self._cancelled.pop(req_id, None) is not None:
@@ -247,18 +253,29 @@ class PagedLLMEngine(LLMEngine):
                 toks = toks[: self._max_len - 1]
             plen = len(toks)
             ps = self._page_size
+            total_pages = -(-plen // ps)
+            if total_pages > self._alloc.num_pages:
+                # no amount of decode finishes can ever free enough
+                # pages — requeueing would livelock admission forever
+                with self._done_lock:
+                    self._done[req_id] = RuntimeError(
+                        f"prompt needs {total_pages} KV pages but the "
+                        f"pool has only {self._alloc.num_pages}; raise "
+                        f"num_pages or shorten the prompt")
+                continue
             # at least the prompt's LAST token must run through
             # prefill (its logits seed generation) — cap the match
             shared, hashes, matched = self._alloc.match_prefix(
                 toks, plen - 1)
-            need = -(-plen // ps) - len(shared)
+            need = total_pages - len(shared)
             fresh = self._alloc.alloc(need)
             if fresh is None:
                 for pg in shared:
                     self._alloc.release(pg)
-                # pool exhausted: requeue and stop admitting; decode
-                # finishes will free pages
-                self._in.put(item)
+                # pool exhausted: park head-of-line and stop admitting;
+                # decode finishes will free pages and this request gets
+                # first claim on them
+                self._retry.appendleft(item)
                 break
             slot = self._free.pop()
             pages = shared + fresh
@@ -292,6 +309,9 @@ class PagedLLMEngine(LLMEngine):
                 "firsts": firsts, "batch": [(req_id, slot)]}))
             admitted = True
         return admitted
+
+    def _has_parked_requests(self) -> bool:
+        return bool(self._retry)
 
     def _set_bt_row(self, slot: int, pages: List[int]):
         self._bt_np[slot, :] = 0
@@ -416,6 +436,7 @@ class PagedLLMEngine(LLMEngine):
 
     def stats(self) -> dict:
         st = super().stats()
+        st["queued"] += len(self._retry)  # parked pool-exhausted requests
         st.update(
             free_pages=len(self._alloc.free),
             cached_prefix_pages=len(self._alloc.lru),

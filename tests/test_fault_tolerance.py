@@ -450,3 +450,227 @@ def test_spill_to_fsspec_uri_backends(local_ray, tmp_path):
             runtime_context.set_core(None)
             os.environ.pop("RTPU_SPILL_DIR", None)
             config.reload()
+
+
+# ---------------------------------------------------------------------------
+# lineage reconstruction: task-produced objects lost to eviction, spill-file
+# loss, or corruption are transparently recomputed from recorded lineage;
+# losses are injected deterministically via core.fault_injection.
+
+
+@pytest.fixture
+def fault_injection():
+    from ray_tpu.core import fault_injection as fi
+
+    fi.clear()
+    yield fi
+    fi.clear()
+
+
+def _payload(x):
+    # > the 100KB inline threshold, so results land in the shm store
+    # (inline payloads ride in the object table and cannot be "lost")
+    return list(range(x, x + 50_000))
+
+
+def test_reconstruct_evicted_shm_object(local_ray, fault_injection):
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+    core = runtime_context.get_core()
+
+    @ray_tpu.remote
+    def produce(x):
+        return _payload(x)
+
+    ref = produce.remote(7)
+    want = ray_tpu.get(ref, timeout=60)
+    assert fi.evict_object(core, ref), "eviction should remove the container"
+    assert ray_tpu.get(ref, timeout=60) == want
+
+
+def test_reconstruct_deleted_spill_file(local_ray, fault_injection):
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+    core = runtime_context.get_core()
+
+    @ray_tpu.remote
+    def produce(x):
+        return _payload(x)
+
+    ref = produce.remote(9)
+    want = ray_tpu.get(ref, timeout=60)
+    assert fi.spill_object(core, ref), "object should spill on demand"
+    assert fi.delete_spill_file(core, ref)
+    assert ray_tpu.get(ref, timeout=60) == want
+
+
+def test_reconstruct_corrupt_spill_file(local_ray, fault_injection):
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+    core = runtime_context.get_core()
+
+    @ray_tpu.remote
+    def produce(x):
+        return _payload(x)
+
+    ref = produce.remote(13)
+    want = ray_tpu.get(ref, timeout=60)
+    assert fi.spill_object(core, ref)
+    assert fi.corrupt_spill_file(core, ref)
+    # the file still exists and stats fine — only decode notices
+    assert ray_tpu.get(ref, timeout=60) == want
+
+
+def test_reconstruct_chained_lineage(local_ray, fault_injection):
+    """Recovering y whose dep x is ALSO lost resubmits both, in order."""
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+    core = runtime_context.get_core()
+
+    @ray_tpu.remote
+    def produce(x):
+        return _payload(x)
+
+    @ray_tpu.remote
+    def double(v):
+        return [n * 2 for n in v]
+
+    x = produce.remote(1)
+    y = double.remote(x)
+    want = ray_tpu.get(y, timeout=60)
+    assert fi.evict_object(core, x)
+    assert fi.evict_object(core, y)
+    assert ray_tpu.get(y, timeout=60) == want
+
+
+def test_max_reconstructions_zero_names_producing_task(
+        local_ray, fault_injection):
+    from ray_tpu.core.config import config
+    from ray_tpu.exceptions import ObjectLostError
+
+    fi = fault_injection
+    os.environ["RTPU_MAX_RECONSTRUCTIONS"] = "0"
+    config.reload()
+    try:
+        ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+        core = runtime_context.get_core()
+
+        @ray_tpu.remote
+        def produce(x):
+            return _payload(x)
+
+        ref = produce.remote(21)
+        ray_tpu.get(ref, timeout=60)
+        assert fi.evict_object(core, ref)
+        with pytest.raises(ObjectLostError) as ei:
+            ray_tpu.get(ref, timeout=60)
+        # deterministic failure must NAME the producing task
+        assert ei.value.task_id, "error should carry the producing task id"
+        assert "task" in str(ei.value)
+    finally:
+        os.environ.pop("RTPU_MAX_RECONSTRUCTIONS", None)
+        config.reload()
+
+
+def test_reconstruction_budget_exhaustion(local_ray, fault_injection):
+    """Repeated injected loss at the get site burns the whole budget,
+    then surfaces ObjectLostError with the attempt history."""
+    from ray_tpu.core.config import config
+    from ray_tpu.exceptions import ObjectLostError
+
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote
+    def produce(x):
+        return _payload(x)
+
+    ref = produce.remote(33)
+    ray_tpu.get(ref, timeout=60)
+    fi.inject("get", "evict", target=ref.id.hex(), times=-1)
+    with pytest.raises(ObjectLostError) as ei:
+        ray_tpu.get(ref, timeout=120)
+    assert ei.value.task_id
+    assert len(ei.value.attempts) == config.max_reconstructions
+    assert "budget" in str(ei.value)
+
+
+def test_free_means_dead_no_reconstruction(local_ray, fault_injection):
+    from ray_tpu.exceptions import ObjectLostError
+
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote
+    def produce(x):
+        return _payload(x)
+
+    ref = produce.remote(41)
+    ray_tpu.get(ref, timeout=60)
+    assert ray_tpu.free([ref]) == 1
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_put_objects_not_reconstructed(local_ray, fault_injection):
+    from ray_tpu.exceptions import ObjectLostError
+
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+    core = runtime_context.get_core()
+    ref = ray_tpu.put(_payload(0))
+    assert fi.evict_object(core, ref)
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_fault_injection_env_surface(local_ray):
+    """RTPU_FAULT_<SITE> env specs arm the same deterministic hooks."""
+    from ray_tpu.core import fault_injection as fi
+
+    os.environ["RTPU_FAULT_GET"] = "evict:1"
+    try:
+        assert fi.load_env() == 1
+        ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+        @ray_tpu.remote
+        def produce(x):
+            return _payload(x)
+
+        ref = produce.remote(55)
+        want_first = _payload(55)
+        # the armed fault evicts exactly once at the get site; the value
+        # still comes back via reconstruction
+        assert ray_tpu.get(ref, timeout=60) == want_first
+        assert ray_tpu.get(ref, timeout=60) == want_first
+    finally:
+        os.environ.pop("RTPU_FAULT_GET", None)
+        fi.clear()
+
+
+def test_lineage_evicted_past_budget_not_reconstructed(
+        local_ray, fault_injection):
+    """With a zero lineage byte budget every entry is evicted on
+    insert, so a lost object is unrecoverable — and says why."""
+    from ray_tpu.core.config import config
+    from ray_tpu.exceptions import ObjectLostError
+
+    fi = fault_injection
+    os.environ["RTPU_LINEAGE_MAX_BYTES"] = "0"
+    config.reload()
+    try:
+        ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+        core = runtime_context.get_core()
+
+        @ray_tpu.remote
+        def produce(x):
+            return _payload(x)
+
+        ref = produce.remote(61)
+        ray_tpu.get(ref, timeout=60)
+        assert fi.evict_object(core, ref)
+        with pytest.raises(ObjectLostError) as ei:
+            ray_tpu.get(ref, timeout=60)
+        assert "lineage" in str(ei.value)
+    finally:
+        os.environ.pop("RTPU_LINEAGE_MAX_BYTES", None)
+        config.reload()

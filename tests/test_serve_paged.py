@@ -226,3 +226,56 @@ def test_paged_engine_cancel_releases_pages():
         assert eng.collect() == {}
     finally:
         eng.shutdown()
+
+
+def test_oversized_prompt_rejected_not_livelocked():
+    """A prompt needing more pages than the POOL HAS can never admit;
+    it must fail fast with RuntimeError instead of requeueing forever —
+    and must not wedge admission for satisfiable requests behind it."""
+    from ray_tpu.serve.paged_engine import PagedLLMEngine
+
+    rng = np.random.default_rng(13)
+    eng = PagedLLMEngine(page_size=8, num_pages=4, **TINY)
+    try:
+        # 40 tokens -> 5 pages > the 4-page pool
+        eng.submit("huge", [int(t) for t in rng.integers(1, 250, 40)])
+        eng.submit("ok", [int(t) for t in rng.integers(1, 250, 9)])
+        out = {}
+        deadline = time.time() + 120
+        while len(out) < 2 and time.time() < deadline:
+            out.update(eng.collect())
+            time.sleep(0.01)
+        assert isinstance(out.get("huge"), RuntimeError)
+        assert "pages" in str(out["huge"])
+        assert len(out["ok"]["tokens"]) == 8
+    finally:
+        eng.shutdown()
+
+
+def test_pool_exhausted_retry_is_head_of_line():
+    """A pool-exhausted request parks and retries BEFORE newer arrivals:
+    the big request admits as soon as pages free, instead of being
+    overtaken indefinitely by a stream of small admits."""
+    from ray_tpu.serve.paged_engine import PagedLLMEngine
+
+    rng = np.random.default_rng(17)
+    eng = PagedLLMEngine(page_size=8, num_pages=8, **TINY)
+    try:
+        eng.submit("s0", [int(t) for t in rng.integers(1, 250, 9)])
+        time.sleep(0.3)  # let s0 admit and hold its pages
+        # 49 tokens -> 7 pages: satisfiable alone, parked while s0 runs
+        eng.submit("big", [int(t) for t in rng.integers(1, 250, 49)])
+        for i in range(1, 4):
+            eng.submit(f"s{i}", [int(t) for t in rng.integers(1, 250, 9)])
+        order = []
+        deadline = time.time() + 180
+        while len(order) < 5 and time.time() < deadline:
+            for rid in eng.collect():
+                order.append(rid)
+            time.sleep(0.01)
+        assert sorted(order) == ["big", "s0", "s1", "s2", "s3"]
+        # head-of-line: big admitted at s0's page release, ahead of the
+        # smalls submitted after it
+        assert order.index("big") < order.index("s1")
+    finally:
+        eng.shutdown()
