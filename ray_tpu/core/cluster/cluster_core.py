@@ -172,11 +172,17 @@ class ClusterCore:
                         # GCS restarted and lost the registry: re-register
                         self.gcs.call(
                             ("register_driver", self._driver_id, {}))
-                except (RpcError, Exception):  # noqa: BLE001
+                # rtpu-lint: disable=L4 — crash-proof daemon loop: call()
+                # re-raises arbitrary picklable remote exceptions, and a
+                # missed heartbeat during a GCS restart must not kill the
+                # death watch (the next tick retries)
+                except Exception:  # noqa: BLE001
                     pass
             try:
                 deaths = self.gcs.call(("deaths_since", self._death_seq))
-            except (RpcError, Exception):  # noqa: BLE001
+            # rtpu-lint: disable=L4 — same: any poll failure (GCS down,
+            # mid-restart, remote error) just means try again next tick
+            except Exception:  # noqa: BLE001
                 continue
             self._drain_freed_channel()
             for seq, node_id in deaths:
@@ -671,7 +677,10 @@ class ClusterCore:
                     r, _ = self._nodes.get(addr).call(
                         ("wait", oids, len(oids), step))
                     ready_set.update(r)
-                except (RpcError, Exception):  # noqa: BLE001
+                # rtpu-lint: disable=L4 — one node failing its poll slice
+                # (dying, restarting) must not fail the whole wait(); its
+                # objects just stay not-ready until the next round
+                except Exception:  # noqa: BLE001
                     pass
 
             threads = [threading.Thread(target=poll, args=(a, o))
@@ -756,10 +765,10 @@ class ClusterCore:
                 }))
                 with self._lock:
                     self._gcs_owned.add(actor_id)
-            except (RpcError, Exception):  # noqa: BLE001
-                # registration failed (GCS outage window): the driver
-                # keeps restart authority — never leave the actor with
-                # NO restart owner
+            # rtpu-lint: disable=L4 — registration failed (GCS outage
+            # window): the driver keeps restart authority — never leave
+            # the actor with NO restart owner
+            except Exception:  # noqa: BLE001
                 pass
         return actor_id
 
@@ -896,6 +905,9 @@ class ClusterCore:
             for addr, local_pg_b in created:
                 try:
                     self._nodes.get(addr).call(("pg", "remove", local_pg_b))
+                # rtpu-lint: disable=L4 — best-effort rollback of the
+                # partially created group; the original placement error
+                # re-raises below regardless
                 except Exception:  # noqa: BLE001
                     pass
             raise
@@ -952,7 +964,9 @@ class ClusterCore:
         for addr, local_pg_b in pg.node_pgs.items():
             try:
                 self._nodes.get(addr).call(("pg", "remove", local_pg_b))
-            except (RpcError, Exception):  # noqa: BLE001
+            # rtpu-lint: disable=L4 — removal on a dead/unreachable node
+            # is moot (its reservations died with it); remove the rest
+            except Exception:  # noqa: BLE001
                 pass
         with self._lock:
             self._pgs.pop(pg_id, None)
@@ -1059,6 +1073,8 @@ class ClusterCore:
         if self._home_store is not None:
             try:
                 self._home_store.close()
+            # rtpu-lint: disable=L4 — shutdown path: keep tearing the
+            # rest of the cluster down whatever state the store is in
             except Exception:  # noqa: BLE001
                 pass
         self._nodes.close_all()

@@ -35,7 +35,8 @@ from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID, make_task_id
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.runtime import Runtime, _TaskSpec
-from ray_tpu.exceptions import ActorDiedError, ObjectLostError
+from ray_tpu.exceptions import (ActorDiedError, ObjectLostError,
+                                ObjectStoreFullError)
 
 # Tag prefix for ops; kept as plain strings (framed pickle transport).
 
@@ -72,8 +73,8 @@ def store_incoming(runtime: Runtime, oid: ObjectID, data: bytes):
             runtime.store.put(oid, data, retain=True)
             runtime._store_payload(oid, ("shm", oid.binary()))
             return
-        except Exception:  # noqa: BLE001 — store full: keep inline
-            pass
+        except (ObjectStoreFullError, ValueError, OSError):
+            pass  # store full/closed: keep the object inline instead
     runtime._store_payload(oid, ("inline", data))
 
 
@@ -1023,13 +1024,19 @@ class NodeServer:
             try:
                 deaths = self.gcs.call(
                     ("driver_deaths_since", self._driver_death_seq))
-            except (RpcError, Exception):  # noqa: BLE001
+            # rtpu-lint: disable=L4 — crash-proof daemon loop: call()
+            # re-raises arbitrary picklable remote exceptions, and a
+            # failed poll (GCS down/restarting) just retries next tick
+            except Exception:  # noqa: BLE001
                 continue
             for seq, driver_id in deaths:
                 self._driver_death_seq = max(self._driver_death_seq, seq)
                 try:
                     self._reclaim_owner(driver_id)
-                except Exception:  # noqa: BLE001 — cleanup is best-effort
+                # rtpu-lint: disable=L4 — cleanup is best-effort: a
+                # partly-reclaimed owner must not wedge the watch loop;
+                # unfreed ids are re-reported on the next death record
+                except Exception:  # noqa: BLE001
                     pass
 
     def _reclaim_owner(self, driver_id: bytes):
@@ -1047,6 +1054,8 @@ class NodeServer:
         for aid_b in dead_actors:
             try:
                 self.runtime.kill_actor(ActorID(aid_b), no_restart=True)
+            # rtpu-lint: disable=L4 — the actor may already be dead or
+            # mid-restart; reclaim must still process the remaining ones
             except Exception:  # noqa: BLE001
                 pass
 
@@ -1151,6 +1160,9 @@ class NodeServer:
                 if had_pin:
                     rt.store.release(oid)
                 rt.store.delete(oid)
+            # rtpu-lint: disable=L4 — the object may have been evicted or
+            # the store closed under us; release is best-effort and the
+            # location drop below must still be published
             except Exception:  # noqa: BLE001
                 pass
             self.gcs.try_call(("loc_drop", b, self.address))
@@ -1284,6 +1296,8 @@ class NodeServer:
         self._server.close()
         try:
             self.runtime.shutdown()
+        # rtpu-lint: disable=L4 — node teardown: whatever state the
+        # runtime is in, the peers and GCS client still get closed
         except Exception:  # noqa: BLE001
             pass
         self._peers.close_all()

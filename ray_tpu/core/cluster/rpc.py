@@ -59,11 +59,13 @@ def _timed_handshake(conn, authkey: bytes, *, server_side: bool,
                     s.shutdown(socket.SHUT_RDWR)
                 finally:
                     s.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, ValueError):
+                # handshake already finished and closed the conn under us
+                # (fileno on a closed Connection) — nothing left to unblock
                 pass
             try:
                 conn.close()
-            except Exception:  # noqa: BLE001
+            except OSError:
                 pass
 
     threading.Thread(target=watchdog, daemon=True,
@@ -128,10 +130,13 @@ class RpcServer:
         ctx: dict = {}
         try:
             _timed_handshake(conn, self._authkey, server_side=True)
+        # rtpu-lint: disable=L4 — any handshake failure (bad key, stall,
+        # peer death, watchdog-forced EOF) means the same thing: drop the
+        # connection; the server must survive arbitrary garbage from peers
         except Exception:  # noqa: BLE001 — bad key / stalled / died
             try:
                 conn.close()
-            except Exception:  # noqa: BLE001
+            except OSError:
                 pass
             return
         try:
@@ -158,18 +163,21 @@ class RpcServer:
             if on_close is not None:
                 try:
                     on_close()
+                # rtpu-lint: disable=L4 — on_close is an arbitrary
+                # handler-registered callback; a buggy one must not take
+                # down the connection teardown path with it
                 except Exception:  # noqa: BLE001
                     pass
             try:
                 conn.close()
-            except Exception:  # noqa: BLE001
+            except OSError:
                 pass
 
     def close(self):
         self._stop = True
         try:
             self._listener.close()
-        except Exception:  # noqa: BLE001
+        except OSError:
             pass
 
 
@@ -247,7 +255,7 @@ class RpcClient:
                 except Exception as he:
                     try:
                         conn.close()
-                    except Exception:  # noqa: BLE001
+                    except OSError:
                         pass
                     from multiprocessing import AuthenticationError
                     if isinstance(he, AuthenticationError):
@@ -280,7 +288,7 @@ class RpcClient:
         except (EOFError, OSError, BrokenPipeError) as e:
             try:
                 conn.close()
-            except Exception:  # noqa: BLE001
+            except OSError:
                 pass
             # same-address retry: a pooled connection that fails almost
             # certainly died while parked (server restart) — drop the
@@ -299,7 +307,7 @@ class RpcClient:
                 for c in stale:
                     try:
                         c.close()
-                    except Exception:  # noqa: BLE001
+                    except OSError:
                         pass
                 conn = self._connect()
                 try:
@@ -308,7 +316,7 @@ class RpcClient:
                 except (EOFError, OSError, BrokenPipeError) as e2:
                     try:
                         conn.close()
-                    except Exception:  # noqa: BLE001
+                    except OSError:
                         pass
                     raise RpcError(
                         f"rpc to {self.address} failed: {e2}") from e2
@@ -337,7 +345,7 @@ class RpcClient:
         for conn in pool:
             try:
                 conn.close()
-            except Exception:  # noqa: BLE001
+            except OSError:
                 pass
 
 

@@ -15,6 +15,14 @@ Usage::
 
 ``python -m ray_tpu.core.config`` prints the full table with docs,
 defaults, and current values.
+
+This table is *enforced*: ``python -m ray_tpu.tools.lint`` (rule L3)
+statically checks that every ``config.<attr>`` read in the package
+resolves to a ``Flag`` row here, that no row is dead (unread), and
+that every literal ``RTPU_*`` env read elsewhere maps to a flag's
+env var, a fault-injection site, or ``WIRING_ENV_VARS`` below — the
+Python stand-in for the build error an unknown ``RAY_CONFIG`` name
+raises in the reference.
 """
 
 from __future__ import annotations
@@ -43,6 +51,11 @@ def _parse_bool(s: str) -> bool:
 # The table. Keep alphabetized within each section.
 _FLAGS: List[Flag] = [
     # ---- core runtime ----------------------------------------------------
+    Flag("assume_tpu", bool, False,
+         "Treat this host as having a TPU even when libtpu detection "
+         "fails (CI containers, forced-TPU test paths). Read at call "
+         "time directly from RTPU_ASSUME_TPU in resources.detect(), not "
+         "via config resolution, so late env changes take effect."),
     Flag("fault_dump_after_s", float, 0.0,
          "If > 0, every worker dumps all thread stacks to "
          "/tmp/rtpu_worker_dump_<pid>.txt after this many seconds "
@@ -60,6 +73,17 @@ _FLAGS: List[Flag] = [
          "Default shm store capacity as a fraction of system RAM when "
          "object_store_memory is not passed to init() (reference: "
          "object_store_memory default heuristic in services.py)."),
+    Flag("store_lib", str, "",
+         "Path to a prebuilt object-store shared library, overriding "
+         "the bundled/compiled one (store-corruption tests, custom "
+         "builds). Read at call time from RTPU_STORE_LIB in "
+         "object_store.store._load_lib, not via config resolution, "
+         "because store subprocesses receive it through their env."),
+    Flag("tpu_topology", str, "",
+         "Override the detected TPU topology string (e.g. '2x2x1'), "
+         "for scheduling tests on hosts without the real topology. "
+         "Read at call time from RTPU_TPU_TOPOLOGY in "
+         "resources.detect(), not via config resolution."),
     Flag("worker_register_timeout_s", float, 30.0,
          "How long wait_for_workers waits for the pool to come up."),
     Flag("worker_shutdown_grace_s", float, 2.0,
@@ -80,6 +104,12 @@ _FLAGS: List[Flag] = [
          "for ray_tpu.timeline() chrome-trace export (reference: "
          "RAY_task_events_* flags + ray.timeline, "
          "python/ray/_private/state.py chrome_tracing_dump)."),
+    Flag("usage_stats_enabled", bool, False,
+         "Opt IN to the local usage-stats stub (reference: "
+         "RAY_usage_stats_enabled, usage_stats_head.py — but inverted "
+         "to opt-in, and nothing ever leaves the machine). Read at call "
+         "time from RTPU_USAGE_STATS_ENABLED in usage_stats.enabled(), "
+         "not via config resolution, so tests can flip it per-call."),
     # ---- fault tolerance -------------------------------------------------
     Flag("task_max_retries", int, 3,
          "Default retry budget for tasks whose worker died mid-execution "
@@ -188,6 +218,28 @@ _FLAGS: List[Flag] = [
 ]
 
 _BY_NAME: Dict[str, Flag] = {f.name: f for f in _FLAGS}
+
+# Per-process plumbing injected by whichever process spawns another:
+# addresses, auth material, identities. These are NOT user tunables (no
+# Flag row, no default, no reload()); they exist so the rtpu-lint L3
+# analyzer — and readers — can tell a registered wiring variable from a
+# stray/undeclared RTPU_* env read. Keep alphabetized.
+WIRING_ENV_VARS: Dict[str, str] = {
+    "RTPU_ADDRESS": "driver/GCS RPC address handed to spawned workers "
+                    "and attached drivers (host:port)",
+    "RTPU_AUTH": "hex authkey for the driver<->worker control plane, "
+                 "generated per session by the spawner",
+    "RTPU_CLUSTER_AUTHKEY": "hex authkey shared by every cluster "
+                            "process (see rpc.cluster_authkey: no "
+                            "default, deliberately)",
+    "RTPU_NODE_ID": "id of the node a spawned worker belongs to",
+    "RTPU_PKG_DIR": "working-dir package root a worker unpacked its "
+                    "runtime env into (set by runtime_env activation)",
+    "RTPU_STORE": "object-store shm segment name handed to workers",
+    "RTPU_WORKER_ID": "id the spawner assigned this worker process",
+    "RTPU_WORKER_PIP_KEY": "cache key of the pip runtime env a worker "
+                           "was launched under (env pool accounting)",
+}
 
 
 class _Config:

@@ -179,7 +179,10 @@ def evict_object(core, ref) -> bool:
         if pinned:
             core.store.release(oid)
         core.store.delete(oid)
-    except Exception:  # noqa: BLE001 — already gone
+    # rtpu-lint: disable=L4 — chaos helper: the object being already
+    # evicted/spilled/closed-with-the-store all count as "gone", which
+    # is the success condition checked below
+    except Exception:  # noqa: BLE001
         pass
     return not core.store.contains(oid)
 

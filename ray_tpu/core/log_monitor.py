@@ -63,6 +63,9 @@ class LogMonitor:
         while not self._stop.wait(self._interval):
             try:
                 self.poll_once()
+            # rtpu-lint: disable=L4 — crash-proof daemon loop: losing the
+            # log monitor silently drops all worker output for the rest
+            # of the session; whatever one poll hit, the next one retries
             except Exception:  # noqa: BLE001 — never kill the monitor
                 pass
 
@@ -111,11 +114,11 @@ class LogMonitor:
             try:
                 text = line.decode("utf-8", errors="replace")
                 sink.write(f"(worker={wid}{node} {kind}) {text}\n")
-            except Exception:  # noqa: BLE001
-                return
+            except (OSError, ValueError):
+                return  # sink closed (interpreter teardown) — stop emitting
         try:
             sink.flush()
-        except Exception:  # noqa: BLE001
+        except (OSError, ValueError):
             pass
 
 

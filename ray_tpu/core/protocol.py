@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core import serialization
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_ref import ObjectRef, begin_ref_collection, end_ref_collection
+from ray_tpu.exceptions import ObjectStoreFullError
 
 # driver -> worker (task conn)
 MSG_REGISTER_FN = "reg_fn"         # (MSG_REGISTER_FN, fn_id, pickled_fn)
@@ -137,8 +138,8 @@ def _store_or_inline(pickled, views, total, store) -> Payload:
             serialization.write_container(dst, pickled, views)
             store.seal(oid, retain=True)
             return ("shm", oid.binary())
-        except Exception:
-            pass  # fall back to inline on store pressure
+        except (ObjectStoreFullError, ValueError, OSError):
+            pass  # store full/closed even after spilling: fall back to inline
     out = bytearray(total)
     serialization.write_container(memoryview(out), pickled, views)
     return ("inline", bytes(out))
@@ -190,7 +191,10 @@ class _Pin:
         if self.count == 0:
             try:
                 self._store.release(self._oid)
-            except Exception:
+            # rtpu-lint: disable=L4 — runs from zero-copy buffer
+            # finalizers, possibly during interpreter teardown with the
+            # store already closed; a pin release must never raise there
+            except Exception:  # noqa: BLE001
                 pass
 
 

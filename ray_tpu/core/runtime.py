@@ -559,7 +559,7 @@ class Runtime:
             except Exception:  # noqa: BLE001 — zygote wedged: drop it
                 try:
                     z.kill()
-                except Exception:  # noqa: BLE001
+                except OSError:
                     pass
                 self._zygote = None
                 return None
@@ -671,7 +671,7 @@ class Runtime:
         except Exception:  # noqa: BLE001 — died mid-handshake
             try:
                 conn.close()
-            except Exception:  # noqa: BLE001
+            except OSError:
                 pass
             return
         if hello[0] != "hello":
@@ -886,6 +886,9 @@ class Runtime:
 
     def _send_msg(self, w: _Worker, msg) -> None:
         with w.send_lock:
+            # rtpu-lint: disable=L2 — send_lock exists precisely to
+            # serialize frames on this worker's task_conn; nothing else
+            # is ever taken under it, so it cannot participate in a cycle
             w.task_conn.send(msg)
 
     def _ensure_fn_on_worker(self, w: _Worker, fn_id: bytes):
@@ -954,6 +957,9 @@ class Runtime:
             # extra pins beyond the adopted creator ref take a real one
             try:
                 self.store.get(ObjectID(oid_b), timeout_ms=0)
+            # rtpu-lint: disable=L4 — best-effort extra pin: if the
+            # container already left the store (evicted/spilled), the
+            # task's dependency resolution recovers it anyway
             except Exception:  # noqa: BLE001
                 pass
 
@@ -972,6 +978,9 @@ class Runtime:
             self.store.release(oid)
             if n <= 0 and delete:
                 self.store.delete(oid)
+        # rtpu-lint: disable=L4 — the container may have been spilled,
+        # freed, or the store closed mid-shutdown; all mean the pin is
+        # already moot
         except Exception:  # noqa: BLE001
             pass
 
@@ -1017,6 +1026,9 @@ class Runtime:
                     try:
                         self.store.release(oid)
                         self.store.delete(oid)
+                    # rtpu-lint: disable=L4 — already evicted or store
+                    # closed: either way the object is gone, which is
+                    # what free() wants
                     except Exception:  # noqa: BLE001
                         pass
                 else:
@@ -1084,6 +1096,9 @@ class Runtime:
             del view
             try:
                 self.store.release(oid)  # the read pin just taken
+            # rtpu-lint: disable=L4 — pin release on a store that may be
+            # closing; failing to release cannot be worse than raising
+            # out of the spill path
             except Exception:  # noqa: BLE001
                 pass
         with self._lock:
@@ -1104,6 +1119,9 @@ class Runtime:
         try:
             self.store.release(oid)  # the tracking pin
             self.store.delete(oid)
+        # rtpu-lint: disable=L4 — the shm copy just became redundant
+        # (payload points at the spill file); if reclaim races a close
+        # or eviction the copy is gone anyway
         except Exception:  # noqa: BLE001
             pass
         if fault_injection.enabled():
@@ -1821,6 +1839,9 @@ class Runtime:
                         self.store.get(ObjectID(data), timeout_ms=0)
                         spec.dep_pins.append(data)
                         pinned = True
+                    # rtpu-lint: disable=L4 — pin miss (raced a spill or
+                    # eviction) is an expected outcome: the not-pinned
+                    # branch below re-reads the entry and recovers
                     except Exception:  # noqa: BLE001
                         pass
                 if spec is not None and not pinned:
@@ -1852,6 +1873,8 @@ class Runtime:
         for oid_b in pins:
             try:
                 self.store.release(ObjectID(oid_b))
+            # rtpu-lint: disable=L4 — flight-pin release races frees and
+            # store shutdown; a stale pin on a gone object is a no-op
             except Exception:  # noqa: BLE001
                 pass
 
@@ -1874,6 +1897,9 @@ class Runtime:
                 if self.store.contains(rid):
                     self.store.release(rid)
                     self.store.delete(rid)
+            # rtpu-lint: disable=L4 — reaping after a worker crash is
+            # best-effort: a container that cannot be reclaimed now is
+            # only a leak, and raising would abort the death handling
             except Exception:  # noqa: BLE001
                 pass
 
@@ -2027,8 +2053,8 @@ class Runtime:
             if retire_env:
                 try:
                     self._send_msg(w, (protocol.MSG_SHUTDOWN,))
-                except Exception:  # noqa: BLE001
-                    pass
+                except (OSError, EOFError, BrokenPipeError):
+                    pass  # already exiting on its own
             else:
                 self._dispatch_env(w.env_key)
             return
@@ -2252,6 +2278,9 @@ class Runtime:
                 try:
                     self._mark_actor_dead(state, ActorDiedError(
                         f"actor failed to start: {e!r}"))
+                # rtpu-lint: disable=L4 — crash-proof daemon loop: the
+                # spawner thread serves every actor; failing to mark one
+                # dead must not stop it from starting the rest
                 except Exception:  # noqa: BLE001
                     pass
 
@@ -2327,6 +2356,9 @@ class Runtime:
         try:
             self._spawn_worker()
             return
+        # rtpu-lint: disable=L4 — spawn can fail many ways (fork EAGAIN,
+        # racing shutdown); the deficit below records the debt so a later
+        # caller retries, which beats failing THIS task submission
         except Exception:  # noqa: BLE001 — racing shutdown
             pass
         with self._lock:
@@ -3179,6 +3211,9 @@ class Runtime:
                             if w.alive and w.proc is not None]
                 if mon.usage_fraction(pids) >= config.memory_usage_threshold:
                     self._kill_for_memory()
+            # rtpu-lint: disable=L4 — crash-proof daemon loop: losing
+            # the monitor silently disables OOM protection for the rest
+            # of the session; one bad poll just skips a tick
             except Exception:  # noqa: BLE001 — monitoring must not die
                 pass
 
@@ -3240,6 +3275,9 @@ class Runtime:
                     except (ProcessLookupError, PermissionError):
                         pass
             victim.proc.kill()
+        # rtpu-lint: disable=L4 — the victim (or its /proc entries) may
+        # vanish mid-walk; an incomplete kill pass must not take the
+        # memory monitor down with it
         except Exception:  # noqa: BLE001
             pass
 
@@ -3298,8 +3336,8 @@ class Runtime:
             try:
                 self._zygote.stdin.close()  # EOF -> zygote exits
                 self._zygote.terminate()
-            except Exception:  # noqa: BLE001
-                pass
+            except (OSError, ValueError):
+                pass  # pipe already broken / zygote already gone
             self._zygote = None
         try:
             self._listener.close()

@@ -29,7 +29,7 @@ from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core import runtime_context
 from ray_tpu.core.object_store.store import ShmObjectStore
-from ray_tpu.exceptions import TaskError
+from ray_tpu.exceptions import ObjectStoreFullError, TaskError
 
 
 class WorkerCore:
@@ -92,8 +92,11 @@ class WorkerCore:
 
             time.sleep(random.uniform(0, config.testing_rpc_delay_ms / 1000))
         with self._data_lock:
+            # rtpu-lint: disable=L2 — _data_lock must span send+recv:
+            # data_conn is shared by every thread in this worker, and the
+            # lock is what pairs each request with its own response
             self.data_conn.send(msg)
-            reply = self.data_conn.recv()
+            reply = self.data_conn.recv()  # rtpu-lint: disable=L2 — see above
         if reply[0] == "err":
             err = protocol.deserialize_payload(reply[1], store=self.store)
             raise err.error if isinstance(err, protocol.ErrorValue) else err
@@ -109,6 +112,8 @@ class WorkerCore:
         returned ref can never reach the driver before its submission is
         applied (else ray.cancel on it would silently no-op)."""
         with self._data_lock:
+            # rtpu-lint: disable=L2 — _data_lock serializes frames on the
+            # shared data_conn (its whole purpose); no other lock nests here
             self.data_conn.send(msg)
         self._async_dirty = True
 
@@ -403,6 +408,9 @@ class WorkerCore:
                                               timeout_ms=5000)
                         outch.write(("e", err), timeout_ms=5000)
                         outs.append(outch)
+                    # rtpu-lint: disable=L4 — best-effort error fan-out:
+                    # a downstream peer that is itself dead cannot be
+                    # told; the remaining descriptors still get the error
                     except Exception:  # noqa: BLE001 — peer gone too
                         pass
                 for ch in ins + outs:
@@ -460,6 +468,8 @@ class WorkerCore:
         # calls concurrently; unsynchronized sends would interleave
         # Connection frames and corrupt the worker->driver protocol.
         with self._send_lock:
+            # rtpu-lint: disable=L2 — _send_lock exists to serialize
+            # result frames on task_conn (see comment above); leaf lock
             self.task_conn.send((protocol.MSG_DONE, task_id_b, payloads))
 
     def _serialize_result(self, value, rid: ObjectID):
@@ -474,8 +484,8 @@ class WorkerCore:
                 # retain: the ref is adopted by the owner's tracking pin
                 self.store.seal(rid, retain=True)
                 return ("shm", rid.binary())
-            except Exception:
-                pass
+            except (ObjectStoreFullError, ValueError, OSError):
+                pass  # store full/closed even after spilling: go inline
         out = bytearray(total)
         serialization.write_container(memoryview(out), pickled, views)
         return ("inline", bytes(out))
@@ -566,6 +576,8 @@ class WorkerCore:
 
     def _send_error(self, task_id_b: bytes, exc: BaseException):
         with self._send_lock:
+            # rtpu-lint: disable=L2 — _send_lock serializes frames on
+            # task_conn against concurrent _send_results; leaf lock
             self.task_conn.send(
                 (protocol.MSG_ERROR, task_id_b, self._error_payload(exc)))
 
@@ -735,6 +747,9 @@ def main():
                     f.write(f"\n--- thread {tid} ---\n")
                     f.write("".join(_tb.format_stack(fr)))
             os.replace(path + ".tmp", path)
+        # rtpu-lint: disable=L4 — signal-handler profiling hook: a failed
+        # stack dump (disk full, frames mutating underneath) must never
+        # kill the worker it is inspecting
         except Exception:  # noqa: BLE001 — profiling must never kill
             pass
 
@@ -784,8 +799,8 @@ def zygote_main():
             continue
         try:
             req = json.loads(line)
-        except Exception:  # noqa: BLE001
-            continue
+        except ValueError:
+            continue  # garbage on stdin: ignore, keep serving forks
         pid = os.fork()
         if pid == 0:
             # ---- child: become a normal worker ----

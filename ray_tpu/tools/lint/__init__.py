@@ -1,0 +1,44 @@
+"""rtpu-lint: AST-based invariant checker for ray_tpu.
+
+Four analyzers enforce invariants the reference runtime gets from its
+C++ toolchain and that otherwise live only in reviewers' heads:
+
+====  ==============================================================
+L1    protocol exhaustiveness — every ``MSG_*``/``REQ_*`` opcode in
+      ``core/protocol.py`` has a dispatch arm in the dispatcher that
+      must handle it, and dispatchers never compare the message tag
+      against undeclared string literals (silent opcode drift)
+L2    lock discipline — no indefinitely-blocking call (sleep,
+      conn recv/send, subprocess, queue get, future result, untimed
+      join) lexically inside a ``with <lock>:`` block in ``core/``
+L3    config/env hygiene — ``config.<attr>`` reads resolve to
+      declared ``Flag`` rows, no dead flags, and every literal
+      ``RTPU_*`` env read maps to a flag env var, a fault-injection
+      site, or ``config.WIRING_ENV_VARS``
+L4    exception discipline — no bare ``except:`` or do-nothing
+      ``except Exception:`` in ``core/``, and no handler drops an
+      ``ObjectLostError`` without re-raising/converting/reconstructing
+====  ==============================================================
+
+Run it::
+
+    python -m ray_tpu.tools.lint              # human-readable, exit 1 on findings
+    python -m ray_tpu.tools.lint --json       # machine-readable
+    python -m ray_tpu.tools.lint --baseline lint_baseline.json
+    python -m ray_tpu.tools.lint --write-baseline lint_baseline.json
+
+Suppress a deliberate violation at its site (justify it in the same
+comment)::
+
+    conn.send(msg)  # rtpu-lint: disable=L2 — send lock exists to serialize this send
+
+``tests/test_lint.py`` runs the checker over the tree in tier-1, so a
+new violation fails CI unless fixed or explicitly waived.
+"""
+
+from ray_tpu.tools.lint.base import Finding, RULES, SourceFile
+from ray_tpu.tools.lint.runner import (apply_baseline, collect_findings,
+                                       load_baseline, write_baseline)
+
+__all__ = ["Finding", "RULES", "SourceFile", "collect_findings",
+           "apply_baseline", "load_baseline", "write_baseline"]

@@ -1,0 +1,69 @@
+"""CLI for rtpu-lint. Exit codes: 0 clean, 1 findings, 2 usage or
+internal error."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ray_tpu.tools.lint import RULES, runner
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.tools.lint",
+        description="AST-based invariant checker for ray_tpu "
+                    "(rules: %s)" % ", ".join(
+                        f"{k}={v.split(':')[0]}" for k, v in RULES.items()))
+    parser.add_argument("--root", default=None,
+                        help="repo root to lint (default: the tree "
+                             "containing the installed ray_tpu package)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON array")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="only fail on findings NOT in this baseline "
+                             "file (grandfather existing ones)")
+    parser.add_argument("--write-baseline", default=None, metavar="FILE",
+                        help="write current findings to FILE and exit 0")
+    args = parser.parse_args(argv)
+
+    rules = [r for r in (args.rules or "").split(",") if r] or None
+    try:
+        findings = runner.collect_findings(root=args.root, rules=rules)
+    except Exception as e:  # noqa: BLE001 — CLI boundary: fold any
+        # analyzer crash into the documented exit-2 contract
+        print(f"rtpu-lint: internal error: {e!r}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        runner.write_baseline(args.write_baseline, findings)
+        print(f"rtpu-lint: wrote {len(findings)} finding key(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.baseline:
+        try:
+            baseline = runner.load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"rtpu-lint: cannot read baseline {args.baseline}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        findings = runner.apply_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps([f.to_dict() for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        word = "new finding(s)" if args.baseline else "finding(s)"
+        print(f"rtpu-lint: {len(findings)} {word}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

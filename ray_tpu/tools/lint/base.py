@@ -1,0 +1,142 @@
+"""Shared plumbing for rtpu-lint analyzers: parsed files, findings,
+and per-site suppression comments.
+
+The reference runtime gets several of these invariants for free from
+the C++ toolchain (exhaustive switches over message types, the
+RAY_CONFIG x-macro table making unknown flags a build error). This
+package recovers them for the Python reproduction with stdlib ``ast``
+passes — no third-party dependencies.
+
+Suppression: a finding is silenced by a ``# rtpu-lint: disable=RULE``
+comment (comma-separated rule ids, or ``all``) on the flagged line or
+anywhere in the contiguous comment block directly above it.
+Suppressions are deliberate per-site waivers and should carry a
+justification in the same comment block.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+SUPPRESS_RE = re.compile(r"#\s*rtpu-lint:\s*disable=([A-Za-z0-9_, ]+)")
+
+#: rule id -> one-line description (the CLI prints this table)
+RULES: Dict[str, str] = {
+    "L1": "protocol exhaustiveness: every opcode dispatched, no "
+          "undeclared opcode literals in dispatchers",
+    "L2": "lock discipline: no blocking calls inside lock-held regions",
+    "L3": "config/env hygiene: config reads resolve to declared flags, "
+          "no dead flags, RTPU_* env reads are registered",
+    "L4": "exception discipline: no bare/swallowing handlers, "
+          "ObjectLostError never silently dropped",
+}
+
+
+@dataclass
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    key: str = field(default="")
+
+    def __post_init__(self):
+        if not self.key:
+            # line-number-free so a baseline survives unrelated edits
+            self.key = f"{self.rule}:{self.path}:{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "key": self.key}
+
+
+class SourceFile:
+    """A parsed Python source file plus its suppression comments."""
+
+    def __init__(self, path: str, relpath: str, text: Optional[str] = None):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> set of suppressed rule ids (lower-cased "all" wildcard)
+        self._suppressions: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip().upper() for r in m.group(1).split(",")
+                         if r.strip()}
+                self._suppressions[i] = rules
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        """True when ``line`` — or the contiguous comment block directly
+        above it — carries a ``# rtpu-lint: disable=`` comment naming
+        ``rule``. Scanning the whole comment block lets a waiver span
+        multiple lines of justification."""
+
+        def hit(ln: int) -> bool:
+            rules = self._suppressions.get(ln)
+            return bool(rules and (rule.upper() in rules or "ALL" in rules))
+
+        if hit(line):
+            return True
+        ln = line - 1
+        while 1 <= ln <= len(self.lines) \
+                and self.lines[ln - 1].lstrip().startswith("#"):
+            if hit(ln):
+                return True
+            ln -= 1
+        return False
+
+
+def load_file(path: str, root: str) -> Optional[SourceFile]:
+    rel = os.path.relpath(path, root)
+    try:
+        return SourceFile(path, rel)
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+
+
+def iter_py_files(root: str, subdir: str = "") -> Iterable[str]:
+    """Yield .py files under root/subdir, skipping caches/hidden dirs."""
+    base = os.path.join(root, subdir) if subdir else root
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames
+                       if not d.startswith(".") and d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def enclosing_function_name(tree: ast.AST, target: ast.AST) -> str:
+    """Dotted name of the innermost function/class containing target
+    (for stable finding messages)."""
+    path: List[str] = []
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            new_stack = stack
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                new_stack = stack + [child.name]
+            if child is target:
+                path[:] = new_stack
+                return True
+            if visit(child, new_stack):
+                return True
+        return False
+
+    visit(tree, [])
+    return ".".join(path) or "<module>"

@@ -1,0 +1,214 @@
+"""L1 — protocol exhaustiveness.
+
+The reference runtime's C++ dispatch switches over RPC message enums are
+exhaustive at compile time; our Python dispatchers are ``if tag ==
+protocol.X`` chains that silently drop unknown opcodes. This analyzer
+recovers the compile-time guarantee:
+
+1. Parse the ``MSG_*``/``REQ_*`` constant table out of
+   ``core/protocol.py`` (the same regex ``protocol.schema()`` uses),
+   tracking each constant's direction section from the module's
+   ``# driver -> worker`` / ``# worker -> driver`` comment headers.
+2. Require a dispatch arm (a comparison against ``protocol.<NAME>``) for
+   every opcode in the dispatcher that must handle it:
+
+   - driver->worker ``MSG_*``  -> ``core/worker_proc.py``  (run_loop)
+   - worker->driver ``MSG_*``  -> ``core/runtime.py``      (recv loop)
+   - ``REQ_*`` (data conn)     -> ``core/runtime.py``      (_handle_data_request)
+
+   ``core/cluster/node_server.py`` intercepts a subset and delegates the
+   rest to ``Runtime``, so it is not required to be exhaustive.
+3. Opcode-drift: inside any function in a dispatcher file, once a
+   subject expression (``tag``, ``msg[0]``, ...) has been compared
+   against a ``protocol.`` constant, comparing the same subject against
+   a string literal that is NOT a declared opcode tag is an error — it
+   is either a typo'd opcode or an undeclared protocol extension.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ray_tpu.tools.lint.base import Finding, SourceFile
+
+CONST_RE = re.compile(
+    r'^((?:MSG|REQ)_\w+)\s*=\s*"([^"]+)"', re.M)
+D2W_RE = re.compile(r"driver\s*-+>\s*worker")
+W2D_RE = re.compile(r"worker\s*-+>\s*driver")
+
+#: requirement targets; keys are direction labels produced by
+#: parse_protocol_table, values are the dispatcher that must be
+#: exhaustive for constants in that direction.
+DISPATCH_TARGETS = {
+    ("MSG", "d2w"): "ray_tpu/core/worker_proc.py",
+    ("MSG", "w2d"): "ray_tpu/core/runtime.py",
+    ("REQ", "d2w"): "ray_tpu/core/runtime.py",
+    ("REQ", "w2d"): "ray_tpu/core/runtime.py",
+}
+
+#: dispatcher files whose string-literal comparisons are held to the
+#: declared-opcode rule
+DISPATCHER_FILES = (
+    "ray_tpu/core/worker_proc.py",
+    "ray_tpu/core/runtime.py",
+    "ray_tpu/core/cluster/node_server.py",
+)
+
+
+def parse_protocol_table(
+        protocol_sf: SourceFile) -> Dict[str, Tuple[str, str, int]]:
+    """name -> (tag, direction, line). Direction is "d2w"/"w2d",
+    carried forward from the most recent section comment."""
+    table: Dict[str, Tuple[str, str, int]] = {}
+    direction = ""
+    for lineno, line in enumerate(protocol_sf.lines, start=1):
+        if line.lstrip().startswith("#"):
+            if D2W_RE.search(line):
+                direction = "d2w"
+            elif W2D_RE.search(line):
+                direction = "w2d"
+            continue
+        m = CONST_RE.match(line)
+        if m:
+            table[m.group(1)] = (m.group(2), direction, lineno)
+    return table
+
+
+def _protocol_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to the protocol module in this file."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("protocol"):
+                    aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "protocol":
+                    aliases.add(a.asname or "protocol")
+    return aliases
+
+
+def _const_names_in(expr: ast.AST, aliases: Set[str]) -> Iterable[str]:
+    """protocol.<NAME> references inside expr (tuples included)."""
+    for node in ast.walk(expr):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in aliases):
+            yield node.attr
+
+
+def handled_constants(sf: SourceFile) -> Set[str]:
+    """Constant names this file compares a subject against (Eq or
+    membership) — its set of dispatch arms."""
+    aliases = _protocol_aliases(sf.tree)
+    handled: Set[str] = set()
+    if not aliases:
+        return handled
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.Eq, ast.In)) for op in node.ops):
+            continue
+        for side in [node.left] + node.comparators:
+            handled.update(_const_names_in(side, aliases))
+    return handled
+
+
+def check_exhaustive(protocol_sf: SourceFile,
+                     dispatchers: Dict[str, SourceFile]) -> List[Finding]:
+    """Every opcode must have an arm in its required dispatcher.
+
+    ``dispatchers`` maps repo-relative dispatcher path -> SourceFile.
+    """
+    findings: List[Finding] = []
+    table = parse_protocol_table(protocol_sf)
+    handled = {path: handled_constants(sf)
+               for path, sf in dispatchers.items()}
+    for name, (tag, direction, lineno) in sorted(table.items()):
+        if not direction:
+            findings.append(Finding(
+                "L1", protocol_sf.relpath, lineno,
+                f"opcode {name} is declared outside any "
+                f"'driver -> worker' / 'worker -> driver' section; "
+                f"L1 cannot assign it a dispatcher"))
+            continue
+        target = DISPATCH_TARGETS[(name.split("_")[0], direction)]
+        if target not in handled:
+            continue  # dispatcher not part of this lint run
+        if name not in handled[target]:
+            findings.append(Finding(
+                "L1", protocol_sf.relpath, lineno,
+                f"opcode {name} ({tag!r}) has no dispatch arm in "
+                f"{target}"))
+    return findings
+
+
+def check_literal_drift(sf: SourceFile,
+                        declared_tags: Set[str]) -> List[Finding]:
+    """In functions that dispatch on protocol constants, flag
+    comparisons of the same subject against undeclared string
+    literals."""
+    findings: List[Finding] = []
+    aliases = _protocol_aliases(sf.tree)
+    if not aliases:
+        return findings
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        compares: List[ast.Compare] = [
+            n for n in ast.walk(fn) if isinstance(n, ast.Compare)
+            and any(isinstance(op, (ast.Eq, ast.In)) for op in n.ops)]
+        # subjects (by dump key) compared at least once to protocol.X
+        subjects: Set[str] = set()
+        for node in compares:
+            sides = [node.left] + node.comparators
+            if any(True for s in sides
+                   for _ in _const_names_in(s, aliases)):
+                for s in sides:
+                    if not list(_const_names_in(s, aliases)) and \
+                            not _is_str_literalish(s):
+                        subjects.add(ast.dump(s))
+        if not subjects:
+            continue
+        for node in compares:
+            sides = [node.left] + node.comparators
+            if not any(ast.dump(s) in subjects for s in sides):
+                continue
+            for s in sides:
+                for lit, lineno in _str_literals(s):
+                    if lit not in declared_tags:
+                        findings.append(Finding(
+                            "L1", sf.relpath, lineno,
+                            f"{fn.name}: dispatch subject compared "
+                            f"against {lit!r}, which is not an opcode "
+                            f"declared in core/protocol.py"))
+    return findings
+
+
+def _is_str_literalish(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_is_str_literalish(e) for e in node.elts)
+    return False
+
+
+def _str_literals(node: ast.AST) -> Iterable[Tuple[str, int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node.lineno
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            yield from _str_literals(e)
+
+
+def analyze(protocol_sf: SourceFile,
+            dispatchers: Dict[str, SourceFile]) -> List[Finding]:
+    findings = check_exhaustive(protocol_sf, dispatchers)
+    declared = {tag for tag, _, _ in
+                parse_protocol_table(protocol_sf).values()}
+    for sf in dispatchers.values():
+        findings.extend(check_literal_drift(sf, declared))
+    return findings

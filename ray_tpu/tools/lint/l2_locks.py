@@ -1,0 +1,145 @@
+"""L2 — lock discipline.
+
+The driver's recv loop, dispatcher, and spill machinery share a handful
+of ``threading.Lock``s. A blocking call made while one is held is the
+deadlock shape that hangs the whole event loop (every other thread
+piles up behind the lock while the holder waits on I/O that may itself
+need the lock to complete). This analyzer flags calls that can block
+indefinitely made *lexically* inside a ``with <...lock...>:`` block:
+
+- ``time.sleep`` (and a bare imported ``sleep``)
+- connection/socket ops: ``recv``/``recv_bytes``/``accept``/
+  ``connect``/``send``/``send_bytes``/``sendall``
+- ``subprocess`` module calls
+- zero-argument ``Queue.get`` (receiver name looks like a queue;
+  ``d.get(key)`` passes the key positionally and is not flagged)
+- ``Future.result``
+- zero-argument ``.join()`` (thread/process join without timeout;
+  ``sep.join(parts)`` always has an argument and is not flagged)
+
+Nested ``def``/``lambda`` bodies are skipped — they execute later, not
+under the lock. Deliberate holds (e.g. a send lock whose entire purpose
+is serializing ``conn.send``) are waived per-site with
+``# rtpu-lint: disable=L2`` plus a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from ray_tpu.tools.lint.base import Finding, SourceFile, \
+    enclosing_function_name
+
+_CONN_OPS = {"recv", "recv_bytes", "accept", "connect", "send",
+             "send_bytes", "sendall"}
+
+
+def _lock_name(expr: ast.AST) -> Optional[str]:
+    """The lock's name when expr looks like a lock acquisition."""
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        return expr.attr
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return expr.id
+    return None
+
+
+def _receiver_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id == "sleep":
+            return "sleep()"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    recv = _receiver_name(func.value)
+    if attr == "sleep" and recv == "time":
+        return "time.sleep()"
+    if recv == "subprocess":
+        return f"subprocess.{attr}()"
+    if attr in _CONN_OPS:
+        return f".{attr}() on a connection/socket"
+    if attr == "result":
+        return ".result() on a future"
+    if (attr == "get" and not call.args
+            and ("queue" in recv.lower() or recv == "q")):
+        # zero positional args: Queue.get(); a dict .get(key) always
+        # passes the key positionally
+        return ".get() on a queue"
+    if attr == "join" and not call.args and not call.keywords:
+        return ".join() without a timeout"
+    return None
+
+
+def _walk_lock_body(stmts: List[ast.stmt]) -> Iterator[ast.Call]:
+    """Calls lexically executed under the lock: skip nested function
+    and lambda bodies (they run later)."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # the whole statement is a deferred body
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                # exclude calls nested inside an inner def/lambda
+                if _inside_deferred(stmt, node):
+                    continue
+                yield node
+
+
+def _inside_deferred(root: ast.AST, target: ast.Call) -> bool:
+    """True when target sits inside a def/lambda nested under root."""
+    found = []
+
+    def visit(node, deferred):
+        if node is target:
+            found.append(deferred)
+            return True
+        for child in ast.iter_child_nodes(node):
+            d = deferred or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+            if visit(child, d):
+                return True
+        return False
+
+    visit(root, False)
+    return bool(found and found[0])
+
+
+def analyze_file(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock = None
+        for item in node.items:
+            lock = _lock_name(item.context_expr)
+            if lock:
+                break
+        if not lock:
+            continue
+        for call in _walk_lock_body(node.body):
+            reason = _blocking_reason(call)
+            if reason is None:
+                continue
+            fn = enclosing_function_name(sf.tree, node)
+            findings.append(Finding(
+                "L2", sf.relpath, call.lineno,
+                f"{fn}: blocking call {reason} while holding "
+                f"{lock!r} — move the blocking work outside the "
+                f"critical section or narrow it"))
+    return findings
+
+
+def analyze(files: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in files:
+        out.extend(analyze_file(sf))
+    return out

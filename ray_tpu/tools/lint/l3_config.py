@@ -1,0 +1,183 @@
+"""L3 — config/env hygiene.
+
+``core/config.py`` is this codebase's analogue of the reference's
+``RAY_CONFIG`` x-macro table (src/ray/common/ray_config_def.h), where an
+unknown flag is a *build error*. Python gives us no such check, so an
+attribute typo (``config.task_max_retrys``) silently reads nothing and
+a renamed flag silently strands every env override. This analyzer
+closes the gap, entirely from the AST (no imports of product code):
+
+- every ``config.<attr>`` access in a module that imports the config
+  singleton must resolve to a declared ``Flag`` row (or a table method);
+- every declared flag must be read somewhere in the package — directly
+  or via its ``RTPU_<NAME>`` env var (dead-flag report, anchored at the
+  ``Flag(...)`` row so the finding survives unrelated edits);
+- every literal ``os.environ``/``os.getenv`` read of an ``RTPU_*`` name
+  must map to a flag's env var, a fault-injection site
+  (``RTPU_FAULT_<SITE>``, sites parsed from
+  ``core/fault_injection.py``), or a wiring variable registered in
+  ``config.WIRING_ENV_VARS`` (per-process plumbing injected by the
+  spawner — addresses, auth keys, ids — which are not user tunables).
+
+Dynamic keys (f-strings) are out of scope; keep env names literal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.base import Finding, SourceFile
+
+CONFIG_MODULE = "ray_tpu.core.config"
+#: non-flag attributes of the config singleton
+CONFIG_METHODS = {"reload", "to_dict", "describe"}
+
+
+def parse_flag_table(config_sf: SourceFile) -> Dict[str, int]:
+    """flag name -> line of its Flag(...) row."""
+    flags: Dict[str, int] = {}
+    for node in ast.walk(config_sf.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Flag"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            flags[node.args[0].value] = node.lineno
+    return flags
+
+
+def parse_wiring_env(config_sf: SourceFile) -> Set[str]:
+    """Keys of the WIRING_ENV_VARS dict literal in config.py."""
+    wiring: Set[str] = set()
+    for node in ast.walk(config_sf.tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # WIRING: Dict[...] = {...}
+            targets = [node.target]
+        else:
+            continue
+        if (any(isinstance(t, ast.Name) and t.id == "WIRING_ENV_VARS"
+                for t in targets)
+                and isinstance(node.value, ast.Dict)):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    wiring.add(k.value)
+    return wiring
+
+
+def parse_fault_sites(fault_sf: Optional[SourceFile]) -> Set[str]:
+    """SITES tuple from core/fault_injection.py -> RTPU_FAULT_* names."""
+    sites: Set[str] = set()
+    if fault_sf is None:
+        return sites
+    for node in ast.walk(fault_sf.tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "SITES"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    sites.add(f"RTPU_FAULT_{e.value.upper()}")
+    return sites
+
+
+def _config_aliases(tree: ast.AST) -> Set[str]:
+    """Names the config singleton is bound to in this module."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == CONFIG_MODULE:
+            for a in node.names:
+                if a.name == "config":
+                    aliases.add(a.asname or "config")
+    return aliases
+
+
+def config_attr_reads(sf: SourceFile) -> List[Tuple[str, int]]:
+    """(attr, line) for every attribute access on the config singleton."""
+    aliases = _config_aliases(sf.tree)
+    if not aliases:
+        return []
+    reads: List[Tuple[str, int]] = []
+    for node in ast.walk(sf.tree):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in aliases):
+            reads.append((node.attr, node.lineno))
+    return reads
+
+
+def env_reads(sf: SourceFile) -> List[Tuple[str, int]]:
+    """(name, line) for literal os.environ/os.getenv reads."""
+    reads: List[Tuple[str, int]] = []
+
+    def is_environ(node: ast.AST) -> bool:
+        return ((isinstance(node, ast.Attribute) and node.attr == "environ")
+                or (isinstance(node, ast.Name) and node.id == "environ"))
+
+    for node in ast.walk(sf.tree):
+        if (isinstance(node, ast.Subscript) and is_environ(node.value)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)):
+            reads.append((node.slice.value, node.lineno))
+        elif isinstance(node, ast.Call) and isinstance(node.func,
+                                                       ast.Attribute):
+            f = node.func
+            key = (node.args[0].value
+                   if node.args and isinstance(node.args[0], ast.Constant)
+                   and isinstance(node.args[0].value, str) else None)
+            if key is None:
+                continue
+            if f.attr == "get" and is_environ(f.value):
+                reads.append((key, node.lineno))
+            elif (f.attr == "getenv" and isinstance(f.value, ast.Name)
+                  and f.value.id == "os"):
+                reads.append((key, node.lineno))
+    return reads
+
+
+def analyze(config_sf: SourceFile, fault_sf: Optional[SourceFile],
+            files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    flags = parse_flag_table(config_sf)
+    env_of_flag = {"RTPU_" + name.upper(): name for name in flags}
+    wiring = parse_wiring_env(config_sf)
+    fault_env = parse_fault_sites(fault_sf)
+
+    read_flags: Set[str] = set()
+    for sf in files:
+        is_config = sf.relpath == config_sf.relpath
+        for attr, lineno in config_attr_reads(sf):
+            if attr in flags:
+                read_flags.add(attr)
+            elif attr not in CONFIG_METHODS and not is_config:
+                if not sf.suppressed(lineno, "L3"):
+                    findings.append(Finding(
+                        "L3", sf.relpath, lineno,
+                        f"config.{attr} does not resolve to any declared "
+                        f"Flag row in core/config.py (typo, or a flag "
+                        f"that was removed/renamed)"))
+        for name, lineno in env_reads(sf):
+            if not name.startswith("RTPU_"):
+                continue
+            if name in env_of_flag:
+                read_flags.add(env_of_flag[name])
+                continue
+            if name in wiring or name in fault_env:
+                continue
+            if not sf.suppressed(lineno, "L3"):
+                findings.append(Finding(
+                    "L3", sf.relpath, lineno,
+                    f"env read of {name} is not declared: no flag has "
+                    f"this env_var, it is not RTPU_FAULT_<site>, and it "
+                    f"is not registered in config.WIRING_ENV_VARS"))
+    for name, lineno in sorted(flags.items()):
+        if name not in read_flags and \
+                not config_sf.suppressed(lineno, "L3"):
+            findings.append(Finding(
+                "L3", config_sf.relpath, lineno,
+                f"flag {name!r} is declared but never read anywhere in "
+                f"the package (dead flag: delete the row or wire it up)"))
+    return findings

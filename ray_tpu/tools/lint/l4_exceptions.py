@@ -1,0 +1,115 @@
+"""L4 — exception discipline in ``ray_tpu/core/``.
+
+Two shapes are flagged:
+
+1. Swallowing handlers: a bare ``except:`` anywhere, or an ``except
+   Exception:``/``except BaseException:`` whose body does nothing (only
+   ``pass``/``...``/``continue``). Broad catches are sometimes right
+   (best-effort cleanup of already-dead resources), but each one must
+   either narrow its type, do something observable (log, count,
+   convert), or carry an explicit ``# rtpu-lint: disable=L4`` waiver
+   with a justification.
+
+2. Dropped ``ObjectLostError``: a handler that catches
+   ``ObjectLostError`` must re-raise it, raise a converted error, or
+   call into reconstruction — PR 1's recovery contract routes every
+   lost-object signal to lineage resubmission, and a handler that
+   swallows the signal silently disables recovery for that path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ray_tpu.tools.lint.base import Finding, SourceFile, \
+    enclosing_function_name
+
+_BROAD = {"Exception", "BaseException"}
+_RECONSTRUCT_HINTS = ("reconstruct", "resubmit", "recover")
+
+
+def _exc_names(type_node: Optional[ast.AST]) -> List[str]:
+    """Exception class names a handler catches."""
+    if type_node is None:
+        return []
+    names: List[str] = []
+    for node in ast.walk(type_node):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return names
+
+
+def _trivial_body(body: List[ast.stmt]) -> bool:
+    """True when the handler body observably does nothing."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _handles_lost_object(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise, raise a conversion, or call into
+    reconstruction machinery?"""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = ""
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            if any(h in name.lower() for h in _RECONSTRUCT_HINTS):
+                return True
+        if isinstance(node, ast.Return) and node.value is not None:
+            # returning a value derived from the handler is a conversion
+            # decision made by the caller's contract; treat an explicit
+            # non-None return as handling
+            return True
+    return False
+
+
+def analyze_file(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        fn = None  # resolved lazily; enclosing lookup is O(tree)
+        names = _exc_names(node.type)
+        if node.type is None:
+            fn = enclosing_function_name(sf.tree, node)
+            findings.append(Finding(
+                "L4", sf.relpath, node.lineno,
+                f"{fn}: bare 'except:' — catch a typed exception "
+                f"(bare catches eat KeyboardInterrupt/SystemExit)"))
+        elif set(names) & _BROAD and _trivial_body(node.body):
+            fn = enclosing_function_name(sf.tree, node)
+            caught = "/".join(n for n in names if n in _BROAD)
+            findings.append(Finding(
+                "L4", sf.relpath, node.lineno,
+                f"{fn}: 'except {caught}: pass' swallows every error — "
+                f"narrow the type, log it, or waive with a "
+                f"justification"))
+        if "ObjectLostError" in names and not _handles_lost_object(node):
+            if fn is None:
+                fn = enclosing_function_name(sf.tree, node)
+            findings.append(Finding(
+                "L4", sf.relpath, node.lineno,
+                f"{fn}: catches ObjectLostError without re-raising, "
+                f"converting, or reconstructing — this silently "
+                f"disables lineage recovery"))
+    return findings
+
+
+def analyze(files: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in files:
+        out.extend(analyze_file(sf))
+    return out

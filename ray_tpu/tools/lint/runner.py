@@ -1,0 +1,115 @@
+"""Orchestrates the four analyzers over a source tree and applies
+suppression comments and baselines.
+
+Scopes (mirroring where each invariant lives):
+
+- L1 runs over ``core/protocol.py`` plus the three dispatcher files;
+- L2 and L4 run over ``ray_tpu/core/`` (the event-loop/lock and
+  recovery-contract surface);
+- L3 runs over the whole ``ray_tpu/`` package (flags are read
+  everywhere).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from ray_tpu.tools.lint import l1_protocol, l2_locks, l3_config, \
+    l4_exceptions
+from ray_tpu.tools.lint.base import Finding, SourceFile, iter_py_files, \
+    load_file
+
+PROTOCOL_PATH = "ray_tpu/core/protocol.py"
+CONFIG_PATH = "ray_tpu/core/config.py"
+FAULT_PATH = "ray_tpu/core/fault_injection.py"
+
+BASELINE_VERSION = 1
+
+
+def default_root() -> str:
+    """The repo root: parent of the installed ray_tpu package."""
+    import ray_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+
+
+def collect_findings(root: Optional[str] = None,
+                     rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected analyzers; suppressed findings are dropped."""
+    root = root or default_root()
+    rules = {r.upper() for r in rules} if rules else {"L1", "L2", "L3",
+                                                      "L4"}
+    by_rel: Dict[str, SourceFile] = {}
+
+    def get(rel: str) -> Optional[SourceFile]:
+        if rel not in by_rel:
+            path = os.path.join(root, rel)
+            if not os.path.exists(path):
+                return None
+            sf = load_file(path, root)
+            if sf is None:
+                return None
+            by_rel[rel] = sf
+        return by_rel.get(rel)
+
+    core_files: List[SourceFile] = []
+    all_files: List[SourceFile] = []
+    for path in iter_py_files(root, "ray_tpu"):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        sf = get(rel)
+        if sf is None:
+            continue
+        all_files.append(sf)
+        if rel.startswith("ray_tpu/core/"):
+            core_files.append(sf)
+
+    findings: List[Finding] = []
+    if "L1" in rules:
+        protocol_sf = get(PROTOCOL_PATH)
+        if protocol_sf is not None:
+            dispatchers = {rel: sf for rel in l1_protocol.DISPATCHER_FILES
+                           if (sf := get(rel)) is not None}
+            findings.extend(l1_protocol.analyze(protocol_sf, dispatchers))
+    if "L2" in rules:
+        findings.extend(l2_locks.analyze(core_files))
+    if "L3" in rules:
+        config_sf = get(CONFIG_PATH)
+        if config_sf is not None:
+            findings.extend(l3_config.analyze(
+                config_sf, get(FAULT_PATH), all_files))
+    if "L4" in rules:
+        findings.extend(l4_exceptions.analyze(core_files))
+
+    out = []
+    for f in findings:
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.suppressed(f.line, f.rule):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out
+
+
+def load_baseline(path: str) -> set:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}")
+    return set(data.get("keys", []))
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    data = {"version": BASELINE_VERSION,
+            "keys": sorted({f.key for f in findings})}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[Finding], baseline: set) -> List[Finding]:
+    """Keep only findings NOT present in the baseline (new violations)."""
+    return [f for f in findings if f.key not in baseline]

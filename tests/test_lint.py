@@ -1,0 +1,446 @@
+"""rtpu-lint: the tree must stay clean, and the analyzers must keep
+catching what they claim to catch.
+
+The tree-clean test is the tier-1 enforcement point: a new violation
+anywhere in ray_tpu/ fails here unless fixed or explicitly waived with
+a justified ``# rtpu-lint: disable=<RULE>`` comment.
+"""
+
+import json
+import os
+import textwrap
+
+from ray_tpu.tools.lint import (collect_findings, apply_baseline,
+                                load_baseline, write_baseline)
+from ray_tpu.tools.lint import l1_protocol, l2_locks, l3_config, \
+    l4_exceptions, runner
+from ray_tpu.tools.lint.__main__ import main as lint_main
+from ray_tpu.tools.lint.base import Finding, SourceFile
+
+
+def _sf(text: str, relpath: str = "ray_tpu/core/sample.py") -> SourceFile:
+    return SourceFile(relpath, relpath, text=textwrap.dedent(text))
+
+
+# ---------------------------------------------------------------- the tree
+
+
+def test_tree_is_clean():
+    findings = collect_findings()
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_rule_filter_runs_subset():
+    # a single-rule run parses fine and is also clean
+    assert collect_findings(rules=["L1"]) == []
+
+
+# ---------------------------------------------------------------- L1
+
+
+_PROTOCOL = '''\
+"""Test protocol."""
+# driver -> worker (task conn)
+MSG_PING = "ping"
+MSG_WORK = "work"
+# worker -> driver
+MSG_DONE = "done"
+'''
+
+
+def _l1(dispatch_src: str):
+    proto = _sf(_PROTOCOL, "ray_tpu/core/protocol.py")
+    disp = _sf(dispatch_src, "ray_tpu/core/worker_proc.py")
+    return l1_protocol.analyze(proto, {disp.relpath: disp})
+
+
+def test_l1_missing_arm_flagged():
+    findings = _l1('''\
+        from ray_tpu.core import protocol
+        def run_loop(msg):
+            if msg[0] == protocol.MSG_PING:
+                return "pong"
+        ''')
+    assert any("MSG_WORK" in f.message for f in findings)
+    assert all(f.rule == "L1" for f in findings)
+
+
+def test_l1_exhaustive_dispatch_clean():
+    assert _l1('''\
+        from ray_tpu.core import protocol
+        def run_loop(msg):
+            if msg[0] == protocol.MSG_PING:
+                return "pong"
+            elif msg[0] == protocol.MSG_WORK:
+                return "did it"
+        ''') == []
+
+
+def test_l1_literal_drift_flagged():
+    findings = _l1('''\
+        from ray_tpu.core import protocol
+        def run_loop(msg):
+            tag = msg[0]
+            if tag == protocol.MSG_PING:
+                return "pong"
+            if tag == protocol.MSG_WORK:
+                return "ok"
+            if tag == "wrok":
+                return "typo'd opcode"
+        ''')
+    assert any("'wrok'" in f.message for f in findings)
+
+
+def test_l1_declared_tag_literal_ok():
+    # comparing against the declared tag *string* is drift-free
+    findings = _l1('''\
+        from ray_tpu.core import protocol
+        def run_loop(msg):
+            tag = msg[0]
+            if tag == protocol.MSG_PING:
+                return "pong"
+            if tag == protocol.MSG_WORK:
+                return "ok"
+            if tag == "done":
+                return "declared tag"
+        ''')
+    assert not any("declared" in f.message and "'done'" in f.message
+                   for f in findings)
+
+
+def test_l1_opcode_outside_direction_section():
+    proto = _sf('MSG_LOST = "lost"\n', "ray_tpu/core/protocol.py")
+    findings = l1_protocol.analyze(proto, {})
+    assert any("outside any" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------- L2
+
+
+def test_l2_blocking_call_under_lock_flagged():
+    findings = l2_locks.analyze([_sf('''\
+        import time
+        class R:
+            def step(self):
+                with self._lock:
+                    time.sleep(1)
+        ''')])
+    assert len(findings) == 1
+    assert "time.sleep()" in findings[0].message
+    assert "_lock" in findings[0].message
+
+
+def test_l2_send_recv_subprocess_flagged():
+    findings = l2_locks.analyze([_sf('''\
+        import subprocess
+        class R:
+            def step(self, conn, fut, q):
+                with self.send_lock:
+                    conn.send(b"x")
+                    conn.recv()
+                    subprocess.run(["true"])
+                    fut.result()
+                    q.join()
+        ''')])
+    assert len(findings) == 5
+
+
+def test_l2_outside_lock_and_nested_def_clean():
+    assert l2_locks.analyze([_sf('''\
+        import time
+        class R:
+            def step(self):
+                time.sleep(1)          # not under a lock
+                with self._lock:
+                    def later():
+                        time.sleep(1)  # deferred: runs after release
+                    self.cb = later
+        ''')]) == []
+
+
+def test_l2_dict_get_not_flagged():
+    # d.get(key) passes the key positionally; Queue.get() does not
+    assert l2_locks.analyze([_sf('''\
+        class R:
+            def step(self):
+                with self._lock:
+                    v = self._env_queue.get("k")
+        ''')]) == []
+
+
+def test_l2_queue_get_flagged():
+    findings = l2_locks.analyze([_sf('''\
+        class R:
+            def step(self):
+                with self._lock:
+                    v = self.work_queue.get()
+        ''')])
+    assert len(findings) == 1
+
+
+# ---------------------------------------------------------------- L3
+
+
+_CONFIG = '''\
+from dataclasses import dataclass
+
+@dataclass
+class Flag:
+    name: str
+    type: type
+    default: object
+    doc: str
+
+_FLAGS = [
+    Flag("alpha", int, 1, "used via attribute"),
+    Flag("beta", int, 2, "used via env var"),
+    Flag("gamma", int, 3, "never read"),
+]
+
+WIRING_ENV_VARS = {"RTPU_WIRED": "plumbing"}
+
+config = None
+'''
+
+_FAULT = 'SITES = ("get", "spill")\n'
+
+
+def _l3(*sources):
+    cfg = _sf(_CONFIG, "ray_tpu/core/config.py")
+    fault = _sf(_FAULT, "ray_tpu/core/fault_injection.py")
+    files = [cfg, fault]
+    for i, src in enumerate(sources):
+        files.append(_sf(src, f"ray_tpu/core/mod{i}.py"))
+    return l3_config.analyze(cfg, fault, files)
+
+
+def test_l3_unknown_config_attr_flagged():
+    findings = _l3('''\
+        from ray_tpu.core.config import config
+        x = config.alpha
+        y = config.alhpa
+        ''')
+    assert any("config.alhpa" in f.message for f in findings)
+    assert not any("config.alpha " in f.message for f in findings)
+
+
+def test_l3_dead_flag_reported_env_read_counts():
+    findings = _l3('''\
+        from ray_tpu.core.config import config
+        import os
+        x = config.alpha
+        y = os.environ.get("RTPU_BETA")
+        ''')
+    dead = [f for f in findings if "dead flag" in f.message]
+    assert len(dead) == 1 and "'gamma'" in dead[0].message
+    # dead-flag findings anchor at the Flag row in config.py
+    assert dead[0].path == "ray_tpu/core/config.py"
+
+
+def test_l3_env_reads_wiring_and_fault_ok_stray_flagged():
+    findings = _l3('''\
+        import os
+        a = os.environ["RTPU_WIRED"]
+        b = os.getenv("RTPU_FAULT_SPILL")
+        c = os.environ.get("RTPU_MYSTERY_KNOB")
+        d = os.environ.get("HOME")
+        ''')
+    stray = [f for f in findings if "RTPU_MYSTERY_KNOB" in f.message]
+    assert len(stray) == 1
+    assert not any("RTPU_WIRED" in f.message for f in findings)
+    assert not any("RTPU_FAULT_SPILL" in f.message for f in findings)
+    assert not any("HOME" in f.message for f in findings)
+
+
+def test_l3_modules_without_config_import_ignored():
+    # rllib/tune-style local `config` objects are not the singleton
+    findings = _l3('''\
+        class Cfg:
+            seed = 1
+        config = Cfg()
+        x = config.seed
+        ''')
+    assert not any("config.seed" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------- L4
+
+
+def test_l4_bare_except_flagged():
+    findings = l4_exceptions.analyze([_sf('''\
+        def f():
+            try:
+                g()
+            except:
+                pass
+        ''')])
+    assert any("bare 'except:'" in f.message for f in findings)
+
+
+def test_l4_swallowing_broad_except_flagged():
+    findings = l4_exceptions.analyze([_sf('''\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        ''')])
+    assert len(findings) == 1
+
+
+def test_l4_broad_except_with_real_body_ok():
+    assert l4_exceptions.analyze([_sf('''\
+        import sys
+        def f():
+            try:
+                g()
+            except Exception as e:
+                print(f"warning: {e!r}", file=sys.stderr)
+        ''')]) == []
+
+
+def test_l4_object_lost_swallowed_flagged():
+    findings = l4_exceptions.analyze([_sf('''\
+        from ray_tpu.exceptions import ObjectLostError
+        def f():
+            try:
+                g()
+            except ObjectLostError:
+                result = None
+        ''')])
+    assert any("ObjectLostError" in f.message for f in findings)
+
+
+def test_l4_object_lost_rereaised_or_reconstructed_ok():
+    assert l4_exceptions.analyze([_sf('''\
+        from ray_tpu.exceptions import ObjectLostError
+        def f(self):
+            try:
+                g()
+            except ObjectLostError:
+                raise
+        def h(self, oid):
+            try:
+                g()
+            except ObjectLostError:
+                self._recover_object(oid)
+        ''')]) == []
+
+
+# ------------------------------------------------------- suppression
+
+
+def test_suppression_same_line_and_comment_block():
+    src = '''\
+        def f():
+            try:
+                g()
+            except Exception:  # rtpu-lint: disable=L4 — teardown
+                pass
+        def h():
+            try:
+                g()
+            # rtpu-lint: disable=L4 — best-effort cleanup: the lock
+            # may already be gone
+            except Exception:
+                pass
+        '''
+    sf = _sf(src)
+    findings = [f for f in l4_exceptions.analyze([sf])
+                if not sf.suppressed(f.line, f.rule)]
+    assert findings == []
+
+
+def test_suppression_is_per_rule():
+    sf = _sf('''\
+        def f():
+            try:
+                g()
+            except Exception:  # rtpu-lint: disable=L2
+                pass
+        ''')
+    findings = [f for f in l4_exceptions.analyze([sf])
+                if not sf.suppressed(f.line, f.rule)]
+    assert len(findings) == 1  # L2 waiver does not silence L4
+
+
+def test_suppression_all_wildcard():
+    sf = _sf('''\
+        def f():
+            try:
+                g()
+            except Exception:  # rtpu-lint: disable=all
+                pass
+        ''')
+    assert all(sf.suppressed(f.line, f.rule)
+               for f in l4_exceptions.analyze([sf]))
+
+
+# ------------------------------------------------------- baseline + CLI
+
+
+def _seed_tree(root, bad: bool):
+    """A miniature lintable tree: package with one core module."""
+    core = os.path.join(root, "ray_tpu", "core")
+    os.makedirs(core)
+    body = "        pass\n" if bad else "        print(e)\n"
+    with open(os.path.join(core, "mod.py"), "w") as f:
+        f.write("def f():\n    try:\n        g()\n"
+                "    except Exception as e:\n" + body)
+
+
+def test_baseline_roundtrip(tmp_path):
+    f1 = Finding("L4", "a.py", 3, "msg one")
+    f2 = Finding("L4", "b.py", 9, "msg two")
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, [f1])
+    keys = load_baseline(path)
+    assert f1.key in keys
+    # line numbers are not part of the key: a moved finding stays known
+    moved = Finding("L4", "a.py", 99, "msg one")
+    assert apply_baseline([moved, f2], keys) == [f2]
+    with open(path) as fh:
+        assert json.load(fh)["version"] == runner.BASELINE_VERSION
+
+
+def test_cli_exit_codes_on_seeded_tree(tmp_path, capsys):
+    bad = str(tmp_path / "bad")
+    good = str(tmp_path / "good")
+    _seed_tree(bad, bad=True)
+    _seed_tree(good, bad=False)
+    assert lint_main(["--root", bad]) == 1
+    assert lint_main(["--root", good]) == 0
+    out = capsys.readouterr().out
+    assert "1 finding(s)" in out and "0 finding(s)" in out
+
+
+def test_cli_json_output(tmp_path, capsys):
+    bad = str(tmp_path / "bad")
+    _seed_tree(bad, bad=True)
+    assert lint_main(["--root", bad, "--json"]) == 1
+    findings = json.loads(capsys.readouterr().out)
+    assert findings and findings[0]["rule"] == "L4"
+    assert set(findings[0]) == {"rule", "path", "line", "message", "key"}
+
+
+def test_cli_baseline_grandfathers_old_findings(tmp_path, capsys):
+    bad = str(tmp_path / "bad")
+    _seed_tree(bad, bad=True)
+    baseline = str(tmp_path / "baseline.json")
+    assert lint_main(["--root", bad, "--write-baseline", baseline]) == 0
+    # the pre-existing finding no longer fails the run
+    assert lint_main(["--root", bad, "--baseline", baseline]) == 0
+    # ... but a NEW violation still does
+    with open(os.path.join(bad, "ray_tpu", "core", "mod2.py"), "w") as f:
+        f.write("def h():\n    try:\n        g()\n    except:\n"
+                "        pass\n")
+    assert lint_main(["--root", bad, "--baseline", baseline]) == 1
+    capsys.readouterr()
+
+
+def test_cli_bad_baseline_is_usage_error(tmp_path, capsys):
+    bad = str(tmp_path / "bad")
+    _seed_tree(bad, bad=True)
+    missing = str(tmp_path / "nope.json")
+    assert lint_main(["--root", bad, "--baseline", missing]) == 2
+    capsys.readouterr()
