@@ -39,7 +39,7 @@ from ray_tpu.api import (  # noqa: F401
     timeline,
     wait,
 )
-from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator  # noqa: F401
 from ray_tpu.core.runtime_context import get_runtime_context  # noqa: F401
 from ray_tpu import exceptions  # noqa: F401
 
@@ -58,6 +58,7 @@ __all__ = [
     "get_actor",
     "timeline",
     "ObjectRef",
+    "ObjectRefGenerator",
     "get_runtime_context",
     "exceptions",
 ]

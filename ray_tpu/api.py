@@ -134,10 +134,18 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     """Cancel the task that produces ``ref`` (reference: ray.cancel,
     worker.py:2970). Queued tasks are dropped; executing tasks are
     interrupted (force=False) or their worker killed (force=True). The
-    caller sees TaskCancelledError at ``get``. ``recursive`` is accepted
-    for API parity; child-task cancellation follows worker death."""
+    caller sees TaskCancelledError at ``get``. Accepts an
+    ``ObjectRefGenerator`` to cancel a ``num_returns="streaming"`` task
+    mid-stream (the consumer's next ref raises, then the stream ends).
+    ``recursive`` is accepted for API parity; child-task cancellation
+    follows worker death."""
     del recursive
     core = runtime_context.get_core()
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_ref import ObjectRefGenerator
+
+    if isinstance(ref, ObjectRefGenerator):
+        ref = ObjectRef(ObjectID(ref.seed), core=core)
     core.cancel_task(ref, force=force)
 
 

@@ -19,12 +19,12 @@ from ray_tpu.core import runtime_context
 class ActorMethod:
     """Bound method accessor: ``handle.method.remote(args)``."""
 
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
 
-    def options(self, num_returns: int = 1, **_):
+    def options(self, num_returns=1, **_):
         return ActorMethod(self._handle, self._name, num_returns)
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
@@ -33,6 +33,10 @@ class ActorMethod:
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns,
         )
+        if self._num_returns == "streaming":
+            from ray_tpu.core.remote_function import _make_generator
+
+            return _make_generator(core, refs[0].binary())
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -35,7 +35,8 @@ from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID,
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.placement_group import PlacementGroup
 from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError,
-                                ObjectLostError, PlacementGroupError)
+                                ObjectLostError, ObjectTimeoutError,
+                                PlacementGroupError)
 
 
 class _ClusterPG:
@@ -385,9 +386,15 @@ class ClusterCore:
     # ----------------------------------------------------------------- tasks
 
     def submit_task(self, fn_id: bytes, args: tuple, kwargs: dict,
-                    num_returns: int = 1, options: Optional[dict] = None
+                    num_returns=1, options: Optional[dict] = None
                     ) -> List[ObjectRef]:
         options = dict(options or {})
+        streaming = num_returns == "streaming"
+        if streaming:
+            # single return id doubles as the stream seed; the chosen
+            # node registers the stream state (node_server._do_submit)
+            num_returns = 1
+            options["__stream"] = True
         args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
         payload, nested = protocol.serialize_args(args2, kwargs2, store=None)
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
@@ -423,6 +430,13 @@ class ClusterCore:
                     raise
                 self._cluster_view(force=True)
         self._mark_shipped(addr, fn_id)
+        if streaming:
+            # No lineage for streams: replay-after-worker-death happens on
+            # the owning node (skip-aware requeue); a lost index object is
+            # not reconstructable and raises ObjectLostError instead.
+            with self._lock:
+                self._ref_node[return_ids[0].binary()] = addr
+            return [ObjectRef(rid, core=self) for rid in return_ids]
         lineage = (fn_id, payload, [d.binary() for d in deps],
                    [r.binary() for r in nested],
                    [r.binary() for r in return_ids], options)
@@ -796,15 +810,18 @@ class ClusterCore:
             return addr, self._nodes.get(addr).call(msg_fn(addr))
 
     def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
-                          kwargs: dict, num_returns: int = 1
+                          kwargs: dict, num_returns=1
                           ) -> List[ObjectRef]:
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 1
         args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
         payload, nested = protocol.serialize_args(args2, kwargs2, store=None)
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
         msg = ("actor_call", actor_id.binary(), method, payload,
                [d.binary() for d in deps], [r.binary() for r in nested],
                [r.binary() for r in return_ids], os.urandom(16),
-               self._driver_id)
+               self._driver_id, streaming)
         try:
             addr, _ = self._actor_call_with_retry(actor_id, lambda a: msg)
         except RpcError as e:
@@ -993,8 +1010,65 @@ class ClusterCore:
         except RpcError:
             pass
 
+    # ---------------------------------------------------- streaming returns
+
+    def stream_owner(self, seed: bytes) -> Optional[Tuple[str, int]]:
+        """Node address owning a stream's state (captured into the
+        ObjectRefGenerator so it keeps routing after cross-node pickling)."""
+        return self._ref_node.get(seed)
+
+    def stream_next(self, seed: bytes, index: int,
+                    timeout: Optional[float] = None, owner=None):
+        """Driver-side consumption: poll the owning node in bounded slices
+        (same contract as Runtime.stream_next — ("ref", rid_b) or
+        ("end", count), ObjectTimeoutError past the deadline)."""
+        addr = tuple(owner) if owner else self._ref_node.get(
+            seed, self._home)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            slice_s = 0.2
+            if deadline is not None:
+                # always probe at least once (timeout=0 is a poll)
+                slice_s = max(0.0, min(slice_s,
+                                       deadline - time.monotonic()))
+            reply = self._nodes.get(addr).call(
+                ("stream_next", seed, index, max(1, int(slice_s * 1000))))
+            if reply[0] == "pending":
+                if (deadline is not None
+                        and time.monotonic() >= deadline):
+                    raise ObjectTimeoutError(
+                        f"stream_next timed out waiting for index {index} "
+                        f"of stream {seed.hex()}")
+                continue
+            if reply[0] == "ref":
+                # the owner sealed the index object locally; route gets
+                with self._lock:
+                    self._ref_node[reply[1]] = addr
+            return reply
+
+    def stream_consumed(self, seed: bytes, index: int, owner=None):
+        """Advance the consumer watermark (backpressure credit) on the
+        owning node; best-effort — a lost credit only delays the producer
+        by one poll slice."""
+        addr = tuple(owner) if owner else self._ref_node.get(
+            seed, self._home)
+        try:
+            self._nodes.get(addr).call(("stream_consumed", seed, index))
+        except RpcError:
+            pass
+
     def kv_op(self, op: str, key: str, value=None):
         return self.gcs.call(("kv", op, key, value))
+
+    def pubsub_op(self, op: str, channel: str, arg=None,
+                  timeout: float = 0.0):
+        """Cluster-wide pubsub IS the GCS channel plane."""
+        if op == "publish":
+            return self.gcs.call(("publish", channel, arg))
+        if op == "poll":
+            return self.gcs.call(("poll", channel, int(arg or 0), timeout))
+        raise ValueError(op)
 
     def free_objects(self, oid_bytes_list: List[bytes]) -> int:
         """Fan eager deletion out to every node holding a copy; returns

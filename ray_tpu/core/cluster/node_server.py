@@ -167,6 +167,22 @@ class NodeRuntime(Runtime):
                         ActorID(actor_id_b), method, args_payload,
                         extra.get("__deps", []), n_returns)
                     return ("ok", [r.binary() for r in refs])
+            elif tag == protocol.REQ_STREAM_NEXT:
+                # generator consumed by a worker on a node that does not
+                # own the stream: forward one wait slice to the owner
+                _, seed, index, timeout_ms, owner = msg
+                if seed not in self._streams and owner is not None:
+                    return srv._peers.get(tuple(owner)).call(
+                        ("stream_next", seed, index, timeout_ms))
+            elif tag == protocol.REQ_STREAM_CONSUMED_ASYNC:
+                _, seed, index, owner = msg
+                if seed not in self._streams and owner is not None:
+                    try:
+                        srv._peers.get(tuple(owner)).call(
+                            ("stream_consumed", seed, index))
+                    except RpcError:
+                        pass  # credit update is best-effort
+                    return protocol.NO_REPLY
             elif tag == protocol.REQ_ACTOR_CALL_ASYNC:
                 _, actor_id_b, method, args_payload, extra, rids_b = msg
                 if ActorID(actor_id_b) not in self._actors:
@@ -189,9 +205,11 @@ class NodeRuntime(Runtime):
         srv = self._server_ref
         if srv is not None:
             if (spec.actor_id is None and spec.request is not None
-                    and spec.pg_wire is None
+                    and spec.pg_wire is None and spec.stream is None
                     and not spec.request.is_subset_of(self._total)
                     and srv.spill_task(spec)):
+                # stream specs never spill: the stream state (and the
+                # consumer's cached owner address) is pinned to this node
                 return
             srv.mark_local_products(spec.return_ids)
         super()._enqueue(spec)
@@ -205,6 +223,17 @@ class NodeRuntime(Runtime):
     # cluster-wide KV lives in the GCS
     def kv_op(self, op: str, key: str, value=None):
         return self._server_ref.gcs.call(("kv", op, key, value))
+
+    # cluster-wide pubsub channels live in the GCS too: a worker's
+    # REQ_PUBSUB reaches every driver subscribed anywhere in the cluster
+    def pubsub_op(self, op: str, channel: str, arg=None,
+                  timeout: float = 0.0):
+        gcs = self._server_ref.gcs
+        if op == "publish":
+            return gcs.call(("publish", channel, arg))
+        if op == "poll":
+            return gcs.call(("poll", channel, int(arg or 0), timeout))
+        raise ValueError(op)
 
     # named actors are registered cluster-wide
     def _create_actor_from_payload(self, cls_fn_id, args_payload, deps, opts,
@@ -889,12 +918,20 @@ class NodeServer:
         task_id = make_task_id(rt.job_id)
         for rid in ret_ids:
             rt._entry(rid)
+        opts = dict(options or {})
+        streaming = bool(opts.pop("__stream", False))
         spec = _TaskSpec(task_id, fn_id, args_payload, dep_ids, ret_ids,
-                         dict(options or {}))
+                         opts)
         spec.nested_deps = [ObjectID(b) for b in nested]
         spec.request, spec.pg_wire = rt._prepare_request(
-            dict(options or {}), is_actor=False)
+            dict(opts), is_actor=False)
         rt._cancellable[ret_ids[0].binary()] = spec
+        if streaming:
+            # this node owns the stream state: the consumer's stream_next
+            # ops route here (ClusterCore caches seed -> this address)
+            seed = ret_ids[0].binary()
+            spec.stream = rt._stream_opts(seed)
+            rt._register_stream(seed)
         rt._enqueue(spec)
         return True
 
@@ -1173,6 +1210,31 @@ class NodeServer:
         return rt.cancel_task(ObjectRef(ObjectID(oid_bytes), core=rt),
                               force=force)
 
+    # -- streaming returns (stream state lives on the owning node; the
+    #    driver and peer nodes poll it with bounded slices)
+
+    def _op_stream_next(self, seed, index, timeout_ms):
+        """One bounded wait slice against a local stream. Returns
+        ("ref", rid_b) | ("end", count) | ("pending",)."""
+        rt = self.runtime
+        st = rt._streams.get(seed)
+        if st is None:
+            raise ValueError(f"unknown stream {seed.hex()}")
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with st.cond:
+            while True:
+                hit = rt._stream_poll_locked(st, index)
+                if hit is not None:
+                    return hit
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return ("pending",)
+                st.cond.wait(remaining)
+
+    def _op_stream_consumed(self, seed, index):
+        self.runtime.stream_consumed(seed, index)
+        return True
+
     # -- actors
 
     def _op_create_actor(self, cls_fn_id, pickled_cls, args_payload, deps,
@@ -1203,13 +1265,14 @@ class NodeServer:
         return actor_id.binary()
 
     def _op_actor_call(self, actor_id_bytes, method, args_payload, deps,
-                       nested, return_ids, nonce=None, owner=None):
+                       nested, return_ids, nonce=None, owner=None,
+                       stream=False):
         return self._dedup(nonce, lambda: self._do_actor_call(
             actor_id_bytes, method, args_payload, deps, nested, return_ids,
-            owner))
+            owner, stream))
 
     def _do_actor_call(self, actor_id_bytes, method, args_payload, deps,
-                       nested, return_ids, owner=None):
+                       nested, return_ids, owner=None, stream=False):
         rt = self.runtime
         if owner is not None:
             self._tag_owner(return_ids, owner)
@@ -1225,6 +1288,10 @@ class NodeServer:
         for rid in ret_ids:
             rt._entry(rid)
         task_id = make_task_id(rt.job_id)
+        if stream:
+            # register before the dead check so ActorDiedError routes
+            # through _fail_stream rather than landing on the seed id
+            rt._register_stream(ret_ids[0].binary())
         if state.dead:
             rt._store_error(ret_ids, ActorDiedError(
                 str(state.death_cause or "actor is dead")))
@@ -1233,6 +1300,8 @@ class NodeServer:
                          [ObjectID(b) for b in deps], ret_ids, {},
                          actor_id=actor_id, method=method)
         spec.nested_deps = [ObjectID(b) for b in nested]
+        if stream:
+            spec.stream = rt._stream_opts(ret_ids[0].binary())
         rt._cancellable[ret_ids[0].binary()] = spec
         rt._enqueue(spec)
         return True

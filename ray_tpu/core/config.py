@@ -79,6 +79,12 @@ _FLAGS: List[Flag] = [
          "builds). Read at call time from RTPU_STORE_LIB in "
          "object_store.store._load_lib, not via config resolution, "
          "because store subprocesses receive it through their env."),
+    Flag("streaming_generator_backpressure", int, 16,
+         "Max in-flight (produced-but-unconsumed) returns a "
+         "num_returns='streaming' generator may buffer before its worker "
+         "blocks waiting for the consumer to catch up; 0 disables "
+         "backpressure (reference: "
+         "_generator_backpressure_num_objects, _raylet.pyx)."),
     Flag("tpu_topology", str, "",
          "Override the detected TPU topology string (e.g. '2x2x1'), "
          "for scheduling tests on hosts without the real topology. "

@@ -80,3 +80,87 @@ class ObjectRef:
         core = self._core or runtime_context.get_core()
         fut = core.as_future(self)
         return fut.__await__()
+
+
+_STREAM_DONE = object()
+
+
+def _resolve_generator(seed: bytes, owner) -> "ObjectRefGenerator":
+    """Unpickle hook: rebind the generator to the local core client."""
+    from ray_tpu.core import runtime_context
+
+    return ObjectRefGenerator(
+        seed, core=runtime_context.get_core_or_none(), owner=owner)
+
+
+class ObjectRefGenerator:
+    """Iterator over the returns of a ``num_returns="streaming"`` task
+    (reference: ObjectRefGenerator, python/ray/_raylet.pyx:263).
+
+    Each ``next()`` blocks until the producing generator has sealed the
+    next yield, then hands back an ``ObjectRef`` — so consumption starts
+    while the task is still running. Advancing the iterator reports the
+    previous index consumed, releasing producer backpressure credit.
+    A mid-stream task failure surfaces as a final ref whose ``get()``
+    raises, followed by ``StopIteration``.
+    """
+
+    def __init__(self, seed: bytes, core=None, owner=None):
+        self._seed = seed
+        self._core = core
+        self._owner = owner  # producing node addr hint (cluster path)
+        self._index = 0
+        self._end: Optional[int] = None
+
+    @property
+    def seed(self) -> bytes:
+        return self._seed
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        return self.next_ref(timeout=None)
+
+    def next_ref(self, timeout: Optional[float] = None) -> "ObjectRef":
+        """Blocking next; raises StopIteration at end of stream and
+        ObjectTimeoutError if ``timeout`` (seconds) elapses first."""
+        if self._end is not None and self._index >= self._end:
+            raise StopIteration
+        from ray_tpu.core import runtime_context
+
+        core = self._core or runtime_context.get_core()
+        kind, detail = core.stream_next(
+            self._seed, self._index, timeout=timeout, owner=self._owner)
+        if kind == "end":
+            self._end = detail
+            raise StopIteration
+        ref = ObjectRef(ObjectID(detail), core=core)
+        core.stream_consumed(self._seed, self._index, owner=self._owner)
+        self._index += 1
+        return ref
+
+    def _next_or_done(self):
+        try:
+            return self.__next__()
+        except StopIteration:
+            return _STREAM_DONE
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        res = await loop.run_in_executor(None, self._next_or_done)
+        if res is _STREAM_DONE:
+            raise StopAsyncIteration
+        return res
+
+    def __reduce__(self):
+        return (_resolve_generator, (self._seed, self._owner))
+
+    def __repr__(self):
+        return (f"ObjectRefGenerator(seed={self._seed.hex()}, "
+                f"next_index={self._index})")

@@ -29,7 +29,7 @@ from ray_tpu.exceptions import ObjectStoreFullError
 MSG_REGISTER_FN = "reg_fn"         # (MSG_REGISTER_FN, fn_id, pickled_fn)
 MSG_CREATE_ACTOR = "create_actor"  # (.., actor_id_b, cls_fn_id, args_payload, inline_values, opts)
 MSG_ACTOR_CALL = "actor_call"      # (.., task_id_b, actor_id_b, method, args_payload, inline_values, return_id_bytes)
-MSG_TASK_BATCH = "task_batch"      # (MSG_TASK_BATCH, [(task_id_b, fn_id, args_payload, inline_values, return_ids, runtime_env|None), ...])
+MSG_TASK_BATCH = "task_batch"      # (MSG_TASK_BATCH, [(task_id_b, fn_id, args_payload, inline_values, return_ids, runtime_env|None, stream_opts|None), ...])
 MSG_SHUTDOWN = "shutdown"
 
 # worker -> driver (task conn)
@@ -38,6 +38,7 @@ MSG_DONE = "done"                  # (MSG_DONE, task_id_b, [payload, ...])
 MSG_ERROR = "error"                # (MSG_ERROR, task_id_b, pickled_exc_payload)
 MSG_ACTOR_READY = "actor_ready"    # (.., actor_id_b)
 MSG_ACTOR_ERROR = "actor_error"    # (.., actor_id_b, pickled_exc_payload)
+MSG_STREAM_YIELD = "stream_yield"  # (.., task_id_b, seed, index, rid_b, payload, is_end): one streamed return sealed
 
 # worker -> driver (data conn, request/response)
 REQ_GET = "get"                    # (REQ_GET, [oid_bytes], timeout_ms, cur_task_id_b) -> ("ok", {oid: payload}) | ("err", payload)
@@ -55,6 +56,9 @@ REQ_PKG_PUT = "pkg_put"            # (REQ_PKG_PUT, hash_str, bytes) -> ("ok", No
 REQ_NEED_SPACE = "need_space"      # (REQ_NEED_SPACE, nbytes) -> ("ok", freed_bool)
 REQ_FREE = "free_objs"             # (REQ_FREE, [oid_bytes]) -> ("ok", count_freed)
 REQ_KILL_ACTOR = "kill_actor_req"  # (REQ_KILL_ACTOR, actor_id_bytes, no_restart) -> ("ok",)
+REQ_STREAM_NEXT = "stream_next"    # (REQ_STREAM_NEXT, seed, index, timeout_ms, owner) -> ("ref", rid_b) | ("end", count) | ("pending",) | ("err", payload)
+REQ_STREAM_CREDIT = "stream_credit"  # (REQ_STREAM_CREDIT, seed, produced) -> ("ok", consumed): producer backpressure probe
+REQ_PUBSUB = "pubsub"              # (REQ_PUBSUB, op, channel, arg, timeout) -> ("ok", result); op in publish/poll (GCS channel semantics)
 
 # fire-and-forget variants (NO reply — the worker pre-generates the ids,
 # so the owner's round trip leaves the submission hot path; errors land
@@ -63,6 +67,7 @@ REQ_KILL_ACTOR = "kill_actor_req"  # (REQ_KILL_ACTOR, actor_id_bytes, no_restart
 REQ_PUT_META_ASYNC = "put_meta_async"      # (.., oid_bytes, payload_or_none)
 REQ_SUBMIT_ASYNC = "submit_async"          # (.., fn_id, pickled_fn_or_none, args_payload, inline_values, return_ids, options)
 REQ_ACTOR_CALL_ASYNC = "actor_call_async"  # (.., actor_id_b, method, args_payload, extra, return_ids)
+REQ_STREAM_CONSUMED_ASYNC = "stream_consumed_async"  # (.., seed, index, owner): consumer advanced past index
 
 REQ_BARRIER = "barrier"  # (REQ_BARRIER,) -> ("ok",): all earlier async sends applied
 
@@ -101,6 +106,35 @@ class _TopLevelDep:
 
     def __reduce__(self):
         return (_TopLevelDep, (self.oid_bytes,))
+
+
+class _StreamEnd:
+    """End-of-stream sentinel sealed at the index one past the final yield
+    of a ``num_returns="streaming"`` task (the reference stores
+    ``ObjectRefStreamEndOfStreamError`` the same way). ``count`` is the
+    number of values the generator produced, so a consumer that attaches
+    late still learns the stream length."""
+
+    __slots__ = ("count",)
+
+    def __init__(self, count: int):
+        self.count = count
+
+    def __reduce__(self):
+        return (_StreamEnd, (self.count,))
+
+
+def stream_index_id(seed: bytes, index: int) -> bytes:
+    """Deterministic per-index object id for a streaming return.
+
+    Derived from the submit-time seed so the owner, the worker, and a
+    replayed generator after worker death all agree on the id of yield
+    ``index`` without a round trip (the reference derives dynamic return
+    ids from the task id + index the same way)."""
+    import hashlib
+
+    return hashlib.blake2b(
+        seed + index.to_bytes(8, "little"), digest_size=16).digest()
 
 
 Payload = Tuple[str, bytes]

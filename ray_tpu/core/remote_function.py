@@ -41,6 +41,7 @@ class RemoteFunction:
     def _remote(self, args, kwargs, options) -> Union[ObjectRef, List[ObjectRef]]:
         core = runtime_context.get_core()
         num_returns = options.get("num_returns", 1)
+        streaming = num_returns == "streaming"
         opts = {k: v for k, v in options.items() if k != "num_returns"}
         if opts.get("runtime_env") and hasattr(core, "prepare_runtime_env"):
             # package working_dir/py_modules paths into hash references
@@ -65,6 +66,8 @@ class RemoteFunction:
                 ).digest()
             refs = core.submit_task(self._fn_id, self._pickled, args, kwargs,
                                     num_returns, opts)
+        if streaming:
+            return _make_generator(core, refs[0].binary())
         return refs[0] if num_returns == 1 else refs
 
     @property
@@ -80,6 +83,17 @@ class RemoteFunction:
 
 def _rebuild(fn, default_options):
     return RemoteFunction(fn, default_options)
+
+
+def _make_generator(core, seed: bytes):
+    """Wrap a streaming submission's seed id in an ObjectRefGenerator,
+    capturing the producing node address when the core is cluster-aware
+    (so the generator keeps working after being pickled cross-node)."""
+    from ray_tpu.core.object_ref import ObjectRefGenerator
+
+    owner_of = getattr(core, "stream_owner", None)
+    owner = owner_of(seed) if callable(owner_of) else None
+    return ObjectRefGenerator(seed, core=core, owner=owner)
 
 
 class _OptionWrapper:

@@ -125,7 +125,7 @@ class _TaskSpec:
         "actor_id", "method", "pending_deps", "request", "pg_wire",
         "acquired_bundle", "blocked_released", "nested_deps", "cancelled",
         "retries_left", "args_pinned", "dep_pins", "submitted_ts",
-        "dispatched_ts", "parent_task", "oom_kills", "env_key",
+        "dispatched_ts", "parent_task", "oom_kills", "env_key", "stream",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -170,6 +170,37 @@ class _TaskSpec:
         # pip-env tasks dispatch only to workers running that env's own
         # interpreter (per-env pools — true module-version isolation)
         self.env_key: Optional[str] = _task_env_key(options)
+        # num_returns="streaming": {"seed": bytes, "skip": int, "cap": int}
+        # shipped to the worker so it seals yields under deterministic
+        # per-index ids; None for ordinary tasks
+        self.stream: Optional[dict] = None
+
+
+class _StreamState:
+    """Owner-side bookkeeping for one ``num_returns="streaming"`` task
+    (reference: the per-generator ObjectRefStream in
+    core_worker/task_manager.h). Index ids are deterministic
+    (protocol.stream_index_id), so only counters live here:
+
+    - ``produced``: indices sealed and reported so far (their entries are
+      resolvable); the consumer may hand out refs below this watermark.
+    - ``consumed``: the consumer's advance watermark — the producer's
+      REQ_STREAM_CREDIT probe blocks it at ``produced - consumed >= cap``.
+    - ``end_index``: total yield count once the end sentinel (or a
+      mid-stream failure ref) lands; None while the stream is live.
+    """
+
+    __slots__ = ("seed", "cap", "produced", "consumed", "end_index",
+                 "failed", "cond")
+
+    def __init__(self, seed: bytes, cap: int):
+        self.seed = seed
+        self.cap = cap
+        self.produced = 0
+        self.consumed = 0
+        self.end_index: Optional[int] = None
+        self.failed = False
+        self.cond = threading.Condition()
 
 
 def _fd_readable(fd, timeout) -> bool:
@@ -403,6 +434,11 @@ class Runtime:
         self._actors: Dict[ActorID, _ActorState] = {}
         self._named_actors: Dict[str, ActorID] = {}
         self._kv: Dict[str, Any] = {}
+        # single-node mirror of the GCS pubsub plane (bounded per-channel
+        # event logs with contiguous seqs; see gcs.py _op_publish/_op_poll)
+        self._channels: Dict[str, list] = {}
+        self._channel_seq: Dict[str, int] = {}
+        self._pubsub_cond = threading.Condition()
         self._packages: Dict[str, bytes] = {}  # runtime_env package store
         # eagerly-freed object ids: insertion-ordered so the tombstone cap
         # evicts oldest-first (dict preserves insertion order)
@@ -423,6 +459,9 @@ class Runtime:
         # First-return-id -> spec, for ray.cancel lookup; entries drop when
         # the task finishes (done/error/cancel paths).
         self._cancellable: Dict[bytes, _TaskSpec] = {}
+        # seed (first-return-id) -> _StreamState for every
+        # num_returns="streaming" task submitted through this owner
+        self._streams: Dict[bytes, _StreamState] = {}
         self._shutdown = False
         self._spawning = 0
         # Pool workers stolen by actors and not yet replaced. Replacement
@@ -733,6 +772,8 @@ class Runtime:
                         self._dispatch()
                 elif tag == protocol.MSG_DONE:
                     self._on_task_done(w, msg[1], msg[2])
+                elif tag == protocol.MSG_STREAM_YIELD:
+                    self._on_stream_yield(w, msg)
                 elif tag == protocol.MSG_ERROR:
                     self._on_task_error(w, msg[1], msg[2])
                 elif tag == protocol.MSG_ACTOR_READY:
@@ -838,6 +879,17 @@ class Runtime:
                 # died before its DONE message flushed leaves a refcount-1
                 # orphan; reclaim it (and clear the id for a retry's write)
                 self._reap_orphan_returns(spec)
+            for spec in requeue:
+                if spec.stream is not None:
+                    # generator replay: every index reported so far survives
+                    # (shm containers are owner-pinned, inline payloads are
+                    # already stored), so the retry re-runs the generator
+                    # but re-seals nothing below the produced watermark
+                    st = self._streams.get(spec.stream["seed"])
+                    if st is not None:
+                        with st.cond:
+                            spec.stream = dict(spec.stream,
+                                               skip=st.produced)
             for spec in fail:
                 self._release_spec_args(spec)
                 self._store_error(
@@ -1138,7 +1190,105 @@ class Runtime:
         payload = protocol.serialize_value(protocol.ErrorValue(err), store=None)
         for oid in oids:
             self._cancellable.pop(oid.binary(), None)
-            self._store_payload(oid, payload)
+            st = self._streams.get(oid.binary())
+            if st is not None:
+                # A streaming task's seed id is never resolved directly;
+                # surface the failure as the stream's final ref instead
+                # (the consumer's next() hands it out, its get() raises,
+                # then the iterator ends).
+                self._fail_stream(st, payload)
+            else:
+                self._store_payload(oid, payload)
+
+    # ------------------------------------------------------ streaming returns
+
+    def _register_stream(self, seed: bytes) -> "_StreamState":
+        st = _StreamState(seed, int(config.streaming_generator_backpressure))
+        with self._lock:
+            self._streams[seed] = st
+        return st
+
+    def _stream_opts(self, seed: bytes) -> dict:
+        """Wire dict shipped to the worker alongside the task."""
+        return {"seed": seed, "skip": 0,
+                "cap": int(config.streaming_generator_backpressure)}
+
+    def _on_stream_yield(self, w: "_Worker", msg):
+        """MSG_STREAM_YIELD: one streamed return sealed by the worker.
+        Adopt the payload under its deterministic index id and advance the
+        produced watermark so blocked ``next()`` calls wake."""
+        _, task_id_b, seed, index, rid_b, payload, is_end = msg
+        st = self._streams.get(seed)
+        self._store_payload(ObjectID(rid_b), payload)
+        if st is None:
+            return  # stream unknown (late report after shutdown/reap)
+        with st.cond:
+            if is_end:
+                if st.end_index is None:
+                    st.end_index = index
+            elif index >= st.produced:
+                st.produced = index + 1
+            st.cond.notify_all()
+
+    def _fail_stream(self, st: "_StreamState", err_payload):
+        """Terminate a stream with an error: seal the payload at the next
+        unproduced index (consumers blocked there wake and get a ref whose
+        get() raises) and end the stream right after it. A stream that
+        already ended normally is left untouched."""
+        with st.cond:
+            if st.end_index is not None:
+                return
+            idx = st.produced
+            st.produced = idx + 1
+            st.end_index = idx + 1
+            st.failed = True
+            st.cond.notify_all()
+        self._store_payload(
+            ObjectID(protocol.stream_index_id(st.seed, idx)), err_payload)
+
+    def stream_next(self, seed: bytes, index: int,
+                    timeout: Optional[float] = None, owner=None):
+        """Blocking driver-side next for ObjectRefGenerator: returns
+        ("ref", rid_bytes) once index is produced or ("end", count) once
+        the stream ended before it. ``owner`` is a cluster-path routing
+        hint; a single-node runtime owns every stream it knows."""
+        from ray_tpu.exceptions import ObjectTimeoutError
+
+        st = self._streams.get(seed)
+        if st is None:
+            raise ValueError(f"unknown stream {seed.hex()}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with st.cond:
+            while True:
+                kind = self._stream_poll_locked(st, index)
+                if kind is not None:
+                    return kind
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise ObjectTimeoutError(
+                        f"stream {seed.hex()} index {index} not produced "
+                        f"within {timeout}s")
+                st.cond.wait(remaining)
+
+    def _stream_poll_locked(self, st: "_StreamState", index: int):
+        """One non-blocking poll; holds st.cond."""
+        if st.end_index is not None and index >= st.end_index:
+            return ("end", st.end_index)
+        if index < st.produced:
+            return ("ref", protocol.stream_index_id(st.seed, index))
+        return None
+
+    def stream_consumed(self, seed: bytes, index: int, owner=None):
+        """The consumer advanced past ``index``: raise the consumed
+        watermark so the producer's backpressure credit frees up."""
+        st = self._streams.get(seed)
+        if st is None:
+            return
+        with st.cond:
+            if index + 1 > st.consumed:
+                st.consumed = index + 1
+            st.cond.notify_all()
 
     # ---------------------------------------------------------------- lineage
 
@@ -1370,9 +1520,14 @@ class Runtime:
     # ------------------------------------------------------------- scheduler
 
     def submit_task(self, fn_id: bytes, args: tuple, kwargs: dict,
-                    num_returns: int = 1, options: Optional[dict] = None
+                    num_returns=1, options: Optional[dict] = None
                     ) -> List[ObjectRef]:
         options = options or {}
+        streaming = num_returns == "streaming"
+        if streaming:
+            # one pre-generated return id doubles as the stream seed; the
+            # yields live under deterministic per-index ids derived from it
+            num_returns = 1
         task_id = make_task_id(self.job_id)
         args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
         args_payload, nested = protocol.serialize_args(
@@ -1384,7 +1539,15 @@ class Runtime:
         for rid in return_ids:
             self._entry(rid)
         self._cancellable[return_ids[0].binary()] = spec
-        self._record_lineage(spec)
+        if streaming:
+            seed = return_ids[0].binary()
+            spec.stream = self._stream_opts(seed)
+            self._register_stream(seed)
+        else:
+            # streaming tasks replay via the worker-death requeue path
+            # (skip=produced); lost index objects surface the enriched
+            # ObjectLostError instead of lineage resubmission
+            self._record_lineage(spec)
         self._enqueue(spec)
         return [ObjectRef(rid, core=self) for rid in return_ids]
 
@@ -1884,7 +2047,18 @@ class Runtime:
         the worker only CREATED (died mid-write) still leaks its creator
         ref — reclaiming that needs dead-process ref accounting in the C
         store, a narrower window left for a future round."""
-        for rid in spec.return_ids:
+        rids = list(spec.return_ids)
+        if spec.stream is not None:
+            # a streaming worker may have sealed index `produced` without
+            # its MSG_STREAM_YIELD flushing; that container is the same
+            # kind of orphan
+            st = self._streams.get(spec.stream["seed"])
+            if st is not None:
+                with st.cond:
+                    nxt = st.produced
+                rids.append(ObjectID(
+                    protocol.stream_index_id(spec.stream["seed"], nxt)))
+        for rid in rids:
             rid_b = rid.binary()
             with self._spill_lock:
                 if rid_b in self._pinned:
@@ -1941,7 +2115,7 @@ class Runtime:
                 entries.append((
                     spec.task_id.binary(), spec.fn_id, spec.args_payload,
                     inline_values, [r.binary() for r in spec.return_ids],
-                    spec.options.get("runtime_env"),
+                    spec.options.get("runtime_env"), spec.stream,
                 ))
                 sent.append(spec)
             if entries:
@@ -1973,6 +2147,7 @@ class Runtime:
                 protocol.MSG_ACTOR_CALL, spec.task_id.binary(),
                 spec.actor_id.binary(), spec.method, spec.args_payload,
                 inline_values, [r.binary() for r in spec.return_ids],
+                spec.stream,
             ))
         except (OSError, EOFError, BrokenPipeError):
             self._on_worker_death(w)
@@ -2027,8 +2202,15 @@ class Runtime:
                                   TaskCancelledError("task was cancelled"))
             else:
                 self._cancellable.pop(spec.return_ids[0].binary(), None)
-                for rid in spec.return_ids:
-                    self._store_payload(rid, err_payload)
+                st = (self._streams.get(spec.stream["seed"])
+                      if spec.stream is not None else None)
+                if st is not None:
+                    # mid-stream app error: becomes the stream's final
+                    # (raising) ref instead of resolving the seed id
+                    self._fail_stream(st, err_payload)
+                else:
+                    for rid in spec.return_ids:
+                        self._store_payload(rid, err_payload)
         self._retry_pending_pgs()
         self._worker_now_idle(w)
 
@@ -2450,16 +2632,23 @@ class Runtime:
             )
 
     def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
-                          kwargs: dict, num_returns: int = 1) -> List[ObjectRef]:
+                          kwargs: dict, num_returns=1) -> List[ObjectRef]:
         state = self._actors.get(actor_id)
         if state is None:
             raise ActorDiedError(f"unknown actor {actor_id}")
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 1
         task_id = make_task_id(self.job_id)
         args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
         args_payload, _ = protocol.serialize_args(args2, kwargs2, store=self.store)
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
         for rid in return_ids:
             self._entry(rid)
+        if streaming:
+            # registered before the dead-actor check so the error routes
+            # through the stream (consumer gets a raising ref, then end)
+            self._register_stream(return_ids[0].binary())
         if state.dead:
             refs = [ObjectRef(rid, core=self) for rid in return_ids]
             self._store_error(
@@ -2468,6 +2657,8 @@ class Runtime:
             return refs
         spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
                          actor_id=actor_id, method=method)
+        if streaming:
+            spec.stream = self._stream_opts(return_ids[0].binary())
         self._cancellable[return_ids[0].binary()] = spec
         self._enqueue(spec)
         return [ObjectRef(rid, core=self) for rid in return_ids]
@@ -2839,6 +3030,7 @@ class Runtime:
         deps = options.pop("__deps", [])
         nested = options.pop("__nested", [])
         parent = options.pop("__parent", None)
+        streaming = options.pop("__stream", False)
         task_id = make_task_id(self.job_id)
         for rid in return_ids:
             self._entry(rid)
@@ -2849,7 +3041,12 @@ class Runtime:
         spec.request, spec.pg_wire = self._prepare_request(
             options, is_actor=False)
         self._cancellable[return_ids[0].binary()] = spec
-        self._record_lineage(spec)
+        if streaming:
+            seed = return_ids[0].binary()
+            spec.stream = self._stream_opts(seed)
+            self._register_stream(seed)
+        else:
+            self._record_lineage(spec)
         self._enqueue(spec)
 
     def _apply_worker_actor_call(self, actor_id_b, method, args_payload,
@@ -2865,6 +3062,10 @@ class Runtime:
         spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
                          actor_id=state.actor_id, method=method)
         spec.parent_task = extra.get("__parent")
+        if extra.get("__stream"):
+            seed = return_ids[0].binary()
+            spec.stream = self._stream_opts(seed)
+            self._register_stream(seed)
         if state.dead:
             self._store_error(
                 return_ids,
@@ -2972,6 +3173,44 @@ class Runtime:
             # sync point: all earlier fire-and-forget sends on this conn
             # are applied once this replies (FIFO per connection)
             return ("ok",)
+        if tag == protocol.REQ_STREAM_NEXT:
+            # one bounded wait slice (the worker loops on "pending", so a
+            # cancel SIGINT never lands mid-recv of an unbounded request)
+            _, seed, index, timeout_ms, owner = msg
+            st = self._streams.get(seed)
+            if st is None:
+                raise ValueError(f"unknown stream {seed.hex()}")
+            with st.cond:
+                hit = self._stream_poll_locked(st, index)
+            if hit is not None:
+                return hit
+            deadline = time.monotonic() + timeout_ms / 1000.0
+            self._mark_worker_blocked(w, None)
+            try:
+                with st.cond:
+                    while True:
+                        hit = self._stream_poll_locked(st, index)
+                        if hit is not None:
+                            return hit
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return ("pending",)
+                        st.cond.wait(remaining)
+            finally:
+                self._unmark_worker_blocked(w, None)
+        if tag == protocol.REQ_STREAM_CREDIT:
+            _, seed, produced = msg
+            st = self._streams.get(seed)
+            if st is None:
+                # stream reaped/unknown: report full consumption so a
+                # producer can never block on a dead stream
+                return ("ok", produced)
+            with st.cond:
+                return ("ok", st.consumed)
+        if tag == protocol.REQ_STREAM_CONSUMED_ASYNC:
+            _, seed, index, owner = msg
+            self.stream_consumed(seed, index)
+            return protocol.NO_REPLY
         if tag == protocol.REQ_SUBMIT_ASYNC:
             # worker pre-generated the return ids: apply without replying
             _, fn_id, pickled_fn, args_payload, inline_values, \
@@ -3036,6 +3275,9 @@ class Runtime:
                 self._kv.pop(key, None)
                 return ("ok", None)
             raise ValueError(f"bad kv op {op}")
+        if tag == protocol.REQ_PUBSUB:
+            _, op, channel, arg, timeout = msg
+            return ("ok", self.pubsub_op(op, channel, arg, timeout))
         if tag == protocol.REQ_PG:
             _, op, *args = msg
             if op == "create":
@@ -3191,6 +3433,42 @@ class Runtime:
         if op == "del":
             self._kv.pop(key, None)
             return None
+        raise ValueError(op)
+
+    _CHANNEL_CAP = 10_000
+
+    def pubsub_op(self, op: str, channel: str, arg=None,
+                  timeout: float = 0.0):
+        """Single-node mirror of the GCS pubsub plane (gcs.py
+        _op_publish/_op_poll): ``publish`` appends to a bounded
+        per-channel log and returns the seq; ``poll`` long-polls for
+        messages with seq > arg, returning [(seq, message)]. Seqs are
+        contiguous per channel so a slow subscriber can detect trimming.
+        In cluster mode the overriding cores route these to the GCS."""
+        if op == "publish":
+            with self._pubsub_cond:
+                seq = self._channel_seq.get(channel, 0) + 1
+                self._channel_seq[channel] = seq
+                log = self._channels.setdefault(channel, [])
+                log.append((seq, arg))
+                if len(log) > self._CHANNEL_CAP:
+                    del log[: len(log) - self._CHANNEL_CAP]
+                self._pubsub_cond.notify_all()
+                return seq
+        if op == "poll":
+            since_seq = int(arg or 0)
+            deadline = time.monotonic() + timeout
+            with self._pubsub_cond:
+                while True:
+                    if self._channel_seq.get(channel, 0) > since_seq:
+                        log = self._channels[channel]
+                        first_seq = log[0][0]
+                        start = max(0, since_seq + 1 - first_seq)
+                        return log[start:]
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return []
+                    self._pubsub_cond.wait(remaining)
         raise ValueError(op)
 
     # -------------------------------------------------- memory monitor

@@ -158,10 +158,16 @@ class WorkerCore:
         return ObjectRef(oid, core=self)
 
     def submit_task(self, fn_id: bytes, pickled_fn: Optional[bytes], args: tuple,
-                    kwargs: dict, num_returns: int, options: dict) -> List[ObjectRef]:
+                    kwargs: dict, num_returns, options: dict) -> List[ObjectRef]:
         args_payload, deps, nested = _prepare_args_local(self, args, kwargs)
         send_fn = None if fn_id in self._driver_known_fns else pickled_fn
         options = dict(options)
+        if num_returns == "streaming":
+            # the single pre-generated return id doubles as the stream
+            # seed; the owner registers the stream when it applies this
+            # submission (see Runtime._apply_worker_submit)
+            num_returns = 1
+            options["__stream"] = True
         options["__deps"] = deps
         # span propagation: nested submissions carry the submitting
         # task's id so cross-process traces keep causality
@@ -177,9 +183,12 @@ class WorkerCore:
         return [ObjectRef(rid, core=self) for rid in return_ids]
 
     def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
-                          kwargs: dict, num_returns: int) -> List[ObjectRef]:
+                          kwargs: dict, num_returns) -> List[ObjectRef]:
         args_payload, deps, _nested = _prepare_args_local(self, args, kwargs)
         extra = {"__deps": deps}
+        if num_returns == "streaming":
+            num_returns = 1
+            extra["__stream"] = True
         if self.current_task_id is not None:
             extra["__parent"] = self.current_task_id.hex()
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
@@ -188,6 +197,36 @@ class WorkerCore:
             args_payload, extra, [r.binary() for r in return_ids],
         )
         return [ObjectRef(rid, core=self) for rid in return_ids]
+
+    # ---- streaming generator consumption (ObjectRefGenerator) ---------------
+
+    def stream_next(self, seed: bytes, index: int,
+                    timeout: Optional[float] = None, owner=None):
+        """Next streamed return of generator ``seed``: blocks (in short
+        request slices, so cancel/SIGINT stays responsive) until the
+        producer seals index ``index`` or ends the stream."""
+        import time
+
+        from ray_tpu.exceptions import ObjectTimeoutError
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            slice_ms = 200
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ObjectTimeoutError(
+                        f"stream {seed.hex()} index {index} not produced "
+                        f"within {timeout}s")
+                slice_ms = min(slice_ms, max(1, int(remaining * 1000)))
+            reply = self._request(
+                protocol.REQ_STREAM_NEXT, seed, index, slice_ms, owner)
+            if reply[0] != "pending":
+                return reply[0], reply[1] if len(reply) > 1 else None
+
+    def stream_consumed(self, seed: bytes, index: int, owner=None):
+        self._send_async(
+            protocol.REQ_STREAM_CONSUMED_ASYNC, seed, index, owner)
 
     def create_actor_from_worker(self, fn_id: bytes, pickled_cls: Optional[bytes],
                                  args: tuple, kwargs: dict, opts: dict) -> ActorID:
@@ -240,6 +279,12 @@ class WorkerCore:
 
     def kv_op(self, op: str, key: str, value=None):
         _, result = self._request(protocol.REQ_KV, op, key, value)
+        return result
+
+    def pubsub_op(self, op: str, channel: str, arg=None,
+                  timeout: float = 0.0):
+        _, result = self._request(protocol.REQ_PUBSUB, op, channel, arg,
+                                  timeout)
         return result
 
     def cancel_task(self, ref: ObjectRef, force: bool = False):
@@ -490,6 +535,83 @@ class WorkerCore:
         serialization.write_container(memoryview(out), pickled, views)
         return ("inline", bytes(out))
 
+    # ---- streaming generator production --------------------------------------
+
+    def _drain_async_gen(self, agen):
+        """Adapt an async generator to a sync iterator on a private loop."""
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        try:
+            while True:
+                try:
+                    yield loop.run_until_complete(agen.__anext__())
+                except StopAsyncIteration:
+                    return
+        finally:
+            loop.close()
+
+    def _stream_report(self, task_id_b: bytes, seed: bytes, index: int,
+                       rid_b: bytes, payload, is_end: bool):
+        if self._async_dirty:
+            # same cross-connection barrier as _send_results: a yielded
+            # value carrying a just-submitted ref must not reach the
+            # driver before its submission is applied
+            self._async_dirty = False
+            self._request(protocol.REQ_BARRIER)
+        with self._send_lock:
+            # rtpu-lint: disable=L2 — _send_lock serializes task_conn
+            # frames against concurrent actor-thread results; leaf lock
+            self.task_conn.send((protocol.MSG_STREAM_YIELD, task_id_b,
+                                 seed, index, rid_b, payload, is_end))
+
+    def _run_stream(self, task_id_b: bytes, result, stream_opts: dict):
+        """Drive a ``num_returns="streaming"`` task: seal each yield under
+        its deterministic index id and report it immediately, honoring the
+        consumer-credit backpressure cap; finish with a _StreamEnd sentinel
+        then a payload-less MSG_DONE for inflight bookkeeping."""
+        import time
+
+        seed = stream_opts["seed"]
+        skip = int(stream_opts.get("skip", 0))
+        cap = int(stream_opts.get("cap", 0))
+        if hasattr(result, "__aiter__") and not hasattr(result, "__next__"):
+            result = self._drain_async_gen(result)
+        if not hasattr(result, "__next__"):
+            raise TypeError(
+                f"num_returns='streaming' requires the task to return a "
+                f"generator/iterator, got {type(result).__name__}")
+        index = 0
+        for value in result:
+            if index < skip:
+                # replay after worker death: these indices were already
+                # sealed (and survive in the owner/store); re-run the
+                # generator for its state but do not re-report them
+                index += 1
+                continue
+            rid = ObjectID(protocol.stream_index_id(seed, index))
+            payload = self._serialize_result(value, rid)
+            self._stream_report(task_id_b, seed, index, rid.binary(),
+                                payload, False)
+            index += 1
+            while cap > 0:
+                # producer backpressure: pause until the consumer is
+                # within `cap` indices of us (instant probe + sleep keeps
+                # SIGINT cancel windows off the data conn)
+                _, consumed = self._request(
+                    protocol.REQ_STREAM_CREDIT, seed, index)
+                if index - consumed < cap:
+                    break
+                time.sleep(0.005)
+        rid = ObjectID(protocol.stream_index_id(seed, index))
+        payload = self._serialize_result(protocol._StreamEnd(index), rid)
+        self._stream_report(task_id_b, seed, index, rid.binary(),
+                            payload, True)
+        with self._send_lock:
+            # rtpu-lint: disable=L2 — _send_lock serializes task_conn
+            # frames (see _send_results); leaf lock
+            self.task_conn.send((protocol.MSG_DONE, task_id_b, []))
+
     def _execute_task_batch(self, tasks):
         """Execute a pipelined batch. The *dispatch* leg is what the batching
         amortizes (one driver→worker message for N tasks, the reference gets
@@ -504,6 +626,7 @@ class WorkerCore:
             task_id_b, fn_id, args_payload, inline_values, return_ids = \
                 entry[:5]
             runtime_env = entry[5] if len(entry) > 5 else None
+            stream_opts = entry[6] if len(entry) > 6 else None
             if config.testing_kill_worker_prob > 0:
                 # Chaos injection (reference: WorkerKillerActor,
                 # python/ray/_private/test_utils.py:1597).
@@ -528,8 +651,11 @@ class WorkerCore:
                 fn = self._functions[fn_id]
                 args, kwargs = self._decode_args(args_payload, inline_values)
                 result = fn(*args, **kwargs)
-                self._send_results(task_id_b, result, len(return_ids),
-                                   return_ids)
+                if stream_opts is not None:
+                    self._run_stream(task_id_b, result, stream_opts)
+                else:
+                    self._send_results(task_id_b, result, len(return_ids),
+                                       return_ids)
             except BaseException as e:  # noqa: BLE001
                 self._send_error(task_id_b, e)
             finally:
@@ -631,7 +757,9 @@ class WorkerCore:
             )
 
     def _execute_actor_call(self, msg):
-        _, task_id_b, actor_id_b, method, args_payload, inline_values, return_ids = msg
+        (_, task_id_b, actor_id_b, method, args_payload, inline_values,
+         return_ids) = msg[:7]
+        stream_opts = msg[7] if len(msg) > 7 else None
         self.current_task_id = TaskID(task_id_b)
         self.current_actor_id = ActorID(actor_id_b)
         try:
@@ -658,7 +786,11 @@ class WorkerCore:
                         loop = asyncio.new_event_loop()
                         self._actor_loops[actor_id_b] = loop
                 result = loop.run_until_complete(result)
-            self._send_results(task_id_b, result, len(return_ids), return_ids)
+            if stream_opts is not None:
+                self._run_stream(task_id_b, result, stream_opts)
+            else:
+                self._send_results(task_id_b, result, len(return_ids),
+                                   return_ids)
         except BaseException as e:  # noqa: BLE001
             self._send_error(task_id_b, e)
         finally:

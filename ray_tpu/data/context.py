@@ -42,6 +42,10 @@ class DataContext:
     prefetch_batches: int = 2
     # Raise instead of warn when a map UDF returns an unknown type.
     strict_mode: bool = True
+    # Run read/map tasks as num_returns="streaming" generators so each
+    # output block is sealed and routed downstream as it is produced
+    # (downstream operators start before the producing task finishes).
+    streaming_map_returns: bool = True
     # Extra metadata attached by tests.
     extras: dict = field(default_factory=dict)
 

@@ -73,6 +73,37 @@ def _run_read_task(read_task, chain: Optional[MapTransformChain]
                                 time.process_time() - c0)
 
 
+def _stream_blocks(block_iter):
+    """Yield (meta, blocks) pairs for a num_returns="streaming" task body:
+    even yields carry the block's metadata (small — the driver fetches it
+    to build the RefBundle), odd yields carry the block itself. Per-block
+    exec stats are incremental so the executor's sums stay correct."""
+    t_prev, c_prev = time.perf_counter(), time.process_time()
+    for b in block_iter:
+        meta = BlockAccessor(b).get_metadata()
+        t, c = time.perf_counter(), time.process_time()
+        meta.exec_stats = {
+            "wall_s": t - t_prev, "cpu_s": c - c_prev,
+            "peak_block_bytes": meta.size_bytes,
+        }
+        t_prev, c_prev = t, c
+        yield [meta]
+        yield [b]
+
+
+@ray_tpu.remote
+def _run_map_task_stream(chain: MapTransformChain, blocks: List[Block]):
+    yield from _stream_blocks(chain(blocks))
+
+
+@ray_tpu.remote
+def _run_read_task_stream(read_task, chain: Optional[MapTransformChain]):
+    blocks = read_task()
+    if chain is not None:
+        blocks = chain(blocks)
+    yield from _stream_blocks(blocks)
+
+
 @ray_tpu.remote
 def _truncate_blocks(blocks: List[Block], rows: int
                      ) -> Tuple[List[Block], List[BlockMetadata]]:
@@ -273,13 +304,20 @@ class PhysicalOperator:
         self.sched_wall_s = 0.0
         self.peak_block_bytes = 0
         self._launch_ts: Dict[ObjectRef, float] = {}
+        # In-flight num_returns="streaming" tasks: seed -> poll state
+        # (the executor drains ready yields each tick via poll_streams).
+        self._streams: Dict[bytes, dict] = {}
         # Ordered emission: outputs enter output_queue in LAUNCH order even
         # though tasks complete out of order (reference: preserve_order in
         # streaming_executor_state; required for sort/zip/limit determinism).
+        # A seq buffers a LIST of bundles (a streaming task emits many);
+        # the head seq's bundles flow out as produced, and the head only
+        # advances once that seq is closed.
         self._seq = 0
         self._emit_next = 0
         self._pending_seq: Dict[ObjectRef, int] = {}
-        self._outbuf: Dict[int, RefBundle] = {}
+        self._outbuf: Dict[int, List[RefBundle]] = {}
+        self._open_seqs: set = set()  # streaming seqs still producing
 
     def _track(self, meta_ref: ObjectRef, blocks_ref: ObjectRef):
         """Register an in-flight task in launch order."""
@@ -288,10 +326,28 @@ class PhysicalOperator:
         self._launch_ts[meta_ref] = time.perf_counter()
         self._seq += 1
 
+    def _track_stream(self, gen):
+        """Register an in-flight streaming task (ObjectRefGenerator)."""
+        seq = self._seq
+        self._seq += 1
+        self._streams[gen.seed] = {"gen": gen, "seq": seq, "meta": None,
+                                   "launched": time.perf_counter()}
+        self._open_seqs.add(seq)
+        self._outbuf.setdefault(seq, [])
+
     def _emit(self, seq: int, bundle: RefBundle):
-        self._outbuf[seq] = bundle
-        while self._emit_next in self._outbuf:
-            self.output_queue.append(self._outbuf.pop(self._emit_next))
+        self._outbuf.setdefault(seq, []).append(bundle)
+        self._flush_emits()
+
+    def _flush_emits(self):
+        while True:
+            buf = self._outbuf.get(self._emit_next)
+            if buf:
+                self.output_queue.extend(buf)
+                buf.clear()
+            if self._emit_next in self._open_seqs or buf is None:
+                return
+            del self._outbuf[self._emit_next]
             self._emit_next += 1
 
     def _emit_direct(self, bundle: RefBundle):
@@ -299,6 +355,54 @@ class PhysicalOperator:
         seq = self._seq
         self._seq += 1
         self._emit(seq, bundle)
+
+    def has_streams(self) -> bool:
+        return bool(self._streams)
+
+    def poll_streams(self) -> Tuple[bool, int]:
+        """Drain every ready yield from in-flight streaming tasks without
+        blocking; returns (progressed, tasks_completed). Even yields are
+        block metadata, odd yields the block list (see _stream_blocks)."""
+        from ray_tpu.exceptions import ObjectTimeoutError
+
+        progressed, completed = False, 0
+        for key, stt in list(self._streams.items()):
+            while True:
+                try:
+                    ref = stt["gen"].next_ref(timeout=0)
+                except ObjectTimeoutError:
+                    break
+                except StopIteration:
+                    del self._streams[key]
+                    self._open_seqs.discard(stt["seq"])
+                    self._flush_emits()
+                    self.sched_wall_s += (time.perf_counter()
+                                          - stt["launched"])
+                    progressed = True
+                    completed += 1
+                    break
+                if stt["meta"] is None:
+                    # already sealed (the ref was delivered): instant get.
+                    # A task error raises here, like on_task_done's get.
+                    stt["meta"] = ray_tpu.get(ref)
+                    continue
+                metas: List[BlockMetadata] = stt["meta"]
+                stt["meta"] = None
+                num_rows = sum(m.num_rows for m in metas)
+                size = sum(m.size_bytes for m in metas)
+                self.rows_out += num_rows
+                self.bytes_out += size
+                for m in metas:
+                    es = m.exec_stats
+                    if es:
+                        self.task_wall_s += es.get("wall_s", 0.0)
+                        self.task_cpu_s += es.get("cpu_s", 0.0)
+                        self.peak_block_bytes = max(
+                            self.peak_block_bytes,
+                            es.get("peak_block_bytes", 0))
+                self._emit(stt["seq"], RefBundle(ref, num_rows, size, metas))
+                progressed = True
+        return progressed, completed
 
     def add_input(self, bundle: RefBundle):
         self.rows_in += bundle.num_rows
@@ -313,7 +417,7 @@ class PhysicalOperator:
 
     def can_launch(self, max_in_flight: int) -> bool:
         return (len(self.input_queue) > 0 and
-                len(self.pending) < max_in_flight)
+                len(self.pending) + len(self._streams) < max_in_flight)
 
     def launch_one(self):
         raise NotImplementedError
@@ -343,14 +447,17 @@ class PhysicalOperator:
     @property
     def done(self) -> bool:
         return (self.inputs_complete and not self.input_queue and
-                not self.pending)
+                not self.pending and not self._streams)
 
     def all_inputs_ready(self) -> bool:
-        return self.inputs_complete and not self.pending
+        return (self.inputs_complete and not self.pending
+                and not self._streams)
 
     def __repr__(self):
         return (f"{self.name}(in={len(self.input_queue)} "
-                f"pending={len(self.pending)} out={len(self.output_queue)})")
+                f"pending={len(self.pending)} "
+                f"streams={len(self._streams)} "
+                f"out={len(self.output_queue)})")
 
 
 class InputDataBuffer(PhysicalOperator):
@@ -365,6 +472,8 @@ class InputDataBuffer(PhysicalOperator):
         self._read_tasks = list(read_tasks or [])
         self._chain = chain
         self._resources = resources or {}
+        from ray_tpu.data.context import DataContext
+        self._streaming = DataContext.get_current().streaming_map_returns
         if bundles:
             for b in bundles:
                 self.rows_out += b.num_rows
@@ -373,19 +482,27 @@ class InputDataBuffer(PhysicalOperator):
         self.inputs_complete = True
 
     def can_launch(self, max_in_flight: int) -> bool:
-        return bool(self._read_tasks) and len(self.pending) < max_in_flight
+        return (bool(self._read_tasks) and
+                len(self.pending) + len(self._streams) < max_in_flight)
 
     def launch_one(self):
         rt = self._read_tasks.pop(0)
-        opts = dict(num_returns=2, **self._resources)
-        blocks_ref, meta_ref = _run_read_task.options(**opts).remote(
-            rt, self._chain)
-        self._track(meta_ref, blocks_ref)
+        if self._streaming:
+            opts = dict(num_returns="streaming", **self._resources)
+            gen = _run_read_task_stream.options(**opts).remote(
+                rt, self._chain)
+            self._track_stream(gen)
+        else:
+            opts = dict(num_returns=2, **self._resources)
+            blocks_ref, meta_ref = _run_read_task.options(**opts).remote(
+                rt, self._chain)
+            self._track(meta_ref, blocks_ref)
         self.tasks_launched += 1
 
     @property
     def done(self) -> bool:
-        return not self._read_tasks and not self.pending
+        return (not self._read_tasks and not self.pending
+                and not self._streams)
 
 
 class TaskPoolMapOperator(PhysicalOperator):
@@ -398,6 +515,8 @@ class TaskPoolMapOperator(PhysicalOperator):
         super().__init__(name)
         self.chain = chain
         self._resources = resources or {}
+        from ray_tpu.data.context import DataContext
+        self._streaming = DataContext.get_current().streaming_map_returns
         # User-requested concurrency cap (map_batches(concurrency=N) →
         # TaskPoolStrategy(N)); min()-ed with the executor-wide cap.
         self._max_concurrency = max_concurrency
@@ -409,10 +528,16 @@ class TaskPoolMapOperator(PhysicalOperator):
 
     def launch_one(self):
         bundle: RefBundle = self.input_queue.popleft()
-        opts = dict(num_returns=2, **self._resources)
-        blocks_ref, meta_ref = _run_map_task.options(**opts).remote(
-            self.chain, bundle.blocks_ref)
-        self._track(meta_ref, blocks_ref)
+        if self._streaming:
+            opts = dict(num_returns="streaming", **self._resources)
+            gen = _run_map_task_stream.options(**opts).remote(
+                self.chain, bundle.blocks_ref)
+            self._track_stream(gen)
+        else:
+            opts = dict(num_returns=2, **self._resources)
+            blocks_ref, meta_ref = _run_map_task.options(**opts).remote(
+                self.chain, bundle.blocks_ref)
+            self._track(meta_ref, blocks_ref)
         self.tasks_launched += 1
 
 

@@ -11,7 +11,7 @@ backpressure) are accumulated by the scheduling loop.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, Optional
 
 
 def _fmt_bytes(n: float) -> str:
@@ -36,6 +36,12 @@ class OpStats:
     sched_wall_s: float = 0.0     # launch -> completion (incl. queueing)
     peak_block_bytes: int = 0
     backpressure_s: float = 0.0   # time gated by downstream pressure
+    # Pipeline-relative timeline (seconds since execution start). With
+    # streaming map returns a downstream op's started_s precedes its
+    # upstream's finished_s — blocks flow before the producing task ends.
+    started_s: Optional[float] = None       # first task launched / output
+    first_output_s: Optional[float] = None  # first rows emitted
+    finished_s: Optional[float] = None      # operator fully done
 
     # kept for pre-existing callers
     @property
@@ -64,6 +70,13 @@ class OpStats:
         if self.peak_block_bytes:
             out.append(
                 f"    peak block: {_fmt_bytes(self.peak_block_bytes)}")
+        if self.started_s is not None:
+            seg = f"    timeline: start +{self.started_s:.3f}s"
+            if self.first_output_s is not None:
+                seg += f", first output +{self.first_output_s:.3f}s"
+            if self.finished_s is not None:
+                seg += f", done +{self.finished_s:.3f}s"
+            out.append(seg)
         if self.backpressure_s > 0.0005:
             out.append(
                 f"    backpressured: {self.backpressure_s:.3f}s")

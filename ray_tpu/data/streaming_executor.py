@@ -79,6 +79,7 @@ class StreamingExecutor:
                 self._route_outputs(topo, sink)
                 launched = self._launch_ready(topo, max_in_flight,
                                               op_stats)
+                self._mark_timeline(ops, op_stats, t0)
                 while sink.output_queue:
                     bundle = sink.output_queue.popleft()
                     op_stats[id(sink)].rows += bundle.num_rows
@@ -91,15 +92,20 @@ class StreamingExecutor:
                     break
                 if not progressed and not launched:
                     # Nothing moved: block on in-flight work instead of
-                    # spinning.
+                    # spinning. Streaming tasks have no waitable ref — the
+                    # next yield only shows up to poll_streams — so cap
+                    # the block while any stream is live.
+                    streaming = any(op.has_streams() for op in ops)
                     refs = [r for op in ops for r in op.waitable_refs()]
                     if refs:
-                        ray_tpu.wait(refs, num_returns=1, timeout=10.0)
+                        ray_tpu.wait(refs, num_returns=1,
+                                     timeout=0.05 if streaming else 10.0)
                     else:
-                        time.sleep(0.002)
+                        time.sleep(0.01 if streaming else 0.002)
         finally:
             self._stats.wall_time_s = time.perf_counter() - t0
             now = time.perf_counter()
+            self._mark_timeline(ops, op_stats, t0)
             for op in ops:
                 since = self._bp_since.pop(id(op), None)
                 if since is not None:
@@ -107,6 +113,20 @@ class StreamingExecutor:
                 self._snapshot_op(op, op_stats[id(op)])
                 if isinstance(op, ActorPoolMapOperator):
                     op.shutdown()
+
+    def _mark_timeline(self, ops, op_stats, t0):
+        """Per-op start / first-output / done timestamps relative to
+        pipeline start. With streaming map returns, a downstream op's
+        start predates its upstream's done — Dataset.stats() shows it."""
+        now = time.perf_counter() - t0
+        for op in ops:
+            s = op_stats[id(op)]
+            if s.started_s is None and (op.tasks_launched or op.rows_out):
+                s.started_s = now
+            if s.first_output_s is None and op.rows_out:
+                s.first_output_s = now
+            if s.finished_s is None and op.done:
+                s.finished_s = now
 
     @staticmethod
     def _snapshot_op(op, s):
@@ -136,14 +156,21 @@ class StreamingExecutor:
             for r in op.waitable_refs():
                 refs.append(r)
                 owner[r] = op
-        if not refs:
-            return False
-        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
-        for r in ready:
-            op = owner[r]
-            op.on_task_done(r)
-            op_stats[id(op)].tasks_finished += 1
-        return bool(ready)
+        if refs:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+            for r in ready:
+                op = owner[r]
+                op.on_task_done(r)
+                op_stats[id(op)].tasks_finished += 1
+            progressed = bool(ready)
+        else:
+            progressed = False
+        # streaming tasks: drain whatever yields are ready right now
+        for op in ops:
+            p, finished = op.poll_streams()
+            progressed = progressed or p
+            op_stats[id(op)].tasks_finished += finished
+        return progressed
 
     def _route_outputs(self, topo: Topology, sink):
         for op in topo.ops:

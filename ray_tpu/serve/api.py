@@ -71,8 +71,11 @@ class DeploymentHandle:
         return _OptionedHandle(self, multiplexed_model_id)
 
     def stream(self, *args, **kwargs):
-        """Token streaming against an engine deployment: a generator of
-        new-token lists (reference: handle streaming + serve.llm)."""
+        """Streaming responses: for generator deployments (the callable
+        uses ``yield``) each yielded item arrives as it is produced via
+        ``num_returns="streaming"``; engine deployments yield new-token
+        lists from the mailbox (reference: handle streaming + serve.llm).
+        """
         return self._get_router().stream_request(args, kwargs)
 
     def __getattr__(self, method: str) -> _MethodCaller:
@@ -110,11 +113,10 @@ class _OptionedHandle:
         return _OptionedHandle(self._handle, multiplexed_model_id)
 
     def stream(self, *args, **kwargs):
-        if self._model_id is not None:
-            raise ValueError(
-                "multiplexed_model_id is not supported for engine "
-                "streaming deployments")
-        return self._handle.stream(*args, **kwargs)
+        # the router rejects model_id only where it genuinely can't be
+        # honored (engine mailbox); generator streams route mux-aware
+        return self._handle._get_router().stream_request(
+            args, kwargs, model_id=self._model_id)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
@@ -220,6 +222,14 @@ def run(target: Deployment, name: Optional[str] = None,
         getattr(fn, "__call__", None), "__serve_batch__", None)
     if marks and not cfg.get("max_batch_size"):
         cfg.update(marks)
+    # generator deployments stream through ObjectRefGenerator: routers
+    # read this to pick the handle.stream() transport
+    import inspect
+
+    call = fn if not isinstance(fn, type) else getattr(fn, "__call__", None)
+    cfg["is_generator"] = bool(
+        call is not None and (inspect.isgeneratorfunction(call)
+                              or inspect.isasyncgenfunction(call)))
     ray_tpu.get(controller.deploy.remote(
         dep_name, cloudpickle.dumps(fn), cfg), timeout=30)
     if wait_for_healthy:

@@ -70,6 +70,20 @@ class ReplicaActor:
                 _current_model_id.reset(token)
         return self._call(*args, **kwargs)
 
+    def handle_stream(self, args: tuple, kwargs: dict):
+        """Generator deployments: invoked with num_returns="streaming" so
+        every yielded item seals into the object store as produced and the
+        router consumes refs via ObjectRefGenerator — no mailbox polling."""
+        from ray_tpu.serve.multiplex import _MUX_KWARG, _current_model_id
+
+        mid = kwargs.pop(_MUX_KWARG, None)
+        token = _current_model_id.set(mid) if mid is not None else None
+        try:
+            yield from self._call(*args, **kwargs)
+        finally:
+            if token is not None:
+                _current_model_id.reset(token)
+
     def handle_batch(self, requests: List[tuple]) -> List[Any]:
         """Dynamic batching: the router flushes a list of (args, kwargs);
         the deployment's batch callable receives the list of first args

@@ -55,6 +55,9 @@ class Router:
         self._max_batch = int(cfg.get("max_batch_size", 0))
         self._batch_wait_s = float(cfg.get("batch_wait_timeout_s", 0.01))
         self._engine = bool(cfg.get("engine", False))
+        # generator deployments stream through num_returns="streaming"
+        # actor calls instead of the engine mailbox (set by serve.run)
+        self._streaming = bool(cfg.get("is_generator", False))
         self._pending: List[Tuple[tuple, dict, Future]] = []
         self._batch_thread: Optional[threading.Thread] = None
         self._engine_state: Dict[str, Any] = {}
@@ -445,13 +448,84 @@ class Router:
 
     # ---------------------------------------------------------------- engine
 
-    def stream_request(self, args, kwargs, timeout_s: float = 600.0):
+    def stream_request(self, args, kwargs, timeout_s: float = 600.0,
+                       model_id: Optional[str] = None):
+        """Streaming entry point. Generator deployments (the callable
+        uses ``yield``) ride ``num_returns="streaming"`` actor calls:
+        each yielded item seals into the object store as produced and is
+        pulled here via ObjectRefGenerator. Engine deployments (LLM
+        continuous batching) fall back to the submit/peek mailbox. A
+        deployment that is neither fails with a clear TypeError."""
+        self._ensure_report_thread()
+        if self._streaming and not self._engine:
+            return self._generator_stream(args, kwargs, timeout_s,
+                                          model_id)
+        if not self._engine:
+            raise TypeError(
+                f"deployment {self._name!r} is neither a generator nor "
+                "an engine: stream() needs a callable that yields, or "
+                "an engine exposing submit/peek/collect; use .remote() "
+                "for request/response")
+        if model_id is not None:
+            # the engine mailbox mixes requests across model ids
+            raise ValueError(
+                "multiplexed_model_id is not supported for engine "
+                "streaming deployments")
+        return self._engine_stream(args, kwargs, timeout_s)
+
+    def _generator_stream(self, args, kwargs, timeout_s: float,
+                          model_id: Optional[str]):
+        """Consume a generator replica: one streaming actor call, yield
+        each item as its ref arrives (backpressure rides the stream's
+        credit window, so a slow consumer stalls the replica's yields)."""
+        from ray_tpu.exceptions import ObjectTimeoutError
+        from ray_tpu.serve.multiplex import _MUX_KWARG
+
+        if model_id is not None:
+            kwargs = dict(kwargs, **{_MUX_KWARG: model_id})
+        rid, handle = self._pick(model_id)
+        with self._lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        deadline = time.monotonic() + timeout_s
+        gen = None
+        try:
+            gen = handle.handle_stream.options(
+                num_returns="streaming").remote(args, kwargs)
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"stream exceeded {timeout_s}s")
+                try:
+                    ref = gen.next_ref(timeout=remaining)
+                except StopIteration:
+                    gen = None  # drained: nothing to cancel
+                    return
+                except ObjectTimeoutError:
+                    raise TimeoutError(
+                        f"stream exceeded {timeout_s}s") from None
+                yield ray_tpu.get(ref)
+        except ActorDiedError:
+            self._drop_replica(rid)
+            raise
+        finally:
+            if gen is not None:
+                # abandoned/errored mid-stream: stop the replica-side
+                # generator so it doesn't keep producing into the void
+                try:
+                    ray_tpu.cancel(gen)
+                except Exception:  # noqa: BLE001
+                    pass
+            with self._lock:
+                if rid in self._inflight:  # dropped replicas stay dropped
+                    self._inflight[rid] = max(0, self._inflight[rid] - 1)
+
+    def _engine_stream(self, args, kwargs, timeout_s: float):
         """Generator over an engine request's progress: yields lists of
         NEW tokens as they are generated, ending after the final chunk
         (reference: serve streaming responses / vLLM token streaming).
         Requires an engine with ``peek`` (the LLM engine); bounded by
         ``timeout_s`` overall."""
-        self._ensure_report_thread()
         with self._lock:
             self._req_seq += 1
             req_id = f"s{id(self)}-{self._req_seq}"

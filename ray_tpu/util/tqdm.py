@@ -1,0 +1,271 @@
+"""Distributed progress bars multiplexed on the driver terminal.
+
+Reference: python/ray/experimental/tqdm_ray.py — remote tasks/actors
+construct a ``tqdm``-shaped bar whose state updates travel to the driver,
+where a single manager owns the terminal and redraws every live bar as one
+block, so bars from concurrent tasks never interleave mid-line.
+
+Transport here is the GCS pubsub plane (cluster mode) or its single-node
+mirror (``Runtime.pubsub_op``): each bar publishes compact state dicts on
+the ``tqdm`` channel (rate-limited, forced on open/close) and the driver's
+:class:`_BarManager` long-polls the channel from seq 0, so bars created
+before the manager attached are replayed, not lost. Stdlib only — no
+dependency on the real tqdm.
+
+Usage (mirrors tqdm's core surface)::
+
+    from ray_tpu.util import tqdm as tqdm_ray
+
+    @ray_tpu.remote
+    def work(n):
+        for _ in tqdm_ray.tqdm(range(n), desc="shard"):
+            ...
+
+    tqdm_ray.instance()          # driver: attach the multiplexer
+    ray_tpu.get([work.remote(100) for _ in range(4)])
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional, TextIO, Tuple
+
+CHANNEL = "tqdm"
+_BAR_WIDTH = 20
+_PUBLISH_INTERVAL_S = 0.05   # per-bar update rate limit on the wire
+_RENDER_INTERVAL_S = 0.05    # terminal redraw rate limit
+
+
+def _core_or_none():
+    from ray_tpu.core import runtime_context
+
+    return runtime_context.get_core_or_none()
+
+
+def _in_worker(core) -> bool:
+    return core is not None and type(core).__module__.endswith("worker_proc")
+
+
+class tqdm:  # noqa: N801 — mirrors the tqdm API
+    """Remote-friendly progress bar: state changes publish to the driver
+    instead of writing to this process's stderr."""
+
+    def __init__(self, iterable=None, desc: Optional[str] = None,
+                 total: Optional[int] = None, position: Optional[int] = None,
+                 unit: str = "it", **_ignored):
+        if total is None and iterable is not None:
+            try:
+                total = len(iterable)
+            except TypeError:
+                total = None
+        self._iterable = iterable
+        self._uuid = uuid.uuid4().hex
+        self._desc = desc or ""
+        self._total = total
+        self._unit = unit
+        self._pos = position
+        self._x = 0
+        self._closed = False
+        self._t0 = time.monotonic()
+        self._last_pub = 0.0
+        self._publish(force=True)
+
+    # -- tqdm surface --------------------------------------------------------
+
+    def update(self, n: int = 1):
+        self._x += n
+        self._publish()
+
+    def set_description(self, desc: str, refresh: bool = True):
+        self._desc = desc
+        if refresh:
+            self._publish(force=True)
+
+    def refresh(self):
+        self._publish(force=True)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._publish(force=True)
+
+    def __iter__(self):
+        if self._iterable is None:
+            raise TypeError("bar created without an iterable")
+        try:
+            for item in self._iterable:
+                yield item
+                self.update(1)
+        finally:
+            self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- wiring --------------------------------------------------------------
+
+    def _state(self) -> Dict[str, Any]:
+        return {
+            "uuid": self._uuid, "pid": os.getpid(), "desc": self._desc,
+            "total": self._total, "x": self._x, "unit": self._unit,
+            "pos": self._pos, "closed": self._closed,
+            "elapsed": time.monotonic() - self._t0,
+        }
+
+    def _publish(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_pub < _PUBLISH_INTERVAL_S:
+            return
+        self._last_pub = now
+        core = _core_or_none()
+        state = self._state()
+        if _in_worker(core):
+            try:
+                core.pubsub_op("publish", CHANNEL, state)
+            except Exception:  # noqa: BLE001 — a lost tick, not a crash
+                pass
+        else:
+            # driver-side bar: feed the manager directly, no round trip
+            instance().update_bar(state)
+
+
+def _format_bar(s: Dict[str, Any]) -> str:
+    desc = s["desc"] or f"pid={s['pid']}"
+    x, total = s["x"], s["total"]
+    elapsed = max(s.get("elapsed", 0.0), 1e-9)
+    rate = x / elapsed
+    if total:
+        frac = min(max(x / total, 0.0), 1.0)
+        filled = int(frac * _BAR_WIDTH)
+        bar = "#" * filled + "-" * (_BAR_WIDTH - filled)
+        body = f"|{bar}| {x}/{total} [{frac * 100:3.0f}%]"
+    else:
+        body = f"{x}{s['unit']}"
+    tail = " done" if s["closed"] else ""
+    return f"{desc}: {body} {rate:.1f}{s['unit']}/s{tail}"
+
+
+class _BarManager:
+    """Driver-side multiplexer: owns the terminal, one redraw per tick.
+
+    Every render rewrites the whole block of live bars in a single
+    ``write()`` under one lock (cursor-up + clear-line per bar), which is
+    what prevents interleaving corruption when many tasks publish at
+    once — per-bar writes from multiple threads can tear mid-line, one
+    block write cannot."""
+
+    def __init__(self, sink: Optional[TextIO] = None):
+        self._sink = sink
+        self._lock = threading.Lock()
+        # (pid, uuid) -> state; insertion order fixes on-screen order
+        self._bars: Dict[Tuple[int, str], Dict[str, Any]] = {}
+        self._lines_drawn = 0
+        self._last_render = 0.0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- ingest --------------------------------------------------------------
+
+    def update_bar(self, state: Dict[str, Any]):
+        with self._lock:
+            self._bars[(state["pid"], state["uuid"])] = state
+            self._render_locked(force=state["closed"])
+
+    def _poll_loop(self):
+        since = 0
+        while not self._stop:
+            core = _core_or_none()
+            if core is None or _in_worker(core):
+                time.sleep(0.2)
+                continue
+            try:
+                msgs = core.pubsub_op("poll", CHANNEL, since, 0.5)
+            except Exception:  # noqa: BLE001 — shutdown / transient rpc
+                time.sleep(0.5)
+                continue
+            for seq, state in msgs:
+                since = max(since, seq)
+                self.update_bar(state)
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._poll_loop, daemon=True, name="rtpu-tqdm")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop = True
+
+    # -- render --------------------------------------------------------------
+
+    def _render_locked(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_render < _RENDER_INTERVAL_S:
+            return
+        self._last_render = now
+        sink = self._sink if self._sink is not None else sys.stderr
+        lines = [_format_bar(s) for s in self._bars.values()]
+        chunk = []
+        if self._lines_drawn:
+            chunk.append(f"\x1b[{self._lines_drawn}A")
+        for ln in lines:
+            chunk.append("\r\x1b[2K" + ln + "\n")
+        if self._lines_drawn > len(lines):
+            chunk.append("\x1b[0J")  # fewer bars than before: clear rest
+        try:
+            sink.write("".join(chunk))
+            sink.flush()
+        except (OSError, ValueError):
+            return  # sink closed (interpreter teardown)
+        self._lines_drawn = len(lines)
+
+    def flush(self):
+        with self._lock:
+            self._render_locked(force=True)
+
+
+_instance: Optional[_BarManager] = None
+_instance_lock = threading.Lock()
+
+
+def instance(sink: Optional[TextIO] = None) -> _BarManager:
+    """The process-wide bar manager; on the driver this also starts the
+    pubsub subscriber thread that mirrors remote bars to the terminal."""
+    global _instance
+    with _instance_lock:
+        if _instance is None:
+            _instance = _BarManager(sink=sink)
+        elif sink is not None:
+            _instance._sink = sink
+        if not _in_worker(_core_or_none()):
+            _instance.start()
+        return _instance
+
+
+def safe_print(*args, **kwargs):
+    """Print without tearing the bar block: temporarily drops below the
+    drawn bars (reference: tqdm_ray.safe_print)."""
+    mgr = _instance
+    if mgr is None:
+        print(*args, **kwargs)
+        return
+    with mgr._lock:
+        sink = mgr._sink if mgr._sink is not None else sys.stderr
+        if mgr._lines_drawn:
+            try:
+                sink.write("\r\x1b[2K")
+            except (OSError, ValueError):
+                pass
+        print(*args, **kwargs)
+        mgr._lines_drawn = 0
+        mgr._render_locked(force=True)

@@ -771,3 +771,50 @@ def test_sender_side_push_flow_control():
         config.reload()
         c.shutdown()
         runtime_context.set_core(prev)
+
+
+def test_cluster_streaming_generator_cross_node(cluster):
+    """Streaming returns work cluster-wide: the driver consumes refs from
+    a producer pinned to a remote node while it is still yielding, the
+    generator survives being pickled into a task on a THIRD node, and
+    mid-stream cancel propagates."""
+    from ray_tpu.exceptions import TaskCancelledError, TaskError
+
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            time.sleep(0.02)
+            yield i * 10
+
+    # driver consumes from a pinned remote producer, while running
+    g = gen.options(num_returns="streaming",
+                    resources={"res1": 1}).remote(8)
+    t0 = time.monotonic()
+    vals, first_at = [], None
+    for ref in g:
+        if first_at is None:
+            first_at = time.monotonic() - t0
+        vals.append(ray_tpu.get(ref, timeout=30))
+    total = time.monotonic() - t0
+    assert vals == [i * 10 for i in range(8)]
+    assert first_at < total / 2, (first_at, total)
+
+    # the generator handle pickles into a task on ANOTHER node
+    @ray_tpu.remote
+    def consume(g2):
+        return [ray_tpu.get(ref, timeout=30) for ref in g2]
+
+    g2 = gen.options(num_returns="streaming",
+                     resources={"res0": 1}).remote(5)
+    out = ray_tpu.get(
+        consume.options(resources={"res2": 1}).remote(g2), timeout=60)
+    assert out == [i * 10 for i in range(5)]
+
+    # mid-stream cancel of a remote producer
+    g3 = gen.options(num_returns="streaming",
+                     resources={"res1": 1}).remote(1000)
+    ray_tpu.get(g3.next_ref(timeout=30), timeout=30)
+    ray_tpu.cancel(g3)
+    with pytest.raises((TaskCancelledError, TaskError)):
+        for ref in g3:
+            ray_tpu.get(ref, timeout=30)

@@ -808,3 +808,56 @@ def test_long_poll_topology_push(serve_ray):
     while _time.monotonic() < deadline and not router._deployment_gone:
         _time.sleep(0.01)
     assert router._deployment_gone
+
+
+# ----------------------------------------------------------- streaming
+
+
+def test_stream_generator_deployment(serve_ray):
+    """A generator deployment streams through num_returns="streaming":
+    the first item arrives while the replica is still yielding, not
+    after the full response is buffered."""
+    @serve.deployment(name="tokens")
+    def tokens(n):
+        for i in range(int(n)):
+            time.sleep(0.01)
+            yield f"tok{i}"
+
+    handle = serve.run(tokens.bind())
+    t0 = time.perf_counter()
+    got, first = [], None
+    for item in handle.stream(20):
+        if first is None:
+            first = time.perf_counter() - t0
+        got.append(item)
+    total = time.perf_counter() - t0
+    assert got == [f"tok{i}" for i in range(20)]
+    assert first < total / 2, (first, total)
+    serve.delete("tokens")
+
+
+def test_stream_class_deployment_with_mux(serve_ray):
+    @serve.deployment(name="muxgen")
+    class Gen:
+        def __call__(self, n):
+            mid = serve.get_multiplexed_model_id()
+            for i in range(int(n)):
+                yield (mid, i)
+
+    handle = serve.run(Gen.bind())
+    out = list(handle.options(multiplexed_model_id="m1").stream(5))
+    assert out == [("m1", i) for i in range(5)]
+    serve.delete("muxgen")
+
+
+def test_stream_non_generator_deployment_raises(serve_ray):
+    @serve.deployment(name="plainfn")
+    def plain(x):
+        return x + 1
+
+    handle = serve.run(plain.bind())
+    with pytest.raises(TypeError, match="generator"):
+        list(handle.stream(1))
+    # request/response still works on the same handle
+    assert handle.remote(1).result(timeout=30) == 2
+    serve.delete("plainfn")
