@@ -903,6 +903,92 @@ def bench_many_nodes(rows: list):
         runtime_context.set_core(prev)
 
 
+def _locality_wave(locality_on: bool, mb: int = 100, tasks: int = 8):
+    """One measurement: a fresh 2-node cluster, a ``mb``-MB object pinned
+    to the src node, then a timed wave of ``tasks`` unconstrained
+    consumers sharing it. Returns (wall_s, summed node fetch stats)."""
+    import ray_tpu
+    from ray_tpu.core import runtime_context
+    from ray_tpu.core.cluster.fixture import Cluster
+    from ray_tpu.core.config import config as cfg
+
+    runtime_context.set_core(None)
+    os.environ["RTPU_LOCALITY_AWARE_SCHEDULING"] = (
+        "1" if locality_on else "0")
+    cfg.reload()
+    c = Cluster(num_nodes=2, num_workers_per_node=2,
+                object_store_memory=512 << 20,
+                node_resources=[{"src": 2}, {"dst": 2}])
+    try:
+        assert c.wait_for_nodes(2, timeout=120)
+        core = c.connect()
+
+        @ray_tpu.remote
+        def produce(n):
+            import numpy as _np
+
+            return _np.ones(n // 8)
+
+        @ray_tpu.remote
+        def warm():
+            import numpy as _np  # noqa: F401 — pay the import cost now
+
+            return 0
+
+        @ray_tpu.remote
+        def consume(a):
+            return a.nbytes
+
+        # every worker pays its numpy import before the timed window, so
+        # the on/off comparison measures data movement, not cold starts
+        ray_tpu.get([warm.options(resources={r: 1}).remote()
+                     for r in ("src", "dst") for _ in range(2)],
+                    timeout=120)
+        ref = produce.options(resources={"src": 1}).remote(mb << 20)
+        ray_tpu.get(ref, timeout=300)
+        time.sleep(0.2)  # batched loc_add flush
+        t0 = time.perf_counter()
+        ray_tpu.get([consume.remote(ref) for _ in range(tasks)],
+                    timeout=600)
+        dt = time.perf_counter() - t0
+        fetch = {"bytes": 0, "seconds": 0.0}
+        for node in c.nodes:
+            st = core._nodes.get(node.address).call(("state",))
+            fetch["bytes"] += st["fetch"]["bytes"]
+            fetch["seconds"] += st["fetch"]["seconds"]
+        return dt, fetch
+    finally:
+        c.shutdown()
+
+
+def bench_cross_node(rows: list):
+    """Locality-scheduling rows: wall-clock speedup of a task wave over a
+    100 MB shared argument with locality-aware placement on vs off, and
+    the effective cross-node pull throughput observed in the off run
+    (which is forced to move the bytes; the zero-copy ranged path)."""
+    from ray_tpu.core import runtime_context
+    from ray_tpu.core.config import config as cfg
+
+    prev = runtime_context.get_core_or_none()
+    old = os.environ.get("RTPU_LOCALITY_AWARE_SCHEDULING")
+    try:
+        t_off, fetch = _locality_wave(False)
+        t_on, _ = _locality_wave(True)
+        if fetch["seconds"] > 0:
+            rows.append(_row("cross_node_fetch_gbps",
+                             fetch["bytes"] * 8 / fetch["seconds"] / 1e9,
+                             "Gbit/s"))
+        rows.append(_row("locality_scheduling_speedup",
+                         t_off / max(t_on, 1e-9), "x"))
+    finally:
+        if old is None:
+            os.environ.pop("RTPU_LOCALITY_AWARE_SCHEDULING", None)
+        else:
+            os.environ["RTPU_LOCALITY_AWARE_SCHEDULING"] = old
+        cfg.reload()
+        runtime_context.set_core(prev)
+
+
 def bench_many_nodes_actors() -> float:
     """The actor-fleet creation row ALONE on a fresh 16-node cluster.
 
@@ -985,6 +1071,14 @@ def main():
         bench_many_nodes_actors_isolated(rows)
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "many_nodes_actors_per_sec", "value": -1,
+                     "unit": f"error: {e}"})
+
+    # locality rows on a fresh 2-node cluster (ISSUE 4 acceptance:
+    # locality_scheduling_speedup >= 1.5x on the shared-arg wave)
+    try:
+        bench_cross_node(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "locality_scheduling_speedup", "value": -1,
                      "unit": f"error: {e}"})
 
     # scalability AFTER many_nodes: the 1M-task slab leaves the single
@@ -1166,6 +1260,9 @@ def main():
             ("serve_int8_itl_p50_ms", "serve_int8_itl_p50_ms", False),
             ("serve_int8_decode_tokens_per_sec",
              "serve_int8_decode_tokens_per_sec", True),
+            ("locality_scheduling_speedup",
+             "locality_scheduling_speedup", True),
+            ("cross_node_fetch_gbps", "cross_node_fetch_gbps", True),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)

@@ -95,6 +95,23 @@ class ClusterCore:
         # driver-local sentinel objects (e.g. cluster PG ready refs)
         self._local: Dict[bytes, Tuple[threading.Event, list]] = {}
         self._rr = 0
+        # object-location cache: oid -> (addrs, cached_at). Fed by
+        # loc_get_batch; invalidated by the GCS "freed" channel, node
+        # death, and locality_cache_ttl_s. Only a scheduling hint —
+        # staleness costs placement quality, never correctness.
+        self._loc_cache: Dict[bytes, Tuple[List[Tuple[str, int]], float]] = {}
+        # known object sizes (driver puts + directory replies); sizes are
+        # immutable so entries never go stale, only die on free
+        self._obj_size: Dict[bytes, int] = {}
+        # locality-scheduling observability (mutated under self._lock):
+        # hits/misses count submissions that did/didn't land on the node
+        # holding the most qualifying argument bytes; bytes_local is the
+        # cross-node transfer volume locality avoided, bytes_remote what
+        # still has to move
+        self.locality_stats: Dict[str, int] = {
+            "hits": 0, "misses": 0, "bytes_local": 0, "bytes_remote": 0,
+            "batched_lookups": 0, "cache_hits": 0,
+        }
 
         self._view: Optional[dict] = None
         self._view_time = 0.0
@@ -211,6 +228,8 @@ class ClusterCore:
                 note_freed(self._freed, oid_list)
                 for b in oid_list:
                     self._drop_lineage_locked(b)
+                    self._loc_cache.pop(b, None)
+                    self._obj_size.pop(b, None)
 
     def _drop_lineage_locked(self, oid_b: bytes):
         old = self._lineage.pop(oid_b, None)
@@ -227,6 +246,10 @@ class ClusterCore:
         addr = tuple(dead[0]["address"])
         self._nodes.drop(addr)
         self._shipped.pop(addr, None)
+        with self._lock:
+            # location cache entries naming the dead node are poison for
+            # the locality scorer; deaths are rare, drop the whole cache
+            self._loc_cache.clear()
         # The GCS owns restarts for plain restartable/detached actors
         # (it got their spec at creation); the driver restarts ONLY
         # PG-scheduled ones, whose placement table is driver state. Stale
@@ -311,13 +334,64 @@ class ClusterCore:
 
     # ------------------------------------------------------------ scheduling
 
+    def _locate_deps(self, oid_bs: Sequence[bytes], fresh: bool = False
+                     ) -> Dict[bytes, Tuple[List[Tuple[str, int]],
+                                            Optional[int]]]:
+        """Resolve locations + sizes for many ids with at most ONE GCS
+        RPC (loc_get_batch), cache-first. ``fresh`` bypasses the cache —
+        reconstruction dep-checks need authoritative absence, not a
+        stale hit. Ids with no known location are omitted."""
+        now = time.monotonic()
+        ttl = config.locality_cache_ttl_s
+        neg_ttl = 0.25  # a confirmed miss (producer not finished yet) is
+        # re-queried at most ~4x/s — bounds the per-submission RPC rate
+        # for pipelined chains without hiding publication for long
+        out: Dict[bytes, Tuple[List[Tuple[str, int]], Optional[int]]] = {}
+        missing: List[bytes] = []
+        for b in oid_bs:
+            ent = None if fresh else self._loc_cache.get(b)
+            if ent is not None:
+                addrs, ts = ent
+                if addrs and now - ts < ttl:
+                    out[b] = (addrs, self._obj_size.get(b))
+                    continue
+                if not addrs and now - ts < neg_ttl:
+                    continue  # recently confirmed absent
+            missing.append(b)
+        cache_hits = len(out)
+        got = {}
+        if missing:
+            try:
+                got = self.gcs.call(("loc_get_batch", list(missing)))
+            except RpcError:
+                got = {}
+        with self._lock:
+            self.locality_stats["cache_hits"] += cache_hits
+            if missing:
+                self.locality_stats["batched_lookups"] += 1
+            for b in missing:
+                ent = got.get(b)
+                if ent is None:
+                    self._loc_cache[b] = ([], now)  # negative entry
+                    continue
+                addrs = [tuple(a) for a in ent[0]]
+                if ent[1] is not None:
+                    self._obj_size[b] = int(ent[1])
+                self._loc_cache[b] = (addrs, now)
+                out[b] = (addrs, self._obj_size.get(b))
+            if len(self._loc_cache) > 65536:
+                self._loc_cache.clear()  # crude bound; it is only a cache
+        return out
+
     def _pick_node_strict(self, options: dict, is_actor: bool
                           ) -> Tuple[str, int]:
         return self._pick_node(options, is_actor, strict=True)
 
     def _pick_node(self, options: dict, is_actor: bool,
                    exclude: Sequence[Tuple[str, int]] = (),
-                   strict: bool = False) -> Tuple[str, int]:
+                   strict: bool = False,
+                   dep_locs: Optional[Dict[bytes, tuple]] = None
+                   ) -> Tuple[str, int]:
         options = options or {}
         req: Dict[str, float] = {}
         num_cpus = options.get("num_cpus")
@@ -345,6 +419,19 @@ class ClusterCore:
             return addr
 
         nodes = self._cluster_view()["nodes"]
+        if wire and wire[0] == "node":
+            # node affinity keeps precedence over locality / load scoring
+            target, soft = wire[1], wire[2]
+            tb = bytes.fromhex(target) if isinstance(target, str) else target
+            for n in nodes:
+                if (n["node_id"] == tb
+                        and tuple(n["address"]) not in exclude):
+                    return tuple(n["address"])
+            if not soft:
+                raise RuntimeError(
+                    f"node affinity target {target!r} is not alive")
+            # soft affinity: target gone, fall through to normal selection
+
         fit = [n for n in nodes
                if tuple(n["address"]) not in exclude
                and all(n["resources"].get(k, 0) >= v for k, v in req.items())]
@@ -357,14 +444,53 @@ class ClusterCore:
             fit = [n for n in nodes if tuple(n["address"]) not in exclude]
         if not fit:
             raise RuntimeError("no alive nodes in cluster")
-        # prefer nodes with availability headroom and low queue, then RR
+
+        # locality: credit each feasible node with the bytes of
+        # qualifying arguments (>= locality_min_arg_bytes) it already
+        # holds, discounted by queue depth (locality_load_penalty_bytes
+        # per queued task) — the owner leases from the node holding the
+        # most argument bytes unless its backlog costs more than the
+        # transfer saves (reference: locality-aware leasing,
+        # lease_policy.h / Ownership NSDI'21)
+        local_bytes: Dict[Tuple[str, int], int] = {}
+        if dep_locs and not is_actor and config.locality_aware_scheduling:
+            floor = config.locality_min_arg_bytes
+            for addrs, nbytes in dep_locs.values():
+                if nbytes is None or nbytes < floor:
+                    continue
+                for a in addrs:
+                    a = tuple(a)
+                    local_bytes[a] = local_bytes.get(a, 0) + nbytes
+        penalty = config.locality_load_penalty_bytes
+
+        # with no locality signal every eff is 0 and ordering reduces to
+        # the classic (availability headroom, queue depth), then RR
         def score(n):
+            addr = tuple(n["address"])
             avail_ok = all(n["avail"].get(k, 0) >= v for k, v in req.items())
-            return (0 if avail_ok else 1, n["load"])
+            eff = (local_bytes.get(addr, 0) - n["load"] * penalty
+                   if local_bytes else 0)
+            return (-eff, 0 if avail_ok else 1, n["load"])
         fit.sort(key=score)
         best = [n for n in fit if score(n) == score(fit[0])]
-        self._rr += 1
-        return tuple(best[self._rr % len(best)]["address"])
+        with self._lock:
+            self._rr += 1
+            chosen = tuple(best[self._rr % len(best)]["address"])
+            if local_bytes:
+                floor = config.locality_min_arg_bytes
+                st = self.locality_stats
+                if local_bytes.get(chosen, 0) >= max(local_bytes.values()):
+                    st["hits"] += 1
+                else:
+                    st["misses"] += 1
+                for addrs, nbytes in dep_locs.values():
+                    if nbytes is None or nbytes < floor:
+                        continue
+                    if chosen in (tuple(a) for a in addrs):
+                        st["bytes_local"] += nbytes   # transfer avoided
+                    else:
+                        st["bytes_remote"] += nbytes  # still has to move
+        return chosen
 
     def _localize_pg(self, options: dict, addr: Tuple[str, int]) -> dict:
         """Rewrite a cluster PG scheduling strategy into the node-local one."""
@@ -398,9 +524,25 @@ class ClusterCore:
         args2, kwargs2, deps = self._swap_top_level_refs(args, kwargs)
         payload, nested = protocol.serialize_args(args2, kwargs2, store=None)
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
-        locations = {d.binary(): self._ref_node.get(d.binary())
-                     for d in deps}
-        locations = {k: v for k, v in locations.items() if v is not None}
+        # one RPC resolves every dep's locations + sizes (cache-first);
+        # feeds both the submit-time location hints and locality scoring
+        dep_bs = [d.binary() for d in deps]
+        dep_locs = (self._locate_deps(dep_bs)
+                    if dep_bs and config.locality_aware_scheduling else {})
+        locations = {}
+        for b in dep_bs:
+            hint = self._ref_node.get(b)
+            addrs, nbytes = dep_locs.get(b, ([], None))
+            if hint is not None and hint not in addrs:
+                # the owner hint covers deps the directory hasn't seen
+                # yet (unfinished producers): the submitting node knows
+                # where the object WILL appear
+                addrs = list(addrs) + [hint]
+            if nbytes is None:
+                nbytes = self._obj_size.get(b)
+            if addrs:
+                dep_locs[b] = (addrs, nbytes)
+                locations[b] = tuple(addrs[0]) if hint is None else hint
         msg_tail = ([d.binary() for d in deps],
                     [r.binary() for r in nested],
                     [r.binary() for r in return_ids])
@@ -415,7 +557,9 @@ class ClusterCore:
         # semantics).
         nonce = os.urandom(16)
         while True:
-            addr = self._pick_node(options, is_actor=False, exclude=tried)
+            # spillback failover re-scores with the tried nodes excluded
+            addr = self._pick_node(options, is_actor=False, exclude=tried,
+                                   dep_locs=dep_locs)
             options2 = self._localize_pg(options, addr)
             pickled_fn = self._ship_fn(addr, fn_id)
             try:
@@ -478,6 +622,10 @@ class ClusterCore:
             ("put", bytes(buf), None, self._driver_id))
         with self._lock:
             self._ref_node[oid_b] = self._home
+            # the driver knows its own puts' size and home before the
+            # node's batched loc_add lands — seed the scorer's tables
+            self._obj_size[oid_b] = total
+            self._loc_cache[oid_b] = ([self._home], time.monotonic())
         return ObjectRef(ObjectID(oid_b), core=self)
 
     def get_objects(self, refs: List[ObjectRef],
@@ -518,10 +666,14 @@ class ClusterCore:
                             ("get", [b], timeout, False))
                         out[b] = self._decode(p2[b])
             except RpcError:
-                # node died: any other location? (GCS directory)
+                # node died: any other location? (GCS directory) — one
+                # batched lookup covers the whole failed group
+                batched = (self._locate_deps(oids, fresh=True)
+                           if len(oids) > 1 else {})
                 for b in oids:
                     try:
-                        out[b] = self._fetch_anywhere(b, timeout)
+                        out[b] = self._fetch_anywhere(
+                            b, timeout, locs=batched.get(b, (None,))[0])
                     except BaseException as e:  # noqa: BLE001
                         errs.append(e)
             except BaseException as e:  # noqa: BLE001
@@ -551,8 +703,12 @@ class ClusterCore:
             return protocol.shm_unpack(self._home_store, ObjectID(data))
         return serialization.unpack(data)
 
-    def _fetch_anywhere(self, oid_b: bytes, timeout: Optional[float]):
-        locs = self.gcs.call(("loc_get", oid_b, 2.0))
+    def _fetch_anywhere(self, oid_b: bytes, timeout: Optional[float],
+                        locs=None):
+        if not locs:
+            # single-id path keeps loc_get's short blocking wait (the
+            # object may be mid-publication on its new node)
+            locs = self.gcs.call(("loc_get", oid_b, 2.0))
         for addr in locs:
             try:
                 data = self._nodes.get(tuple(addr)).call(("fetch", oid_b))
@@ -609,11 +765,19 @@ class ClusterCore:
         if n >= config.max_reconstructions:
             return False
         fn_id, payload, deps_b, nested_b, return_ids_b, options = lineage
-        # deps that are lost themselves get reconstructed first
-        for dep_b in deps_b:
-            if not self.gcs.call(("loc_get", dep_b, 0.0)):
-                if not self._reconstruct(dep_b, depth + 1):
-                    return False
+        # deps that are lost themselves get reconstructed first; with
+        # several deps one loc_get_batch replaces the per-id loop
+        # (fresh: a stale cache hit here would skip reviving a lost dep)
+        if len(deps_b) > 1:
+            present = self._locate_deps(deps_b, fresh=True)
+            missing = [b for b in deps_b
+                       if not present.get(b, ((), None))[0]]
+        else:
+            missing = [b for b in deps_b
+                       if not self.gcs.call(("loc_get", b, 0.0))]
+        for dep_b in missing:
+            if not self._reconstruct(dep_b, depth + 1):
+                return False
         # the cluster view can lag node death by a heartbeat timeout;
         # fail over across candidate nodes
         tried: List[Tuple[str, int]] = []
@@ -1101,6 +1265,8 @@ class ClusterCore:
                 # drop the location hint too — the periodic-free pattern
                 # (router load reports) must not grow _ref_node unboundedly
                 self._ref_node.pop(b, None)
+                self._loc_cache.pop(b, None)
+                self._obj_size.pop(b, None)
             for b in freed:
                 self._drop_lineage_locked(b)
         return len(freed)

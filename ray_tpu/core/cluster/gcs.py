@@ -9,9 +9,13 @@ capability level:
   event stream (reference: gcs_node_manager.h:45,
   gcs_health_check_manager.h:39)
 - named actor directory (gcs_actor_manager)
-- object location directory with blocking waits (the reference spreads this
-  across the ownership layer + object directory; here the GCS is the
-  rendezvous so any node can find any object's owner)
+- size-tracked object location directory with blocking waits (the
+  reference spreads this across the ownership layer + object directory;
+  here the GCS is the rendezvous so any node can find any object's
+  owner). ``loc_add``/``loc_add_batch`` optionally carry ``nbytes`` so
+  the directory doubles as a size table; ``loc_get_batch`` resolves many
+  ids in one RPC (non-blocking) and returns ``{oid: (addrs, nbytes)}``
+  for the driver's locality-aware scheduler
 - cluster KV (gcs_kv_manager) and a cluster function table
   (function_manager.py exports to GCS in the reference)
 
@@ -100,6 +104,10 @@ class GcsServer:
         self._named_actors: Dict[str, Tuple[bytes, tuple]] = {}
         self._actor_table: Dict[bytes, dict] = {}
         self._locations: Dict[bytes, List[tuple]] = {}
+        # object sizes (bytes), keyed like _locations and sharing its
+        # lifecycle: entries die when the last location drops. Sizes feed
+        # the driver's locality scorer; None/absent means "unknown".
+        self._obj_sizes: Dict[bytes, int] = {}
         self._functions: Dict[bytes, bytes] = {}
         self._deaths: List[Tuple[int, bytes]] = []  # (seq, node_id)
         self._death_seq = 0
@@ -158,6 +166,7 @@ class GcsServer:
                                 for k, v in self._actor_table.items()},
                 "locations": {k: list(v)
                               for k, v in self._locations.items()},
+                "obj_sizes": dict(self._obj_sizes),
                 "functions": dict(self._functions),
                 "actor_specs": {k: dict(v)
                                 for k, v in self._actor_specs.items()},
@@ -187,6 +196,7 @@ class GcsServer:
                              for k, v in s.get("actor_table", {}).items()}
         self._locations = {k: list(map(tuple, v))
                            for k, v in s.get("locations", {}).items()}
+        self._obj_sizes = dict(s.get("obj_sizes", {}))
         self._functions = dict(s.get("functions", {}))
         self._actor_specs = {k: dict(v)
                              for k, v in s.get("actor_specs", {}).items()}
@@ -316,6 +326,7 @@ class GcsServer:
                 self._locations[oid] = locs
             else:
                 del self._locations[oid]
+                self._obj_sizes.pop(oid, None)
         # GCS-owned actor restart (reference: gcs_actor_manager.h:278 —
         # the FSM lives HERE so named/detached actors survive driver exit
         # and node death alike)
@@ -654,22 +665,27 @@ class GcsServer:
 
     # -- object directory
 
-    def _op_loc_add(self, oid: bytes, node_addr):
+    def _op_loc_add(self, oid: bytes, node_addr, nbytes: Optional[int] = None):
         with self._lock:
             locs = self._locations.setdefault(oid, [])
             addr = tuple(node_addr)
             if addr not in locs:
                 locs.append(addr)
+            if nbytes is not None:
+                self._obj_sizes[oid] = int(nbytes)
             self._cond.notify_all()
         return True
 
-    def _op_loc_add_batch(self, oids: List[bytes], node_addr):
+    def _op_loc_add_batch(self, oids: List[bytes], node_addr,
+                          sizes: Optional[List[Optional[int]]] = None):
         addr = tuple(node_addr)
         with self._lock:
-            for oid in oids:
+            for i, oid in enumerate(oids):
                 locs = self._locations.setdefault(oid, [])
                 if addr not in locs:
                     locs.append(addr)
+                if sizes is not None and sizes[i] is not None:
+                    self._obj_sizes[oid] = int(sizes[i])
             self._cond.notify_all()
         return True
 
@@ -685,6 +701,21 @@ class GcsServer:
                     return []
                 self._cond.wait(remaining)
 
+    def _op_loc_get_batch(self, oids: List[bytes]):
+        """Resolve many ids in one RPC: {oid: (addrs, nbytes_or_None)}.
+
+        Non-blocking by design (unlike loc_get's optional wait): callers
+        use it to resolve a whole submission's deps for locality scoring,
+        where "unknown yet" is an acceptable answer. Ids with no known
+        location are omitted from the reply."""
+        with self._lock:
+            out = {}
+            for oid in oids:
+                locs = self._locations.get(oid)
+                if locs:
+                    out[oid] = (list(locs), self._obj_sizes.get(oid))
+            return out
+
     def _op_loc_drop(self, oid: bytes, node_addr):
         addr = tuple(node_addr)
         with self._lock:
@@ -693,6 +724,7 @@ class GcsServer:
                 locs.remove(addr)
                 if not locs:
                     del self._locations[oid]
+                    self._obj_sizes.pop(oid, None)
         return True
 
     # -- pubsub

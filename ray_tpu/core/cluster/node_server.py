@@ -36,9 +36,14 @@ from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID, make_task_id
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.runtime import Runtime, _TaskSpec
 from ray_tpu.exceptions import (ActorDiedError, ObjectLostError,
-                                ObjectStoreFullError)
+                                ObjectStoreFullError, ObjectTimeoutError)
 
 # Tag prefix for ops; kept as plain strings (framed pickle transport).
+
+#: sentinel returned by _fetch_ranged when the payload was written
+#: directly into the shm store (zero-copy bulk path) — there is nothing
+#: left for the caller to store.
+_STORED = object()
 
 
 class _PullAdmissionTimeout(Exception):
@@ -58,6 +63,34 @@ def materialize(runtime: Runtime, payload) -> Tuple[str, bytes]:
     view = runtime.store.get(oid, timeout_ms=0)
     try:
         return ("inline", bytes(view))
+    finally:
+        del view
+        runtime.store.release(oid)
+
+
+def payload_nbytes(runtime: Runtime, payload) -> Optional[int]:
+    """Byte size of a stored payload, or None when it cannot be measured
+    cheaply. Sizes feed the GCS object directory for locality-aware
+    scheduling; 'unknown' merely opts the object out of locality scoring."""
+    kind, data = payload
+    if kind == "inline":
+        try:
+            return len(data)
+        except TypeError:
+            return None
+    if kind == "spilled":
+        path = data[0] if isinstance(data, tuple) else data
+        try:
+            return external_storage.size(path)
+        except OSError:
+            return None
+    oid = ObjectID(data)
+    try:
+        view = runtime.store.get(oid, timeout_ms=0)
+    except (ObjectTimeoutError, ValueError, OSError):
+        return None
+    try:
+        return view.nbytes
     finally:
         del view
         runtime.store.release(oid)
@@ -109,12 +142,13 @@ class NodeRuntime(Runtime):
                 data = srv.gcs.call(("kv", "get", f"pkg:{pkg_hash}", None))
         return data
 
-    # locations: publish every stored object id to the GCS directory
+    # locations: publish every stored object id (with its payload size,
+    # for the locality scorer) to the GCS directory
     def _store_payload(self, oid, payload):
         super()._store_payload(oid, payload)
         srv = self._server_ref
         if srv is not None and oid.binary() not in srv._unpublished:
-            srv.note_location(oid.binary())
+            srv.note_location(oid.binary(), payload_nbytes(self, payload))
 
     # Worker-originated requests that need cluster awareness: remote-object
     # gets/waits, cluster KV, and calls on actors living on peer nodes.
@@ -336,9 +370,11 @@ class NodeServer:
         self._push_inflight = 0
         self._push_waits = 0  # observability: times a chunk had to queue
 
-        # object-location publication (batched)
+        # object-location publication (batched); entries are
+        # (oid_bytes, nbytes_or_None) — sizes ride along so the GCS
+        # directory can feed the driver's locality scorer
         self._loc_lock = threading.Lock()
-        self._loc_pending: List[bytes] = []
+        self._loc_pending: List[Tuple[bytes, Optional[int]]] = []
         self._loc_thread = threading.Thread(
             target=self._loc_flush_loop, daemon=True, name="node-locs")
         self._loc_thread.start()
@@ -379,6 +415,11 @@ class NodeServer:
         self._fetching: set = set()
         self._fetch_prio: Dict[bytes, list] = {}
         self._fetch_lock = threading.Lock()
+        # cross-node pull throughput (cumulative; surfaced via ("state",))
+        self._fetch_stats_lock = threading.Lock()
+        self._fetch_bytes = 0
+        self._fetch_seconds = 0.0
+        self._fetch_count = 0
         # pull admission: bulk transfers reserve their byte size against
         # a store-derived budget, in priority order task-args > get >
         # wait (reference: pull_manager.h:52). Small payloads (below the
@@ -437,9 +478,9 @@ class NodeServer:
                     {}))
             time.sleep(interval)
 
-    def note_location(self, oid_bytes: bytes):
+    def note_location(self, oid_bytes: bytes, nbytes: Optional[int] = None):
         with self._loc_lock:
-            self._loc_pending.append(oid_bytes)
+            self._loc_pending.append((oid_bytes, nbytes))
 
     def _loc_flush_loop(self):
         while not self._stop:
@@ -447,7 +488,8 @@ class NodeServer:
             with self._loc_lock:
                 batch, self._loc_pending = self._loc_pending, []
             if batch:
-                self.gcs.try_call(("loc_add_batch", batch, self.address))
+                self.gcs.try_call(("loc_add_batch", [b for b, _ in batch],
+                                   self.address, [n for _, n in batch]))
 
     def note_remote_actor(self, actor_id: ActorID, addr: Tuple[str, int]):
         self._remote_actors[actor_id] = tuple(addr)
@@ -514,11 +556,13 @@ class NodeServer:
         from ray_tpu.core.config import config as cfg
 
         threshold = cfg.fetch_parallel_threshold_bytes
+        t0 = time.monotonic()
         data = self._peers.get(addr).call(
             ("fetch", oid_bytes, threshold if threshold > 0 else None))
         if data is None:
             return None
         if data[0] != "size":
+            self._note_fetch(len(data[1]), time.monotonic() - t0)
             return data[1]
         size = data[1]
 
@@ -537,10 +581,12 @@ class NodeServer:
                 f"{prio_box[0]})")
         priority = prio_box[0]  # class at grant time, for the timeline
         granted_ts = time.time()
+        granted_mono = time.monotonic()
         ok = False
         try:
             data = self._fetch_ranged(addr, oid_bytes, size, cfg)
             ok = True
+            self._note_fetch(size, time.monotonic() - granted_mono)
             return data
         finally:
             self.pulls.release(size)
@@ -559,11 +605,27 @@ class NodeServer:
                 })
 
     def _fetch_ranged(self, addr, oid_bytes: bytes, size: int, cfg):
+        """Chunked bulk pull. The normal path pre-creates the shm store
+        allocation and writes every ranged chunk straight into it, then
+        seals — ONE copy from socket to store, where the old
+        assemble-into-bytearray-then-bytes() path held two full copies at
+        peak. Returns ``_STORED`` when the payload landed in the store
+        (caller skips store_incoming), else the assembled bytes (store
+        full / id already allocated: rare pressure fallback)."""
+        rt = self.runtime
+        oid = ObjectID(oid_bytes)
         chunk = max(1 << 20, cfg.fetch_chunk_bytes)
         nstreams = max(1, min(cfg.fetch_parallelism,
                               (size + chunk - 1) // chunk))
         offsets = list(range(0, size, chunk))
-        out = bytearray(size)
+        dst = None
+        if oid_bytes not in rt._freed and not rt.store.contains(oid):
+            try:
+                dst = rt.store.create_object(oid, size)
+            except (ObjectStoreFullError, ValueError, OSError):
+                dst = None  # heap-assembly fallback below
+        buf = None if dst is not None else bytearray(size)
+        out = dst if dst is not None else memoryview(buf)
         failed: List[str] = []
         idx_lock = threading.Lock()
         next_idx = [0]
@@ -596,9 +658,33 @@ class NodeServer:
         for t in threads:
             t.join()
         if failed:
-            raise RpcError(f"chunked fetch of {len(out)} bytes from "
+            if dst is not None:
+                # abort the unsealed allocation: drop the creator ref,
+                # then free (an unsealed object is invisible to getters,
+                # so nobody else can hold it)
+                rt.store.release(oid)
+                rt.store.delete(oid)
+            raise RpcError(f"chunked fetch of {size} bytes from "
                            f"{addr} failed: {failed[0]}")
-        return bytes(out)
+        if dst is not None:
+            rt.store.seal(oid, retain=True)
+            if oid_bytes in rt._freed:
+                # freed while the transfer was in flight: reclaim instead
+                # of publishing (mirrors store_incoming's tombstone check)
+                rt.store.release(oid)
+                rt.store.delete(oid)
+                return _STORED
+            # retain'd ref hands off to the tracking pin; publishes the
+            # location (with size) like any other stored payload
+            rt._store_payload(oid, ("shm", oid_bytes))
+            return _STORED
+        return bytes(buf)
+
+    def _note_fetch(self, nbytes: int, seconds: float):
+        with self._fetch_stats_lock:
+            self._fetch_bytes += nbytes
+            self._fetch_seconds += seconds
+            self._fetch_count += 1
 
     def _fetch_object(self, oid_bytes: bytes, hint, prio_box=None):
         rt = self.runtime
@@ -635,6 +721,8 @@ class NodeServer:
                     except (RpcError, Exception):  # noqa: BLE001
                         self.gcs.try_call(("loc_drop", oid_bytes, addr))
                         continue
+                    if data is _STORED:
+                        return  # zero-copy path already sealed + published
                     if data is not None:
                         store_incoming(rt, oid, data)
                         return
@@ -804,6 +892,10 @@ class NodeServer:
         s = self.runtime.state_summary()
         s["push_waits"] = self._push_waits  # sender-side backpressure hits
         s["pulls"] = self.pulls.stats()     # admission-control occupancy
+        with self._fetch_stats_lock:        # cross-node pull throughput
+            s["fetch"] = {"bytes": self._fetch_bytes,
+                          "seconds": round(self._fetch_seconds, 6),
+                          "count": self._fetch_count}
         return s
 
     def _op_stack_dump(self):

@@ -193,7 +193,8 @@ _IDEMPOTENT_OPS = frozenset({
     # reads / polls
     "ping", "status", "state", "stack_dump", "task_events", "list_logs",
     "get_log", "list_nodes", "wait_nodes", "deaths_since", "freed_check",
-    "get_named_actor", "list_actors", "loc_get", "poll", "get_fn",
+    "get_named_actor", "list_actors", "loc_get", "loc_get_batch", "poll",
+    "get_fn",
     "get", "fetch", "fetch_size", "fetch_range", "has", "wait",
     "actor_opts",
     # set/last-writer-wins writes (apply-twice == apply-once)

@@ -151,6 +151,26 @@ _FLAGS: List[Flag] = [
          "being copied/served concurrently per node; excess chunk "
          "requests queue (reference: push_manager.h caps chunks in "
          "flight on the sending side). 0 disables the cap."),
+    Flag("locality_aware_scheduling", bool, True,
+         "Score resource-feasible nodes by the bytes of task arguments "
+         "already resident on each (args >= locality_min_arg_bytes), so "
+         "tasks chase their data instead of pulling it (reference: "
+         "locality-aware leasing, lease_policy.h / Ownership NSDI'21). "
+         "Placement-group and node-affinity strategies keep precedence; "
+         "off = pure resource-fit + load + round-robin."),
+    Flag("locality_cache_ttl_s", float, 5.0,
+         "Driver-side object-location cache max staleness. Entries are "
+         "invalidated eagerly on free (the GCS 'freed' channel) and node "
+         "death; the TTL bounds staleness from eviction/spill, which "
+         "only ever costs scheduling quality, not correctness."),
+    Flag("locality_load_penalty_bytes", int, 16 << 20,
+         "Queue-depth tradeoff for locality scoring: each queued task on "
+         "a node discounts its local-argument bytes by this much, so a "
+         "deeply backlogged holder loses to an idle peer once the "
+         "transfer it saves is cheaper than the wait."),
+    Flag("locality_min_arg_bytes", int, 1 << 20,
+         "Arguments at or above this size participate in locality "
+         "scoring; smaller ones are cheaper to ship than to chase."),
     Flag("gcs_heartbeat_interval_s", float, 0.2,
          "Node -> GCS heartbeat period (reference: "
          "raylet_report_resources_period_milliseconds)."),

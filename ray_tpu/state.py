@@ -68,6 +68,57 @@ def _objects_from(summaries: List[dict]) -> Dict[str, Any]:
     return agg
 
 
+def _transfer_from(summaries: List[dict]) -> Dict[str, Any]:
+    """Cluster-wide data-plane movement: cross-node pull throughput,
+    pull-admission occupancy, and sender-side backpressure, aggregated
+    from each node's ("state",) reply."""
+    agg: Dict[str, Any] = {"fetch_bytes": 0, "fetch_seconds": 0.0,
+                           "fetch_count": 0, "fetch_gbps": 0.0,
+                           "push_waits": 0, "pulls": []}
+    for n in summaries:
+        s = n["summary"]
+        if not s:
+            continue
+        f = s.get("fetch")
+        if f:
+            agg["fetch_bytes"] += f["bytes"]
+            agg["fetch_seconds"] += f["seconds"]
+            agg["fetch_count"] += f["count"]
+        agg["push_waits"] += s.get("push_waits", 0)
+        if s.get("pulls") is not None:
+            agg["pulls"].append({"node_id": n["node_id"], **s["pulls"]})
+    if agg["fetch_seconds"] > 0:
+        agg["fetch_gbps"] = round(
+            agg["fetch_bytes"] * 8 / agg["fetch_seconds"] / 1e9, 3)
+    return agg
+
+
+def summarize_transfers() -> Dict[str, Any]:
+    """Object-movement stats: bytes pulled cross-node, effective fetch
+    throughput, per-node pull-manager occupancy, push backpressure. The
+    single-node runtime has no cross-node plane: returns zeros."""
+    core = _core()
+    if _is_cluster(core):
+        return _transfer_from(_node_summaries(core))
+    return {"fetch_bytes": 0, "fetch_seconds": 0.0, "fetch_count": 0,
+            "fetch_gbps": 0.0, "push_waits": 0, "pulls": []}
+
+
+def locality_stats() -> Dict[str, int]:
+    """This driver's locality-scheduling counters: submissions that
+    landed on the node holding the most argument bytes (hits) vs not
+    (misses), cross-node transfer bytes avoided (bytes_local) vs still
+    required (bytes_remote), and directory lookup efficiency
+    (batched_lookups, cache_hits). All zeros on the single-node core,
+    where every argument is always local."""
+    core = _core()
+    if _is_cluster(core):
+        with core._lock:
+            return dict(core.locality_stats)
+    return {"hits": 0, "misses": 0, "bytes_local": 0, "bytes_remote": 0,
+            "batched_lookups": 0, "cache_hits": 0}
+
+
 def list_nodes() -> List[dict]:
     core = _core()
     if _is_cluster(core):
@@ -212,6 +263,8 @@ def state_summary() -> Dict[str, Any]:
             "actors": list_actors(),
             "tasks": _tasks_from(summaries),
             "objects": _objects_from(summaries),
+            "transfers": _transfer_from(summaries),
+            "scheduling": {"locality": locality_stats()},
             "cluster_resources": cluster_resources(),
             "available_resources": available_resources(),
         }
@@ -220,6 +273,8 @@ def state_summary() -> Dict[str, Any]:
         "actors": list_actors(),
         "tasks": summarize_tasks(),
         "objects": summarize_objects(),
+        "transfers": summarize_transfers(),
+        "scheduling": {"locality": locality_stats()},
         "cluster_resources": cluster_resources(),
         "available_resources": available_resources(),
     }
