@@ -545,6 +545,30 @@ def bench_core(rows: list):
     rows.append(_row("1_1_actor_calls_concurrent", rate, "calls/s",
                      BASE["1_1_actor_calls_concurrent"]))
 
+    # actor restart recovery: SIGKILL the worker, time until the first
+    # call against the NEW incarnation returns (restart fork + __init__ +
+    # replayed dispatch). Median of 3 kills; no reference number — the
+    # conservative bar lives in BASELINE.json.published.
+    import signal as _signal
+
+    @ray_tpu.remote(max_restarts=10, max_task_retries=10)
+    class Restartable:
+        def pid(self):
+            return os.getpid()
+
+        def f(self):
+            return b"ok"
+
+    ra = Restartable.remote()
+    recov = []
+    for _ in range(3):
+        pid = ray_tpu.get(ra.pid.remote())
+        os.kill(pid, _signal.SIGKILL)
+        t0 = time.perf_counter()
+        ray_tpu.get(ra.f.remote())
+        recov.append((time.perf_counter() - t0) * 1e3)
+    rows.append(_row("actor_restart_recovery_ms", sorted(recov)[1], "ms"))
+
     # async actors (asyncio event-loop per actor, ray_perf.py:26-35)
     @ray_tpu.remote
     class AsyncA:
@@ -1257,6 +1281,8 @@ def main():
              "many_nodes_actors_per_sec", True),
             ("streaming_first_output_latency_ms",
              "streaming_first_output_latency_ms", False),
+            ("actor_restart_recovery_ms",
+             "actor_restart_recovery_ms", False),
             ("serve_int8_itl_p50_ms", "serve_int8_itl_p50_ms", False),
             ("serve_int8_decode_tokens_per_sec",
              "serve_int8_decode_tokens_per_sec", True),

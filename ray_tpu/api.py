@@ -125,7 +125,16 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True):
-    """Forcibly terminate an actor (reference: ray.kill, worker.py:2939)."""
+    """Forcibly terminate an actor (reference: ray.kill, worker.py:2939).
+
+    With ``no_restart=True`` (the default) the death is terminal: pending
+    and future calls fail with ``ActorDiedError`` and the restart spec is
+    dropped so nothing resurrects the actor. With ``no_restart=False``
+    the kill behaves exactly like a worker crash: it consumes one unit of
+    the actor's ``max_restarts`` budget and, if budget remains, the actor
+    restarts — in-flight calls with ``max_task_retries`` left replay
+    against the new incarnation and calls submitted meanwhile buffer
+    through the RESTARTING window."""
     core = runtime_context.get_core()
     core.kill_actor(actor.actor_id, no_restart=no_restart)
 

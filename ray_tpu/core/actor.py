@@ -19,19 +19,41 @@ from ray_tpu.core import runtime_context
 class ActorMethod:
     """Bound method accessor: ``handle.method.remote(args)``."""
 
-    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1,
+                 max_task_retries=None, retry_exceptions=None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        # None = inherit the class-level default (resolved runtime-side
+        # from the actor's opts); reference: max_task_retries /
+        # retry_exceptions on ray.method (python/ray/actor.py:566)
+        self._max_task_retries = max_task_retries
+        self._retry_exceptions = retry_exceptions
 
-    def options(self, num_returns=1, **_):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns=None, max_task_retries=None,
+                retry_exceptions=None):
+        """Per-call overrides. Unknown keyword arguments raise TypeError
+        (a typo like ``max_retires=`` must not pass silently); ``None``
+        keeps the method's current setting."""
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+            (self._max_task_retries if max_task_retries is None
+             else max_task_retries),
+            (self._retry_exceptions if retry_exceptions is None
+             else retry_exceptions),
+        )
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         core = runtime_context.get_core()
+        call_opts = {}
+        if self._max_task_retries is not None:
+            call_opts["max_task_retries"] = self._max_task_retries
+        if self._retry_exceptions is not None:
+            call_opts["retry_exceptions"] = self._retry_exceptions
         refs = core.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
-            num_returns=self._num_returns,
+            num_returns=self._num_returns, options=call_opts or None,
         )
         if self._num_returns == "streaming":
             from ray_tpu.core.remote_function import _make_generator
@@ -61,7 +83,10 @@ class ActorHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         opts = self._method_opts.get(name, {})
-        return ActorMethod(self, name, num_returns=opts.get("num_returns", 1))
+        return ActorMethod(self, name,
+                           num_returns=opts.get("num_returns", 1),
+                           max_task_retries=opts.get("max_task_retries"),
+                           retry_exceptions=opts.get("retry_exceptions"))
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._method_opts))

@@ -34,9 +34,9 @@ from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.placement_group import PlacementGroup
-from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError,
-                                ObjectLostError, ObjectTimeoutError,
-                                PlacementGroupError)
+from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
+                                GetTimeoutError, ObjectLostError,
+                                ObjectTimeoutError, PlacementGroupError)
 
 
 class _ClusterPG:
@@ -117,6 +117,11 @@ class ClusterCore:
         self._view_time = 0.0
         self._death_seq = 0
         self._freed_seq = 0  # cursor into the GCS "freed" channel
+        # cursor into the GCS "actor_state" channel + last seen restart-FSM
+        # state per actor (aid bytes -> message dict): RESTARTING gates
+        # call retries on the restart finishing instead of failing fast
+        self._actor_state_seq = 0
+        self._actor_states: Dict[bytes, dict] = {}
         self._monitor_stop = False
         # owner identity: this driver registers with the GCS and
         # heartbeats; if it dies, nodes reclaim its objects and its
@@ -203,6 +208,7 @@ class ClusterCore:
             except Exception:  # noqa: BLE001
                 continue
             self._drain_freed_channel()
+            self._drain_actor_state_channel()
             for seq, node_id in deaths:
                 self._death_seq = max(self._death_seq, seq)
                 self._on_node_death(node_id)
@@ -230,6 +236,59 @@ class ClusterCore:
                     self._drop_lineage_locked(b)
                     self._loc_cache.pop(b, None)
                     self._obj_size.pop(b, None)
+
+    def _drain_actor_state_channel(self):
+        """Apply actor-restart FSM broadcasts (the GCS ``actor_state``
+        channel): ALIVE updates routing so the next call goes straight to
+        the new incarnation's node; RESTARTING is remembered so call
+        retries wait out the restart window instead of failing fast;
+        DEAD is terminal (buffable-and-wait would hang forever)."""
+        try:
+            msgs = self.gcs.call(
+                ("poll", "actor_state", self._actor_state_seq, 0.0))
+        except (RpcError, OSError):
+            return
+        if not msgs:
+            return
+        with self._lock:
+            for seq, m in msgs:
+                self._actor_state_seq = max(self._actor_state_seq, seq)
+                aid_b = m.get("actor_id")
+                if aid_b is None:
+                    continue
+                self._actor_states[aid_b] = m
+                aid = ActorID(aid_b)
+                if m.get("state") == "ALIVE" and m.get("node"):
+                    self._actor_node[aid] = tuple(m["node"])
+                elif m.get("state") in ("RESTARTING", "DEAD"):
+                    # stale routing either way: re-resolve on next call
+                    self._actor_node.pop(aid, None)
+
+    def _await_actor_restart(self, actor_id: ActorID) -> bool:
+        """If the actor is mid-restart per the ``actor_state`` channel,
+        block (bounded by ``actor_restart_timeout_s``) until the FSM
+        publishes a terminal transition. Returns True when the actor came
+        back ALIVE, False when no restart is known to be underway; raises
+        when the restart failed or overran its window."""
+        aid_b = actor_id.binary()
+        state = (self._actor_states.get(aid_b) or {}).get("state")
+        if state != "RESTARTING":
+            return state == "ALIVE"
+        deadline = time.monotonic() + config.actor_restart_timeout_s
+        while time.monotonic() < deadline:
+            self._drain_actor_state_channel()
+            state = (self._actor_states.get(aid_b) or {}).get("state")
+            if state == "ALIVE":
+                return True
+            if state == "DEAD":
+                raise ActorDiedError(
+                    f"actor {actor_id} died during restart",
+                    cause="restart failed (budget exhausted or no node)")
+            time.sleep(0.05)
+        raise ActorUnavailableError(
+            f"actor {actor_id} did not finish restarting within "
+            f"actor_restart_timeout_s ({config.actor_restart_timeout_s}s); "
+            f"the restart may still complete — retry later")
 
     def _drop_lineage_locked(self, oid_b: bytes):
         old = self._lineage.pop(oid_b, None)
@@ -963,18 +1022,24 @@ class ClusterCore:
 
     def _actor_call_with_retry(self, actor_id: ActorID, msg_fn):
         """Run an actor-routed RPC; on stale routing (node died, actor was
-        restarted elsewhere) re-resolve via the GCS actor table and retry."""
+        restarted elsewhere) re-resolve via the GCS actor table and retry.
+        When the ``actor_state`` channel says a restart is underway, the
+        retry first waits (bounded) for the new incarnation so the call
+        lands on it instead of surfacing a transient death."""
         addr = self._actor_addr(actor_id)
         try:
             return addr, self._nodes.get(addr).call(msg_fn(addr))
         except (RpcError, ActorDiedError):
             with self._lock:
                 self._actor_node.pop(actor_id, None)
+            self._drain_actor_state_channel()
+            self._await_actor_restart(actor_id)
             addr = self._actor_addr(actor_id)
             return addr, self._nodes.get(addr).call(msg_fn(addr))
 
     def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
-                          kwargs: dict, num_returns=1
+                          kwargs: dict, num_returns=1,
+                          options: Optional[dict] = None
                           ) -> List[ObjectRef]:
         streaming = num_returns == "streaming"
         if streaming:
@@ -985,7 +1050,7 @@ class ClusterCore:
         msg = ("actor_call", actor_id.binary(), method, payload,
                [d.binary() for d in deps], [r.binary() for r in nested],
                [r.binary() for r in return_ids], os.urandom(16),
-               self._driver_id, streaming)
+               self._driver_id, streaming, dict(options or {}))
         try:
             addr, _ = self._actor_call_with_retry(actor_id, lambda a: msg)
         except RpcError as e:
@@ -1006,6 +1071,9 @@ class ClusterCore:
             self._actor_call_with_retry(
                 actor_id,
                 lambda a: ("kill_actor", actor_id.binary(), no_restart))
+        # rtpu-lint: disable=L4 — kill of an already-dead/unreachable
+        # actor is the desired end state, not a lost signal: there is
+        # nothing left to kill and no caller waiting on a result
         except (RpcError, ActorDiedError):
             pass
 

@@ -360,11 +360,20 @@ class GcsServer:
             restarts = int(opts.get("max_restarts", 0))
             detached = opts.get("lifetime") == "detached"
             if restarts == 0 and not detached:
+                # budget exhausted: terminal — subscribers must fail
+                # buffered calls with ActorDiedError, not keep waiting
+                with self._lock:
+                    self._actor_table.setdefault(aid, {})["state"] = "DEAD"
+                    self._publish_actor_state_locked(aid, "DEAD", spec, opts)
                 continue
             if restarts > 0:
                 opts["max_restarts"] = restarts - 1
+            with self._lock:
+                self._publish_actor_state_locked(aid, "RESTARTING", spec,
+                                                 opts)
             deadline = time.monotonic() + timeout
             nonce = os.urandom(16)
+            restarted = False
             while time.monotonic() < deadline and not self._stop:
                 addr = self._pick_restart_node(opts)
                 if addr is None:
@@ -406,6 +415,9 @@ class GcsServer:
                             if name and self._named_actors.get(
                                     name, (None,))[0] == aid:
                                 self._named_actors[name] = (aid, addr)
+                            self._publish_actor_state_locked(
+                                aid, "ALIVE", spec, opts, node=addr)
+                            restarted = True
                     if not dropped and self._wal is not None:
                         self._wal_write_locked(
                             "register_actor",
@@ -423,6 +435,25 @@ class GcsServer:
                     except RpcError:
                         pass
                 break
+            if not restarted:
+                # dropped mid-restart or no node materialized before the
+                # deadline: terminal either way from the callers' view
+                with self._lock:
+                    self._actor_table.setdefault(aid, {})["state"] = "DEAD"
+                    self._publish_actor_state_locked(aid, "DEAD", spec, opts)
+
+    def _publish_actor_state_locked(self, aid: bytes, state: str,
+                                    spec: dict, opts: dict, node=None):
+        """One actor-restart FSM transition on the ``actor_state``
+        channel (same shape the single-node runtime publishes, so driver
+        subscribers handle both sources with one code path)."""
+        self._publish_locked("actor_state", {
+            "actor_id": aid,
+            "state": state,
+            "restarts_left": int(opts.get("max_restarts", 0)),
+            "name": spec.get("name"),
+            "node": list(node) if node else None,
+        })
 
     def _pick_restart_node(self, opts: dict):
         """An ALIVE node whose TOTAL resources cover the request (the

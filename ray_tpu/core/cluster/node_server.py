@@ -35,7 +35,7 @@ from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID, make_task_id
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.runtime import Runtime, _TaskSpec
-from ray_tpu.exceptions import (ActorDiedError, ObjectLostError,
+from ray_tpu.exceptions import (ActorDiedError, ActorError, ObjectLostError,
                                 ObjectStoreFullError, ObjectTimeoutError)
 
 # Tag prefix for ops; kept as plain strings (framed pickle transport).
@@ -199,7 +199,8 @@ class NodeRuntime(Runtime):
                 if ActorID(actor_id_b) not in self._actors:
                     refs = srv.forward_actor_call_payload(
                         ActorID(actor_id_b), method, args_payload,
-                        extra.get("__deps", []), n_returns)
+                        extra.get("__deps", []), n_returns,
+                        opts=extra.get("__opts"))
                     return ("ok", [r.binary() for r in refs])
             elif tag == protocol.REQ_STREAM_NEXT:
                 # generator consumed by a worker on a node that does not
@@ -224,11 +225,15 @@ class NodeRuntime(Runtime):
                         srv.forward_actor_call_payload(
                             ActorID(actor_id_b), method, args_payload,
                             extra.get("__deps", []), len(rids_b),
-                            return_ids=[ObjectID(b) for b in rids_b])
+                            return_ids=[ObjectID(b) for b in rids_b],
+                            opts=extra.get("__opts"))
                     except BaseException as e:  # noqa: BLE001 — at get()
+                        # keep ActorError subtypes intact: a worker-side
+                        # get must see ActorUnavailableError as itself,
+                        # not masked as a terminal death
                         self._store_error(
                             [ObjectID(b) for b in rids_b],
-                            e if isinstance(e, ActorDiedError)
+                            e if isinstance(e, ActorError)
                             else ActorDiedError(
                                 f"actor call failed: {e!r}"))
                     return protocol.NO_REPLY
@@ -300,12 +305,13 @@ class NodeRuntime(Runtime):
 
     # actor calls targeting a peer node's actor (worker-held handles)
     def submit_actor_task(self, actor_id, method, args, kwargs,
-                          num_returns=1):
+                          num_returns=1, options=None):
         if actor_id in self._actors or self._server_ref is None:
             return super().submit_actor_task(
-                actor_id, method, args, kwargs, num_returns)
+                actor_id, method, args, kwargs, num_returns,
+                options=options)
         return self._server_ref.remote_actor_call(
-            actor_id, method, args, kwargs, num_returns)
+            actor_id, method, args, kwargs, num_returns, options=options)
 
     def get_actor_method_opts(self, actor_id):
         if actor_id in self._actors or self._server_ref is None:
@@ -812,42 +818,59 @@ class NodeServer:
         return addr
 
     def remote_actor_call(self, actor_id: ActorID, method: str, args, kwargs,
-                          num_returns: int) -> List[ObjectRef]:
+                          num_returns: int, options=None) -> List[ObjectRef]:
         rt = self.runtime
         args2, kwargs2, deps = rt._swap_top_level_refs(args, kwargs)
         payload, nested = protocol.serialize_args(args2, kwargs2, store=None)
         return self._send_actor_call(
             actor_id, method, payload, [d.binary() for d in deps],
-            [r.binary() for r in nested], num_returns)
+            [r.binary() for r in nested], num_returns, opts=options)
 
     def forward_actor_call_payload(self, actor_id: ActorID, method: str,
                                    args_payload, deps: List[bytes],
                                    num_returns: int,
                                    return_ids: Optional[List[ObjectID]]
-                                   = None) -> List[ObjectRef]:
+                                   = None, opts=None) -> List[ObjectRef]:
         """Route a worker's call on a peer node's actor (payload level).
         ``return_ids`` preset = fire-and-forget caller already handed
         refs out."""
         return self._send_actor_call(
             actor_id, method, materialize(self.runtime, args_payload),
-            list(deps), [], num_returns, return_ids=return_ids)
+            list(deps), [], num_returns, return_ids=return_ids, opts=opts)
 
     def _send_actor_call(self, actor_id, method, payload, deps, nested,
-                         num_returns, return_ids=None) -> List[ObjectRef]:
+                         num_returns, return_ids=None,
+                         opts=None) -> List[ObjectRef]:
         rt = self.runtime
         if return_ids is None:
             return_ids = [ObjectID.from_random()
                           for _ in range(num_returns)]
         msg = ("actor_call", actor_id.binary(), method, payload, deps, nested,
-               [r.binary() for r in return_ids], os.urandom(16))
+               [r.binary() for r in return_ids], os.urandom(16), None, False,
+               dict(opts or {}))
         addr = self._actor_addr(actor_id)
         try:
             self._peers.get(addr).call(msg)
         except (RpcError, ActorDiedError):
-            # stale cache: the actor may have been restarted on another node
+            # stale cache: the actor may have been restarted on another
+            # node. The GCS re-registers it only once the new incarnation
+            # is up, so keep re-resolving for the restart window — a call
+            # racing a cross-node restart must land on the new
+            # incarnation, not surface a transient routing error.
+            # _actor_addr itself raising (table says DEAD/unknown) stays
+            # terminal: that's a real death, not a stale route.
             self._remote_actors.pop(actor_id, None)
-            addr = self._actor_addr(actor_id)
-            self._peers.get(addr).call(msg)
+            deadline = time.monotonic() + config.actor_restart_timeout_s
+            while True:
+                addr = self._actor_addr(actor_id)
+                try:
+                    self._peers.get(addr).call(msg)
+                    break
+                except (RpcError, ActorDiedError):
+                    self._remote_actors.pop(actor_id, None)
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.2)
         for rid in return_ids:
             rt._entry(rid)
             self.ensure_available(rid.binary(), hint=addr)
@@ -1358,13 +1381,14 @@ class NodeServer:
 
     def _op_actor_call(self, actor_id_bytes, method, args_payload, deps,
                        nested, return_ids, nonce=None, owner=None,
-                       stream=False):
+                       stream=False, opts=None):
         return self._dedup(nonce, lambda: self._do_actor_call(
             actor_id_bytes, method, args_payload, deps, nested, return_ids,
-            owner, stream))
+            owner, stream, opts))
 
     def _do_actor_call(self, actor_id_bytes, method, args_payload, deps,
-                       nested, return_ids, owner=None, stream=False):
+                       nested, return_ids, owner=None, stream=False,
+                       opts=None):
         rt = self.runtime
         if owner is not None:
             self._tag_owner(return_ids, owner)
@@ -1372,6 +1396,11 @@ class NodeServer:
         state = rt._actors.get(actor_id)
         if state is None:
             raise ActorDiedError(f"actor {actor_id} is not on this node")
+        # bounded restart window: past the buffer cap / restart deadline
+        # this raises ActorUnavailableError, which travels back through
+        # the RPC layer typed (callers must see "may come back", never a
+        # hang and never a premature death)
+        rt._check_actor_admission(state)
         for b in deps:
             self.ensure_available(b, priority=PRIO_TASK_ARGS)
         for b in nested:
@@ -1385,12 +1414,11 @@ class NodeServer:
             # through _fail_stream rather than landing on the seed id
             rt._register_stream(ret_ids[0].binary())
         if state.dead:
-            rt._store_error(ret_ids, ActorDiedError(
-                str(state.death_cause or "actor is dead")))
+            rt._store_error(ret_ids, rt._actor_dead_error(state))
             return True
         spec = _TaskSpec(task_id, None, args_payload,
-                         [ObjectID(b) for b in deps], ret_ids, {},
-                         actor_id=actor_id, method=method)
+                         [ObjectID(b) for b in deps], ret_ids,
+                         dict(opts or {}), actor_id=actor_id, method=method)
         spec.nested_deps = [ObjectID(b) for b in nested]
         if stream:
             spec.stream = rt._stream_opts(ret_ids[0].binary())

@@ -117,6 +117,16 @@ _FLAGS: List[Flag] = [
          "time from RTPU_USAGE_STATS_ENABLED in usage_stats.enabled(), "
          "not via config resolution, so tests can flip it per-call."),
     # ---- fault tolerance -------------------------------------------------
+    Flag("actor_restart_buffer_max", int, 1000,
+         "How many calls may queue on a RESTARTING actor before new "
+         "submissions raise ActorUnavailableError instead of buffering "
+         "(reference: the bounded client queue in "
+         "actor_task_submitter.h)."),
+    Flag("actor_restart_timeout_s", float, 30.0,
+         "Deadline for one actor restart: calls buffered longer than "
+         "this (and new calls submitted past it) fail with "
+         "ActorUnavailableError while the restart keeps going "
+         "(reference: timeout_ms on the GCS actor restart path)."),
     Flag("task_max_retries", int, 3,
          "Default retry budget for tasks whose worker died mid-execution "
          "(reference: max_retries / task_retry_delay_ms, "

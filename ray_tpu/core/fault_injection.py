@@ -28,6 +28,20 @@ site           key                      actions
 ``task``       function id (hex)        ``exit`` — the worker process
                                         exits before executing the task
                                         (worker-side; arm via env)
+``actor_call``  "<actor hex>:<method>"  ``drop`` — the driver silently
+                                        drops the dispatch (the call is
+                                        in flight but the worker never
+                                        sees it — a lost message);
+                                        ``kill_worker`` — SIGKILL the
+                                        actor's worker right after the
+                                        call is sent
+``actor_worker_kill``  same key         ``exit`` — the actor's worker
+                                        exits before executing the call
+                                        (in-flight kill); ``exit_after``
+                                        — it executes the method and
+                                        seals the results, then exits
+                                        before the DONE report flushes
+                                        (worker-side; arm via env)
 =============  =======================  ==================================
 
 Env/config surface: ``RTPU_FAULT_<SITE>=<action>[:<times>[:<match>]]``
@@ -51,7 +65,8 @@ import signal
 import threading
 from typing import Dict, List, Optional
 
-SITES = ("get", "spill", "dispatch", "task")
+SITES = ("get", "spill", "dispatch", "task", "actor_call",
+         "actor_worker_kill")
 
 _lock = threading.Lock()
 _specs: Dict[str, List[dict]] = {}

@@ -59,6 +59,11 @@ REQ_KILL_ACTOR = "kill_actor_req"  # (REQ_KILL_ACTOR, actor_id_bytes, no_restart
 REQ_STREAM_NEXT = "stream_next"    # (REQ_STREAM_NEXT, seed, index, timeout_ms, owner) -> ("ref", rid_b) | ("end", count) | ("pending",) | ("err", payload)
 REQ_STREAM_CREDIT = "stream_credit"  # (REQ_STREAM_CREDIT, seed, produced) -> ("ok", consumed): producer backpressure probe
 REQ_PUBSUB = "pubsub"              # (REQ_PUBSUB, op, channel, arg, timeout) -> ("ok", result); op in publish/poll (GCS channel semantics)
+# well-known pubsub channels: "freed" (eager-free tombstone broadcast),
+# "node_deaths" (GCS health monitor), "actor_state" (actor-restart FSM
+# transitions: {"actor_id", "state": ALIVE|RESTARTING|DEAD,
+# "restarts_left", "name", ...} — published by the owning runtime on
+# worker-death restarts and by the GCS on cross-node restarts)
 
 # fire-and-forget variants (NO reply — the worker pre-generates the ids,
 # so the owner's round trip leaves the submission hot path; errors land
@@ -67,6 +72,11 @@ REQ_PUBSUB = "pubsub"              # (REQ_PUBSUB, op, channel, arg, timeout) -> 
 REQ_PUT_META_ASYNC = "put_meta_async"      # (.., oid_bytes, payload_or_none)
 REQ_SUBMIT_ASYNC = "submit_async"          # (.., fn_id, pickled_fn_or_none, args_payload, inline_values, return_ids, options)
 REQ_ACTOR_CALL_ASYNC = "actor_call_async"  # (.., actor_id_b, method, args_payload, extra, return_ids)
+# ``extra`` on REQ_ACTOR_CALL / REQ_ACTOR_CALL_ASYNC is a dict of optional
+# keys: "__deps" (top-level dep oid bytes), "__stream" (streaming call),
+# "__parent" (submitting task id), "__opts" (per-call overrides —
+# max_task_retries / retry_exceptions — resolved against the actor's
+# class-level opts at enqueue).
 REQ_STREAM_CONSUMED_ASYNC = "stream_consumed_async"  # (.., seed, index, owner): consumer advanced past index
 
 REQ_BARRIER = "barrier"  # (REQ_BARRIER,) -> ("ok",): all earlier async sends applied

@@ -46,8 +46,8 @@ from ray_tpu.core.resources import (
     ResourceSet, TpuSliceTopology, node_resources,
 )
 from ray_tpu.exceptions import (
-    ActorDiedError, GetTimeoutError, ObjectLostError, PlacementGroupError,
-    TaskCancelledError, TaskError, WorkerCrashedError,
+    ActorDiedError, ActorUnavailableError, GetTimeoutError, ObjectLostError,
+    PlacementGroupError, TaskCancelledError, TaskError, WorkerCrashedError,
 )
 
 
@@ -126,6 +126,7 @@ class _TaskSpec:
         "acquired_bundle", "blocked_released", "nested_deps", "cancelled",
         "retries_left", "args_pinned", "dep_pins", "submitted_ts",
         "dispatched_ts", "parent_task", "oom_kills", "env_key", "stream",
+        "seq",
     )
 
     def __init__(self, task_id, fn_id, args_payload, deps, return_ids, options,
@@ -174,6 +175,11 @@ class _TaskSpec:
         # shipped to the worker so it seals yields under deterministic
         # per-index ids; None for ordinary tasks
         self.stream: Optional[dict] = None
+        # Actor calls only: position in the actor's per-submission order
+        # (assigned at enqueue); the actor's completion watermark keys off
+        # it so a replayed already-completed call is served from the
+        # store, never re-executed.
+        self.seq: Optional[int] = None
 
 
 class _StreamState:
@@ -317,6 +323,8 @@ class _ActorState:
         "creation_deps", "opts", "queue", "ready", "dead", "death_cause",
         "restarts_left", "name", "creation_event", "request", "pg_wire",
         "acquired_bundle", "chips", "resources_acquired", "capacity",
+        "restarting", "restarting_since", "incarnation", "next_seq",
+        "seq_watermark", "completed_seqs",
     )
 
     def __init__(self, actor_id, cls_fn_id, args_payload, deps, opts):
@@ -344,6 +352,20 @@ class _ActorState:
         self.acquired_bundle = None
         self.chips: List[int] = []
         self.resources_acquired = False
+        # Restart FSM (reference: gcs_actor_manager.h:278 ALIVE ->
+        # RESTARTING -> ALIVE|DEAD): while restarting, new calls buffer
+        # (bounded by actor_restart_buffer_max / actor_restart_timeout_s)
+        # and queued+in-flight calls replay to the next incarnation.
+        self.restarting = False
+        self.restarting_since = 0.0
+        self.incarnation = 0
+        # Per-actor call sequencing for exactly-once result delivery:
+        # every call gets the next seq at enqueue; completion advances a
+        # contiguous watermark (out-of-order completions park in
+        # completed_seqs) so replays of finished calls are recognized.
+        self.next_seq = 0
+        self.seq_watermark = 0
+        self.completed_seqs: set = set()
 
 
 def _reap_stale_shm_arenas():
@@ -818,6 +840,15 @@ class Runtime:
             w.inflight.clear()
             actor_id = w.actor_id
             oom = w.oom_killed
+            if actor_id is not None:
+                # detach the dead worker NOW (not in the later restart
+                # handling): a concurrent _dispatch_actor must never pop
+                # queued calls into a dead worker's inflight table,
+                # where they would be lost
+                st = self._actors.get(actor_id)
+                if st is not None and st.worker is w:
+                    st.worker = None
+                    st.ready = False
         if inflight:
             # Results flush per task, so inflight = not-yet-completed, in
             # dispatch order. Only the head task can have been executing
@@ -844,7 +875,25 @@ class Runtime:
                 else:
                     fail, requeue = inflight[:1], inflight[1:]
             else:
-                fail, requeue = inflight, []
+                # Actor calls: at-least-once replay (reference:
+                # max_task_retries, actor_task_submitter resubmission).
+                # Every in-flight call whose retry budget allows it goes
+                # back on the actor's queue for the restarted
+                # incarnation; a call whose results the dead worker
+                # already sealed is adopted straight from the store —
+                # exactly-once result delivery, no re-execution.
+                fail, requeue = [], []
+                for spec in inflight:
+                    if spec.cancelled:
+                        fail.append(spec)
+                    elif self._adopt_sealed_actor_result(spec):
+                        pass  # served from the store
+                    elif spec.retries_left != 0:
+                        if spec.retries_left > 0:
+                            spec.retries_left -= 1
+                        requeue.append(spec)
+                    else:
+                        fail.append(spec)
             if oom:
                 from ray_tpu.exceptions import OutOfMemoryError
 
@@ -853,6 +902,12 @@ class Runtime:
                     f"node memory monitor (usage above "
                     f"{config.memory_usage_threshold:.0%}) and the task "
                     f"is out of OOM retries")
+            elif actor_id is not None:
+                st = self._actors.get(actor_id)
+                err = ActorDiedError(
+                    "the actor's worker process died mid-call and the "
+                    "call is out of task retries",
+                    incarnation=st.incarnation if st is not None else None)
             else:
                 err = WorkerCrashedError(
                     f"worker {w.worker_id.hex()[:8]} died while "
@@ -898,7 +953,15 @@ class Runtime:
                     if spec.cancelled else err)
             if requeue:
                 with self._lock:
-                    self._task_queue.extendleft(reversed(requeue))
+                    if actor_id is not None:
+                        # replayed calls rejoin the FRONT of the actor's
+                        # queue in dispatch order, ahead of calls that
+                        # buffered during the restart window
+                        st = self._actors.get(actor_id)
+                        if st is not None:
+                            st.queue.extendleft(reversed(requeue))
+                    else:
+                        self._task_queue.extendleft(reversed(requeue))
             self._retry_pending_pgs()
         if actor_id is not None:
             self._handle_actor_worker_death(actor_id)
@@ -1569,9 +1632,24 @@ class Runtime:
                 "placement group was removed"))
             return
         if spec.retries_left is None:
-            spec.retries_left = (0 if spec.actor_id is not None else
-                                 int(spec.options.get("max_retries",
-                                                      config.task_max_retries)))
+            if spec.actor_id is not None:
+                # per-call option > per-method/class default > 0 (actor
+                # calls are not retried unless asked — reference:
+                # max_task_retries defaults to 0, python/ray/actor.py)
+                state = self._actors.get(spec.actor_id)
+                default = (int(state.opts.get("max_task_retries", 0))
+                           if state is not None else 0)
+                spec.retries_left = int(
+                    (spec.options or {}).get("max_task_retries", default))
+            else:
+                spec.retries_left = int(spec.options.get(
+                    "max_retries", config.task_max_retries))
+        if spec.actor_id is not None and spec.seq is None:
+            state = self._actors.get(spec.actor_id)
+            if state is not None:
+                with self._lock:
+                    spec.seq = state.next_seq
+                    state.next_seq += 1
         if self._events is not None and not spec.submitted_ts:
             spec.submitted_ts = time.time()
         self._pin_spec_args(spec)
@@ -1592,11 +1670,19 @@ class Runtime:
                     self._queue_ready(spec)
 
             for e in unresolved:
+                # check-and-append stays under the lock (lost-wakeup
+                # guard), but the callback must fire OUTSIDE it: on_ready
+                # of the last pending dep runs _queue_ready, which
+                # re-acquires the (non-reentrant) lock — invoking it here
+                # would deadlock the submitting thread against itself
+                fire = False
                 with self._lock:
                     if e.event.is_set():
-                        on_ready()
+                        fire = True
                     else:
                         e.callbacks.append(on_ready)
+                if fire:
+                    on_ready()
         else:
             self._queue_ready(spec)
 
@@ -1953,6 +2039,7 @@ class Runtime:
     def _dispatch_actor(self, state: _ActorState):
         specs: List[_TaskSpec] = []
         failed: List[_TaskSpec] = []
+        served: List[_TaskSpec] = []
         with self._lock:
             w = state.worker
             if state.dead and state.queue:
@@ -1965,13 +2052,22 @@ class Runtime:
                 while (state.queue
                        and len(w.inflight) < state.capacity):
                     spec = state.queue.popleft()
+                    if (spec.seq is not None
+                            and (spec.seq < state.seq_watermark
+                                 or spec.seq in state.completed_seqs)):
+                        # replay of a call that already completed (its
+                        # result is sealed in the store): deliver from
+                        # the store, never re-execute the side effect
+                        served.append(spec)
+                        continue
                     w.inflight[spec.task_id.binary()] = spec
                     specs.append(spec)
+        for spec in served:
+            self._release_spec_args(spec)
+            self._release_spec_deps(spec)
+            self._cancellable.pop(spec.return_ids[0].binary(), None)
         for f in failed:
-            self._store_error(
-                f.return_ids,
-                ActorDiedError(str(state.death_cause or "actor is dead")),
-            )
+            self._store_error(f.return_ids, self._actor_dead_error(state))
         for spec in specs:
             self._send_actor_call(w, spec)
 
@@ -2138,6 +2234,17 @@ class Runtime:
         try:
             # unconditional: the OOM kill policy sorts on this
             spec.dispatched_ts = time.time()
+            fault = None
+            if fault_injection.enabled():
+                # 'actor_call' fault site, keyed "<actor hex>:<method>":
+                # 'drop' loses the dispatch (the call stays in flight but
+                # the worker never sees it), 'kill_worker' SIGKILLs the
+                # actor's worker right after the send
+                fault = fault_injection.fire(
+                    "actor_call",
+                    f"{spec.actor_id.hex()}:{spec.method}")
+                if fault == "drop":
+                    return
             try:
                 inline_values = self._inline_values_for(spec.deps, spec)
             except _DepsLost as lost:
@@ -2149,6 +2256,11 @@ class Runtime:
                 inline_values, [r.binary() for r in spec.return_ids],
                 spec.stream,
             ))
+            if fault == "kill_worker" and w.proc is not None:
+                try:
+                    os.kill(w.proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
         except (OSError, EOFError, BrokenPipeError):
             self._on_worker_death(w)
 
@@ -2184,6 +2296,7 @@ class Runtime:
                 self._cancellable.pop(spec.return_ids[0].binary(), None)
                 for rid, payload in zip(spec.return_ids, payloads):
                     self._store_payload(rid, payload)
+            self._actor_call_completed(spec)
         self._retry_pending_pgs()
         self._worker_now_idle(w)
 
@@ -2193,8 +2306,15 @@ class Runtime:
             if spec is not None:
                 self._release_spec_locked(spec)
         if spec is not None:
-            self._release_spec_args(spec)
             self._release_spec_deps(spec)
+            if (not spec.cancelled
+                    and self._maybe_retry_actor_error(spec, err_payload)):
+                # retry_exceptions replay: the args stay pinned for the
+                # re-execution, the error is never delivered
+                self._retry_pending_pgs()
+                self._worker_now_idle(w)
+                return
+            self._release_spec_args(spec)
             if spec.cancelled:
                 # SIGINT-interrupted execution surfaces as a cancellation,
                 # not as the raw KeyboardInterrupt TaskError.
@@ -2211,6 +2331,7 @@ class Runtime:
                 else:
                     for rid in spec.return_ids:
                         self._store_payload(rid, err_payload)
+            self._actor_call_completed(spec)
         self._retry_pending_pgs()
         self._worker_now_idle(w)
 
@@ -2380,11 +2501,17 @@ class Runtime:
             else:
                 loop.call_soon_threadsafe(fut.set_result, v)
 
+        # same discipline as _enqueue's dep registration: check-and-append
+        # under the lock, but run the callback outside it — resolve() can
+        # enter reconstruction, which re-acquires the non-reentrant lock
+        fire = False
         with self._lock:
             if e.event.is_set():
-                resolve()
+                fire = True
             else:
                 e.callbacks.append(resolve)
+        if fire:
+            resolve()
         return fut
 
     # ----------------------------------------------------------------- actors
@@ -2574,9 +2701,191 @@ class Runtime:
         state = self._actors.get(actor_id)
         if state is None:
             return
-        state.ready = True
+        with self._lock:
+            restarted = state.restarting
+            state.restarting = False
+            state.ready = True
         state.creation_event.set()
+        if restarted:
+            # RESTARTING -> ALIVE: buffered + replayed calls drain to the
+            # new incarnation in _dispatch_actor below
+            self._publish_actor_state(state, "ALIVE")
         self._dispatch_actor(state)
+
+    def _publish_actor_state(self, state: _ActorState, st: str):
+        """Broadcast an actor FSM transition (ALIVE/RESTARTING/DEAD) on
+        the ``actor_state`` pubsub channel. Single-node this lands in the
+        Runtime's local mirror; in cluster mode the overriding core
+        routes it to the GCS so every node and driver observes the same
+        buffer/raise/replay semantics."""
+        try:
+            self.pubsub_op("publish", "actor_state", {
+                "actor_id": state.actor_id.binary(),
+                "state": st,
+                "incarnation": state.incarnation,
+                "restarts_left": state.restarts_left,
+                "name": state.name,
+            })
+        # rtpu-lint: disable=L4 — the publication is advisory (a
+        # subscriber that misses a transition re-reads the actor table);
+        # losing it must never break the death/restart handling itself
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _actor_dead_error(self, state: _ActorState) -> ActorDiedError:
+        """Terminal-death error enriched with the cause, the restart
+        budget spent, and the incarnation that failed."""
+        opts_max = int(state.opts.get("max_restarts", 0) or 0)
+        consumed = (state.incarnation if opts_max < 0
+                    else opts_max - max(0, state.restarts_left))
+        return ActorDiedError(
+            "actor is dead",
+            cause=str(state.death_cause or "unknown"),
+            restarts_consumed=consumed,
+            incarnation=state.incarnation)
+
+    def _check_actor_admission(self, state: _ActorState):
+        """While an actor is RESTARTING new calls buffer on its queue —
+        but only actor_restart_buffer_max of them, and only until the
+        restart has run for actor_restart_timeout_s. Past either bound
+        the caller gets ActorUnavailableError: unlike ActorDiedError the
+        actor may come back, so callers can retry later."""
+        if state.dead or not state.restarting:
+            return
+        if (time.monotonic() - state.restarting_since
+                > config.actor_restart_timeout_s):
+            raise ActorUnavailableError(
+                f"actor {state.actor_id.hex()[:12]} has been RESTARTING "
+                f"for more than actor_restart_timeout_s="
+                f"{config.actor_restart_timeout_s:g}s "
+                f"(incarnation {state.incarnation})")
+        if len(state.queue) >= config.actor_restart_buffer_max:
+            raise ActorUnavailableError(
+                f"actor {state.actor_id.hex()[:12]} is RESTARTING and "
+                f"its call buffer is full (actor_restart_buffer_max="
+                f"{config.actor_restart_buffer_max})")
+
+    def _actor_call_completed(self, spec: _TaskSpec):
+        """Advance the actor's completed-call watermark: a replayed call
+        at a seq the watermark already covers is served from the store
+        by _dispatch_actor, never re-executed (exactly-once result
+        delivery on top of at-least-once execution)."""
+        if spec.actor_id is None or spec.seq is None:
+            return
+        state = self._actors.get(spec.actor_id)
+        if state is None:
+            return
+        with self._lock:
+            state.completed_seqs.add(spec.seq)
+            while state.seq_watermark in state.completed_seqs:
+                state.completed_seqs.discard(state.seq_watermark)
+                state.seq_watermark += 1
+
+    def _actor_retry_exceptions(self, spec: _TaskSpec):
+        """Resolved retry_exceptions setting for one call: per-call
+        option > per-method/class default > False. True retries any
+        application exception; a list/tuple retries matching types."""
+        copts = spec.options or {}
+        if "retry_exceptions" in copts:
+            return copts["retry_exceptions"]
+        state = self._actors.get(spec.actor_id)
+        return state.opts.get("retry_exceptions", False) if state else False
+
+    def _maybe_retry_actor_error(self, spec: _TaskSpec, err_payload) -> bool:
+        """Application-error retry (reference: retry_exceptions,
+        task_manager.cc RetryTaskIfPossible): when the call's resolved
+        retry_exceptions setting matches the raised error and retry
+        budget remains, requeue it at the front of the actor's queue
+        instead of delivering the error."""
+        if (spec.actor_id is None or spec.stream is not None
+                or spec.retries_left == 0):
+            return False
+        retry_on = self._actor_retry_exceptions(spec)
+        if not retry_on:
+            return False
+        if retry_on is not True:
+            try:
+                v = protocol.deserialize_payload(err_payload,
+                                                 store=self.store)
+                err = v.error if isinstance(v, protocol.ErrorValue) else v
+                cause = err.cause if isinstance(err, TaskError) else err
+                if not isinstance(cause, tuple(retry_on)):
+                    return False
+            # rtpu-lint: disable=L4 — an error payload that cannot be
+            # deserialized (or a malformed retry_exceptions list) cannot
+            # be matched: deliver the original error instead of retrying
+            except Exception:  # noqa: BLE001
+                return False
+        state = self._actors.get(spec.actor_id)
+        if state is None or state.dead:
+            return False
+        if spec.retries_left > 0:
+            spec.retries_left -= 1
+        with self._lock:
+            state.queue.appendleft(spec)
+        self._dispatch_actor(state)
+        return True
+
+    def _adopt_sealed_actor_result(self, spec: _TaskSpec) -> bool:
+        """Exactly-once result delivery for a call in flight at worker
+        death: if the worker sealed every return container before dying
+        (death landed between the seal and the DONE report flushing),
+        adopt the results from the store instead of re-executing the
+        call — its side effect already happened exactly once."""
+        if spec.cancelled or spec.stream is not None:
+            return False
+        sealed = True
+        for rid in spec.return_ids:
+            e = self._objects.get(rid)
+            if e is None or not e.event.is_set():
+                sealed = False
+                break
+        if not sealed:
+            try:
+                if not all(self.store.contains(rid)
+                           for rid in spec.return_ids):
+                    return False
+            # rtpu-lint: disable=L4 — a store probe that fails (store
+            # closing, container racing an eviction) simply means the
+            # result is NOT recoverable: fall back to replaying the call
+            except Exception:  # noqa: BLE001
+                return False
+            for rid in spec.return_ids:
+                # same descriptor the worker's DONE report would have
+                # carried; _store_payload adopts the retained seal ref
+                self._store_payload(rid, ("shm", rid.binary()))
+        with self._lock:
+            self._release_spec_locked(spec)
+        self._release_spec_deps(spec)
+        self._release_spec_args(spec)
+        self._cancellable.pop(spec.return_ids[0].binary(), None)
+        self._actor_call_completed(spec)
+        return True
+
+    def _actor_restart_deadline(self, state: _ActorState, incarnation: int):
+        """actor_restart_timeout_s elapsed for one restart attempt: if
+        that SAME restart is still in progress, fail the buffered calls
+        with ActorUnavailableError. The restart itself keeps going — a
+        later call may find the actor ALIVE again."""
+        if self._shutdown:
+            return
+        with self._lock:
+            stuck = (state.restarting and not state.dead
+                     and state.incarnation == incarnation)
+            buffered = list(state.queue) if stuck else []
+            if stuck:
+                state.queue.clear()
+        if not buffered:
+            return
+        err = ActorUnavailableError(
+            f"actor {state.actor_id.hex()[:12]} did not finish restarting "
+            f"within actor_restart_timeout_s="
+            f"{config.actor_restart_timeout_s:g}s "
+            f"(incarnation {incarnation})")
+        for spec in buffered:
+            self._cancellable.pop(spec.return_ids[0].binary(), None)
+            self._release_spec_args(spec)
+            self._store_error(spec.return_ids, err)
 
     def _on_actor_error(self, w: _Worker, actor_id: ActorID, err_payload):
         state = self._actors.get(actor_id)
@@ -2595,6 +2904,7 @@ class Runtime:
                 return  # keep the original death cause
             state.dead = True
             state.ready = False
+            state.restarting = False
             state.death_cause = cause
             pending = list(state.queue)
             state.queue.clear()
@@ -2610,7 +2920,9 @@ class Runtime:
             # terminal death: the creation-args container is never needed
             # again — release the adopted ref and free it
             self._unpin_args(state.creation_args_payload[1])
-        err = cause if isinstance(cause, ActorDiedError) else ActorDiedError(str(cause))
+        err = (cause if isinstance(cause, ActorDiedError)
+               else self._actor_dead_error(state))
+        self._publish_actor_state(state, "DEAD")
         for spec in pending:
             self._store_error(spec.return_ids, err)
         self._retry_pending_pgs()
@@ -2623,8 +2935,23 @@ class Runtime:
         if state.restarts_left != 0 and not state.dead:
             if state.restarts_left > 0:
                 state.restarts_left -= 1
-            state.ready = False
-            state.worker = None
+            with self._lock:
+                state.ready = False
+                state.worker = None
+                state.restarting = True
+                state.restarting_since = time.monotonic()
+                state.incarnation += 1
+                incarnation = state.incarnation
+            self._publish_actor_state(state, "RESTARTING")
+            # bound the RESTARTING window: past the deadline the calls
+            # buffered for this incarnation fail with
+            # ActorUnavailableError (restarts are rare; one short-lived
+            # timer thread per attempt is fine)
+            timer = threading.Timer(
+                config.actor_restart_timeout_s,
+                self._actor_restart_deadline, args=(state, incarnation))
+            timer.daemon = True
+            timer.start()
             self._actor_start_queue.put(state)
         else:
             self._mark_actor_dead(
@@ -2632,10 +2959,14 @@ class Runtime:
             )
 
     def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
-                          kwargs: dict, num_returns=1) -> List[ObjectRef]:
+                          kwargs: dict, num_returns=1,
+                          options: Optional[dict] = None) -> List[ObjectRef]:
         state = self._actors.get(actor_id)
         if state is None:
             raise ActorDiedError(f"unknown actor {actor_id}")
+        # RESTARTING admission: buffer, or raise ActorUnavailableError
+        # past the buffer/deadline — before any state is built
+        self._check_actor_admission(state)
         streaming = num_returns == "streaming"
         if streaming:
             num_returns = 1
@@ -2651,12 +2982,11 @@ class Runtime:
             self._register_stream(return_ids[0].binary())
         if state.dead:
             refs = [ObjectRef(rid, core=self) for rid in return_ids]
-            self._store_error(
-                return_ids, ActorDiedError(str(state.death_cause or "actor is dead"))
-            )
+            self._store_error(return_ids, self._actor_dead_error(state))
             return refs
-        spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
-                         actor_id=actor_id, method=method)
+        spec = _TaskSpec(task_id, None, args_payload, deps, return_ids,
+                         dict(options or {}), actor_id=actor_id,
+                         method=method)
         if streaming:
             spec.stream = self._stream_opts(return_ids[0].binary())
         self._cancellable[return_ids[0].binary()] = spec
@@ -2735,6 +3065,22 @@ class Runtime:
             state.restarts_left = 0
         with self._lock:
             w = state.worker
+        if not no_restart and state.restarts_left != 0 and not state.dead:
+            # kill(no_restart=False) with restart budget left behaves
+            # exactly like a worker death: the budget is consumed and
+            # the actor restarts; queued + in-flight calls follow the
+            # normal replay path (reference: ray.kill(no_restart=False)
+            # routes through the GCS restart FSM, gcs_actor_manager.cc).
+            if w is not None and w.proc is not None:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+                # reader-thread EOF -> _on_worker_death -> replay +
+                # _handle_actor_worker_death consumes the budget
+            # no live worker: the actor is starting or already mid-
+            # restart — there is no incarnation to kill
+            return
         self._mark_actor_dead(state, ActorDiedError("actor was killed via kill()"))
         if w is not None and w.proc is not None:
             # ray.kill semantics are FORCEFUL (no exit handlers), so
@@ -3059,7 +3405,8 @@ class Runtime:
         task_id = make_task_id(self.job_id)
         for rid in return_ids:
             self._entry(rid)
-        spec = _TaskSpec(task_id, None, args_payload, deps, return_ids, {},
+        spec = _TaskSpec(task_id, None, args_payload, deps, return_ids,
+                         dict(extra.get("__opts") or {}),
                          actor_id=state.actor_id, method=method)
         spec.parent_task = extra.get("__parent")
         if extra.get("__stream"):
@@ -3067,11 +3414,11 @@ class Runtime:
             spec.stream = self._stream_opts(seed)
             self._register_stream(seed)
         if state.dead:
-            self._store_error(
-                return_ids,
-                ActorDiedError(str(state.death_cause or "actor is dead")),
-            )
+            self._store_error(return_ids, self._actor_dead_error(state))
         else:
+            # raises ActorUnavailableError past the RESTARTING buffer;
+            # the data-server handlers preserve ActorError subtypes
+            self._check_actor_admission(state)
             self._enqueue(spec)
 
     def _data_server(self, w: _Worker):
@@ -3232,9 +3579,13 @@ class Runtime:
                                               args_payload, extra,
                                               return_ids)
             except BaseException as e:  # noqa: BLE001 — surface at get()
-                # _store_error creates missing entries itself
+                from ray_tpu.exceptions import ActorError
+
+                # _store_error creates missing entries itself; ActorError
+                # subtypes (ActorDiedError, ActorUnavailableError) must
+                # reach the caller as-is
                 self._store_error(
-                    return_ids, e if isinstance(e, ActorDiedError)
+                    return_ids, e if isinstance(e, ActorError)
                     else ActorDiedError(f"actor call failed: {e!r}"))
             return protocol.NO_REPLY
         if tag == protocol.REQ_SUBMIT:
@@ -3399,8 +3750,10 @@ class Runtime:
                 "actor_id": s.actor_id.hex(),
                 "name": s.name,
                 "state": ("DEAD" if s.dead else
+                          "RESTARTING" if s.restarting else
                           "ALIVE" if s.ready else "PENDING"),
                 "restarts_left": s.restarts_left,
+                "incarnation": s.incarnation,
                 "queued_calls": len(s.queue),
             } for s in self._actors.values()]
             queued = len(self._task_queue)

@@ -183,9 +183,14 @@ class WorkerCore:
         return [ObjectRef(rid, core=self) for rid in return_ids]
 
     def submit_actor_task(self, actor_id: ActorID, method: str, args: tuple,
-                          kwargs: dict, num_returns) -> List[ObjectRef]:
+                          kwargs: dict, num_returns,
+                          options=None) -> List[ObjectRef]:
         args_payload, deps, _nested = _prepare_args_local(self, args, kwargs)
         extra = {"__deps": deps}
+        if options:
+            # per-call retry options (max_task_retries/retry_exceptions)
+            # resolved by the owner when it builds the spec
+            extra["__opts"] = dict(options)
         if num_returns == "streaming":
             num_returns = 1
             extra["__stream"] = True
@@ -760,6 +765,22 @@ class WorkerCore:
         (_, task_id_b, actor_id_b, method, args_payload, inline_values,
          return_ids) = msg[:7]
         stream_opts = msg[7] if len(msg) > 7 else None
+        from ray_tpu.core import fault_injection
+
+        kill_after = False
+        if fault_injection.enabled():
+            # deterministic 'actor_worker_kill' site (env-armed: the
+            # worker inherits RTPU_FAULT_ACTOR_WORKER_KILL): 'exit' dies
+            # before the method runs (a pure in-flight kill); 'exit_after'
+            # runs the method and seals its results, then dies before the
+            # DONE report flushes — the owner must adopt the sealed
+            # results instead of re-executing the side effect
+            act = fault_injection.fire(
+                "actor_worker_kill",
+                f"{ActorID(actor_id_b).hex()}:{method}")
+            if act == "exit":
+                os._exit(1)
+            kill_after = act == "exit_after"
         self.current_task_id = TaskID(task_id_b)
         self.current_actor_id = ActorID(actor_id_b)
         try:
@@ -786,6 +807,14 @@ class WorkerCore:
                         loop = asyncio.new_event_loop()
                         self._actor_loops[actor_id_b] = loop
                 result = loop.run_until_complete(result)
+            if kill_after and stream_opts is None:
+                # seal the results exactly as _send_results would, then
+                # die without reporting: the sealed containers are the
+                # evidence the owner's adoption path recovers from
+                values = self._split_returns(result, len(return_ids))
+                for value, rid in zip(values, return_ids):
+                    self._serialize_result(value, ObjectID(rid))
+                os._exit(1)
             if stream_opts is not None:
                 self._run_stream(task_id_b, result, stream_opts)
             else:

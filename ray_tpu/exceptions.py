@@ -25,11 +25,40 @@ class ActorError(RayTpuError):
 
 
 class ActorDiedError(ActorError):
-    """The actor is dead: it crashed, was killed, or exhausted restarts."""
+    """The actor is dead: it crashed, was killed, or exhausted restarts.
+
+    When the runtime knows more than the bare fact, the structured
+    fields carry it (and are appended to the message, so the context
+    survives pickling across processes): ``cause`` is the terminal
+    death reason, ``restarts_consumed`` how many of the actor's
+    ``max_restarts`` budget were spent, and ``incarnation`` which
+    incarnation (0 = the original process) failed.
+    """
+
+    def __init__(self, message: str = "", cause: str = "",
+                 restarts_consumed=None, incarnation=None):
+        self.cause = cause
+        self.restarts_consumed = restarts_consumed
+        self.incarnation = incarnation
+        detail = []
+        if cause:
+            detail.append(f"cause: {cause}")
+        if restarts_consumed is not None:
+            detail.append(f"restarts consumed: {restarts_consumed}")
+        if incarnation is not None:
+            detail.append(f"failing incarnation: {incarnation}")
+        if detail:
+            message += " (" + "; ".join(detail) + ")"
+        super().__init__(message)
 
 
 class ActorUnavailableError(ActorError):
-    """The actor is temporarily unreachable (e.g. restarting)."""
+    """The actor is temporarily unreachable: its worker died and a
+    restart is underway, but the call could not be buffered — the
+    RESTARTING queue is past ``actor_restart_buffer_max``, or the
+    restart has been running longer than ``actor_restart_timeout_s``.
+    Unlike ``ActorDiedError`` the actor may come back; callers may
+    retry later."""
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):

@@ -15,6 +15,12 @@ Two shapes are flagged:
    call into reconstruction — PR 1's recovery contract routes every
    lost-object signal to lineage resubmission, and a handler that
    swallows the signal silently disables recovery for that path.
+
+3. Dropped ``ActorDiedError``: same contract for the actor plane — a
+   handler that catches ``ActorDiedError`` must re-raise it, convert it,
+   or route into the restart/retry machinery (restart, retry, resubmit,
+   replay, re-resolve). Swallowing the death signal silently turns a
+   restartable actor's failure into a hang or a lost call.
 """
 
 from __future__ import annotations
@@ -27,6 +33,8 @@ from ray_tpu.tools.lint.base import Finding, SourceFile, \
 
 _BROAD = {"Exception", "BaseException"}
 _RECONSTRUCT_HINTS = ("reconstruct", "resubmit", "recover")
+_RESTART_HINTS = ("restart", "retry", "resubmit", "replay", "resolve",
+                  "convert")
 
 
 def _exc_names(type_node: Optional[ast.AST]) -> List[str]:
@@ -76,6 +84,25 @@ def _handles_lost_object(handler: ast.ExceptHandler) -> bool:
     return False
 
 
+def _handles_actor_death(handler: ast.ExceptHandler) -> bool:
+    """Does the handler re-raise, convert (raise / non-None return), or
+    route into the restart/retry machinery?"""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = ""
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            if any(h in name.lower() for h in _RESTART_HINTS):
+                return True
+        if isinstance(node, ast.Return) and node.value is not None:
+            return True
+    return False
+
+
 def analyze_file(sf: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
     for node in ast.walk(sf.tree):
@@ -105,6 +132,14 @@ def analyze_file(sf: SourceFile) -> List[Finding]:
                 f"{fn}: catches ObjectLostError without re-raising, "
                 f"converting, or reconstructing — this silently "
                 f"disables lineage recovery"))
+        if "ActorDiedError" in names and not _handles_actor_death(node):
+            if fn is None:
+                fn = enclosing_function_name(sf.tree, node)
+            findings.append(Finding(
+                "L4", sf.relpath, node.lineno,
+                f"{fn}: catches ActorDiedError without re-raising, "
+                f"converting, or routing into restart/retry — dropping "
+                f"the death signal loses calls silently"))
     return findings
 
 

@@ -818,3 +818,53 @@ def test_cluster_streaming_generator_cross_node(cluster):
     with pytest.raises((TaskCancelledError, TaskError)):
         for ref in g3:
             ray_tpu.get(ref, timeout=30)
+
+
+def test_cluster_actor_restart_transparent_calls():
+    """Cross-node restart transparency: after the actor's host node dies,
+    new calls ride out the RESTARTING window (the GCS actor_state channel
+    tells the driver a restart is underway) and land on the restarted
+    incarnation on the replacement node — the death never surfaces."""
+    prev_core = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=2, num_workers_per_node=2,
+                node_resources=[{"ra": 4}, {"rb": 4}])
+    try:
+        c.wait_for_nodes(2)
+        c.connect()
+
+        @ray_tpu.remote
+        class Echo:
+            def __init__(self):
+                self.served = 0
+
+            def hit(self, x):
+                self.served += 1
+                return x * 3
+
+        e = Echo.options(resources={"rb": 0.1}, max_restarts=2,
+                         max_task_retries=2).remote()
+        assert ray_tpu.get(e.hit.remote(1), timeout=60) == 3
+
+        victim = c.nodes[1]
+        c.remove_node(victim, graceful=False)
+        c.add_node(resources={"rb": 4})
+        c.wait_for_nodes(2)
+
+        # new calls during/after the restart window reach the new
+        # incarnation; the transient death must not surface as
+        # ActorDiedError once the budget and window allow a comeback
+        deadline = time.monotonic() + 120
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = ray_tpu.get(e.hit.remote(14), timeout=15)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert got == 42, "actor calls never recovered after node death"
+        # steady state: calls work repeatedly against the new incarnation
+        assert ray_tpu.get(e.hit.remote(5), timeout=60) == 15
+    finally:
+        c.shutdown()
+        runtime_context.set_core(prev_core)

@@ -674,3 +674,365 @@ def test_lineage_evicted_past_budget_not_reconstructed(
     finally:
         os.environ.pop("RTPU_LINEAGE_MAX_BYTES", None)
         config.reload()
+
+
+# ---------------------------------------------------------------------------
+# actor task retries: at-least-once execution, exactly-once result delivery.
+# Reference model: max_task_retries / ActorUnavailableError semantics in
+# python/ray/tests/test_actor_failures.py.
+
+
+def _actor_pid(handle):
+    return ray_tpu.get(handle.pid.remote(), timeout=60)
+
+
+def test_actor_task_retry_inflight_kill(local_ray):
+    """SIGKILL the actor's worker mid-call: with max_task_retries the
+    in-flight call replays against the restarted incarnation and the
+    caller sees the correct result, never the death."""
+    import signal
+
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            return os.getpid()
+
+        def slow_inc(self, delay):
+            time.sleep(delay)
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    pid = _actor_pid(c)
+    ref = c.slow_inc.remote(1.0)
+    time.sleep(0.3)  # let the call reach the worker
+    os.kill(pid, signal.SIGKILL)
+    assert ray_tpu.get(ref, timeout=60) == 1
+    assert _actor_pid(c) != pid  # really a new incarnation
+
+
+def test_actor_call_fault_site_kill_worker(local_ray, fault_injection):
+    """The deterministic actor_call site kills the worker right after one
+    targeted dispatch; the replay is invisible to the caller."""
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class A:
+        def pid(self):
+            return os.getpid()
+
+        def f(self, x):
+            return x * 2
+
+    a = A.remote()
+    pid = _actor_pid(a)
+    fi.inject("actor_call", "kill_worker", target=f"{a.actor_id.hex()}:f")
+    assert ray_tpu.get(a.f.remote(21), timeout=60) == 42
+    assert _actor_pid(a) != pid
+
+
+def test_actor_call_drop_then_death_replays(local_ray, fault_injection):
+    """A dropped dispatch (lost message) is recovered by the worker-death
+    replay: the call is still tracked in-flight, so killing the worker
+    re-submits it to the new incarnation."""
+    import signal
+
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class A:
+        def pid(self):
+            return os.getpid()
+
+        def f(self, x):
+            return x + 1
+
+    a = A.remote()
+    pid = _actor_pid(a)
+    fi.inject("actor_call", "drop", target=f"{a.actor_id.hex()}:f")
+    ref = a.f.remote(1)  # silently dropped: worker never sees it
+    time.sleep(0.3)
+    os.kill(pid, signal.SIGKILL)
+    assert ray_tpu.get(ref, timeout=60) == 2
+
+
+def test_actor_sealed_result_adopted_exactly_once(local_ray, tmp_path):
+    """Worker dies between sealing the results and flushing the DONE
+    report (exit_after fault): the owner adopts the sealed containers
+    instead of re-executing — the side effect happens exactly once."""
+    from ray_tpu.core import fault_injection as fi
+
+    marker = str(tmp_path / "executions")
+    os.environ["RTPU_FAULT_ACTOR_WORKER_KILL"] = "exit_after:1"
+    try:
+        ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+        @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+        class S:
+            def bump(self, path):
+                with open(path, "a") as f:
+                    f.write("x")
+                return _payload(7)  # > inline threshold: sealed into shm
+
+        s = S.remote()
+        assert ray_tpu.get(s.bump.remote(marker), timeout=60) == _payload(7)
+        time.sleep(0.5)  # nothing should re-execute afterwards
+        assert open(marker).read() == "x"
+    finally:
+        os.environ.pop("RTPU_FAULT_ACTOR_WORKER_KILL", None)
+        fi.clear()
+
+
+def test_actor_replayed_completed_call_served_from_store(local_ray):
+    """In-flight kill with several calls queued: completed calls at or
+    below the watermark are never re-executed on replay — each increment
+    lands exactly once even though the batch is re-submitted."""
+    import signal
+
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def pid(self):
+            return os.getpid()
+
+        def add(self, i, delay=0.0):
+            time.sleep(delay)
+            self.log.append(i)
+            return i
+
+        def get_log(self):
+            return list(self.log)
+
+    s = Seq.remote()
+    pid = _actor_pid(s)
+    refs = [s.add.remote(0), s.add.remote(1),
+            s.add.remote(2, delay=1.0), s.add.remote(3)]
+    time.sleep(0.4)  # 0 and 1 complete; 2 is mid-execution
+    os.kill(pid, signal.SIGKILL)
+    assert ray_tpu.get(refs, timeout=60) == [0, 1, 2, 3]
+    # state is rebuilt by replay, and no index ran twice POST-restart
+    log = ray_tpu.get(s.get_log.remote(), timeout=60)
+    assert sorted(set(log)) == sorted(log), f"re-executed entries: {log}"
+
+
+def test_actor_restart_buffer_overflow_unavailable(local_ray):
+    """Calls buffer on a RESTARTING actor up to actor_restart_buffer_max;
+    past it submissions raise ActorUnavailableError (not a hang, not
+    ActorDiedError). Buffered calls drain after the restart."""
+    import signal
+
+    from ray_tpu.core.config import config
+    from ray_tpu.exceptions import ActorUnavailableError
+
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+    old = config.actor_restart_buffer_max
+    config.actor_restart_buffer_max = 5
+    try:
+        @ray_tpu.remote(max_restarts=3, max_task_retries=1)
+        class B:
+            def __init__(self):
+                time.sleep(2.0)  # slow restart: hold the window open
+
+            def pid(self):
+                return os.getpid()
+
+            def f(self, i):
+                return i
+
+        b = B.remote()
+        pid = _actor_pid(b)
+        os.kill(pid, signal.SIGKILL)
+        time.sleep(0.3)  # death noticed -> RESTARTING
+        refs, unavailable = [], 0
+        for i in range(20):
+            try:
+                refs.append(b.f.remote(i))
+            except ActorUnavailableError:
+                unavailable += 1
+        assert unavailable > 0, "overflow never raised"
+        assert len(refs) <= 5 + 1  # cap (one may race the death notice)
+        assert ray_tpu.get(refs, timeout=60) == list(range(len(refs)))
+    finally:
+        config.actor_restart_buffer_max = old
+
+
+def test_actor_budget_exhaustion_enriched_death(local_ray):
+    """Terminal death carries the cause, restarts consumed, and the
+    failing incarnation in both the message and structured fields."""
+    from ray_tpu.exceptions import ActorDiedError
+
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=0)
+    class D:
+        def boom(self):
+            os._exit(1)
+
+        def ok(self):
+            return "fine"
+
+    d = D.remote()
+    # crash until the budget is gone: the terminal error (unlike the
+    # transient mid-call one) carries the structured death fields
+    deadline = time.monotonic() + 60
+    err = None
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(d.boom.remote(), timeout=10)
+        except ActorDiedError as e:
+            if e.restarts_consumed is not None:
+                err = e
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    assert err is not None, "never saw the terminal ActorDiedError"
+    assert "restarts consumed: 1" in str(err)
+    assert err.restarts_consumed == 1
+    assert err.incarnation is not None
+    assert "cause" in str(err)
+
+
+def test_actor_retry_exceptions_app_error(local_ray):
+    """retry_exceptions re-runs a call whose application error matches;
+    non-matching errors surface immediately."""
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote(max_task_retries=3, retry_exceptions=[ValueError])
+    class Flaky:
+        def __init__(self):
+            self.attempts = 0
+
+        def eventually(self):
+            self.attempts += 1
+            if self.attempts < 3:
+                raise ValueError("transient")
+            return self.attempts
+
+        def wrong_type(self):
+            raise KeyError("not retryable")
+
+    f = Flaky.remote()
+    assert ray_tpu.get(f.eventually.remote(), timeout=60) == 3
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(f.wrong_type.remote(), timeout=60)
+    assert "KeyError" in str(ei.value)
+
+
+def test_actor_method_options_explicit_kwargs(local_ray):
+    """ActorMethod.options accepts the retry options and rejects typos
+    with TypeError instead of swallowing them."""
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote
+    class M:
+        def f(self):
+            return 1
+
+    m = M.remote()
+    assert ray_tpu.get(
+        m.f.options(max_task_retries=2, retry_exceptions=True).remote(),
+        timeout=60) == 1
+    with pytest.raises(TypeError):
+        m.f.options(max_retires=5)
+    with pytest.raises(TypeError):
+        m.f.options(num_return=2)
+
+
+def test_kill_no_restart_false_consumes_budget_and_restarts(local_ray):
+    """ray_tpu.kill(actor, no_restart=False) behaves like a worker death:
+    one restart is consumed and the actor comes back; once the budget is
+    gone the next kill is terminal."""
+    from ray_tpu.exceptions import ActorDiedError
+
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=2)
+    class K:
+        def pid(self):
+            return os.getpid()
+
+    k = K.remote()
+    p1 = _actor_pid(k)
+    ray_tpu.kill(k, no_restart=False)
+    p2 = _actor_pid(k)
+    assert p2 != p1, "actor did not restart after kill(no_restart=False)"
+    ray_tpu.kill(k, no_restart=False)  # budget exhausted: terminal
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(k.pid.remote(), timeout=10)
+        if isinstance(ei.value, ActorDiedError):
+            break
+        time.sleep(0.2)
+    assert isinstance(ei.value, ActorDiedError)
+
+
+def test_chaos_actor_workers_sigkilled_zero_lost_calls(local_ray):
+    """Serve/Tune-shaped chaos: replica actors serve a stream of calls
+    while their workers are SIGKILLed repeatedly; with max_task_retries
+    every call returns its correct result — zero lost, zero duplicated
+    deliveries."""
+    import signal
+
+    ray_tpu.init(num_workers=4, object_store_memory=64 << 20)
+
+    @ray_tpu.remote(max_restarts=-1, max_task_retries=-1)
+    class Replica:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def pid(self):
+            return os.getpid()
+
+        def infer(self, x):
+            time.sleep(0.01)
+            return x * self.scale
+
+    replicas = [Replica.remote(10), Replica.remote(100)]
+    pids = [_actor_pid(r) for r in replicas]
+
+    stop = {"flag": False}
+
+    def killer():
+        rounds = 0
+        while not stop["flag"] and rounds < 4:
+            time.sleep(0.5)
+            for i, r in enumerate(replicas):
+                try:
+                    os.kill(pids[i], signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            time.sleep(1.0)
+            for i, r in enumerate(replicas):
+                try:
+                    pids[i] = ray_tpu.get(r.pid.remote(), timeout=30)
+                except Exception:
+                    pass
+            rounds += 1
+
+    import threading
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    refs = []
+    for i in range(60):
+        refs.append((i, 0, replicas[0].infer.remote(i)))
+        refs.append((i, 1, replicas[1].infer.remote(i)))
+        time.sleep(0.02)
+    stop["flag"] = True
+    kt.join(timeout=30)
+    scales = [10, 100]
+    for i, rep, ref in refs:
+        assert ray_tpu.get(ref, timeout=120) == i * scales[rep], \
+            f"call {i} on replica {rep} lost or wrong"

@@ -145,3 +145,52 @@ def test_llama_tied_embeddings_shardings_match_params():
     placed = jax.device_put(params, shardings)
     out = llama.forward(cfg, placed, jnp.zeros((1, 8), jnp.int32))
     assert out.shape == (1, 8, cfg.vocab_size)
+
+
+def test_dep_callback_resolved_mid_enqueue_does_not_deadlock():
+    # A dep whose entry resolves between _enqueue's unresolved scan and
+    # its callback registration used to run on_ready -> _queue_ready
+    # while still holding the runtime lock: the submitting thread
+    # re-acquired the non-reentrant lock and deadlocked the whole
+    # runtime. The doctored event below reproduces that interleaving
+    # deterministically; the submission must still complete.
+    proc = _run_fresh("""
+        import ray_tpu
+        from ray_tpu import api as rt_api
+
+        ray_tpu.init(num_workers=1, object_store_memory=64 << 20)
+
+        @ray_tpu.remote
+        def dep():
+            return 20
+
+        @ray_tpu.remote
+        def consumer(x):
+            return x + 1
+
+        d = dep.remote()
+        assert ray_tpu.get(d) == 20
+        core = rt_api._runtime
+        entry = core._objects[d.id]
+
+        class FlipEvent:
+            # reports "unresolved" exactly once (the scan), then truthful
+            def __init__(self, ev):
+                self._ev = ev
+                self._lies = 1
+
+            def is_set(self):
+                if self._lies:
+                    self._lies -= 1
+                    return False
+                return self._ev.is_set()
+
+            def __getattr__(self, name):
+                return getattr(self._ev, name)
+
+        entry.event = FlipEvent(entry.event)
+        print(ray_tpu.get(consumer.remote(d), timeout=30))
+        ray_tpu.shutdown()
+    """, timeout=90.0)
+    assert proc.returncode == 0, proc.stderr
+    assert "21" in proc.stdout, (proc.stdout, proc.stderr)
