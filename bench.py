@@ -1013,6 +1013,53 @@ def bench_cross_node(rows: list):
         runtime_context.set_core(prev)
 
 
+def bench_gcs_failover(rows: list):
+    """gcs_failover_recovery_ms: SIGKILL the head of a live 2-node
+    cluster (WAL persistence on), restart it on the same port, and time
+    until the control plane fully answers again — both nodes ALIVE, a KV
+    write accepted, and an actor call served. Median of 3 rounds; no
+    reference number — the conservative bar lives in
+    BASELINE.json.published."""
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.core import runtime_context
+    from ray_tpu.core.cluster.fixture import Cluster
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    with tempfile.TemporaryDirectory() as pdir:
+        c = Cluster(num_nodes=2, num_workers_per_node=1,
+                    object_store_memory=64 << 20, gcs_persist_dir=pdir,
+                    env={"RTPU_GCS_RECONNECT_TIMEOUT_S": "60"})
+        try:
+            assert c.wait_for_nodes(2, timeout=120)
+            core = c.connect()
+
+            @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+            class P:
+                def ping(self):
+                    return 1
+
+            a = P.remote()
+            assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+
+            times = []
+            for _ in range(3):
+                c.kill_gcs()
+                t0 = time.perf_counter()
+                c.restart_gcs()
+                assert c.wait_for_nodes(2, timeout=60)
+                core.gcs.call(("kv", "put", "bench-ha", 1))
+                assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+                times.append((time.perf_counter() - t0) * 1e3)
+            rows.append(_row("gcs_failover_recovery_ms",
+                             sorted(times)[1], "ms"))
+        finally:
+            c.shutdown()
+            runtime_context.set_core(prev)
+
+
 def bench_many_nodes_actors() -> float:
     """The actor-fleet creation row ALONE on a fresh 16-node cluster.
 
@@ -1103,6 +1150,14 @@ def main():
         bench_cross_node(rows)
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "locality_scheduling_speedup", "value": -1,
+                     "unit": f"error: {e}"})
+
+    # head-node failover recovery on a fresh 2-node cluster (ISSUE 6:
+    # GCS SIGKILL + same-port restart with WAL persistence)
+    try:
+        bench_gcs_failover(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "gcs_failover_recovery_ms", "value": -1,
                      "unit": f"error: {e}"})
 
     # scalability AFTER many_nodes: the 1M-task slab leaves the single
@@ -1289,6 +1344,8 @@ def main():
             ("locality_scheduling_speedup",
              "locality_scheduling_speedup", True),
             ("cross_node_fetch_gbps", "cross_node_fetch_gbps", True),
+            ("gcs_failover_recovery_ms", "gcs_failover_recovery_ms",
+             False),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)

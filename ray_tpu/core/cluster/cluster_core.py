@@ -29,7 +29,8 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core import protocol, serialization
-from ray_tpu.core.cluster.rpc import ClientCache, RpcClient, RpcError, cluster_authkey
+from ray_tpu.core.cluster.ha import HaGcsClient
+from ray_tpu.core.cluster.rpc import ClientCache, RpcError, cluster_authkey
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
@@ -60,7 +61,12 @@ class ClusterCore:
     def __init__(self, gcs_address: Tuple[str, int],
                  authkey: Optional[bytes] = None):
         self._authkey = authkey or cluster_authkey()
-        self.gcs = RpcClient(tuple(gcs_address), self._authkey)
+        # ride-through GCS client: calls park (bounded by
+        # gcs_op_buffer_max / gcs_reconnect_timeout_s) while the head is
+        # down, then fail with the typed GcsUnavailableError; a detected
+        # head restart re-registers this driver and clamps pubsub cursors
+        self.gcs = HaGcsClient(tuple(gcs_address), self._authkey,
+                               on_reconnect=self._on_gcs_reconnect)
         self.gcs.call(("ping",))
         self._nodes = ClientCache(self._authkey)
         self.job_id = JobID.from_random()
@@ -177,6 +183,29 @@ class ClusterCore:
         self._view_time = now
         return view
 
+    def _on_gcs_reconnect(self, info: dict):
+        """The head restarted (epoch change): re-assert this driver's
+        registration and clamp channel/death cursors to the fresh heads.
+        After an EMPTY restart every seq restarts from 0, so a cursor
+        left at its old (higher) value would silently skip every future
+        freed/actor_state/death event; after a persisted restart the
+        heads are >= the cursors and the clamps are no-ops."""
+        try:
+            self.gcs.try_call(("register_driver", self._driver_id, {}))
+            heads = info.get("channel_seq") or {}
+            with self._lock:
+                self._freed_seq = min(self._freed_seq,
+                                      heads.get("freed", 0))
+                self._actor_state_seq = min(self._actor_state_seq,
+                                            heads.get("actor_state", 0))
+            self._death_seq = min(self._death_seq,
+                                  info.get("death_seq", 0))
+        # rtpu-lint: disable=L4 — reconnect hook runs inside whichever
+        # call detected the restart; a malformed info dict must not
+        # poison that call (the next heartbeat tick re-registers anyway)
+        except Exception:  # noqa: BLE001
+            pass
+
     def _death_watch(self):
         last_hb = 0.0
         # cadence must satisfy BOTH duties: node-death polling and the
@@ -192,9 +221,12 @@ class ClusterCore:
                 try:
                     if not self.gcs.call(
                             ("driver_heartbeat", self._driver_id)):
-                        # GCS restarted and lost the registry: re-register
-                        self.gcs.call(
-                            ("register_driver", self._driver_id, {}))
+                        # GCS restarted and lost the (transient) driver
+                        # registry: re-register and clamp cursors — an
+                        # EMPTY restart also reset every pubsub seq
+                        info = self.gcs.call(("gcs_info",))
+                        self._on_gcs_reconnect(
+                            info if isinstance(info, dict) else {})
                 # rtpu-lint: disable=L4 — crash-proof daemon loop: call()
                 # re-raises arbitrary picklable remote exceptions, and a
                 # missed heartbeat during a GCS restart must not kill the

@@ -112,10 +112,35 @@ class Cluster:
         if self._gcs_proc in self.procs:
             self.procs.remove(self._gcs_proc)
 
-    def restart_gcs(self):
+    def gcs_alive(self) -> bool:
+        """Whether the GCS subprocess is still running (chaos tests use
+        this to observe a fault-injected self-kill, e.g. gcs_kill)."""
+        return self._gcs_proc.poll() is None
+
+    def wait_gcs_dead(self, timeout: float = 30.0) -> bool:
+        """Block until the GCS subprocess exits (e.g. an armed gcs_kill
+        site fired). Reaps the handle so restart_gcs can follow."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._gcs_proc.poll() is not None:
+                if self._gcs_proc in self.procs:
+                    self.procs.remove(self._gcs_proc)
+                return True
+            time.sleep(0.02)
+        return False
+
+    def restart_gcs(self, env_overrides: Optional[Dict[str, str]] = None):
         """Restart the GCS on the SAME port (requires gcs_persist_dir for
-        state to survive); nodes re-register on their next heartbeat."""
+        state to survive); nodes re-register on their next heartbeat.
+        ``env_overrides`` mutate the cluster env for the new process — a
+        value of None deletes the var (e.g. disarm an RTPU_FAULT_* spec
+        that already fired so the restarted head doesn't re-arm it)."""
         self.kill_gcs()
+        for k, v in (env_overrides or {}).items():
+            if v is None:
+                self._env.pop(k, None)
+            else:
+                self._env[k] = v
         self._start_gcs()
 
     def add_node(self, num_workers: Optional[int] = None,

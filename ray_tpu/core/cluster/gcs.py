@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.core import fault_injection
 from ray_tpu.core.cluster.rpc import RpcServer, cluster_authkey
 from ray_tpu.core.config import config
 
@@ -128,6 +129,15 @@ class GcsServer:
         self._freed: Dict[bytes, None] = {}
         self._view_version = 0
         self._stop = False
+        # Incarnation marker: minted fresh per GCS process, never
+        # persisted. Clients compare it across replies to detect that the
+        # head restarted (even a fast restart between two heartbeats) and
+        # trigger a full resync (reference: gcs_server session_name).
+        self._epoch = os.urandom(8).hex()
+        # RECOVERING window: a restart that rehydrated prior state gives
+        # known nodes/drivers this long to heartbeat back in before the
+        # health loop may declare them DEAD (set in _load_persisted).
+        self._recovering_until = 0.0
         # persistence: rehydrate BEFORE serving so no request sees
         # pre-recovery state. LOCK ORDER: _wal_lock, then self._lock —
         # mutating ops apply-and-log atomically under _wal_lock (the op
@@ -214,15 +224,29 @@ class GcsServer:
     def _load_persisted(self):
         snap_path = os.path.join(self._pdir, "snapshot.pkl")
         wal_path = os.path.join(self._pdir, "wal.pkl")
+        # a crash mid-compaction can strand the temp file; the real
+        # snapshot (if any) is intact because os.replace is atomic
+        try:
+            os.unlink(snap_path + ".tmp")
+        except OSError:
+            pass
+        recovered = False
         if os.path.exists(snap_path):
             with open(snap_path, "rb") as f:
                 self._restore_state(pickle.load(f))
+            recovered = True
         if os.path.exists(wal_path):
+            recovered = recovered or os.path.getsize(wal_path) > 0
             with open(wal_path, "rb") as f:
                 while True:
                     try:
                         op, args = pickle.load(f)
-                    except (EOFError, pickle.UnpicklingError):
+                    # rtpu-lint: disable=L4 — a torn tail record from a
+                    # crash mid-append can surface as EOFError,
+                    # UnpicklingError, or (truncated frame/garbage bytes)
+                    # ValueError/AttributeError and others; any failure to
+                    # decode the NEXT record means the log ends here
+                    except Exception:  # noqa: BLE001
                         break  # torn tail record from a crash: stop here
                     try:
                         if op == "__death__":
@@ -246,6 +270,9 @@ class GcsServer:
                     # the whole GCS from starting
                     except Exception:  # noqa: BLE001
                         continue
+        if recovered:
+            self._recovering_until = (time.monotonic()
+                                      + config.gcs_recovery_grace_s)
 
     def _wal_write_locked(self, op: str, args: tuple):
         """Append one record (+ any buffered death records); _wal_lock
@@ -282,6 +309,13 @@ class GcsServer:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, snap_path)
+        # fsync the directory too: the rename itself must be durable, or
+        # a host crash can resurrect the old snapshot with a truncated WAL
+        dfd = os.open(self._pdir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self._wal.close()
         self._wal = open(os.path.join(self._pdir, "wal.pkl"), "wb")
         self._wal_count = 0
@@ -294,6 +328,13 @@ class GcsServer:
         while not self._stop:
             time.sleep(min(0.1, timeout / 4))
             now = time.monotonic()
+            if now < self._recovering_until:
+                # RECOVERING: we just rehydrated from snapshot+WAL and the
+                # whole cluster is reconnecting — declaring anything DEAD
+                # on a stale last_heartbeat now would cascade restarts for
+                # nodes that are merely mid-reconnect
+                self._flush_pending_deaths()
+                continue
             with self._lock:
                 for info in self._nodes.values():
                     if (info.state == "ALIVE"
@@ -478,6 +519,12 @@ class GcsServer:
 
     def _handle(self, msg, ctx) -> Any:
         op = msg[0]
+        if fault_injection.enabled():
+            # chaos site: SIGKILL the head mid-request, deterministically
+            # keyed by op name (arm e.g. RTPU_FAULT_GCS_KILL=kill:1:kv to
+            # die while handling the first kv op)
+            if fault_injection.fire("gcs_kill", op) == "kill":
+                os.kill(os.getpid(), 9)  # SIGKILL — no cleanup, no WAL flush
         fn = getattr(self, "_op_" + op, None)
         if fn is None:
             raise ValueError(f"unknown GCS op {op!r}")
@@ -503,16 +550,20 @@ class GcsServer:
         return True
 
     def _op_heartbeat(self, node_id: bytes, avail: dict, load: int):
+        # replies carry the GCS epoch so nodes detect a head restart even
+        # when every heartbeat is accepted (persisted state restored the
+        # node as ALIVE) and resync their locations/actors/PGs
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or info.state == "DEAD":
-                return {"accepted": False}  # node must re-register
+                # node must re-register
+                return {"accepted": False, "epoch": self._epoch}
             info.last_heartbeat = time.monotonic()
             if info.avail != avail or info.load != load:
                 info.avail = dict(avail)
                 info.load = load
                 self._view_version += 1
-        return {"accepted": True}
+        return {"accepted": True, "epoch": self._epoch}
 
     def _op_unregister_node(self, node_id: bytes):
         with self._lock:
@@ -812,6 +863,27 @@ class GcsServer:
 
     def _op_ping(self):
         return "pong"
+
+    def _op_gcs_info(self):
+        """Identity + recovery status + resync cursors, in one read.
+
+        Clients reconnecting after an outage compare ``epoch`` to the one
+        they last saw: a change means the head restarted, so they
+        re-register and clamp their pubsub/death cursors to the returned
+        heads (after an EMPTY restart the heads reset to 0 and a cursor
+        left high would skip every future event; after a persisted
+        restart the heads are >= the cursors and nothing moves)."""
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "recovering": time.monotonic() < self._recovering_until,
+                "view_version": self._view_version,
+                "nodes_alive": sum(1 for i in self._nodes.values()
+                                   if i.state == "ALIVE"),
+                "channel_seq": dict(self._channel_seq),
+                "death_seq": self._death_seq,
+                "driver_death_seq": self._driver_death_seq,
+            }
 
     def _op_shutdown_gcs(self):
         threading.Thread(target=self.close, daemon=True).start()

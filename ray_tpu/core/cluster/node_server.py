@@ -29,8 +29,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core import external_storage, protocol, serialization
 from ray_tpu.core.cluster.pull_manager import (PRIO_GET, PRIO_TASK_ARGS,
                                                PRIO_WAIT)
-from ray_tpu.core.cluster.rpc import (ClientCache, RpcClient, RpcError,
-                                      RpcServer, cluster_authkey)
+from ray_tpu.core.cluster.ha import HaGcsClient, resync_node
+from ray_tpu.core.cluster.rpc import (ClientCache, RpcError, RpcServer,
+                                      cluster_authkey)
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID, make_task_id
 from ray_tpu.core.object_ref import ObjectRef
@@ -344,10 +345,21 @@ class NodeServer:
                  port: int = 0, authkey: Optional[bytes] = None,
                  labels: Optional[dict] = None):
         self._authkey = authkey or cluster_authkey()
-        self.gcs = RpcClient(tuple(gcs_address), self._authkey)
+        # ride-through GCS client: calls buffer across a head restart;
+        # an epoch change (the head came back as a new process) triggers
+        # a full state resync — see _on_gcs_reconnect
+        self.gcs = HaGcsClient(tuple(gcs_address), self._authkey,
+                               on_reconnect=self._on_gcs_reconnect)
         self.gcs.call(("ping",))
         self._peers = ClientCache(self._authkey)
         self._stop = False
+        self._labels = dict(labels or {})
+        # GCS incarnation this node's state is known to be synced into;
+        # a heartbeat reply carrying a different epoch (or a rejection)
+        # re-runs resync_node until it succeeds. _resync_lock serializes
+        # concurrent triggers (heartbeat loop + reconnect hook).
+        self._synced_epoch: Optional[str] = None
+        self._resync_lock = threading.Lock()
         # True when this server IS the process (python -m ...node_server):
         # a shutdown_node drain then exits the process so the
         # autoscaler's cloud view sees the node release promptly
@@ -449,19 +461,27 @@ class NodeServer:
         # known remote actors: actor_id -> node address
         self._remote_actors: Dict[ActorID, Tuple[str, int]] = {}
 
-        topo = self.runtime.topology
-        self.gcs.call(("register_node", self.node_id.binary(), self.address,
-                       self.runtime._total.to_dict(),
-                       {"chips": getattr(topo, "num_chips", 0),
-                        "kind": getattr(topo, "kind", "none"),
-                        "store": self.runtime.store.name,
-                        "hostname": socket.gethostname(), "pid": os.getpid()},
-                       labels or {}))
+        self.gcs.call(self.register_msg())
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="node-heartbeat")
         self._hb_thread.start()
 
     # --------------------------------------------------------------- plumbing
+
+    def register_msg(self) -> tuple:
+        """The register_node RPC for THIS node — one builder so initial
+        registration, heartbeat-rejection recovery, and post-failover
+        resync all register identically (same node_id: the GCS replaces
+        the row wholesale, so re-registration never double-counts
+        resources)."""
+        topo = self.runtime.topology
+        return ("register_node", self.node_id.binary(), self.address,
+                self.runtime._total.to_dict(),
+                {"chips": getattr(topo, "num_chips", 0),
+                 "kind": getattr(topo, "kind", "none"),
+                 "store": self.runtime.store.name,
+                 "hostname": socket.gethostname(), "pid": os.getpid()},
+                dict(self._labels))
 
     def _heartbeat_loop(self):
         interval = config.gcs_heartbeat_interval_s
@@ -472,17 +492,34 @@ class NodeServer:
                 load = len(rt._task_queue)
             reply = self.gcs.try_call(
                 ("heartbeat", self.node_id.binary(), avail, load))
-            if reply is not None and not reply.get("accepted", True):
-                # marked dead (e.g. after a long GC pause): re-register
-                topo = self.runtime.topology
-                self.gcs.try_call((
-                    "register_node", self.node_id.binary(), self.address,
-                    rt._total.to_dict(),
-                    {"chips": getattr(topo, "num_chips", 0),
-                     "store": rt.store.name,
-                     "hostname": socket.gethostname(), "pid": os.getpid()},
-                    {}))
+            if reply is not None:
+                epoch = reply.get("epoch")
+                rejected = not reply.get("accepted", True)
+                if self._synced_epoch is None and not rejected:
+                    # first contact after our own registration: baseline
+                    self._synced_epoch = epoch
+                elif rejected or (epoch is not None
+                                  and epoch != self._synced_epoch):
+                    # marked dead (long GC pause), or the head restarted
+                    # (possibly from EMPTY state — epoch changed even
+                    # though the rehydrated row accepted us): re-register
+                    # and re-publish locations/actors/PG state
+                    self._resync(epoch)
             time.sleep(interval)
+
+    def _resync(self, epoch: Optional[str]):
+        with self._resync_lock:
+            if epoch is not None and self._synced_epoch == epoch:
+                return  # a concurrent trigger already resynced into it
+            if resync_node(self):
+                self._synced_epoch = epoch
+
+    def _on_gcs_reconnect(self, info: dict):
+        # runs from whichever thread's call detected the restart — hand
+        # the (RPC-heavy) resync to its own thread so that caller's op
+        # returns promptly
+        threading.Thread(target=self._resync, args=(info.get("epoch"),),
+                         daemon=True, name="node-gcs-resync").start()
 
     def note_location(self, oid_bytes: bytes, nbytes: Optional[int] = None):
         with self._loc_lock:
@@ -494,8 +531,16 @@ class NodeServer:
             with self._loc_lock:
                 batch, self._loc_pending = self._loc_pending, []
             if batch:
-                self.gcs.try_call(("loc_add_batch", [b for b, _ in batch],
-                                   self.address, [n for _, n in batch]))
+                ok = self.gcs.try_call(
+                    ("loc_add_batch", [b for b, _ in batch],
+                     self.address, [n for _, n in batch]))
+                if ok is None:
+                    # head unreachable (e.g. mid-failover): requeue so
+                    # the publications land once it is back, bounded so
+                    # a long outage can't grow the buffer without limit
+                    with self._loc_lock:
+                        self._loc_pending[:0] = batch
+                        del self._loc_pending[100_000:]
 
     def note_remote_actor(self, actor_id: ActorID, addr: Tuple[str, int]):
         self._remote_actors[actor_id] = tuple(addr)

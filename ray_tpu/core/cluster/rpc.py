@@ -13,17 +13,27 @@ for their duration; the pool grows on demand and idles out.
 from __future__ import annotations
 
 import os
+import random
 import socket
 import threading
 import time
 from multiprocessing.connection import Client as _MpClient  # noqa: F401
-from multiprocessing.connection import Listener as _MpListener
+from multiprocessing.connection import Connection as _MpConnection
 from multiprocessing.connection import answer_challenge, deliver_challenge
 from typing import Any, Callable, List, Optional, Tuple
 
 
 class RpcError(Exception):
-    """Transport-level RPC failure (peer died, connection refused)."""
+    """Transport-level RPC failure (peer died, connection refused).
+
+    ``maybe_applied`` is True when the request made it onto the wire but
+    the reply was lost, the op is not on the retry-after-apply whitelist,
+    and the server may therefore have applied it once already — blind
+    replay would risk running the side effect twice. False means the
+    request either never reached the server or is safe to re-send.
+    """
+
+    maybe_applied: bool = False
 
 
 class RemoteError(Exception):
@@ -89,6 +99,33 @@ def pick_port() -> int:
     return port
 
 
+class _ReuseAddrListener:
+    """``multiprocessing.connection.Listener`` equivalent (same framed
+    ``Connection`` objects) over a SO_REUSEADDR socket: a server
+    restarted on the SAME port — the GCS failover path — must not lose
+    the bind to a predecessor connection lingering in TIME_WAIT."""
+
+    def __init__(self, address: Tuple[str, int]):
+        self._sock = socket.create_server(address, backlog=128)
+        self.address = self._sock.getsockname()
+
+    def accept(self):
+        s, _ = self._sock.accept()
+        s.setblocking(True)
+        return _MpConnection(s.detach())
+
+    def close(self):
+        # shutdown() first: close() alone does not release the socket
+        # while the accept thread is parked in accept() (the in-flight
+        # syscall pins the open file description, which would keep the
+        # port bound and fail a same-port successor)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
 class RpcServer:
     """Threaded request/response server.
 
@@ -107,9 +144,14 @@ class RpcServer:
         # NO authkey on the listener: accept() must return immediately
         # after the TCP accept; the HMAC handshake runs (bounded) in the
         # per-connection thread — see _timed_handshake
-        self._listener = _MpListener((host, port))
+        self._listener = _ReuseAddrListener((host, port))
         self.address: Tuple[str, int] = (host, port)
         self._stop = False
+        # live accepted connections, severed on close(): the per-conn
+        # threads are parked in recv() and would otherwise keep serving
+        # a "closed" server until the process exits
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="rpc-accept")
         self._accept_thread.start()
@@ -128,6 +170,21 @@ class RpcServer:
 
     def _serve_conn(self, conn):
         ctx: dict = {}
+        with self._conns_lock:
+            if self._stop:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            self._conns.add(conn)
+        try:
+            self._serve_conn_inner(conn, ctx)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def _serve_conn_inner(self, conn, ctx):
         try:
             _timed_handshake(conn, self._authkey, server_side=True)
         # rtpu-lint: disable=L4 — any handshake failure (bad key, stall,
@@ -179,6 +236,20 @@ class RpcServer:
             self._listener.close()
         except OSError:
             pass
+        # sever live connections: their serve threads are parked in
+        # recv() and would otherwise keep answering pooled clients after
+        # "close" (a process kill severs them; in-process close must too)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                s = socket.socket(fileno=conn.fileno())
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                finally:
+                    s.detach()
+            except OSError:
+                pass
 
 
 # Ops that are safe to retry after the request may have been APPLIED once
@@ -203,7 +274,7 @@ _IDEMPOTENT_OPS = frozenset({
     "register_actor_spec", "drop_actor_spec", "loc_add", "loc_add_batch",
     "loc_drop", "register_fn", "cancel", "kill_actor", "prestart_workers",
     "register_driver", "driver_heartbeat", "unregister_driver",
-    "driver_deaths_since", "owner_cleanup",
+    "driver_deaths_since", "owner_cleanup", "gcs_info",
     # exactly-once via server-side dedup on the caller-chosen id
     # (NodeServer._dedup): re-apply is a no-op
     "submit", "actor_call", "create_actor",
@@ -233,13 +304,22 @@ class RpcClient:
     """
 
     def __init__(self, address: Tuple[str, int], authkey: bytes,
-                 connect_timeout: float = 10.0):
+                 connect_timeout: float = 10.0,
+                 unavailable_exc: Optional[type] = None):
         self.address = tuple(address)
         self._authkey = authkey
         self._timeout = connect_timeout
+        # Exception type raised when connect retries exhaust (must accept
+        # a single message argument). Lets GCS clients surface a typed
+        # GcsUnavailableError while plain node clients keep RpcError.
+        self._unavailable_exc = unavailable_exc or RpcError
         self._pool: List[Any] = []
         self._lock = threading.Lock()
         self._closed = False
+        # bumped whenever an established connection failed and we dialed
+        # again: lets wrappers (HaGcsClient) notice a server restart that
+        # the in-call reconnect absorbed without surfacing any error
+        self.reconnects = 0
 
     def _connect(self):
         deadline = time.monotonic() + self._timeout
@@ -269,10 +349,14 @@ class RpcClient:
                 return conn
             except (ConnectionRefusedError, OSError) as e:
                 if time.monotonic() >= deadline:
-                    raise RpcError(
+                    raise self._unavailable_exc(
                         f"cannot connect to {self.address}: {e}") from e
-                time.sleep(delay)
-                delay = min(delay * 2, 0.25)
+                # Exponential backoff with full jitter: a restarting
+                # server sees the whole cluster reconnect at once, and
+                # synchronized retries stampede its accept loop.
+                time.sleep(min(delay * random.random() + 0.005,
+                               max(deadline - time.monotonic(), 0.005)))
+                delay = min(delay * 2, 0.5)
 
     def call(self, msg: Any) -> Any:
         if self._closed:
@@ -305,24 +389,32 @@ class RpcClient:
             if not sent or _retry_safe_after_apply(msg):
                 with self._lock:
                     stale, self._pool = self._pool, []
+                    self.reconnects += 1
                 for c in stale:
                     try:
                         c.close()
                     except OSError:
                         pass
                 conn = self._connect()
+                sent2 = False
                 try:
                     conn.send(msg)
+                    sent2 = True
                     tag, value = conn.recv()
                 except (EOFError, OSError, BrokenPipeError) as e2:
                     try:
                         conn.close()
                     except OSError:
                         pass
-                    raise RpcError(
-                        f"rpc to {self.address} failed: {e2}") from e2
+                    err2 = RpcError(
+                        f"rpc to {self.address} failed: {e2}")
+                    err2.maybe_applied = (
+                        sent2 and not _retry_safe_after_apply(msg))
+                    raise err2 from e2
             else:
-                raise RpcError(f"rpc to {self.address} failed: {e}") from e
+                err = RpcError(f"rpc to {self.address} failed: {e}")
+                err.maybe_applied = True  # sent and not retry-safe
+                raise err from e
         with self._lock:
             if self._closed:
                 conn.close()

@@ -225,6 +225,22 @@ _FLAGS: List[Flag] = [
          "durability then covers GCS process crashes (the common failure), "
          "not host/OS crashes. Turn on for strict durability at ~ms/append "
          "cost (reference: gcs_storage durability knobs)."),
+    Flag("gcs_reconnect_timeout_s", float, 15.0,
+         "How long GCS clients (driver ClusterCore, node servers) keep "
+         "buffering and retrying calls while the head is unreachable "
+         "before failing them with GcsUnavailableError. Covers a SIGKILL "
+         "+ restart of the GCS process (reference: "
+         "gcs_rpc_server_reconnect_timeout_s)."),
+    Flag("gcs_op_buffer_max", int, 512,
+         "Max GCS calls a single client parks in the ride-through buffer "
+         "while the head is down; calls beyond this raise "
+         "GcsUnavailableError immediately instead of piling up threads "
+         "(mirror of actor_restart_buffer_max at the cluster level)."),
+    Flag("gcs_recovery_grace_s", float, 5.0,
+         "After a GCS restart that recovered prior state, suppress "
+         "death-marking of known nodes/drivers for this long so they can "
+         "heartbeat back in before the health loop declares them DEAD "
+         "(reference: gcs_failover_worker_reconnect_timeout)."),
     Flag("driver_heartbeat_interval_s", float, 0.5,
          "Driver -> GCS owner-liveness heartbeat period."),
     Flag("driver_heartbeat_timeout_s", float, 3.0,

@@ -42,6 +42,12 @@ site           key                      actions
                                         seals the results, then exits
                                         before the DONE report flushes
                                         (worker-side; arm via env)
+``gcs_kill``   GCS op name              ``kill`` — SIGKILL the GCS
+                                        process as it starts handling a
+                                        matching op, before the op is
+                                        applied or WAL'd (head-node
+                                        chaos; arm via env — the site
+                                        fires inside the GCS process)
 =============  =======================  ==================================
 
 Env/config surface: ``RTPU_FAULT_<SITE>=<action>[:<times>[:<match>]]``
@@ -66,7 +72,7 @@ import threading
 from typing import Dict, List, Optional
 
 SITES = ("get", "spill", "dispatch", "task", "actor_call",
-         "actor_worker_kill")
+         "actor_worker_kill", "gcs_kill")
 
 _lock = threading.Lock()
 _specs: Dict[str, List[dict]] = {}

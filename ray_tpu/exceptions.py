@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+from ray_tpu.core.cluster.rpc import RpcError as _RpcError
+
 
 class RayTpuError(Exception):
     """Base class for all framework errors."""
@@ -59,6 +61,20 @@ class ActorUnavailableError(ActorError):
     restart has been running longer than ``actor_restart_timeout_s``.
     Unlike ``ActorDiedError`` the actor may come back; callers may
     retry later."""
+
+
+class GcsUnavailableError(RayTpuError, _RpcError):
+    """The head node (GCS) is temporarily unreachable: it died or is
+    mid-restart, and the call could not be buffered past the ride-through
+    window — more than ``gcs_op_buffer_max`` calls are already parked, the
+    outage outlasted ``gcs_reconnect_timeout_s``, or the op is not on the
+    retry-after-apply whitelist and its reply was lost (blind replay could
+    run the side effect twice). Unlike a node death this is usually
+    transient: a restarted GCS recovers its state from snapshot+WAL and
+    the cluster resyncs, so callers may retry later. Mirrors
+    ``ActorUnavailableError`` semantics at the cluster level; subclasses
+    the transport ``RpcError`` so existing best-effort handlers keep
+    treating it as a connectivity failure."""
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):
