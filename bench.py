@@ -1060,6 +1060,84 @@ def bench_gcs_failover(rows: list):
             runtime_context.set_core(prev)
 
 
+def bench_elastic(rows: list):
+    """elastic_resume_s: a 4-worker elastic training gang loses its
+    highest rank to SIGKILL mid-run (gang_resize fault site) and rides
+    through — abort the in-flight collective generation, drain the
+    survivors, re-form at world 3, resume from the last consistent
+    checkpoint. The row is the shrink event's resume_s (death detected
+    -> training live again at the new world size), i.e. the cost of a
+    warm resize instead of a cold gang restart. No reference number —
+    the conservative bar lives in BASELINE.json.published."""
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu import train as train_mod
+    from ray_tpu.core import fault_injection, runtime_context
+    from ray_tpu.train import JaxConfig, RunConfig, ScalingConfig
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    ray_tpu.init(num_workers=6, object_store_memory=128 << 20)
+    try:
+        fault_injection.clear()
+        fault_injection.inject("gang_resize", "kill", target="3")
+
+        def loop(config):
+            import json as _json
+            import os as _os
+            import tempfile as _tf
+
+            import numpy as np
+
+            from ray_tpu import train
+            from ray_tpu.parallel import collective
+
+            ctx = train.get_context()
+            world = ctx.get_world_size()
+            w = np.zeros(4)
+            start = 0
+            ckpt = train.get_checkpoint()
+            if ckpt is not None:
+                with ckpt.as_directory() as d:
+                    state = _json.load(
+                        open(_os.path.join(d, "state.json")))
+                start = state["step"] + 1
+                w = np.asarray(state["w"])
+            for step in range(start, 12):
+                rng = np.random.default_rng(step)
+                X = rng.normal(size=(16, 4))
+                g = X.T @ (X @ w - X.sum(axis=1))
+                if world > 1:
+                    g = np.asarray(
+                        collective.allreduce(g, group_name="train"))
+                w = w - 0.01 * g / 16
+                with _tf.TemporaryDirectory() as d:
+                    with open(_os.path.join(d, "state.json"), "w") as f:
+                        _json.dump({"step": step, "w": w.tolist()}, f)
+                    train.report(
+                        {"step": step},
+                        checkpoint=train.Checkpoint.from_directory(d))
+
+        with tempfile.TemporaryDirectory() as sdir:
+            trainer = train_mod.DataParallelTrainer(
+                loop,
+                backend_config=JaxConfig(platform=None,
+                                         host_collectives=True),
+                scaling_config=ScalingConfig(num_workers=4, min_workers=2),
+                run_config=RunConfig(storage_path=sdir, name="bench"),
+            )
+            res = trainer.fit()
+        assert res.error is None, res.error
+        shrinks = [e for e in res.elastic_stats if e["event"] == "shrink"]
+        assert shrinks, "the gang never shrank"
+        rows.append(_row("elastic_resume_s", shrinks[0]["resume_s"], "s"))
+    finally:
+        fault_injection.clear()
+        ray_tpu.shutdown()
+        runtime_context.set_core(prev)
+
+
 def bench_many_nodes_actors() -> float:
     """The actor-fleet creation row ALONE on a fresh 16-node cluster.
 
@@ -1158,6 +1236,15 @@ def main():
         bench_gcs_failover(rows)
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "gcs_failover_recovery_ms", "value": -1,
+                     "unit": f"error: {e}"})
+
+    # elastic gang shrink ride-through (ISSUE 7: SIGKILL a gang worker,
+    # resume warm at the smaller world size from the last consistent
+    # checkpoint)
+    try:
+        bench_elastic(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "elastic_resume_s", "value": -1,
                      "unit": f"error: {e}"})
 
     # scalability AFTER many_nodes: the 1M-task slab leaves the single
@@ -1346,6 +1433,7 @@ def main():
             ("cross_node_fetch_gbps", "cross_node_fetch_gbps", True),
             ("gcs_failover_recovery_ms", "gcs_failover_recovery_ms",
              False),
+            ("elastic_resume_s", "elastic_resume_s", False),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)

@@ -146,6 +146,22 @@ _FLAGS: List[Flag] = [
          "Byte budget for the driver's lineage table (serialized task "
          "descriptions kept for object reconstruction); oldest entries "
          "are evicted past it (reference: max_lineage_bytes)."),
+    # ---- train / elastic gangs -------------------------------------------
+    Flag("elastic_grow_cooldown_s", float, 3.0,
+         "Minimum spacing between attempts to grow an elastic training "
+         "gang back toward its target world size. Each attempt probes "
+         "for capacity by creating one replacement worker; the cooldown "
+         "keeps a capacity-starved cluster from paying a probe (and a "
+         "failed placement) every step."),
+    Flag("elastic_grow_probe_timeout_s", float, 10.0,
+         "How long a grow attempt waits for the probe worker to come up "
+         "in its placement bundle before concluding capacity has not "
+         "returned (the probe actor is killed and the gang stays at its "
+         "current size)."),
+    Flag("train_pg_ready_timeout_s", float, 60.0,
+         "How long WorkerGroup.start waits for the gang's placement "
+         "group before failing with PlacementGroupError; the error "
+         "names the first bundle the cluster cannot satisfy."),
     # ---- cluster plane ---------------------------------------------------
     Flag("fetch_chunk_bytes", int, 16 << 20,
          "Chunk size for ranged node-to-node object transfer "

@@ -48,6 +48,17 @@ site           key                      actions
                                         applied or WAL'd (head-node
                                         chaos; arm via env — the site
                                         fires inside the GCS process)
+``gang_resize``  batch index (decimal)  ``kill`` — SIGKILL the
+                                        highest-rank training worker
+                                        right after the matching result
+                                        batch is harvested (abrupt
+                                        preemption); ``sigterm`` —
+                                        deliver SIGTERM instead, giving
+                                        the worker its checkpoint grace
+                                        window (scheduled preemption).
+                                        Fires driver-side inside
+                                        BackendExecutor, so in-process
+                                        ``inject`` works
 =============  =======================  ==================================
 
 Env/config surface: ``RTPU_FAULT_<SITE>=<action>[:<times>[:<match>]]``
@@ -72,7 +83,7 @@ import threading
 from typing import Dict, List, Optional
 
 SITES = ("get", "spill", "dispatch", "task", "actor_call",
-         "actor_worker_kill", "gcs_kill")
+         "actor_worker_kill", "gcs_kill", "gang_resize")
 
 _lock = threading.Lock()
 _specs: Dict[str, List[dict]] = {}

@@ -2906,6 +2906,14 @@ class Runtime:
             state.ready = False
             state.restarting = False
             state.death_cause = cause
+            if state.name and self._named_actors.get(state.name) == \
+                    state.actor_id:
+                # Terminal death frees the name: a later named create or
+                # get-or-create (e.g. a collective coordinator re-formed
+                # after a gang restart) must not rendezvous with this
+                # corpse (reference: GCS removes the named-actor entry on
+                # terminal death).
+                del self._named_actors[state.name]
             pending = list(state.queue)
             state.queue.clear()
             self._release_actor_locked(state)

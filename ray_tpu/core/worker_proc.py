@@ -50,6 +50,9 @@ class WorkerCore:
         # task/actor context is thread-local: concurrent actor threads
         # (max_concurrency > 1) must not clobber each other's attribution
         self._ctx_tls = threading.local()
+        # set by the SIGTERM handler of actors created with trap_sigterm
+        # (train workers); read by train.preempted()
+        self.preempted = threading.Event()
         self._data_lock = threading.Lock()
         self._send_lock = threading.Lock()
         self._async_dirty = False  # async sends since last barrier
@@ -721,6 +724,19 @@ class WorkerCore:
             # actor-scoped runtime_env: applied for the actor's lifetime
             # (the worker is dedicated to it)
             self._apply_runtime_env(opts.get("runtime_env"))
+            if opts.get("trap_sigterm"):
+                # TPU maintenance events arrive as SIGTERM; this actor
+                # asked for them as a flag (train.preempted()) instead
+                # of sudden death. Installed HERE because actor calls
+                # run on pool threads when max_concurrency > 1 and only
+                # the main thread (this recv loop) may set signal
+                # handlers. Forceful teardown is unaffected: runtime
+                # kills escalate to SIGKILL.
+                import signal as _signal
+
+                _signal.signal(
+                    _signal.SIGTERM,
+                    lambda signum, frame: self.preempted.set())
             instance = cls(*args, **kwargs)
             self._actors[actor_id_b] = instance
             mc = int(opts.get("max_concurrency") or 1)

@@ -24,10 +24,28 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_tpu.exceptions import RayTpuError
+
 _COORD_PREFIX = "rtpu_collective::"
 _groups: Dict[str, "CollectiveGroup"] = {}
 
 REDUCE_OPS = ("sum", "prod", "min", "max")
+
+# Sentinel the coordinator hands back from every rendezvous method once
+# the group is aborted; members convert it into CollectiveAbortedError.
+# A marker return (instead of raising inside the actor) keeps the abort
+# indistinguishable from a normal reply on the wire — no reliance on
+# exception pickling — and lets blocked pollers observe it on their very
+# next 2 ms poll instead of waiting out the 120 s _sync_op timeout.
+_ABORT = "__rtpu_collective_abort__"
+
+
+class CollectiveAbortedError(RayTpuError):
+    """An in-flight collective was aborted — typically because a gang
+    peer died and the driver is resizing the group. The message names
+    the reason (including the dead rank when known). Callers inside a
+    train loop should let it propagate: the session/executor treat it
+    as a resize signal, not an application error."""
 
 
 class _Coordinator:
@@ -35,14 +53,26 @@ class _Coordinator:
 
     Methods are polled by members; per-operation state is keyed by a
     monotonically increasing per-member round counter so reuse is safe.
+    Once ``abort`` is called every rendezvous method returns the abort
+    marker forever — the group is dead and must be re-created (under a
+    new generation) to be used again.
     """
 
     def __init__(self, world_size: int):
         self.world_size = world_size
         self.rounds: Dict[str, dict] = {}
         self.mailbox: Dict[Tuple[int, int, int], Any] = {}
+        self.aborted: Optional[str] = None
+
+    def abort(self, reason: str):
+        self.aborted = reason or "collective group aborted"
+        self.rounds.clear()
+        self.mailbox.clear()
+        return True
 
     def contribute(self, key: str, rank: int, data, op: str):
+        if self.aborted is not None:
+            return (_ABORT, self.aborted)
         st = self.rounds.setdefault(key, {"parts": {}, "result": None, "op": op})
         st["parts"][rank] = data
         if len(st["parts"]) == self.world_size and st["result"] is None:
@@ -51,6 +81,8 @@ class _Coordinator:
         return st["result"] is not None
 
     def fetch(self, key: str, rank: int):
+        if self.aborted is not None:
+            return (_ABORT, self.aborted)
         st = self.rounds.get(key)
         if st is None or st["result"] is None:
             return False, None
@@ -87,9 +119,14 @@ class _Coordinator:
         raise ValueError(f"unknown reduce op {op!r}")
 
     def post(self, src: int, dst: int, tag: int, data):
+        if self.aborted is not None:
+            return (_ABORT, self.aborted)
         self.mailbox[(src, dst, tag)] = data
+        return None
 
     def take(self, src: int, dst: int, tag: int):
+        if self.aborted is not None:
+            return (_ABORT, self.aborted)
         if (src, dst, tag) in self.mailbox:
             return True, self.mailbox.pop((src, dst, tag))
         return False, None
@@ -99,18 +136,20 @@ class CollectiveGroup:
     """A member's view of one collective group."""
 
     def __init__(self, group_name: str, world_size: int, rank: int,
-                 backend: str = "host"):
+                 backend: str = "host", generation: int = 0):
         if backend not in ("host", "xla"):
             raise ValueError(f"backend must be 'host' or 'xla', got {backend!r}")
         self.name = group_name
         self.world_size = world_size
         self.rank = rank
         self.backend = backend
+        self.generation = generation
         self._round = 0
         self._coord = None
         self._mesh = None
         if backend == "host":
-            self._coord = _get_or_create_coordinator(group_name, world_size)
+            self._coord = _get_or_create_coordinator(
+                group_name, world_size, generation)
         else:
             from ray_tpu.parallel.mesh import MeshSpec, build_mesh
 
@@ -118,20 +157,37 @@ class CollectiveGroup:
 
     # ---- host backend primitives -------------------------------------------
 
+    def abort(self, reason: str = "aborted"):
+        """Poison the group: every member blocked in (or later entering)
+        a collective gets CollectiveAbortedError on its next poll."""
+        if self._coord is not None:
+            import ray_tpu
+
+            ray_tpu.get(self._coord.abort.remote(reason))
+
+    def _check_abort(self, reply):
+        """Raise if the coordinator replied with the abort marker."""
+        if (isinstance(reply, tuple) and len(reply) == 2
+                and reply[0] == _ABORT):
+            raise CollectiveAbortedError(
+                f"collective group {self.name!r} aborted "
+                f"(rank {self.rank}/{self.world_size}): {reply[1]}")
+        return reply
+
     def _sync_op(self, data, op: str, timeout: float = 120.0):
         import ray_tpu
 
         self._round += 1
         key = f"{op.split(':')[0]}:{self._round}"
-        ray_tpu.get(
+        self._check_abort(ray_tpu.get(
             self._coord.contribute.remote(key, self.rank, data, op),
             timeout=timeout,
-        )
+        ))
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            done, result = ray_tpu.get(
+            done, result = self._check_abort(ray_tpu.get(
                 self._coord.fetch.remote(key, self.rank), timeout=timeout
-            )
+            ))
             if done:
                 return result
             time.sleep(0.002)
@@ -163,7 +219,8 @@ class CollectiveGroup:
             ref = ray_tpu.put(np.ascontiguousarray(chunk))
             # nested (listed) refs pass through UNRESOLVED, so the
             # coordinator mailbox holds the ref, never the payload
-            ray_tpu.get(self._coord.post.remote(r, (r + 1) % W, tag, [ref]))
+            self._check_abort(ray_tpu.get(
+                self._coord.post.remote(r, (r + 1) % W, tag, [ref])))
 
         def recv_chunk(tag):
             boxed = self.recv((r - 1) % W, tag=tag, timeout=timeout)
@@ -209,18 +266,18 @@ class CollectiveGroup:
     def send(self, tensor, dst_rank: int, tag: int = 0):
         import ray_tpu
 
-        ray_tpu.get(
+        self._check_abort(ray_tpu.get(
             self._coord.post.remote(self.rank, dst_rank, tag, np.asarray(tensor))
-        )
+        ))
 
     def recv(self, src_rank: int, tag: int = 0, timeout: float = 120.0):
         import ray_tpu
 
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            ok, data = ray_tpu.get(
+            ok, data = self._check_abort(ray_tpu.get(
                 self._coord.take.remote(src_rank, self.rank, tag)
-            )
+            ))
             if ok:
                 return data
             time.sleep(0.002)
@@ -253,10 +310,20 @@ def _xla_allreduce(mesh, tensor, op: str):
     return jax.jit(f)(jnp.asarray(tensor))
 
 
-def _get_or_create_coordinator(group_name: str, world_size: int):
+def _coord_name(group_name: str, generation: int = 0) -> str:
+    """Named-actor name for a group's coordinator. Generations let an
+    elastic gang re-form the same logical group at a new world size
+    without colliding with (or resurrecting the abort flag of) the
+    previous incarnation's coordinator."""
+    name = _COORD_PREFIX + group_name
+    return name if generation == 0 else f"{name}@{generation}"
+
+
+def _get_or_create_coordinator(group_name: str, world_size: int,
+                               generation: int = 0):
     import ray_tpu
 
-    name = _COORD_PREFIX + group_name
+    name = _coord_name(group_name, generation)
     try:
         return ray_tpu.get_actor(name)
     except ValueError:
@@ -269,13 +336,57 @@ def _get_or_create_coordinator(group_name: str, world_size: int):
         return ray_tpu.get_actor(name)
 
 
+def abort_group(group_name: str = "default", reason: str = "aborted",
+                generation: int = 0) -> bool:
+    """Driver-side: poison a group's coordinator so every member blocked
+    in a collective fails over to CollectiveAbortedError within one poll
+    interval (~ms), instead of stalling out the 120 s op timeout. Safe
+    to call from a process that never joined the group. Returns False
+    when no coordinator exists (nothing to abort)."""
+    import ray_tpu
+
+    try:
+        coord = ray_tpu.get_actor(_coord_name(group_name, generation))
+    except ValueError:
+        return False
+    ray_tpu.get(coord.abort.remote(reason))
+    return True
+
+
+def destroy_coordinator(group_name: str = "default",
+                        generation: int = 0) -> bool:
+    """Driver-side: kill a group's coordinator actor (after members have
+    drained). A later init at the same name starts from fresh state."""
+    import ray_tpu
+
+    name = _coord_name(group_name, generation)
+    try:
+        coord = ray_tpu.get_actor(name)
+    except ValueError:
+        return False
+    ray_tpu.kill(coord)
+    # Wait until the name is actually deregistered: kill() is async, and
+    # a fresh gang re-forming at the same name (cold restart after a
+    # shrink below min_workers) must get-or-create a NEW coordinator, not
+    # rendezvous with this dying one.
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get_actor(name)
+        except ValueError:
+            return True
+        time.sleep(0.02)
+    return True
+
+
 def init_collective_group(world_size: int, rank: int,
                           backend: str = "host",
-                          group_name: str = "default") -> CollectiveGroup:
+                          group_name: str = "default",
+                          generation: int = 0) -> CollectiveGroup:
     """Join a collective group (call once per member)."""
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
-    group = CollectiveGroup(group_name, world_size, rank, backend)
+    group = CollectiveGroup(group_name, world_size, rank, backend, generation)
     _groups[group_name] = group
     return group
 

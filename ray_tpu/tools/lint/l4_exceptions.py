@@ -1,6 +1,7 @@
-"""L4 — exception discipline in ``ray_tpu/core/``.
+"""L4 — exception discipline in ``ray_tpu/core/``, ``ray_tpu/train/``,
+and ``ray_tpu/parallel/`` (the recovery-contract surface).
 
-Two shapes are flagged:
+These shapes are flagged:
 
 1. Swallowing handlers: a bare ``except:`` anywhere, or an ``except
    Exception:``/``except BaseException:`` whose body does nothing (only
@@ -21,6 +22,14 @@ Two shapes are flagged:
    or route into the restart/retry machinery (restart, retry, resubmit,
    replay, re-resolve). Swallowing the death signal silently turns a
    restartable actor's failure into a hang or a lost call.
+
+4. Dropped ``TrainingWorkerError`` / ``CollectiveAbortedError``: the
+   elastic-training contract routes both signals into gang resize or
+   gang restart — a handler that swallows either silently converts a
+   recoverable preemption into a hang (peers stuck in collectives) or a
+   lost run. Same handling test as ActorDiedError, with the resize verbs
+   (resize, shrink, grow, abort, interrupt, drain) also counting as
+   routing.
 """
 
 from __future__ import annotations
@@ -35,6 +44,8 @@ _BROAD = {"Exception", "BaseException"}
 _RECONSTRUCT_HINTS = ("reconstruct", "resubmit", "recover")
 _RESTART_HINTS = ("restart", "retry", "resubmit", "replay", "resolve",
                   "convert")
+_RESIZE_HINTS = _RESTART_HINTS + ("resize", "shrink", "grow", "abort",
+                                  "interrupt", "drain")
 
 
 def _exc_names(type_node: Optional[ast.AST]) -> List[str]:
@@ -84,9 +95,9 @@ def _handles_lost_object(handler: ast.ExceptHandler) -> bool:
     return False
 
 
-def _handles_actor_death(handler: ast.ExceptHandler) -> bool:
+def _handles_signal(handler: ast.ExceptHandler, hints) -> bool:
     """Does the handler re-raise, convert (raise / non-None return), or
-    route into the restart/retry machinery?"""
+    route into the recovery machinery named by ``hints``?"""
     for node in ast.walk(handler):
         if isinstance(node, ast.Raise):
             return True
@@ -96,11 +107,15 @@ def _handles_actor_death(handler: ast.ExceptHandler) -> bool:
                 name = node.func.id
             elif isinstance(node.func, ast.Attribute):
                 name = node.func.attr
-            if any(h in name.lower() for h in _RESTART_HINTS):
+            if any(h in name.lower() for h in hints):
                 return True
         if isinstance(node, ast.Return) and node.value is not None:
             return True
     return False
+
+
+def _handles_actor_death(handler: ast.ExceptHandler) -> bool:
+    return _handles_signal(handler, _RESTART_HINTS)
 
 
 def analyze_file(sf: SourceFile) -> List[Finding]:
@@ -140,6 +155,15 @@ def analyze_file(sf: SourceFile) -> List[Finding]:
                 f"{fn}: catches ActorDiedError without re-raising, "
                 f"converting, or routing into restart/retry — dropping "
                 f"the death signal loses calls silently"))
+        for sig in ("TrainingWorkerError", "CollectiveAbortedError"):
+            if sig in names and not _handles_signal(node, _RESIZE_HINTS):
+                if fn is None:
+                    fn = enclosing_function_name(sf.tree, node)
+                findings.append(Finding(
+                    "L4", sf.relpath, node.lineno,
+                    f"{fn}: catches {sig} without re-raising, converting, "
+                    f"or routing into gang resize/restart — swallowing "
+                    f"the signal strands the surviving ranks"))
     return findings
 
 

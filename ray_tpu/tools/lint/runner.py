@@ -4,8 +4,11 @@ suppression comments and baselines.
 Scopes (mirroring where each invariant lives):
 
 - L1 runs over ``core/protocol.py`` plus the three dispatcher files;
-- L2 and L4 run over ``ray_tpu/core/`` (the event-loop/lock and
-  recovery-contract surface);
+- L2 runs over ``ray_tpu/core/`` (the event-loop/lock surface);
+- L4 runs over ``ray_tpu/core/``, ``ray_tpu/train/``, and
+  ``ray_tpu/parallel/`` (the recovery-contract surface — elastic
+  training extends the contract to TrainingWorkerError and
+  CollectiveAbortedError);
 - L3 runs over the whole ``ray_tpu/`` package (flags are read
   everywhere).
 """
@@ -56,6 +59,7 @@ def collect_findings(root: Optional[str] = None,
         return by_rel.get(rel)
 
     core_files: List[SourceFile] = []
+    recovery_files: List[SourceFile] = []  # L4 scope
     all_files: List[SourceFile] = []
     for path in iter_py_files(root, "ray_tpu"):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
@@ -65,6 +69,9 @@ def collect_findings(root: Optional[str] = None,
         all_files.append(sf)
         if rel.startswith("ray_tpu/core/"):
             core_files.append(sf)
+        if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
+                           "ray_tpu/parallel/")):
+            recovery_files.append(sf)
 
     findings: List[Finding] = []
     if "L1" in rules:
@@ -81,7 +88,7 @@ def collect_findings(root: Optional[str] = None,
             findings.extend(l3_config.analyze(
                 config_sf, get(FAULT_PATH), all_files))
     if "L4" in rules:
-        findings.extend(l4_exceptions.analyze(core_files))
+        findings.extend(l4_exceptions.analyze(recovery_files))
 
     out = []
     for f in findings:

@@ -29,6 +29,7 @@ from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
 from ray_tpu.train.result import Result  # noqa: F401
 from ray_tpu.train.session import (  # noqa: F401
     PreemptedError,
+    SessionInterruptedError,
     TrainContext,
     get_checkpoint,
     get_context,
@@ -36,7 +37,10 @@ from ray_tpu.train.session import (  # noqa: F401
     preempted,
     report,
 )
-from ray_tpu.train.storage import StorageContext  # noqa: F401
+from ray_tpu.train.storage import (  # noqa: F401
+    StorageContext,
+    validate_checkpoint_dir,
+)
 from ray_tpu.train.worker_group import WorkerGroup  # noqa: F401
 
 __all__ = [
@@ -45,7 +49,7 @@ __all__ = [
     "Checkpoint", "CheckpointManager", "CheckpointConfig",
     "FailureConfig", "RunConfig", "ScalingConfig",
     "DataParallelTrainer", "JaxTrainer", "Result",
-    "PreemptedError", "preempted",
+    "PreemptedError", "preempted", "SessionInterruptedError",
     "TrainContext", "get_checkpoint", "get_context", "get_dataset_shard",
-    "report", "StorageContext", "WorkerGroup",
+    "report", "StorageContext", "validate_checkpoint_dir", "WorkerGroup",
 ]

@@ -32,6 +32,19 @@ class Backend:
                           backend_config: "BackendConfig"):
         pass
 
+    def abort_collectives(self, worker_group: WorkerGroup, reason: str):
+        """Elastic resize, step 1: unblock survivors stuck in in-flight
+        collectives (they fail over to CollectiveAbortedError within a
+        poll interval instead of stalling out the op timeout). Called
+        with the gang still at its OLD generation."""
+
+    def on_resize(self, worker_group: WorkerGroup,
+                  backend_config: "BackendConfig"):
+        """Elastic resize, step 2: re-wire the (already re-ranked) gang
+        at its new world size and generation — re-join collective
+        groups, refresh platform/distributed state on every worker
+        (including workers added by a grow)."""
+
     def on_shutdown(self, worker_group: WorkerGroup):
         pass
 
@@ -108,11 +121,16 @@ def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int)
                                    process_id=process_id)
 
 
-def _join_host_collective_group(world_size: int, rank: int, group_name: str):
+def _join_host_collective_group(world_size: int, rank: int, group_name: str,
+                                generation: int = 0):
     from ray_tpu.parallel import collective
 
     collective.init_collective_group(world_size, rank, backend="host",
-                                     group_name=group_name)
+                                     group_name=group_name,
+                                     generation=generation)
+
+
+TRAIN_GROUP = "train"
 
 
 class _JaxBackend(Backend):
@@ -136,13 +154,58 @@ class _JaxBackend(Backend):
             ]
             ray_tpu.get(refs)
 
-    def on_training_start(self, worker_group: WorkerGroup, cfg: JaxConfig):
+    def _join_collectives(self, worker_group: WorkerGroup, cfg: JaxConfig):
         if cfg.host_collectives and len(worker_group) > 1:
             import ray_tpu
 
             refs = [
                 w.execute.remote(_join_host_collective_group,
-                                 len(worker_group), rank, "train")
+                                 len(worker_group), rank, TRAIN_GROUP,
+                                 worker_group.generation)
                 for rank, w in enumerate(worker_group.workers)
             ]
             ray_tpu.get(refs)
+
+    def on_training_start(self, worker_group: WorkerGroup, cfg: JaxConfig):
+        self._join_collectives(worker_group, cfg)
+
+    def abort_collectives(self, worker_group: WorkerGroup, reason: str):
+        from ray_tpu.parallel import collective
+
+        collective.abort_group(TRAIN_GROUP, reason,
+                               generation=worker_group.generation)
+
+    def on_resize(self, worker_group: WorkerGroup, cfg: JaxConfig):
+        from ray_tpu.parallel import collective
+        from ray_tpu.train.session import _install_preemption_handler
+
+        # the previous incarnation's (aborted) coordinator has been fully
+        # drained by now; reclaim its name slot
+        if worker_group.generation > 0:
+            collective.destroy_coordinator(
+                TRAIN_GROUP, generation=worker_group.generation - 1)
+        # idempotent for survivors, required for grown-in workers
+        worker_group.execute(_setup_jax_platform, cfg.platform,
+                             cfg.cpu_devices_per_worker)
+        worker_group.execute(_install_preemption_handler)
+        if cfg.distributed and len(worker_group) > 1:
+            coordinator = worker_group.execute_single(
+                0, _pick_coordinator, cfg.coordinator_port)
+            import ray_tpu
+
+            refs = [
+                w.execute.remote(_init_jax_distributed, coordinator,
+                                 len(worker_group), rank)
+                for rank, w in enumerate(worker_group.workers)
+            ]
+            ray_tpu.get(refs)
+        self._join_collectives(worker_group, cfg)
+
+    def on_shutdown(self, worker_group: WorkerGroup):
+        from ray_tpu.parallel import collective
+
+        # reclaim the current incarnation's coordinator so a later gang
+        # (cold restart in the same runtime) starts from fresh,
+        # un-aborted state
+        collective.destroy_coordinator(
+            TRAIN_GROUP, generation=worker_group.generation)

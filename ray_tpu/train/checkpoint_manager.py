@@ -3,12 +3,15 @@ python/ray/train/_internal/checkpoint_manager.py)."""
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import CheckpointConfig
-from ray_tpu.train.storage import StorageContext
+from ray_tpu.train.storage import StorageContext, validate_checkpoint_dir
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -53,6 +56,26 @@ class CheckpointManager:
     @property
     def latest(self) -> Optional[Checkpoint]:
         return self.checkpoints[-1].checkpoint if self.checkpoints else None
+
+    def latest_consistent(self) -> Optional[Checkpoint]:
+        """The newest tracked checkpoint that passes manifest validation.
+
+        Torn/partial dirs (the persisting worker died mid-commit, or the
+        dir was damaged after the fact) are dropped from tracking with a
+        warning and the walk continues to the previous checkpoint —
+        resume never crashes on a bad dir, it just loses fewer-than-all
+        steps."""
+        while self.checkpoints:
+            tc = self.checkpoints[-1]
+            if validate_checkpoint_dir(tc.checkpoint.path,
+                                       tc.checkpoint.filesystem):
+                return tc.checkpoint
+            logger.warning(
+                "checkpoint %s is torn/partial (manifest validation "
+                "failed); falling back to the previous checkpoint",
+                tc.checkpoint.path)
+            self.checkpoints.pop()
+        return None
 
     @property
     def best(self) -> Optional[Checkpoint]:

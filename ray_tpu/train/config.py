@@ -26,6 +26,12 @@ class ScalingConfig:
         STRICT_PACK keeps the gang on one ICI domain.
     topology: optional TPU topology hint, e.g. "v5e-8" — lets the scheduler
         gang-place onto a whole sub-slice.
+    min_workers / max_workers: set min_workers to make the gang ELASTIC —
+        a worker death becomes a resize event (shrink and continue from
+        the last consistent checkpoint) instead of a gang failure, as long
+        as at least min_workers survive; the gang grows back toward
+        min(num_workers, max_workers) when capacity returns. Leave
+        min_workers unset for the classic all-or-nothing gang.
     """
 
     num_workers: int = 1
@@ -35,6 +41,23 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
     topology: Optional[str] = None
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_workers is not None:
+            if not 1 <= self.min_workers <= self.num_workers:
+                raise ValueError(
+                    f"min_workers={self.min_workers} must be in "
+                    f"[1, num_workers={self.num_workers}]")
+        if self.max_workers is not None and self.max_workers < self.num_workers:
+            raise ValueError(
+                f"max_workers={self.max_workers} must be >= "
+                f"num_workers={self.num_workers}")
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None
 
     def bundle_for_worker(self) -> Dict[str, float]:
         b: Dict[str, float] = {}

@@ -60,13 +60,18 @@ class DataParallelTrainer:
         preemptions = 0
         latest_metrics: Dict[str, Any] = {}
         history: list = []
+        elastic_stats: list = []
         last_error: Optional[BaseException] = None
 
         while True:
             executor = BackendExecutor(self.backend_config, self.scaling_config)
             try:
                 executor.start()
-                resume = ckpt_mgr.latest or self.resume_from_checkpoint
+                # resume from the newest CONSISTENT checkpoint: torn/
+                # partial dirs (worker died mid-persist) are dropped with
+                # a warning instead of crashing the restart
+                resume = ckpt_mgr.latest_consistent() \
+                    or self.resume_from_checkpoint
                 executor.start_training(
                     self.train_loop_per_worker,
                     self.train_loop_config,
@@ -85,6 +90,7 @@ class DataParallelTrainer:
                         "trial_name": storage.trial_name,
                         "checkpoint_index_start": ckpt_mgr.next_index,
                     },
+                    shard_fn=self._shard_datasets,
                 )
                 while True:
                     results = executor.get_next_results()
@@ -100,6 +106,10 @@ class DataParallelTrainer:
                         ckpt_mgr.register_persisted(ckpt_dirs[0], latest_metrics)
                 last_error = None
                 break
+            # rtpu-lint: disable=L4 — this handler IS the restart
+            # machinery: the enclosing while-loop rebuilds the gang and
+            # resumes from the latest consistent checkpoint (bounded by
+            # max_failures / max_preemptions)
             except TrainingWorkerError as e:
                 last_error = e
                 if isinstance(e.__cause__, PreemptedError):
@@ -120,13 +130,15 @@ class DataParallelTrainer:
                     if max_failures >= 0 and failures > max_failures:
                         break
             finally:
+                elastic_stats.extend(executor.elastic_stats)
                 executor.shutdown()
 
         return Result(metrics=latest_metrics,
                       checkpoint=ckpt_mgr.best,
                       error=last_error,
                       path=storage.trial_path,
-                      metrics_history=history)
+                      metrics_history=history,
+                      elastic_stats=elastic_stats)
 
     # ------------------------------------------------------------ datasets
     def _shard_datasets(self, n: int):

@@ -15,6 +15,9 @@ class Result:
     error: Optional[BaseException] = None
     path: str = ""
     metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    # One entry per elastic resize the run rode through: {event:
+    # "shrink"|"grow", old_world, new_world, cause, resume_s}.
+    elastic_stats: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def metrics_dataframe(self):

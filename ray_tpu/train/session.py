@@ -70,6 +70,15 @@ class TrainContext:
         return self.trial_dir
 
 
+class SessionInterruptedError(BaseException):
+    """Raised inside the user train loop when the driver interrupts the
+    session (gang resize: a peer died or the gang is growing back). A
+    BaseException on purpose: a user loop's ``except Exception`` must not
+    swallow the interrupt — the loop is being unwound so the worker can
+    rejoin at the new world size and resume from the last consistent
+    checkpoint."""
+
+
 class _TrainSession:
     """Pumps results from the user training thread to the actor thread.
 
@@ -94,12 +103,18 @@ class _TrainSession:
         self._result_q: "queue.Queue[TrainingResult]" = queue.Queue(maxsize=1)
         self._consumed = threading.Semaphore(0)
         self._finished = False
+        self._interrupted: Optional[str] = None
 
         def runner():
             try:
                 train_fn(config) if _wants_config(train_fn) else train_fn()
                 self._result_q.put(TrainingResult(metrics={}, done=True))
             except BaseException as e:  # surfaced to the driver, not swallowed
+                # Includes SessionInterruptedError: the queue may still
+                # hold the result the interrupt overtook, but the driver
+                # drains every queued result until it sees this done
+                # sentinel, so the blocking put always completes — and
+                # the sentinel is never dropped.
                 self._result_q.put(
                     TrainingResult(metrics={}, done=True, error=e))
 
@@ -109,9 +124,27 @@ class _TrainSession:
     def start(self):
         self._thread.start()
 
+    # --------------------------------------------- called by the driver
+    # (via _TrainWorker.interrupt_session, on the actor's second
+    # concurrency slot while next_result may be blocked on the first)
+    def interrupt(self, reason: str = "gang resize"):
+        """Ask the train loop to unwind at its next report boundary.
+
+        Protocol: set the flag, then release one ``_consumed`` token so a
+        loop blocked in lockstep (report() waiting for the driver) wakes
+        up and observes the flag. A loop blocked inside a collective is
+        unblocked separately by the coordinator abort. The driver must
+        keep calling ``next_result`` (draining) until it sees a ``done``
+        result — in-flight reports complete normally before the loop
+        raises SessionInterruptedError."""
+        self._interrupted = reason
+        self._consumed.release()
+
     # ------------------------------------------------- called by train_fn
     def report(self, metrics: Dict[str, Any],
                checkpoint_dir: Optional[str] = None):
+        if self._interrupted is not None:
+            raise SessionInterruptedError(self._interrupted)
         persisted = None
         if checkpoint_dir is not None:
             if (self.storage is not None
@@ -126,6 +159,8 @@ class _TrainSession:
         # Lockstep: wait until the driver consumed this result before the
         # training loop continues (mirrors reference's blocking report).
         self._consumed.acquire()
+        if self._interrupted is not None:
+            raise SessionInterruptedError(self._interrupted)
 
     # --------------------------------------------------- called by driver
     def next_result(self, timeout: Optional[float] = None) -> TrainingResult:
@@ -169,11 +204,24 @@ class PreemptedError(RuntimeError):
     with a grace window)."""
 
 
+def _core_preempt_event():
+    """The worker-process-level preemption flag, when running inside a
+    runtime worker (set by the SIGTERM handler that trap_sigterm actors
+    install at creation — see core/worker_proc.py). None driver-side."""
+    from ray_tpu.core import runtime_context
+
+    core = runtime_context.get_core_or_none()
+    return getattr(core, "preempted", None)
+
+
 def preempted() -> bool:
     """True once a preemption signal (SIGTERM) reached this worker.
     Poll at step boundaries: save a checkpoint, then raise
     PreemptedError so the gang restarts cleanly on fresh resources."""
-    return _preempt_event.is_set()
+    if _preempt_event.is_set():
+        return True
+    ev = _core_preempt_event()
+    return ev is not None and ev.is_set()
 
 
 def _flag_preemption():
@@ -184,22 +232,26 @@ def _flag_preemption():
 
 
 def _install_preemption_handler():
-    """Worker-side: route SIGTERM to a flag instead of sudden death so
-    the train loop gets its grace window (forceful teardown uses
-    SIGKILL — runtime kill_actor — which cannot be trapped). Installed
-    by the Jax backend on gang start; runs in the worker's main thread.
-
-    The flag is cleared BEFORE the handler goes in: a SIGTERM landing in
-    between must stick (a drain racing gang start), while a stale flag
-    from a previous gang on a reused process must not."""
+    """Worker-side: arm the SIGTERM→flag route for a (new or resized)
+    gang incarnation. The actual signal handler lives in the worker
+    process's main thread, installed at actor creation for trap_sigterm
+    actors (core/worker_proc.py) — actor calls run on pool threads when
+    max_concurrency > 1 and may not set signal handlers themselves, so
+    this call only CLEARS stale flags: a preemption observed by a
+    previous gang on a reused process must not re-fire, while a SIGTERM
+    landing after this point must stick. In-process sessions (driver-
+    side unit tests) get a best-effort direct install instead."""
     import signal
 
     _preempt_event.clear()
+    ev = _core_preempt_event()
+    if ev is not None:
+        ev.clear()
     try:
         signal.signal(signal.SIGTERM, lambda signum, frame:
                       _flag_preemption())
     except ValueError:
-        pass  # not the main thread: _flag_preemption() remains the hook
+        pass  # not the main thread: the process-level handler owns it
 
 
 def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None,

@@ -1036,3 +1036,395 @@ def test_chaos_actor_workers_sigkilled_zero_lost_calls(local_ray):
     for i, rep, ref in refs:
         assert ray_tpu.get(ref, timeout=120) == i * scales[rep], \
             f"call {i} on replica {rep} lost or wrong"
+
+
+# ---------------------------------------------------------------------------
+# elastic gang training: preemption ride-through with deterministic
+# shrink/grow resume. The chaos drill kills/preempts gang workers via the
+# gang_resize fault site and asserts the loss curve matches an
+# uninterrupted run; the unit tests pin the resize protocol's pieces
+# (session interrupt drain, collective abort, worker-group bookkeeping,
+# crash-safe checkpoint commit, PG-wait timeout flag).
+
+
+def _elastic_sgd_loop(config):
+    """Data-parallel SGD on a fixed linear-regression problem, float64.
+
+    Deterministic by construction at ANY world size: step ``s``'s global
+    batch comes from an rng keyed by ``s`` alone, each rank takes the
+    ``rank::world`` slice, and the allreduced gradient SUM is normalized
+    by the GLOBAL batch size — the loss curve depends only on the step
+    sequence, never on how many ranks computed it.
+    """
+    import json as _json
+    import os as _os
+    import tempfile
+
+    import numpy as np
+
+    from ray_tpu import train
+    from ray_tpu.parallel import collective
+
+    ctx = train.get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    dim, gb = 4, int(config["global_batch"])
+    true_w = np.arange(1.0, dim + 1.0)
+    weights = np.zeros(dim, dtype=np.float64)
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            state = _json.load(open(_os.path.join(d, "state.json")))
+        start = state["step"] + 1
+        weights = np.asarray(state["w"], dtype=np.float64)
+    for step in range(start, int(config["steps"])):
+        if train.preempted():
+            # maintenance SIGTERM observed at the step boundary — the
+            # previous step's checkpoint is already persisted
+            raise train.PreemptedError(f"rank {rank} preempted")
+        rng = np.random.default_rng(1000 + step)  # keyed by step ONLY
+        X = rng.normal(size=(gb, dim))
+        y = X @ true_w
+        Xs, ys = X[rank::world], y[rank::world]
+        grad = Xs.T @ (Xs @ weights - ys)  # local SUM over the shard
+        if world > 1:
+            grad = np.asarray(
+                collective.allreduce(grad, group_name="train"))
+        weights = weights - float(config["lr"]) * grad / gb
+        loss = float(np.mean((X @ weights - y) ** 2))
+        with tempfile.TemporaryDirectory() as d:
+            with open(_os.path.join(d, "state.json"), "w") as f:
+                _json.dump({"step": step, "w": weights.tolist()}, f)
+            train.report(
+                {"step": step, "loss": loss, "world": world,
+                 "pid": _os.getpid()},
+                checkpoint=train.Checkpoint.from_directory(d))
+
+
+def _fit_elastic(loop_cfg, scaling, storage_path, max_failures=0):
+    from ray_tpu import train as train_mod
+    from ray_tpu.train import FailureConfig, JaxConfig, RunConfig
+
+    trainer = train_mod.DataParallelTrainer(
+        _elastic_sgd_loop,
+        train_loop_config=loop_cfg,
+        backend_config=train_mod.JaxConfig(platform=None,
+                                           host_collectives=True),
+        scaling_config=scaling,
+        run_config=RunConfig(storage_path=storage_path, name="elastic",
+                             failure_config=FailureConfig(
+                                 max_failures=max_failures)),
+    )
+    return trainer.fit()
+
+
+def test_elastic_chaos_shrink_grow_loss_parity(local_ray, fault_injection,
+                                               tmp_path):
+    """The chaos drill: a 4-worker elastic gang rides through an abrupt
+    SIGKILL (shrink to 3), grows back when the cooldown expires, then
+    rides through a scheduled SIGTERM preemption — and the per-step loss
+    curve is identical to an uninterrupted 4-worker run. Rank 0's worker
+    process survives every resize (warm resume, not a cold gang
+    restart)."""
+    from ray_tpu.core.config import config
+    from ray_tpu.train import ScalingConfig
+
+    fi = fault_injection
+    os.environ["RTPU_ELASTIC_GROW_COOLDOWN_S"] = "0.4"
+    config.reload()
+    try:
+        ray_tpu.init(num_workers=6, object_store_memory=128 << 20)
+        steps = 80
+        loop_cfg = {"steps": steps, "global_batch": 16, "lr": 0.05}
+
+        base = _fit_elastic(loop_cfg, ScalingConfig(num_workers=4),
+                            str(tmp_path / "base"))
+        assert base.error is None, base.error
+        base_loss = {m["step"]: m["loss"] for m in base.metrics_history}
+        assert len(base_loss) == steps
+
+        # abrupt preemption (SIGKILL) after batch 3; scheduled
+        # preemption (SIGTERM, checkpoint grace) after batch 45
+        fi.inject("gang_resize", "kill", target="3")
+        fi.inject("gang_resize", "sigterm", target="45")
+        el = _fit_elastic(loop_cfg,
+                          ScalingConfig(num_workers=4, min_workers=2),
+                          str(tmp_path / "elastic"))
+        assert el.error is None, el.error
+
+        # deterministic resume: replayed steps overwrite their first
+        # attempt (last occurrence wins), and every step's loss matches
+        # the uninterrupted run
+        el_loss, pids0 = {}, set()
+        for m in el.metrics_history:
+            el_loss[m["step"]] = m["loss"]
+            pids0.add(m["pid"])
+        assert set(el_loss) == set(base_loss)
+        for s in sorted(base_loss):
+            assert np.isclose(el_loss[s], base_loss[s],
+                              rtol=1e-8, atol=1e-12), \
+                f"step {s}: {el_loss[s]} != {base_loss[s]}"
+
+        # the gang really shrank, and grew back when capacity returned
+        worlds = [m["world"] for m in el.metrics_history]
+        assert min(worlds) < 4, "the gang never shrank"
+        shrinks = [e for e in el.elastic_stats if e["event"] == "shrink"]
+        grows = [e for e in el.elastic_stats if e["event"] == "grow"]
+        assert len(shrinks) >= 2, el.elastic_stats  # kill + sigterm
+        assert len(grows) >= 1, el.elastic_stats
+        assert all(e["resume_s"] > 0 for e in el.elastic_stats)
+        assert {e["cause"] for e in shrinks} >= {"ActorDiedError",
+                                                 "PreemptedError"}
+
+        # warm resume: rank 0's process was never replaced
+        assert len(pids0) == 1, f"rank-0 worker was replaced: {pids0}"
+    finally:
+        os.environ.pop("RTPU_ELASTIC_GROW_COOLDOWN_S", None)
+        config.reload()
+
+
+def test_elastic_below_min_workers_cold_restarts(local_ray, fault_injection,
+                                                 tmp_path):
+    """Shrinking below min_workers must NOT limp along at a world size
+    the user forbade: the resize path raises TrainingWorkerError and
+    recovery goes through the classic cold gang restart (consuming the
+    failure budget), resuming from the last consistent checkpoint."""
+    from ray_tpu.train import ScalingConfig
+
+    fi = fault_injection
+    ray_tpu.init(num_workers=4, object_store_memory=64 << 20)
+    fi.inject("gang_resize", "kill", target="1")
+    res = _fit_elastic({"steps": 6, "global_batch": 8, "lr": 0.05},
+                       ScalingConfig(num_workers=2, min_workers=2),
+                       str(tmp_path / "floor"), max_failures=1)
+    assert res.error is None, res.error
+    assert not res.elastic_stats, res.elastic_stats  # no in-place resize
+    step_seq = [m["step"] for m in res.metrics_history]
+    assert set(step_seq) == set(range(6))
+    # the restart resumed from the batch-1 checkpoint, not from scratch
+    assert step_seq.count(0) == 1, step_seq
+
+
+def test_session_interrupt_drains_to_done_sentinel():
+    """The resize drain protocol, in-process: an interrupt that lands
+    while the loop is blocked in lockstep (result queued, waiting for
+    the driver) must deliver BOTH the overtaken result and the done
+    sentinel — and a hostile ``except Exception`` in user code must not
+    swallow the interrupt (it is a BaseException)."""
+    from ray_tpu.train.session import (
+        SessionInterruptedError,
+        TrainContext,
+        _TrainSession,
+    )
+
+    box = {}
+
+    def loop():
+        i = 0
+        while True:
+            try:
+                box["s"].report({"i": i})
+            except Exception:
+                pass  # hostile user code: must not eat the interrupt
+            i += 1
+
+    s = _TrainSession(loop, {}, TrainContext())
+    box["s"] = s
+    s.start()
+    assert s.next_result(timeout=10).metrics == {"i": 0}
+    # wait until the loop queued i=1 and blocked in lockstep
+    deadline = time.monotonic() + 10
+    while s._result_q.qsize() == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    s.interrupt("gang resize test")
+    r1 = s.next_result(timeout=10)
+    assert r1.metrics == {"i": 1} and not r1.done
+    r2 = s.next_result(timeout=10)
+    assert r2.done
+    assert isinstance(r2.error, SessionInterruptedError)
+    assert "gang resize test" in str(r2.error)
+    s._thread.join(timeout=10)
+    assert not s._thread.is_alive(), "train loop thread leaked"
+
+
+def test_collective_abort_unblocks_member_fast(local_ray, tmp_path):
+    """A member blocked in an in-flight collective fails over to
+    CollectiveAbortedError (naming the reason — here, the dead rank)
+    within ~a poll interval of the abort, not the 120 s op timeout."""
+    from ray_tpu.parallel import collective
+
+    ray_tpu.init(num_workers=3, object_store_memory=64 << 20)
+    ready = str(tmp_path / "member_ready")
+
+    @ray_tpu.remote
+    class Member:
+        def run(self, world, rank, ready_path):
+            import time as _time
+
+            import numpy as np
+
+            from ray_tpu.parallel import collective as coll
+
+            g = coll.init_collective_group(world, rank, group_name="abrt")
+            open(ready_path, "w").close()
+            t0 = _time.monotonic()
+            try:
+                g.allreduce(np.ones(3))
+            except coll.CollectiveAbortedError as e:
+                return _time.monotonic() - t0, str(e)
+            return None, "allreduce completed?!"
+
+    m = Member.remote()
+    ref = m.run.remote(2, 0, ready)  # rank 1 never joins: the op blocks
+    deadline = time.monotonic() + 30
+    while not os.path.exists(ready) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert os.path.exists(ready), "member never started"
+    time.sleep(0.5)  # member is now blocked polling the coordinator
+    t0 = time.monotonic()
+    assert collective.abort_group(
+        "abrt", reason="gang resize: lost rank(s) [1] (ActorDiedError)")
+    blocked_s, msg = ray_tpu.get(ref, timeout=30)
+    unblock_s = time.monotonic() - t0
+    assert unblock_s < 2.0, f"abort took {unblock_s:.2f}s to propagate"
+    assert blocked_s >= 0.4, "member was not actually blocked"
+    assert "lost rank(s) [1]" in msg and "'abrt'" in msg
+    # a second abort on the same group is idempotent; a missing group
+    # reports False instead of raising
+    assert collective.abort_group("abrt", reason="again")
+    assert not collective.abort_group("no_such_group")
+
+
+def test_worker_group_resize_bookkeeping(local_ray):
+    """Shrink/grow bookkeeping: removed positions free their placement
+    bundles, a grow re-creates a worker INTO the freed bundle, and
+    reassign_ranks compacts ranks to 0..n-1 in survivor order."""
+    from ray_tpu.train import ScalingConfig
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    ray_tpu.init(num_workers=5, object_store_memory=64 << 20)
+    wg = WorkerGroup(ScalingConfig(num_workers=3, min_workers=1))
+    wg.start()
+    try:
+        assert wg.bundle_indices == [0, 1, 2]
+        assert len(wg) == 3
+        wg.remove_positions({1})
+        assert wg.bundle_indices == [0, 2]
+        wg.generation += 1
+        wg.reassign_ranks()
+        infos = ray_tpu.get([w.node_info.remote() for w in wg.workers])
+        assert [i["rank"] for i in infos] == [0, 1]
+        pos = wg.try_add_worker(probe_timeout_s=30.0)
+        assert pos == 2, "grow did not land"
+        assert wg.bundle_indices == [0, 2, 1]  # reused the freed bundle
+        wg.reassign_ranks()
+        infos = ray_tpu.get([w.node_info.remote() for w in wg.workers])
+        assert [i["rank"] for i in infos] == [0, 1, 2]
+    finally:
+        wg.shutdown()
+    assert wg.workers == [] and wg.bundle_indices == []
+
+
+def test_checkpoint_persist_atomic_manifest(tmp_path):
+    """Crash-safe persistence: the committed dir carries a manifest
+    listing every file and size, no stage (.tmp-*) dirs survive the
+    commit, and re-persisting the same index (deterministic elastic
+    replay over an orphan) replaces the dir atomically."""
+    import json as _json
+
+    from ray_tpu.train.storage import (
+        MANIFEST_NAME,
+        StorageContext,
+        validate_checkpoint_dir,
+    )
+
+    storage = StorageContext(str(tmp_path / "results"), "exp", "trial")
+    storage.ensure_trial_dir()
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "state.json").write_text('{"step": 0}')
+    (src / "shards").mkdir()
+    (src / "shards" / "part-0.bin").write_bytes(b"x" * 1024)
+    ckpt = storage.persist_checkpoint_dir(str(src), 0)
+
+    man = _json.load(open(os.path.join(ckpt.path, MANIFEST_NAME)))
+    assert man["index"] == 0
+    assert man["files"] == {
+        "state.json": len('{"step": 0}'),
+        os.path.join("shards", "part-0.bin"): 1024,
+    }
+    parent = os.path.dirname(ckpt.path)
+    assert not [p for p in os.listdir(parent) if p.startswith(".tmp-")]
+    assert validate_checkpoint_dir(ckpt.path)
+
+    # deterministic replay: overwriting the same index wins atomically
+    (src / "state.json").write_text('{"step": 0, "replayed": true}')
+    ckpt2 = storage.persist_checkpoint_dir(str(src), 0)
+    assert ckpt2.path == ckpt.path
+    assert validate_checkpoint_dir(ckpt.path)
+    assert "replayed" in open(os.path.join(ckpt.path, "state.json")).read()
+
+
+def test_torn_checkpoint_falls_back_to_previous(tmp_path):
+    """Resume skips torn checkpoint dirs: a size-mismatched file and a
+    missing file both fail manifest validation, and latest_consistent()
+    walks back to the newest intact checkpoint instead of crashing."""
+    from ray_tpu.train.checkpoint_manager import CheckpointManager
+    from ray_tpu.train.config import CheckpointConfig
+    from ray_tpu.train.storage import StorageContext, validate_checkpoint_dir
+
+    storage = StorageContext(str(tmp_path / "results"), "exp", "trial")
+    storage.ensure_trial_dir()
+    mgr = CheckpointManager(storage, CheckpointConfig())
+    for i in range(3):
+        src = tmp_path / f"src{i}"
+        src.mkdir()
+        (src / "state.json").write_text('{"step": %d}' % i)
+        ckpt = storage.persist_checkpoint_dir(str(src), i)
+        mgr.register_persisted(ckpt.path, {"step": i})
+
+    p2 = storage.checkpoint_path(2)
+    open(os.path.join(p2, "state.json"), "w").close()  # torn: size mismatch
+    assert not validate_checkpoint_dir(p2)
+    p1 = storage.checkpoint_path(1)
+    os.remove(os.path.join(p1, "state.json"))  # torn: file missing
+    assert not validate_checkpoint_dir(p1)
+
+    best = mgr.latest_consistent()
+    assert best is not None
+    assert best.path == storage.checkpoint_path(0)
+    assert len(mgr.checkpoints) == 1  # torn entries dropped from tracking
+    # a manifest-less (legacy) dir is trusted as-is
+    legacy = tmp_path / "legacy_ckpt"
+    legacy.mkdir()
+    (legacy / "state.json").write_text("{}")
+    assert validate_checkpoint_dir(str(legacy))
+
+
+def test_train_pg_ready_timeout_flag_names_bundle(local_ray):
+    """WorkerGroup.start honours train_pg_ready_timeout_s (replacing the
+    old hardcoded 60 s wait) and the error names the bundle the cluster
+    cannot satisfy."""
+    from ray_tpu.core.config import config
+    from ray_tpu.exceptions import PlacementGroupError
+    from ray_tpu.train import ScalingConfig
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    os.environ["RTPU_TRAIN_PG_READY_TIMEOUT_S"] = "1.5"
+    config.reload()
+    try:
+        # 2-CPU cluster, 3 one-CPU bundles: each bundle fits, the gang
+        # never will — the PG stays pending until the configured timeout
+        ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+        wg = WorkerGroup(ScalingConfig(num_workers=3))
+        t0 = time.monotonic()
+        with pytest.raises(PlacementGroupError) as ei:
+            wg.start()
+        assert time.monotonic() - t0 < 30.0  # the hardcoded 60 s is gone
+        msg = str(ei.value)
+        assert "train_pg_ready_timeout_s" in msg
+        assert "1.5" in msg
+        assert "CPU" in msg, msg  # names the bundle it cannot place
+    finally:
+        os.environ.pop("RTPU_TRAIN_PG_READY_TIMEOUT_S", None)
+        config.reload()
