@@ -35,6 +35,7 @@ from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.placement_group import PlacementGroup
+from ray_tpu.util.debug_lock import make_lock
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
                                 GetTimeoutError, ObjectLostError,
                                 ObjectTimeoutError, PlacementGroupError)
@@ -73,7 +74,7 @@ class ClusterCore:
         self.node_id = NodeID.from_random()     # driver pseudo-node id
         self.worker_id = WorkerID.from_random()
 
-        self._lock = threading.Lock()
+        self._lock = make_lock("ClusterCore._lock")
         self._functions: Dict[bytes, bytes] = {}
         self._fn_cache: Dict[int, Tuple[bytes, Any]] = {}
         self._shipped: Dict[Tuple[str, int], set] = {}

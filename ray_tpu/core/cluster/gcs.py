@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core import fault_injection
 from ray_tpu.core.cluster.rpc import RpcServer, cluster_authkey
 from ray_tpu.core.config import config
+from ray_tpu.util.debug_lock import make_lock
 
 # ops whose effects must survive a GCS restart (heartbeats and reads are
 # deliberately not logged: transient / no effect). kv is logged only for
@@ -98,7 +99,7 @@ class GcsServer:
         # restartable/detached actor specs: the GCS owns the restart FSM
         # (reference: gcs_actor_manager.h:278) so actors outlive drivers
         self._actor_specs: Dict[bytes, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("GcsServer._lock")
         self._cond = threading.Condition(self._lock)
         self._nodes: Dict[bytes, _NodeInfo] = {}
         self._kv: Dict[str, Any] = {}
@@ -146,7 +147,7 @@ class GcsServer:
         # exists. Code holding self._lock must never take _wal_lock
         # (deaths buffer into _wal_pending instead).
         self._wal = None
-        self._wal_lock = threading.Lock()
+        self._wal_lock = make_lock("GcsServer._wal_lock")
         self._wal_pending: List[tuple] = []  # guarded by self._lock
         self._wal_count = 0
         self._replaying = False

@@ -43,6 +43,7 @@ from typing import Any, Callable, Optional, Tuple
 from ray_tpu.core.cluster.rpc import RpcClient, RpcError
 from ray_tpu.core.config import config
 from ray_tpu.exceptions import GcsUnavailableError
+from ray_tpu.util.debug_lock import check_fire_outside, make_lock
 
 # Per-attempt connect budget inside the ride-through loop: short, so the
 # loop (not the transport) owns pacing against gcs_reconnect_timeout_s.
@@ -68,7 +69,7 @@ class HaGcsClient:
                               connect_timeout=_ATTEMPT_TIMEOUT_S,
                               unavailable_exc=GcsUnavailableError)
         self._on_reconnect = on_reconnect
-        self._lock = threading.Lock()
+        self._lock = make_lock("HaGcsClient._lock")
         self._buffered = 0          # calls currently parked in ride-through
         self._epoch: Optional[str] = None   # last GCS incarnation seen
         self._saw_outage = False    # a call failed since the last epoch check
@@ -175,6 +176,9 @@ class HaGcsClient:
             self._saw_outage = False
         if prev is not None and prev != info["epoch"] \
                 and self._on_reconnect is not None:
+            # resync code re-enters the GCS client; firing it under
+            # _lock would deadlock the ride-through bookkeeping
+            check_fire_outside("HaGcsClient._check_epoch.on_reconnect")
             try:
                 self._on_reconnect(info)
             # rtpu-lint: disable=L4 — the reconnect hook is arbitrary

@@ -36,6 +36,7 @@ from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID, make_task_id
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.runtime import Runtime, _TaskSpec
+from ray_tpu.util.debug_lock import make_condition, make_lock
 from ray_tpu.exceptions import (ActorDiedError, ActorError, ObjectLostError,
                                 ObjectStoreFullError, ObjectTimeoutError)
 
@@ -359,7 +360,7 @@ class NodeServer:
         # re-runs resync_node until it succeeds. _resync_lock serializes
         # concurrent triggers (heartbeat loop + reconnect hook).
         self._synced_epoch: Optional[str] = None
-        self._resync_lock = threading.Lock()
+        self._resync_lock = make_lock("NodeServer._resync_lock")
         # True when this server IS the process (python -m ...node_server):
         # a shutdown_node drain then exits the process so the
         # autoscaler's cloud view sees the node release promptly
@@ -384,14 +385,14 @@ class NodeServer:
         # sender-side transfer flow control (reference: push_manager.h —
         # cap outbound chunk bytes in flight; requesters queue FIFO-ish
         # on the condition instead of over-committing sender memory)
-        self._push_cv = threading.Condition()
+        self._push_cv = make_condition("NodeServer._push_cv")
         self._push_inflight = 0
         self._push_waits = 0  # observability: times a chunk had to queue
 
         # object-location publication (batched); entries are
         # (oid_bytes, nbytes_or_None) — sizes ride along so the GCS
         # directory can feed the driver's locality scorer
-        self._loc_lock = threading.Lock()
+        self._loc_lock = make_lock("NodeServer._loc_lock")
         self._loc_pending: List[Tuple[bytes, Optional[int]]] = []
         self._loc_thread = threading.Thread(
             target=self._loc_flush_loop, daemon=True, name="node-locs")
@@ -411,7 +412,7 @@ class NodeServer:
         # (reference: task-id dedup in
         # src/ray/core_worker/transport/direct_actor_transport.cc)
         self._applied: "OrderedDict[bytes, tuple]" = OrderedDict()
-        self._applied_lock = threading.Lock()
+        self._applied_lock = make_lock("NodeServer._applied_lock")
 
         # ownership: driver-submitted work tags its return objects (and
         # actors) with the owner driver id; when the GCS declares that
@@ -424,7 +425,7 @@ class NodeServer:
         # object merely falls back to normal LRU/spill lifecycle).
         self._owner_of: "OrderedDict[bytes, bytes]" = OrderedDict()
         self._actor_owner: Dict[bytes, bytes] = {}
-        self._owner_lock = threading.Lock()
+        self._owner_lock = make_lock("NodeServer._owner_lock")
         self._driver_death_seq = 0
 
         # in-flight fetch/proxy threads, keyed by oid bytes; _fetch_prio
@@ -432,9 +433,9 @@ class NodeServer:
         # pull is queued for admission)
         self._fetching: set = set()
         self._fetch_prio: Dict[bytes, list] = {}
-        self._fetch_lock = threading.Lock()
+        self._fetch_lock = make_lock("NodeServer._fetch_lock")
         # cross-node pull throughput (cumulative; surfaced via ("state",))
-        self._fetch_stats_lock = threading.Lock()
+        self._fetch_stats_lock = make_lock("NodeServer._fetch_stats_lock")
         self._fetch_bytes = 0
         self._fetch_seconds = 0.0
         self._fetch_count = 0
@@ -678,7 +679,7 @@ class NodeServer:
         buf = None if dst is not None else bytearray(size)
         out = dst if dst is not None else memoryview(buf)
         failed: List[str] = []
-        idx_lock = threading.Lock()
+        idx_lock = make_lock("NodeServer._fetch_ranged.<idx>")
         next_idx = [0]
 
         client = self._peers.get(addr)  # pooled: N concurrent calls use

@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Optional
 
+from ray_tpu.util.debug_lock import make_lock
+
 PRIO_TASK_ARGS = 0
 PRIO_GET = 1
 PRIO_WAIT = 2
@@ -39,7 +41,7 @@ class PullManager:
         self._seq = 0
         self._waiting = []  # heap of (priority, seq); head = next admitted
         self._granted = set()
-        self._cv = threading.Condition(threading.Lock())
+        self._cv = threading.Condition(make_lock("PullManager._cv"))
 
     def acquire(self, nbytes: int, priority=PRIO_GET,
                 timeout: Optional[float] = None) -> bool:

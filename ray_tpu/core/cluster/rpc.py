@@ -22,6 +22,8 @@ from multiprocessing.connection import Connection as _MpConnection
 from multiprocessing.connection import answer_challenge, deliver_challenge
 from typing import Any, Callable, List, Optional, Tuple
 
+from ray_tpu.util.debug_lock import make_lock
+
 
 class RpcError(Exception):
     """Transport-level RPC failure (peer died, connection refused).
@@ -151,7 +153,7 @@ class RpcServer:
         # threads are parked in recv() and would otherwise keep serving
         # a "closed" server until the process exits
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("RpcServer._conns_lock")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="rpc-accept")
         self._accept_thread.start()
@@ -314,7 +316,7 @@ class RpcClient:
         # GcsUnavailableError while plain node clients keep RpcError.
         self._unavailable_exc = unavailable_exc or RpcError
         self._pool: List[Any] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("RpcClient._lock")
         self._closed = False
         # bumped whenever an established connection failed and we dialed
         # again: lets wrappers (HaGcsClient) notice a server restart that
@@ -448,7 +450,7 @@ class ClientCache:
     def __init__(self, authkey: bytes):
         self._authkey = authkey
         self._clients = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("ClientCache._lock")
 
     def get(self, address: Tuple[str, int]) -> RpcClient:
         address = tuple(address)

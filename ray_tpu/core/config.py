@@ -303,6 +303,10 @@ WIRING_ENV_VARS: Dict[str, str] = {
     "RTPU_NODE_ID": "id of the node a spawned worker belongs to",
     "RTPU_PKG_DIR": "working-dir package root a worker unpacked its "
                     "runtime env into (set by runtime_env activation)",
+    "RTPU_SANITIZE": "arm the lock-order sanitizer: util/debug_lock.py "
+                     "wraps core locks, raises on acquisition-order "
+                     "inversions and callbacks fired under a tracked "
+                     "lock (read at import, inherited by workers)",
     "RTPU_STORE": "object-store shm segment name handed to workers",
     "RTPU_WORKER_ID": "id the spawner assigned this worker process",
     "RTPU_WORKER_PIP_KEY": "cache key of the pip runtime env a worker "

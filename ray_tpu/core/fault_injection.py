@@ -82,10 +82,12 @@ import signal
 import threading
 from typing import Dict, List, Optional
 
+from ray_tpu.util.debug_lock import make_lock
+
 SITES = ("get", "spill", "dispatch", "task", "actor_call",
          "actor_worker_kill", "gcs_kill", "gang_resize")
 
-_lock = threading.Lock()
+_lock = make_lock("fault_injection._lock")
 _specs: Dict[str, List[dict]] = {}
 _armed = False
 

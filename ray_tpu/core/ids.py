@@ -11,6 +11,8 @@ from __future__ import annotations
 import os
 import threading
 
+from ray_tpu.util.debug_lock import make_lock
+
 _ID_LENGTH = 16  # bytes; reference uses 28 for ObjectID, 16 is plenty single-cluster.
 
 
@@ -100,7 +102,7 @@ class _Counter:
 
     def __init__(self):
         self._value = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("_IdGen._lock")
 
     def next(self) -> int:
         with self._lock:

@@ -12,10 +12,12 @@ import os
 import subprocess
 import threading
 
+from ray_tpu.util.debug_lock import make_lock
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "_shm_store.cc")
 _LIB = os.path.join(_DIR, "_shm_store.so")
-_lock = threading.Lock()
+_lock = make_lock("object_store.build._lock")
 
 _SAN_FLAGS = {
     "thread": ["-fsanitize=thread", "-O1", "-g"],

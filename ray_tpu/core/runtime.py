@@ -45,6 +45,8 @@ from ray_tpu.core.protocol import _TopLevelDep
 from ray_tpu.core.resources import (
     ResourceSet, TpuSliceTopology, node_resources,
 )
+from ray_tpu.util.debug_lock import check_fire_outside, make_condition, \
+    make_lock
 from ray_tpu.exceptions import (
     ActorDiedError, ActorUnavailableError, GetTimeoutError, ObjectLostError,
     PlacementGroupError, TaskCancelledError, TaskError, WorkerCrashedError,
@@ -206,7 +208,7 @@ class _StreamState:
         self.consumed = 0
         self.end_index: Optional[int] = None
         self.failed = False
-        self.cond = threading.Condition()
+        self.cond = make_condition("_StreamState.cond")
 
 
 def _fd_readable(fd, timeout) -> bool:
@@ -308,7 +310,7 @@ class _Worker:
         self.data_thread: Optional[threading.Thread] = None
         # Connection.send is not thread-safe; every task_conn.send goes
         # through this lock (reader thread, dispatchers, shutdown).
-        self.send_lock = threading.Lock()
+        self.send_lock = make_lock("_Worker.send_lock")
         # True while the worker is blocked in a driver-side get/wait; used
         # by the scheduler to oversubscribe the pool instead of deadlocking.
         self.blocked = False
@@ -425,13 +427,13 @@ class Runtime:
         self._spill_dir = external_storage.spill_dir_for(
             config.spill_dir, self._session)
 
-        self._lock = threading.Lock()
+        self._lock = make_lock("Runtime._lock")
         self._objects: Dict[ObjectID, _ObjectEntry] = {}
         # Memory management: the runtime pins every tracked shm container so
         # the LRU can never evict a live object out from under a ref; under
         # pressure, cold pinned containers are spilled to disk instead
         # (reference: local_object_manager.h spilling + pinning).
-        self._spill_lock = threading.Lock()
+        self._spill_lock = make_lock("Runtime._spill_lock")
         self._pinned: Dict[bytes, int] = {}       # container oid -> access seq
         self._pin_seq = 0
         self._args_pins: Dict[bytes, int] = {}    # in-flight args refcounts
@@ -460,7 +462,7 @@ class Runtime:
         # event logs with contiguous seqs; see gcs.py _op_publish/_op_poll)
         self._channels: Dict[str, list] = {}
         self._channel_seq: Dict[str, int] = {}
-        self._pubsub_cond = threading.Condition()
+        self._pubsub_cond = make_condition("Runtime._pubsub_cond")
         self._packages: Dict[str, bytes] = {}  # runtime_env package store
         # eagerly-freed object ids: insertion-ordered so the tombstone cap
         # evicts oldest-first (dict preserves insertion order)
@@ -527,7 +529,7 @@ class Runtime:
         # zygote: pre-warmed fork template for ~10ms worker launch
         # (reference: prestarted workers, raylet/worker_pool.h:344)
         self._zygote: Optional[subprocess.Popen] = None
-        self._zygote_lock = threading.Lock()
+        self._zygote_lock = make_lock("Runtime._zygote_lock")
         if config.worker_zygote:
             try:
                 self._start_zygote_locked()
@@ -1048,6 +1050,11 @@ class Runtime:
         # are spill candidates; that is every put/task-return container.
         if payload[0] == "shm" and payload[1] == oid.binary():
             self._pin_container(payload[1])
+        # Foreign callables (dep-ready continuations, as_future
+        # resolvers): must dispatch with no runtime lock held — a
+        # callback that re-enters the runtime deadlocks the holder
+        # (the PR 5 _enqueue bug). Sanitizer-enforced when armed.
+        check_fire_outside("Runtime._store_payload")
         for cb in callbacks:
             cb()
 
@@ -1660,7 +1667,7 @@ class Runtime:
                 unresolved.append(e)
         spec.pending_deps = len(unresolved)
         if unresolved:
-            lock = threading.Lock()
+            lock = make_lock("Runtime._enqueue.<deps>")
 
             def on_ready():
                 with lock:
@@ -1682,6 +1689,7 @@ class Runtime:
                     else:
                         e.callbacks.append(on_ready)
                 if fire:
+                    check_fire_outside("Runtime._enqueue.on_ready")
                     on_ready()
         else:
             self._queue_ready(spec)
@@ -2445,7 +2453,7 @@ class Runtime:
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = {r.id: r for r in refs}
         ready: List[ObjectRef] = []
-        cond = threading.Condition()
+        cond = make_condition("Runtime.wait.<cond>")
 
         def notify():
             with cond:

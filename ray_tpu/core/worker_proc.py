@@ -30,6 +30,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core import runtime_context
 from ray_tpu.core.object_store.store import ShmObjectStore
 from ray_tpu.exceptions import ObjectStoreFullError, TaskError
+from ray_tpu.util.debug_lock import make_lock
 
 
 class WorkerCore:
@@ -53,8 +54,8 @@ class WorkerCore:
         # set by the SIGTERM handler of actors created with trap_sigterm
         # (train workers); read by train.preempted()
         self.preempted = threading.Event()
-        self._data_lock = threading.Lock()
-        self._send_lock = threading.Lock()
+        self._data_lock = make_lock("WorkerCore._data_lock")
+        self._send_lock = make_lock("WorkerCore._send_lock")
         self._async_dirty = False  # async sends since last barrier
         self._functions: Dict[bytes, Any] = {}
         self._driver_known_fns: set = set()
@@ -734,6 +735,10 @@ class WorkerCore:
                 # kills escalate to SIGKILL.
                 import signal as _signal
 
+                # rtpu-lint: disable=L6 — _create_actor runs on the
+                # recv loop, which IS this worker process's main
+                # thread (main() dispatches to it directly); pool
+                # threads only ever run method bodies, never creation
                 _signal.signal(
                     _signal.SIGTERM,
                     lambda signum, frame: self.preempted.set())

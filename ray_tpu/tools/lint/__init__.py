@@ -18,12 +18,25 @@ L3    config/env hygiene — ``config.<attr>`` reads resolve to
 L4    exception discipline — no bare ``except:`` or do-nothing
       ``except Exception:`` in ``core/``, and no handler drops an
       ``ObjectLostError`` without re-raising/converting/reconstructing
+L5    lock order — whole-program acquisition-order graph has no ABBA
+      cycles, no function chain re-acquires a non-reentrant lock the
+      caller holds (the PR 5 ``_enqueue`` deadlock shape), and no
+      foreign callable (stored callback, callable argument, resolver)
+      is invoked while any lock is held
+L6    thread context — ``signal.signal``/``setitimer`` only from
+      main-thread-guaranteed contexts (the PR 7 actor-pool bug), no
+      ``os.fork``/subprocess spawn under a held lock, no blocking
+      sync calls inside ``async def`` bodies
 ====  ==============================================================
+
+L3 additionally checks fault-site coverage: every site in
+``fault_injection.SITES`` must be armed by at least one test.
 
 Run it::
 
     python -m ray_tpu.tools.lint              # human-readable, exit 1 on findings
-    python -m ray_tpu.tools.lint --json       # machine-readable
+    python -m ray_tpu.tools.lint --json       # machine-readable (+ per-rule wall time)
+    python -m ray_tpu.tools.lint --jobs 4     # rules in parallel
     python -m ray_tpu.tools.lint --baseline lint_baseline.json
     python -m ray_tpu.tools.lint --write-baseline lint_baseline.json
 
@@ -38,7 +51,9 @@ new violation fails CI unless fixed or explicitly waived.
 
 from ray_tpu.tools.lint.base import Finding, RULES, SourceFile
 from ray_tpu.tools.lint.runner import (apply_baseline, collect_findings,
+                                       collect_findings_timed,
                                        load_baseline, write_baseline)
 
 __all__ = ["Finding", "RULES", "SourceFile", "collect_findings",
-           "apply_baseline", "load_baseline", "write_baseline"]
+           "collect_findings_timed", "apply_baseline", "load_baseline",
+           "write_baseline"]

@@ -24,7 +24,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="comma-separated rule ids to run "
                              "(default: all)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="emit findings as a JSON array")
+                        help="emit a JSON object: {'findings': [...], "
+                             "'rule_wall_ms': {rule: ms}}")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="run rules in parallel on N threads "
+                             "(default: 1, serial)")
     parser.add_argument("--baseline", default=None, metavar="FILE",
                         help="only fail on findings NOT in this baseline "
                              "file (grandfather existing ones)")
@@ -33,8 +37,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     rules = [r for r in (args.rules or "").split(",") if r] or None
+    if args.jobs < 1:
+        print("rtpu-lint: --jobs must be >= 1", file=sys.stderr)
+        return 2
     try:
-        findings = runner.collect_findings(root=args.root, rules=rules)
+        findings, wall_ms = runner.collect_findings_timed(
+            root=args.root, rules=rules, jobs=args.jobs)
     except Exception as e:  # noqa: BLE001 — CLI boundary: fold any
         # analyzer crash into the documented exit-2 contract
         print(f"rtpu-lint: internal error: {e!r}", file=sys.stderr)
@@ -56,7 +64,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings = runner.apply_baseline(findings, baseline)
 
     if args.as_json:
-        print(json.dumps([f.to_dict() for f in findings], indent=1))
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "rule_wall_ms": wall_ms}, indent=1))
     else:
         for f in findings:
             print(f.render())

@@ -33,6 +33,12 @@ RULES: Dict[str, str] = {
           "no dead flags, RTPU_* env reads are registered",
     "L4": "exception discipline: no bare/swallowing handlers, "
           "ObjectLostError never silently dropped",
+    "L5": "lock order: no ABBA cycles in the global acquisition-order "
+          "graph, no interprocedural re-acquire of a held non-reentrant "
+          "lock, no foreign callables invoked under a lock",
+    "L6": "thread context: signal handlers only from main-thread "
+          "contexts, no fork/spawn under a held lock, no blocking sync "
+          "calls in async bodies",
 }
 
 

@@ -83,6 +83,48 @@ def parse_fault_sites(fault_sf: Optional[SourceFile]) -> Set[str]:
     return sites
 
 
+def fault_site_coverage(fault_sf: Optional[SourceFile],
+                        test_files: List[SourceFile]) -> List[Finding]:
+    """Every site in ``fault_injection.SITES`` must be exercised by at
+    least one test: an armed site nothing fires is dead chaos
+    instrumentation — the product hook can rot (or be deleted) without
+    any signal. A test exercises a site by arming it through any of the
+    three mechanisms: in-process ``inject("<site>", ...)``, the
+    ``RTPU_FAULT_<SITE>`` env var, or a ``fault_injection`` config-flag
+    spec containing ``<site>=``. Findings anchor at the ``SITES`` row so
+    they survive unrelated edits."""
+    if fault_sf is None:
+        return []
+    sites: Dict[str, int] = {}
+    for node in ast.walk(fault_sf.tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "SITES"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    sites[e.value] = node.lineno
+    corpus = "\n".join(sf.text for sf in test_files)
+    findings: List[Finding] = []
+    for site, lineno in sorted(sites.items()):
+        # the flag-spec pattern is quote-anchored ("task=exit:1") so
+        # e.g. site "get" does not match every "target=..." kwarg
+        patterns = (f'inject("{site}"', f"inject('{site}'",
+                    f"RTPU_FAULT_{site.upper()}", f'"{site}=',
+                    f"'{site}=")
+        if any(p in corpus for p in patterns):
+            continue
+        if fault_sf.suppressed(lineno, "L3"):
+            continue
+        findings.append(Finding(
+            "L3", fault_sf.relpath, lineno,
+            f"fault site {site!r} is declared in SITES but no test "
+            f"under tests/ arms it (inject(\"{site}\", ...), "
+            f"RTPU_FAULT_{site.upper()}, or a fault_injection flag "
+            f"spec); an unexercised site is dead chaos instrumentation"))
+    return findings
+
+
 def _config_aliases(tree: ast.AST) -> Set[str]:
     """Names the config singleton is bound to in this module."""
     aliases: Set[str] = set()

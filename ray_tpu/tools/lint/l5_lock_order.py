@@ -1,0 +1,584 @@
+"""L5 — whole-program lock order and callback-under-lock discipline.
+
+L2 is lexical: it sees a blocking call *textually* inside a ``with
+<lock>:`` block and nothing else. The two concurrency bug classes this
+repo has already shipped hand-fixes for are invisible to it:
+
+- PR 5: ``_enqueue`` fired a dep-ready callback while holding the
+  non-reentrant runtime lock; the callback re-entered ``_queue_ready``
+  which re-acquired the same lock — a guaranteed self-deadlock, two
+  calls deep, in another function.
+- ABBA inversions: thread 1 takes A then B, thread 2 takes B then A.
+  Each site is locally innocent; only the *global* acquisition-order
+  graph shows the cycle.
+
+This analyzer builds a per-module call graph over the runtime's
+concurrency surface and propagates held-lock sets interprocedurally
+(bounded depth ``DEPTH``), recognizing both ``with <lock>:`` blocks and
+paired ``.acquire()``/``.release()`` statements. Three finding shapes:
+
+``reacquire``
+    A function (or a callee up to ``DEPTH`` calls away) acquires a
+    non-reentrant lock the caller already holds — self-deadlock. The
+    message names the call chain.
+``lock-order``
+    An acquisition edge A -> B whose reverse order B -> ... -> A also
+    exists in the global graph (merged across every module in scope) —
+    two threads interleaving the paths deadlock.
+``callback-under-lock``
+    A *foreign callable* — a stored callback attribute, a callable
+    argument, a name iterated from a callbacks/hooks/waiters
+    collection, or a resolver — invoked while any lock is held. The
+    analyzer cannot see inside a foreign callable, and the PR 5
+    deadlock was exactly a callback that turned out to need the held
+    lock: swap out under the lock, fire after release, or waive with
+    justification.
+
+Approximations (deliberate, documented in the README):
+
+- Lock identity is the qualified attribute: ``self.X`` in class ``C``
+  of module ``m`` is ``m.C.X``; module globals ``m.X``; function
+  locals share a token per outermost function (so closures that
+  capture an outer lock match); attributes of non-self receivers
+  collapse to ``m.*.X`` — wildcard tokens never produce reacquire
+  findings (two distinct instances may legitimately nest), only order
+  edges.
+- Calls resolve by name within the module only: ``self.m()`` to a
+  method of the enclosing class, bare names to nested defs then module
+  functions. Cross-module calls are not followed; the order graph is
+  still merged globally so cross-module ABBA cycles surface.
+- ``threading.Condition(self._lock)`` aliases the condition attribute
+  to the underlying lock token; ``RLock``/``make_rlock``/
+  ``make_condition``/bare ``Condition()`` construction marks a token
+  reentrant.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.base import Finding, SourceFile
+
+#: interprocedural propagation depth (caller + DEPTH transitive callees)
+DEPTH = 4
+
+LOCK_RE = re.compile(r"lock|cond|cv|mutex", re.IGNORECASE)
+
+#: attribute / variable names that denote stored callables
+CB_RE = re.compile(
+    r"(^|_)(cb|cbs|callback|callbacks|hook|hooks|resolver|resolvers|"
+    r"waiter|waiters|listener|listeners|on_[a-z0-9_]+)$")
+
+#: method names that are never foreign callables, even under a lock
+_SAFE_CALLS = {
+    "append", "pop", "popleft", "appendleft", "add", "discard",
+    "remove", "clear", "get", "items", "keys", "values", "update",
+    "setdefault", "extend", "copy", "insert", "index", "count",
+    "split", "rsplit", "join", "strip", "encode", "decode", "format",
+    "startswith", "endswith", "hex", "binary", "is_set", "set",
+    "wait", "wait_for", "notify", "notify_all", "acquire", "release",
+    "locked",
+}
+
+_BODY_FIELDS = ("body", "orelse", "finalbody")
+
+
+# ------------------------------------------------------------- lock tokens
+
+
+def _terminal_attr(expr: object) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+class _Scope:
+    """Names a function's world: module stem, enclosing class, qualname,
+    parameter names, and the module-global name set."""
+
+    def __init__(self, mod: str, cls: Optional[str], fqn: str, root: str,
+                 params: Set[str], module_globals: Set[str]):
+        self.mod = mod
+        self.cls = cls
+        self.fqn = fqn          # e.g. Runtime._enqueue.on_ready
+        self.root = root        # outermost function: Runtime._enqueue
+        self.params = params
+        self.module_globals = module_globals
+
+    def lock_token(self, expr: ast.AST) -> Optional[str]:
+        """Global-graph identity of a lock expression, or None when the
+        expression does not look like a lock."""
+        attr = _terminal_attr(expr)
+        if attr is None or not LOCK_RE.search(attr):
+            return None
+        if isinstance(expr, ast.Attribute):
+            recv = expr.value
+            if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+                owner = self.cls or self.root
+                return f"{self.mod}.{owner}.{attr}"
+            return f"{self.mod}.*.{attr}"
+        if attr in self.module_globals:
+            return f"{self.mod}.{attr}"
+        # function-local: one namespace per outermost function, so a
+        # closure capturing the outer function's lock gets the same token
+        return f"{self.mod}.{self.root}.<{attr}>"
+
+
+def _is_wildcard(token: str) -> bool:
+    return ".*." in token
+
+
+# ------------------------------------------------------------ function IR
+
+
+class _Event:
+    """One call made while ``held`` locks were held."""
+
+    __slots__ = ("held", "call", "line")
+
+    def __init__(self, held: Tuple[str, ...], call: ast.Call):
+        self.held = held
+        self.call = call
+        self.line = call.lineno
+
+
+class _Acquire:
+    __slots__ = ("held", "token", "line")
+
+    def __init__(self, held: Tuple[str, ...], token: str, line: int):
+        self.held = held
+        self.token = token
+        self.line = line
+
+
+class _FnInfo:
+    def __init__(self, key: str, node: ast.AST, scope: _Scope,
+                 sf: SourceFile):
+        self.key = key
+        self.node = node
+        self.scope = scope
+        self.sf = sf
+        self.events: List[_Event] = []
+        self.acquires: List[_Acquire] = []
+        self.nested: Dict[str, str] = {}  # bare name -> fn key
+
+
+class _Module:
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.mod = os.path.splitext(os.path.basename(sf.relpath))[0]
+        self.fns: Dict[str, _FnInfo] = {}
+        self.methods: Dict[str, Dict[str, str]] = {}  # cls -> name -> key
+        self.module_fns: Dict[str, str] = {}          # name -> key
+        self.globals: Set[str] = set()
+        self.reentrant: Set[str] = set()   # reentrant lock tokens
+        self.alias: Dict[str, str] = {}    # condition token -> lock token
+
+
+def _fn_params(node) -> Set[str]:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _collect_module(sf: SourceFile) -> _Module:
+    m = _Module(sf)
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    m.globals.add(tgt.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            m.globals.add(node.target.id)
+        elif isinstance(node, ast.ClassDef):
+            meths = m.methods.setdefault(node.name, {})
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    meths[item.name] = f"{node.name}.{item.name}"
+
+    _scan_lock_ctors(m)
+
+    for node in sf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            m.module_fns[node.name] = _walk_fn(m, node, None, "",
+                                               node.name, None)
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    _walk_fn(m, item, node.name, f"{node.name}.",
+                             f"{node.name}.{item.name}", None)
+    return m
+
+
+def _scan_lock_ctors(m: _Module) -> None:
+    """Reentrancy + Condition aliasing from assignment shapes: walk the
+    whole tree once, tracking the enclosing class lexically."""
+
+    def visit(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            ccls = child.name if isinstance(child, ast.ClassDef) else cls
+            if isinstance(child, ast.Assign) and len(child.targets) == 1 \
+                    and isinstance(child.value, ast.Call):
+                _note_ctor(m, child, ccls)
+            visit(child, ccls)
+
+    visit(m.sf.tree, None)
+
+
+def _note_ctor(m: _Module, node: ast.Assign, cls: Optional[str]) -> None:
+    scope = _Scope(m.mod, cls, "<module>", "<module>", set(), m.globals)
+    token = scope.lock_token(node.targets[0])
+    if token is None:
+        return
+    ctor = _terminal_attr(node.value.func) or ""
+    if ctor in ("RLock", "make_rlock", "make_condition"):
+        m.reentrant.add(token)
+    elif ctor == "Condition":
+        if not node.value.args:
+            m.reentrant.add(token)  # bare Condition() wraps an RLock
+            return
+        arg = node.value.args[0]
+        src = scope.lock_token(arg)
+        if src is not None:
+            # with self._cond: acquires the underlying self._lock
+            m.alias[token] = src
+        elif isinstance(arg, ast.Call) and _terminal_attr(arg.func) in (
+                "RLock", "make_rlock"):
+            m.reentrant.add(token)
+
+
+def _walk_fn(m: _Module, node, cls: Optional[str], prefix: str,
+             root: str, parent: Optional[_FnInfo]) -> str:
+    key = f"{prefix}{node.name}"
+    scope = _Scope(m.mod, cls, key, root, _fn_params(node), m.globals)
+    fi = _FnInfo(key, node, scope, m.sf)
+    m.fns[key] = fi
+    if parent is not None:
+        parent.nested[node.name] = key
+    _walk_body(node.body, (), fi, m)
+    for child in _direct_nested_defs(node):
+        _walk_fn(m, child, cls, key + ".", root, fi)
+    return key
+
+
+def _direct_nested_defs(fn_node) -> Iterable[ast.AST]:
+    """Function defs directly inside ``fn_node`` (not inside a deeper
+    def/class)."""
+
+    def scan(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child
+            elif not isinstance(child, ast.ClassDef):
+                yield from scan(child)
+
+    yield from scan(fn_node)
+
+
+def _walk_body(stmts: List[ast.stmt], held: Tuple[str, ...],
+               fi: _FnInfo, m: _Module) -> None:
+    """Record calls and acquisitions with the held-lock set in effect.
+    A ``X.acquire()`` statement holds until a matching ``X.release()``
+    later in the same statement list (or the end of the list)."""
+    held = tuple(held)
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested def bodies are walked as their own fns
+        tok = _acq_rel_token(stmt, fi.scope, "acquire")
+        if tok is not None:
+            tok = m.alias.get(tok, tok)
+            fi.acquires.append(_Acquire(held, tok, stmt.lineno))
+            if tok not in held:
+                held = held + (tok,)
+            continue
+        tok = _acq_rel_token(stmt, fi.scope, "release")
+        if tok is not None and tok in held:
+            held = tuple(t for t in held if t != tok)
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                _scan_expr_calls(item.context_expr, held, fi)
+                tok = fi.scope.lock_token(item.context_expr)
+                if tok is not None:
+                    tok = m.alias.get(tok, tok)
+                    fi.acquires.append(_Acquire(inner, tok, stmt.lineno))
+                    if tok not in inner:
+                        inner = inner + (tok,)
+            _walk_body(stmt.body, inner, fi, m)
+            continue
+        # the statement's own expressions (test / iter / targets / value)
+        for field, value in ast.iter_fields(stmt):
+            if field in _BODY_FIELDS or field == "handlers":
+                continue
+            if isinstance(value, ast.AST):
+                _scan_expr_calls(value, held, fi)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.AST):
+                        _scan_expr_calls(v, held, fi)
+        # control flow: child statement lists inherit the held set
+        for field in _BODY_FIELDS:
+            body = getattr(stmt, field, None)
+            if body:
+                _walk_body(body, held, fi, m)
+        for handler in getattr(stmt, "handlers", ()):
+            _walk_body(handler.body, held, fi, m)
+
+
+def _scan_expr_calls(expr: ast.AST, held: Tuple[str, ...],
+                     fi: _FnInfo) -> None:
+    stack: List[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue  # runs later, not at this program point
+        if isinstance(node, ast.Call):
+            fi.events.append(_Event(held, node))
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _acq_rel_token(stmt: ast.stmt, scope: _Scope,
+                   which: str) -> Optional[str]:
+    if not isinstance(stmt, ast.Expr) or not isinstance(stmt.value,
+                                                        ast.Call):
+        return None
+    func = stmt.value.func
+    if not isinstance(func, ast.Attribute) or func.attr != which:
+        return None
+    return scope.lock_token(func.value)
+
+
+# -------------------------------------------------------------- resolution
+
+
+def _resolve(call: ast.Call, fi: _FnInfo, m: _Module) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id in fi.nested:
+            return fi.nested[func.id]
+        # nested defs of an enclosing function (closure siblings)
+        for key, other in m.fns.items():
+            if func.id in other.nested and (
+                    fi.key == key or fi.key.startswith(key + ".")):
+                return other.nested[func.id]
+        target = m.module_fns.get(func.id)
+        if target in m.fns:
+            return target
+        return None
+    if isinstance(func, ast.Attribute):
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            if recv.id in ("self", "cls") and fi.scope.cls:
+                return m.methods.get(fi.scope.cls, {}).get(func.attr)
+            if recv.id in m.methods:
+                return m.methods[recv.id].get(func.attr)
+    return None
+
+
+def _foreign_reason(call: ast.Call, fi: _FnInfo) -> Optional[str]:
+    """Why this call dispatches a foreign callable, or None."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        name = func.id
+        if name == "self":
+            return None
+        if name in fi.scope.params:
+            return f"callable argument {name!r}"
+        binding = _name_binding(fi.node, name)
+        if binding is not None:
+            return binding
+        if CB_RE.search(name):
+            return f"callback-named local {name!r}"
+        return None
+    if isinstance(func, ast.Attribute):
+        if func.attr in _SAFE_CALLS:
+            return None
+        if CB_RE.search(func.attr):
+            recv = _terminal_attr(func.value) or "?"
+            return f"stored callback attribute {recv}.{func.attr}"
+    return None
+
+
+def _name_binding(fn_node, name: str) -> Optional[str]:
+    """A foreign-callable description when ``name`` is bound from a
+    callbacks-shaped source inside this function: a loop target over a
+    callbacks collection, or an assignment from a callback attribute."""
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.For) and \
+                isinstance(node.target, ast.Name) and \
+                node.target.id == name:
+            src = _terminal_attr(node.iter)
+            if src and CB_RE.search(src):
+                return f"callback iterated from {src!r}"
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name:
+            src = _terminal_attr(node.value)
+            if src and CB_RE.search(src):
+                return f"callable loaded from {src!r}"
+    return None
+
+
+# --------------------------------------------------------------- analysis
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "path", "line", "fn", "via")
+
+    def __init__(self, src, dst, path, line, fn, via=""):
+        self.src = src
+        self.dst = dst
+        self.path = path
+        self.line = line
+        self.fn = fn
+        self.via = via
+
+
+def _summaries(m: _Module) -> Dict[str, Dict[str, str]]:
+    """fn key -> {lock token -> call chain} of locks the function may
+    acquire within DEPTH calls. Bounded fixpoint: depth-k summaries are
+    built from depth-(k-1) callee summaries, so call-graph cycles
+    terminate by construction."""
+    base: Dict[str, Dict[str, str]] = {}
+    callees: Dict[str, Set[str]] = {}
+    for key, fi in m.fns.items():
+        base[key] = {a.token: "" for a in fi.acquires}
+        callees[key] = set()
+        for ev in fi.events:
+            c = _resolve(ev.call, fi, m)
+            if c is not None:
+                callees[key].add(c)
+    summ = {k: dict(v) for k, v in base.items()}
+    for _ in range(DEPTH):
+        nxt = {k: dict(v) for k, v in summ.items()}
+        changed = False
+        for key in summ:
+            for c in callees[key]:
+                for tok, chain in summ.get(c, {}).items():
+                    if tok not in nxt[key]:
+                        nxt[key][tok] = f"{c} -> {chain}" if chain else c
+                        changed = True
+        summ = nxt
+        if not changed:
+            break
+    return summ
+
+
+def analyze(files: List[SourceFile]) -> List[Finding]:
+    modules = [_collect_module(sf) for sf in files]
+    findings: List[Finding] = []
+    edges: List[_Edge] = []
+    reentrant: Set[str] = set()
+    for m in modules:
+        reentrant |= m.reentrant
+
+    for m in modules:
+        summ = _summaries(m)
+        for fi in m.fns.values():
+            # direct acquisitions: order edges + lexical reacquire
+            for acq in fi.acquires:
+                for h in acq.held:
+                    if h != acq.token:
+                        edges.append(_Edge(h, acq.token, fi.sf.relpath,
+                                           acq.line, fi.key))
+                if acq.token in acq.held \
+                        and acq.token not in reentrant \
+                        and not _is_wildcard(acq.token):
+                    findings.append(Finding(
+                        "L5", fi.sf.relpath, acq.line,
+                        f"{fi.key}: reacquires non-reentrant lock "
+                        f"{acq.token!r} already held by this thread — "
+                        f"guaranteed self-deadlock"))
+            # calls made while holding locks
+            for ev in fi.events:
+                if not ev.held:
+                    continue
+                callee = _resolve(ev.call, fi, m)
+                if callee is not None:
+                    for tok, chain in summ.get(callee, {}).items():
+                        label = f"{callee} -> {chain}" if chain else callee
+                        if tok in ev.held and tok not in reentrant \
+                                and not _is_wildcard(tok):
+                            findings.append(Finding(
+                                "L5", fi.sf.relpath, ev.line,
+                                f"{fi.key}: call into {label} "
+                                f"(re)acquires {tok!r} while this "
+                                f"thread already holds it — "
+                                f"self-deadlock (PR 5 shape)"))
+                        else:
+                            for h in ev.held:
+                                if h != tok:
+                                    edges.append(_Edge(
+                                        h, tok, fi.sf.relpath, ev.line,
+                                        fi.key, via=label))
+                    continue
+                reason = _foreign_reason(ev.call, fi)
+                if reason is not None:
+                    findings.append(Finding(
+                        "L5", fi.sf.relpath, ev.line,
+                        f"{fi.key}: {reason} invoked while holding "
+                        f"{_fmt_held(ev.held)} — a callback that needs "
+                        f"the lock deadlocks the holder; swap out under "
+                        f"the lock, fire after release"))
+
+    findings.extend(_order_findings(edges))
+    return findings
+
+
+def _fmt_held(held: Tuple[str, ...]) -> str:
+    return ", ".join(repr(h) for h in held)
+
+
+def _order_findings(edges: List[_Edge]) -> List[Finding]:
+    graph: Dict[str, Dict[str, _Edge]] = {}
+    for e in edges:
+        if e.src != e.dst:
+            graph.setdefault(e.src, {}).setdefault(e.dst, e)
+
+    def back_path(src: str, dst: str) -> Optional[List[str]]:
+        seen = {src}
+        stack = [[src]]
+        while stack:
+            p = stack.pop()
+            for nxt in graph.get(p[-1], ()):
+                if nxt == dst:
+                    return p + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(p + [nxt])
+        return None
+
+    out: List[Finding] = []
+    reported: Set[Tuple[str, str]] = set()
+    for src, dsts in graph.items():
+        for dst, e in dsts.items():
+            pair = tuple(sorted((src, dst)))
+            if pair in reported:
+                continue
+            back = back_path(dst, src)
+            if back is None:
+                continue
+            reported.add(pair)
+            other = graph[dst][back[1]]
+            via = f" (via {e.via})" if e.via else ""
+            out.append(Finding(
+                "L5", e.path, e.line,
+                f"{e.fn}: lock-order inversion — acquires {dst!r} "
+                f"while holding {src!r}{via}, but the reverse order "
+                f"{' -> '.join(back)} is established at {other.path}:"
+                f"{other.line} ({other.fn}); two threads interleaving "
+                f"these paths deadlock"))
+    return out

@@ -1,0 +1,248 @@
+"""L6 — thread-context discipline: APIs that only work (or only work
+safely) on particular threads.
+
+Three checks, each encoding a bug this repo has already hit or a
+CPython footgun one refactor away:
+
+``signal-off-main``
+    ``signal.signal`` / ``signal.setitimer`` / ``signal.alarm`` raise
+    ``ValueError`` when called off the main thread. PR 7's actor-pool
+    bug was exactly this: a handler installed from a pool thread, with
+    the raise silently swallowed — preemption ride-through never
+    armed. The call is allowed at module top level, inside a function
+    whose name marks it as a process entrypoint (``main``, ``*_main``),
+    or under an explicit lexical guard::
+
+        if threading.current_thread() is threading.main_thread():
+            signal.signal(...)
+
+    Wrapping the call in ``try/except ValueError`` does NOT satisfy
+    the rule — that idiom is how the PR 7 bug hid. A site that is
+    genuinely main-thread-by-construction gets a per-site waiver with
+    a justification.
+
+``fork-under-lock``
+    ``os.fork`` (and fork-based spawn helpers) while this thread holds
+    a lock: the child inherits every *other* lock in whatever state it
+    was at fork time, and any thread holding one of them does not
+    exist in the child — first acquire there deadlocks forever. Held
+    sets come from the same interprocedural walk as L5.
+
+``sync-in-async``
+    Blocking synchronous calls (``time.sleep``, sync socket ops,
+    ``subprocess.run``-family, ``.result()``/``.join()``) inside an
+    ``async def`` body stall the entire event loop — every request on
+    the serve/dag path, not just this one. Use the async equivalent or
+    push the work to a thread.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from ray_tpu.tools.lint.base import Finding, SourceFile
+from ray_tpu.tools.lint.l5_lock_order import _collect_module, \
+    _terminal_attr
+
+#: functions whose name marks them as process entrypoints (run on the
+#: main thread by construction)
+MAIN_FN_RE = re.compile(r"^main$|_main$")
+
+SIGNAL_CALLS = {"signal", "setitimer", "alarm", "siginterrupt"}
+
+FORK_CALLS = {"fork", "forkpty"}
+SPAWN_CALLS = {"Popen", "run", "call", "check_call", "check_output",
+               "system", "popen", "spawnv", "spawnvp", "posix_spawn"}
+
+#: (module-ish receiver, attr) pairs that block inside async bodies
+_ASYNC_BLOCKING_ATTRS = {"sleep": ("time",),
+                         "run": ("subprocess",),
+                         "call": ("subprocess",),
+                         "check_call": ("subprocess",),
+                         "check_output": ("subprocess",)}
+_SOCK_OPS = {"recv", "recv_into", "recvfrom", "send", "sendall",
+             "accept", "connect"}
+
+
+def analyze(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        findings.extend(_signal_findings(sf))
+        findings.extend(_fork_findings(sf))
+        findings.extend(_async_findings(sf))
+    return findings
+
+
+# ---------------------------------------------------------- signal checks
+
+
+def _signal_module_aliases(tree: ast.AST) -> set:
+    """Names the signal module is imported as (``import signal as
+    _signal`` must not evade the check)."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "signal":
+                    aliases.add(a.asname or "signal")
+    return aliases or {"signal"}
+
+
+def _signal_findings(sf: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    aliases = _signal_module_aliases(sf.tree)
+    for call, ctx in _calls_with_context(sf.tree):
+        func = call.func
+        if not isinstance(func, ast.Attribute) \
+                or func.attr not in SIGNAL_CALLS:
+            continue
+        if _terminal_attr(func.value) not in aliases:
+            continue  # only the signal module's API
+        fn_name, guarded = ctx
+        if fn_name is None:
+            continue  # module top level: import runs on the main thread
+        if MAIN_FN_RE.search(fn_name):
+            continue
+        if guarded:
+            continue
+        out.append(Finding(
+            "L6", sf.relpath, call.lineno,
+            f"signal.{func.attr} in {fn_name}(): raises ValueError off "
+            f"the main thread (the PR 7 actor-pool bug); guard with "
+            f"'threading.current_thread() is threading.main_thread()', "
+            f"move to a main/*_main entrypoint, or waive with a "
+            f"justification — do NOT swallow the ValueError"))
+    return out
+
+
+def _calls_with_context(tree: ast.AST):
+    """Yield ``(call, (enclosing_fn_name_or_None, main_thread_guarded))``
+    for every call in the module."""
+
+    def visit(node, fn_name: Optional[str], guarded: bool):
+        for child in ast.iter_child_nodes(node):
+            c_fn, c_guard = fn_name, guarded
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                c_fn, c_guard = child.name, False
+            elif isinstance(child, ast.If) and _is_main_thread_guard(
+                    child.test):
+                # only the if-body is guarded, not orelse
+                if isinstance(child.test, ast.AST):
+                    for sub in child.body:
+                        yield from visit_one(sub, c_fn, True)
+                    for sub in child.orelse:
+                        yield from visit_one(sub, c_fn, c_guard)
+                    yield from _expr_calls(child.test, c_fn, c_guard)
+                    continue
+            if isinstance(child, ast.Call):
+                yield (child, (c_fn, c_guard))
+            yield from visit(child, c_fn, c_guard)
+
+    def visit_one(node, fn_name, guarded):
+        if isinstance(node, ast.Call):
+            yield (node, (fn_name, guarded))
+        yield from visit(node, fn_name, guarded)
+
+    def _expr_calls(expr, fn_name, guarded):
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                yield (sub, (fn_name, guarded))
+
+    yield from visit(tree, None, False)
+
+
+def _is_main_thread_guard(test: ast.AST) -> bool:
+    """``threading.current_thread() is threading.main_thread()`` (either
+    operand order, == also accepted)."""
+    if not isinstance(test, ast.Compare) or len(test.comparators) != 1:
+        return False
+    sides = (test.left, test.comparators[0])
+    names = set()
+    for side in sides:
+        if isinstance(side, ast.Call):
+            attr = _terminal_attr(side.func)
+            if attr:
+                names.add(attr)
+    return {"current_thread", "main_thread"} <= names
+
+
+# ------------------------------------------------------- fork under lock
+
+
+def _fork_findings(sf: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    m = _collect_module(sf)
+    for fi in m.fns.values():
+        for ev in fi.events:
+            if not ev.held:
+                continue
+            func = ev.call.func
+            attr = _terminal_attr(func)
+            if attr in FORK_CALLS or (
+                    attr in SPAWN_CALLS
+                    and isinstance(func, ast.Attribute)
+                    and _terminal_attr(func.value) in ("subprocess",
+                                                       "os")):
+                held = ", ".join(repr(h) for h in ev.held)
+                out.append(Finding(
+                    "L6", sf.relpath, ev.line,
+                    f"{fi.key}: {attr}() while holding {held} — the "
+                    f"child inherits every lock's state but not the "
+                    f"threads that would release them; first "
+                    f"contended acquire in the child deadlocks. Spawn "
+                    f"outside the critical section"))
+    return out
+
+
+# --------------------------------------------------------- sync in async
+
+
+def _async_findings(sf: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        for call in _async_body_calls(node):
+            reason = _blocking_reason(call)
+            if reason is not None:
+                out.append(Finding(
+                    "L6", sf.relpath, call.lineno,
+                    f"blocking {reason} inside async def "
+                    f"{node.name}(): stalls the event loop for every "
+                    f"in-flight request; use the async equivalent or "
+                    f"run_in_executor"))
+    return out
+
+
+def _async_body_calls(fn: ast.AsyncFunctionDef):
+    """Calls lexically inside the async body, excluding nested (sync or
+    async) function definitions — those run on their own schedule."""
+
+    def scan(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            yield from scan(child)
+
+    yield from scan(fn)
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = _terminal_attr(func.value)
+    mods = _ASYNC_BLOCKING_ATTRS.get(func.attr)
+    if mods and recv in mods:
+        return f"{recv}.{func.attr}()"
+    if func.attr in _SOCK_OPS and recv and "sock" in recv.lower():
+        return f"sync socket op {recv}.{func.attr}()"
+    if func.attr == "result" and recv and (
+            "future" in recv.lower() or "fut" in recv.lower()):
+        return f"{recv}.result()"
+    return None

@@ -1,4 +1,4 @@
-"""Orchestrates the four analyzers over a source tree and applies
+"""Orchestrates the analyzers over a source tree and applies
 suppression comments and baselines.
 
 Scopes (mirroring where each invariant lives):
@@ -10,23 +10,36 @@ Scopes (mirroring where each invariant lives):
   training extends the contract to TrainingWorkerError and
   CollectiveAbortedError);
 - L3 runs over the whole ``ray_tpu/`` package (flags are read
-  everywhere).
+  everywhere) plus ``tests/`` for the fault-site coverage check;
+- L5 runs over ``ray_tpu/core/`` (including ``core/cluster/``) and
+  ``ray_tpu/train/`` — the multi-threaded lock surface;
+- L6 runs over L5's scope plus ``ray_tpu/serve/`` and ``ray_tpu/dag/``
+  (the async request paths the sync-in-async check guards).
+
+Rules run as independent thunks so the CLI can fan them out across a
+thread pool (``--jobs``); each thunk's wall time is reported in the
+``--json`` output (``rule_wall_ms``) so a rule that goes quadratic on
+a growing tree is visible before it hurts.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Optional, Sequence
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.tools.lint import l1_protocol, l2_locks, l3_config, \
-    l4_exceptions
+    l4_exceptions, l5_lock_order, l6_thread_context
 from ray_tpu.tools.lint.base import Finding, SourceFile, iter_py_files, \
     load_file
 
 PROTOCOL_PATH = "ray_tpu/core/protocol.py"
 CONFIG_PATH = "ray_tpu/core/config.py"
 FAULT_PATH = "ray_tpu/core/fault_injection.py"
+
+ALL_RULES = ("L1", "L2", "L3", "L4", "L5", "L6")
 
 BASELINE_VERSION = 1
 
@@ -39,12 +52,10 @@ def default_root() -> str:
         ray_tpu.__file__)))
 
 
-def collect_findings(root: Optional[str] = None,
-                     rules: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Run the selected analyzers; suppressed findings are dropped."""
-    root = root or default_root()
-    rules = {r.upper() for r in rules} if rules else {"L1", "L2", "L3",
-                                                      "L4"}
+def _rule_thunks(root: str, rules: set) -> Tuple[
+        Dict[str, Callable[[], List[Finding]]], Dict[str, SourceFile]]:
+    """Load the tree once, return one zero-arg thunk per selected rule
+    plus the relpath -> SourceFile map (for suppression filtering)."""
     by_rel: Dict[str, SourceFile] = {}
 
     def get(rel: str) -> Optional[SourceFile]:
@@ -59,7 +70,9 @@ def collect_findings(root: Optional[str] = None,
         return by_rel.get(rel)
 
     core_files: List[SourceFile] = []
-    recovery_files: List[SourceFile] = []  # L4 scope
+    recovery_files: List[SourceFile] = []   # L4 scope
+    lock_files: List[SourceFile] = []       # L5 scope
+    thread_files: List[SourceFile] = []     # L6 scope
     all_files: List[SourceFile] = []
     for path in iter_py_files(root, "ray_tpu"):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
@@ -72,23 +85,74 @@ def collect_findings(root: Optional[str] = None,
         if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
                            "ray_tpu/parallel/")):
             recovery_files.append(sf)
+        if rel.startswith(("ray_tpu/core/", "ray_tpu/train/")):
+            lock_files.append(sf)
+        if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
+                           "ray_tpu/serve/", "ray_tpu/dag/")):
+            thread_files.append(sf)
 
-    findings: List[Finding] = []
+    test_files: List[SourceFile] = []
+    if "L3" in rules:
+        for path in iter_py_files(root, "tests"):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            sf = load_file(path, root)
+            if sf is not None:
+                test_files.append(sf)
+
+    thunks: Dict[str, Callable[[], List[Finding]]] = {}
     if "L1" in rules:
         protocol_sf = get(PROTOCOL_PATH)
         if protocol_sf is not None:
             dispatchers = {rel: sf for rel in l1_protocol.DISPATCHER_FILES
                            if (sf := get(rel)) is not None}
-            findings.extend(l1_protocol.analyze(protocol_sf, dispatchers))
+            thunks["L1"] = lambda: l1_protocol.analyze(protocol_sf,
+                                                       dispatchers)
     if "L2" in rules:
-        findings.extend(l2_locks.analyze(core_files))
+        thunks["L2"] = lambda: l2_locks.analyze(core_files)
     if "L3" in rules:
         config_sf = get(CONFIG_PATH)
+        fault_sf = get(FAULT_PATH)
         if config_sf is not None:
-            findings.extend(l3_config.analyze(
-                config_sf, get(FAULT_PATH), all_files))
+            thunks["L3"] = lambda: (
+                l3_config.analyze(config_sf, fault_sf, all_files)
+                + l3_config.fault_site_coverage(fault_sf, test_files))
     if "L4" in rules:
-        findings.extend(l4_exceptions.analyze(recovery_files))
+        thunks["L4"] = lambda: l4_exceptions.analyze(recovery_files)
+    if "L5" in rules:
+        thunks["L5"] = lambda: l5_lock_order.analyze(lock_files)
+    if "L6" in rules:
+        thunks["L6"] = lambda: l6_thread_context.analyze(thread_files)
+    return thunks, by_rel
+
+
+def collect_findings_timed(
+        root: Optional[str] = None,
+        rules: Optional[Sequence[str]] = None,
+        jobs: int = 1) -> Tuple[List[Finding], Dict[str, float]]:
+    """Run the selected analyzers (``jobs`` > 1 fans rules out across a
+    thread pool); suppressed findings are dropped. Returns the sorted
+    findings and per-rule wall time in milliseconds."""
+    root = root or default_root()
+    selected = {r.upper() for r in rules} if rules else set(ALL_RULES)
+    thunks, by_rel = _rule_thunks(root, selected)
+
+    findings: List[Finding] = []
+    wall_ms: Dict[str, float] = {}
+
+    def run(rule: str) -> Tuple[str, List[Finding], float]:
+        t0 = time.perf_counter()
+        result = thunks[rule]()
+        return rule, result, (time.perf_counter() - t0) * 1000.0
+
+    order = [r for r in ALL_RULES if r in thunks]
+    if jobs > 1 and len(order) > 1:
+        with ThreadPoolExecutor(max_workers=min(jobs, len(order))) as ex:
+            results = list(ex.map(run, order))
+    else:
+        results = [run(r) for r in order]
+    for rule, result, ms in results:
+        findings.extend(result)
+        wall_ms[rule] = round(ms, 3)
 
     out = []
     for f in findings:
@@ -97,7 +161,14 @@ def collect_findings(root: Optional[str] = None,
             continue
         out.append(f)
     out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
-    return out
+    return out, wall_ms
+
+
+def collect_findings(root: Optional[str] = None,
+                     rules: Optional[Sequence[str]] = None,
+                     jobs: int = 1) -> List[Finding]:
+    """Run the selected analyzers; suppressed findings are dropped."""
+    return collect_findings_timed(root=root, rules=rules, jobs=jobs)[0]
 
 
 def load_baseline(path: str) -> set:

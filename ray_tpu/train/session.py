@@ -247,11 +247,14 @@ def _install_preemption_handler():
     ev = _core_preempt_event()
     if ev is not None:
         ev.clear()
-    try:
+    # Only the main thread may install handlers (CPython rule). On a
+    # pool thread the process-level handler installed at actor creation
+    # (core/worker_proc.py) owns the SIGTERM route — skip explicitly
+    # rather than swallow the ValueError, which is how the original
+    # never-armed bug stayed invisible.
+    if threading.current_thread() is threading.main_thread():
         signal.signal(signal.SIGTERM, lambda signum, frame:
                       _flag_preemption())
-    except ValueError:
-        pass  # not the main thread: the process-level handler owns it
 
 
 def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None,

@@ -1,18 +1,65 @@
 """ray_tpu.util: placement groups, scheduling strategies, collectives
-(API parity with the reference's ray.util namespace)."""
+(API parity with the reference's ray.util namespace).
 
-from ray_tpu.core.placement_group import (  # noqa: F401
-    PlacementGroup,
-    placement_group,
-    placement_group_table,
-    remove_placement_group,
-)
-from ray_tpu.core.scheduling_strategies import (  # noqa: F401
-    NodeAffinitySchedulingStrategy,
-    PlacementGroupSchedulingStrategy,
-)
-from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
-from ray_tpu.util.queue import Empty, Full, Queue  # noqa: F401
+Re-exports resolve lazily (PEP 562): deep core modules import
+``ray_tpu.util.debug_lock`` (the lock factory) at their own import
+time, which executes this package ``__init__`` — eager re-imports of
+``ray_tpu.core.*`` here would close an import cycle through
+``ray_tpu.exceptions``.
+"""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "PlacementGroup": ("ray_tpu.core.placement_group", "PlacementGroup"),
+    "placement_group": ("ray_tpu.core.placement_group", "placement_group"),
+    "placement_group_table": ("ray_tpu.core.placement_group",
+                              "placement_group_table"),
+    "remove_placement_group": ("ray_tpu.core.placement_group",
+                               "remove_placement_group"),
+    "NodeAffinitySchedulingStrategy": (
+        "ray_tpu.core.scheduling_strategies",
+        "NodeAffinitySchedulingStrategy"),
+    "PlacementGroupSchedulingStrategy": (
+        "ray_tpu.core.scheduling_strategies",
+        "PlacementGroupSchedulingStrategy"),
+    "ActorPool": ("ray_tpu.util.actor_pool", "ActorPool"),
+    "Empty": ("ray_tpu.util.queue", "Empty"),
+    "Full": ("ray_tpu.util.queue", "Full"),
+    "Queue": ("ray_tpu.util.queue", "Queue"),
+}
+
+__all__ = sorted(_EXPORTS) + ["host_node_pid"]
+
+if TYPE_CHECKING:  # pragma: no cover — static analyzers only
+    from ray_tpu.core.placement_group import (  # noqa: F401
+        PlacementGroup,
+        placement_group,
+        placement_group_table,
+        remove_placement_group,
+    )
+    from ray_tpu.core.scheduling_strategies import (  # noqa: F401
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+    from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+    from ray_tpu.util.queue import Empty, Full, Queue  # noqa: F401
+
+
+def __getattr__(name: str):
+    entry = _EXPORTS.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(entry[0]), entry[1])
+    globals()[name] = value  # cache: resolve once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
 
 
 def host_node_pid() -> int:

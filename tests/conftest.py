@@ -35,6 +35,32 @@ if "jax" in sys.modules:
 
 import pytest
 
+# Modules that exercise the concurrency surface hardest run with the
+# lock-order sanitizer armed: every runtime lock built inside them is a
+# DebugLock, so an acquisition-order inversion or a callback fired
+# under a tracked lock fails the test at the offending site instead of
+# hanging CI. The env var makes spawned workers arm themselves too.
+_SANITIZED_MODULES = {"test_fault_tolerance", "test_ha",
+                      "test_regressions"}
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_sanitizer(request):
+    name = request.module.__name__.rpartition(".")[2]
+    if name not in _SANITIZED_MODULES:
+        yield
+        return
+    from ray_tpu.util import debug_lock
+
+    os.environ["RTPU_SANITIZE"] = "1"
+    debug_lock.arm()
+    try:
+        yield
+    finally:
+        debug_lock.disarm()
+        debug_lock.reset()
+        os.environ.pop("RTPU_SANITIZE", None)
+
 
 @pytest.fixture(scope="module")
 def rt():

@@ -647,6 +647,66 @@ def test_fault_injection_env_surface(local_ray):
         fi.clear()
 
 
+def test_dispatch_fault_site_kill_worker_recovers(local_ray,
+                                                  fault_injection):
+    """The deterministic 'dispatch' site SIGKILLs the worker right after
+    it receives the task batch; the worker-death retry path re-runs the
+    task elsewhere, invisibly to the caller."""
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+    @ray_tpu.remote
+    def produce(x):
+        return _payload(x)
+
+    fi.inject("dispatch", "kill_worker")
+    ref = produce.remote(17)
+    assert ray_tpu.get(ref, timeout=60) == _payload(17)
+
+
+def test_task_fault_site_env_armed_exit_recovers(local_ray):
+    """RTPU_FAULT_TASK is worker-side: every worker (including zygote
+    respawns, which inherit the zygote's armed environment) os._exit(1)s
+    before running the task, so each retry deterministically dies and
+    the caller gets WorkerCrashedError once the budget is spent."""
+    from ray_tpu.core import fault_injection as fi
+    from ray_tpu.exceptions import WorkerCrashedError
+
+    os.environ["RTPU_FAULT_TASK"] = "exit:-1"
+    try:
+        ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+
+        @ray_tpu.remote(max_retries=1)
+        def produce(x):
+            return _payload(x)
+
+        with pytest.raises(WorkerCrashedError):
+            ray_tpu.get(produce.remote(29), timeout=60)
+    finally:
+        os.environ.pop("RTPU_FAULT_TASK", None)
+        fi.clear()
+
+
+def test_spill_fault_site_delete_on_spill_reconstructs(local_ray,
+                                                       fault_injection):
+    """The 'spill' site loses the file the moment the payload moves to
+    disk (torn write / reclaimed scratch volume); a later get
+    reconstructs from lineage instead of reading the vanished file."""
+    fi = fault_injection
+    ray_tpu.init(num_workers=2, object_store_memory=64 << 20)
+    core = runtime_context.get_core()
+
+    @ray_tpu.remote
+    def produce(x):
+        return _payload(x)
+
+    ref = produce.remote(23)
+    want = ray_tpu.get(ref, timeout=60)
+    fi.inject("spill", "delete")
+    assert fi.spill_object(core, ref), "object should spill on demand"
+    assert ray_tpu.get(ref, timeout=60) == want
+
+
 def test_lineage_evicted_past_budget_not_reconstructed(
         local_ray, fault_injection):
     """With a zero lineage byte budget every entry is evicted on

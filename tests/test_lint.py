@@ -13,7 +13,7 @@ import textwrap
 from ray_tpu.tools.lint import (collect_findings, apply_baseline,
                                 load_baseline, write_baseline)
 from ray_tpu.tools.lint import l1_protocol, l2_locks, l3_config, \
-    l4_exceptions, runner
+    l4_exceptions, l5_lock_order, l6_thread_context, runner
 from ray_tpu.tools.lint.__main__ import main as lint_main
 from ray_tpu.tools.lint.base import Finding, SourceFile
 
@@ -376,6 +376,317 @@ def test_suppression_all_wildcard():
                for f in l4_exceptions.analyze([sf]))
 
 
+# ---------------------------------------------------------------- L5
+
+
+def test_l5_pr5_enqueue_interprocedural_reacquire_flagged():
+    """The PR 5 deadlock, re-encoded: _enqueue holds the directory lock
+    and fires a just-defined callback that re-enters via _queue_ready,
+    which takes the same lock. Lexically the reacquire is invisible —
+    only the call-graph walk sees it."""
+    findings = l5_lock_order.analyze([_sf('''\
+        import threading
+
+        class ObjectDirectory:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ready = []
+
+            def _queue_ready(self, oid):
+                with self._lock:
+                    self._ready.append(oid)
+
+            def _enqueue(self, oid):
+                with self._lock:
+                    def on_ready():
+                        self._queue_ready(oid)
+                    on_ready()
+        ''')])
+    hits = [f for f in findings if "PR 5 shape" in f.message]
+    assert len(hits) == 1
+    assert "_queue_ready" in hits[0].message
+    assert "_lock" in hits[0].message
+
+
+def test_l5_abba_inversion_flagged_once_per_pair():
+    findings = l5_lock_order.analyze([_sf('''\
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._lock_a = threading.Lock()
+                self._lock_b = threading.Lock()
+
+            def fwd(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+
+            def rev(self):
+                with self._lock_b:
+                    with self._lock_a:
+                        pass
+        ''')])
+    inv = [f for f in findings if "inversion" in f.message]
+    assert len(inv) == 1  # one finding per unordered pair, not two
+    assert "_lock_a" in inv[0].message and "_lock_b" in inv[0].message
+
+
+_BUS = '''\
+    import threading
+
+    class Bus:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._callbacks = []
+
+        def publish(self, msg):
+            with self._lock:
+                for cb in self._callbacks:
+                    cb(msg)
+
+        def run_locked(self, fn):
+            with self._lock:
+                fn()
+
+        def publish_ok(self, msg):
+            with self._lock:
+                cbs = list(self._callbacks)
+            for cb in cbs:
+                cb(msg)
+    '''
+
+
+def test_l5_callback_under_lock_flagged_swap_then_fire_clean():
+    findings = l5_lock_order.analyze([_sf(_BUS)])
+    under = [f for f in findings if "invoked while holding" in f.message]
+    # publish (iterating a stored callback list) and run_locked (callable
+    # parameter) are both flagged; publish_ok's swap-then-fire is clean
+    assert len(under) == 2
+    assert {f.line for f in under} == {11, 15}
+
+
+def test_l5_rlock_reentry_clean():
+    assert l5_lock_order.analyze([_sf('''\
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        ''')]) == []
+
+
+def test_l5_condition_aliases_its_backing_lock():
+    """threading.Condition(self._lock) shares self._lock's token: an
+    inversion threaded through the condition on one side and the raw
+    lock on the other is still one cycle."""
+    findings = l5_lock_order.analyze([_sf('''\
+        import threading
+
+        class Gcs:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._other_mutex = threading.Lock()
+
+            def wait_side(self):
+                with self._cond:
+                    with self._other_mutex:
+                        pass
+
+            def notify_side(self):
+                with self._other_mutex:
+                    with self._lock:
+                        self._cond.notify()
+        ''')])
+    inv = [f for f in findings if "inversion" in f.message]
+    assert len(inv) == 1
+    assert "_other_mutex" in inv[0].message
+
+
+def test_l5_suppression_honored():
+    sf = _sf('''\
+        import threading
+
+        class Bus:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._callbacks = []
+
+            def publish(self, msg):
+                with self._lock:
+                    for cb in self._callbacks:
+                        cb(msg)  # rtpu-lint: disable=L5 — cbs are wait-free
+        ''')
+    findings = [f for f in l5_lock_order.analyze([sf])
+                if not sf.suppressed(f.line, f.rule)]
+    assert findings == []
+
+
+# ---------------------------------------------------------------- L6
+
+
+def test_l6_pr7_pool_thread_signal_flagged_despite_swallow():
+    """The PR 7 bug, re-encoded: signal.signal from an actor-pool
+    thread raises ValueError; wrapping it in try/except ValueError is
+    exactly how the handler silently never armed — the swallow must NOT
+    bless the call."""
+    findings = l6_thread_context.analyze([_sf('''\
+        import signal
+
+        class _TrainWorker:
+            def _install_preemption_handler(self):
+                try:
+                    signal.signal(signal.SIGTERM, lambda s, f: None)
+                except ValueError:
+                    pass
+        ''')])
+    assert len(findings) == 1
+    assert "PR 7" in findings[0].message
+    assert "_install_preemption_handler" in findings[0].message
+
+
+def test_l6_main_contexts_and_guard_clean_else_branch_flagged():
+    findings = l6_thread_context.analyze([_sf('''\
+        import signal
+        import threading
+
+        signal.signal(signal.SIGINT, None)  # import time: main thread
+
+        def main():
+            signal.signal(signal.SIGTERM, None)
+
+        def worker_main():
+            signal.setitimer(signal.ITIMER_REAL, 0.1)
+
+        def guarded():
+            if threading.current_thread() is threading.main_thread():
+                signal.signal(signal.SIGTERM, None)
+
+        def guard_inverted():
+            if threading.current_thread() is threading.main_thread():
+                pass
+            else:
+                signal.signal(signal.SIGTERM, None)
+        ''')])
+    assert len(findings) == 1  # only the else-branch install
+    assert "guard_inverted" in findings[0].message
+
+
+def test_l6_aliased_signal_import_does_not_evade():
+    findings = l6_thread_context.analyze([_sf('''\
+        import signal as _signal
+
+        def attach():
+            _signal.signal(_signal.SIGTERM, None)
+        ''')])
+    assert len(findings) == 1
+    assert "attach" in findings[0].message
+
+
+def test_l6_fork_and_spawn_under_lock_flagged_outside_clean():
+    findings = l6_thread_context.analyze([_sf('''\
+        import os
+        import subprocess
+        import threading
+
+        _zygote_lock = threading.Lock()
+
+        def spawn_worker():
+            with _zygote_lock:
+                pid = os.fork()
+            return pid
+
+        def launch_tool():
+            with _zygote_lock:
+                subprocess.run(["true"])
+
+        def launch_outside():
+            with _zygote_lock:
+                pass
+            subprocess.run(["true"])
+        ''')])
+    held = [f for f in findings if "while holding" in f.message]
+    assert len(held) == 2
+    assert any("fork" in f.message for f in held)
+    assert any("run" in f.message for f in held)
+
+
+def test_l6_blocking_sync_in_async_body_flagged():
+    findings = l6_thread_context.analyze([_sf('''\
+        import asyncio
+        import time
+
+        async def handle(req):
+            time.sleep(0.1)
+            return req
+
+        async def handle_ok(req):
+            await asyncio.sleep(0.1)
+            return req
+
+        def sync_helper():
+            time.sleep(1)
+        ''')])
+    assert len(findings) == 1
+    assert "time.sleep()" in findings[0].message
+    assert "handle" in findings[0].message
+
+
+# ---------------------------------------------- L3 fault-site coverage
+
+
+def _fault_sf(src: str):
+    return _sf(src, "ray_tpu/core/fault_injection.py")
+
+
+def test_fault_site_coverage_uncovered_site_flagged_at_sites_row():
+    fault = _fault_sf('SITES = (\n    "get",\n    "spill",\n)\n')
+    tests = [_sf('def test_x(fi):\n    fi.inject("get", "kill")\n',
+                 "tests/test_ft.py")]
+    findings = l3_config.fault_site_coverage(fault, tests)
+    assert len(findings) == 1
+    assert "'spill'" in findings[0].message
+    assert findings[0].path == "ray_tpu/core/fault_injection.py"
+    assert findings[0].line == 1  # anchored at the SITES assignment
+
+
+def test_fault_site_coverage_all_three_arming_mechanisms_count():
+    fault = _fault_sf('SITES = ("get", "spill", "task")\n')
+    tests = [_sf('''\
+        def test_env(monkeypatch):
+            monkeypatch.setenv("RTPU_FAULT_SPILL", "delete:1")
+
+        def test_flag(rt):
+            rt.init(fault_injection="task=exit:1")
+
+        def test_inproc(fi):
+            fi.inject("get", "kill_worker")
+        ''', "tests/test_cov.py")]
+    assert l3_config.fault_site_coverage(fault, tests) == []
+
+
+def test_fault_site_coverage_flag_spec_match_is_quote_anchored():
+    # "target=" contains the substring "get=", but only a quote-anchored
+    # '"get=' counts as a fault_injection flag spec arming site "get"
+    fault = _fault_sf('SITES = ("get",)\n')
+    tests = [_sf('x = fire("spill", target="w1")\n', "tests/test_t.py")]
+    findings = l3_config.fault_site_coverage(fault, tests)
+    assert len(findings) == 1 and "'get'" in findings[0].message
+
+
+def test_fault_site_coverage_tolerates_missing_fault_module():
+    assert l3_config.fault_site_coverage(None, []) == []
+
+
 # ------------------------------------------------------- baseline + CLI
 
 
@@ -418,9 +729,26 @@ def test_cli_json_output(tmp_path, capsys):
     bad = str(tmp_path / "bad")
     _seed_tree(bad, bad=True)
     assert lint_main(["--root", bad, "--json"]) == 1
-    findings = json.loads(capsys.readouterr().out)
+    data = json.loads(capsys.readouterr().out)
+    findings = data["findings"]
     assert findings and findings[0]["rule"] == "L4"
     assert set(findings[0]) == {"rule", "path", "line", "message", "key"}
+    # every rule that ran reports its wall time (the mini-tree has no
+    # protocol.py/config.py, so L1/L3 are skipped and report none)
+    assert set(data["rule_wall_ms"]) == {"L2", "L4", "L5", "L6"}
+    assert all(ms >= 0 for ms in data["rule_wall_ms"].values())
+
+
+def test_cli_jobs_parallel_matches_serial(tmp_path, capsys):
+    bad = str(tmp_path / "bad")
+    _seed_tree(bad, bad=True)
+    assert lint_main(["--root", bad, "--json"]) == 1
+    serial = json.loads(capsys.readouterr().out)["findings"]
+    assert lint_main(["--root", bad, "--jobs", "4", "--json"]) == 1
+    parallel = json.loads(capsys.readouterr().out)["findings"]
+    assert parallel == serial  # same findings, same sort order
+    assert lint_main(["--root", bad, "--jobs", "0"]) == 2  # usage error
+    capsys.readouterr()
 
 
 def test_cli_baseline_grandfathers_old_findings(tmp_path, capsys):
