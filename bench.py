@@ -1138,6 +1138,87 @@ def bench_elastic(rows: list):
         runtime_context.set_core(prev)
 
 
+def bench_serve_overload(rows: list):
+    """serve_p99_ttft_overload_ms: p99 completion latency of the HIGH
+    priority class through the serve plane under sustained mixed-priority
+    overload (arrival ~an order of magnitude over capacity; admission
+    control on: 2 replicas, max_queue_depth=8, heavy-tail service times),
+    plus the fraction of offered load shed with typed BackpressureError.
+    The row pins the overload contract: admitted high-priority work rides
+    a bounded queue, so its tail stays flat instead of growing with the
+    offered load. No reference number — the conservative bar lives in
+    BASELINE.json.published."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core import runtime_context
+    from ray_tpu.exceptions import BackpressureError
+    from ray_tpu.serve import qos
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    ray_tpu.init(num_workers=4, object_store_memory=128 << 20)
+    try:
+        @serve.deployment(name="overload_bench", num_replicas=2,
+                          max_queue_depth=8)
+        def work(dt):
+            time.sleep(dt)
+            return dt
+
+        handle = serve.run(work)
+
+        def service_time(i):  # heavy tail: mostly fast, thick slow tail
+            if i % 13 == 0:
+                return 0.3
+            if i % 5 == 0:
+                return 0.12
+            return 0.02
+
+        lat = {"low": [], "normal": [], "high": []}
+        shed = {"low": 0, "normal": 0, "high": 0}
+        lock = threading.Lock()
+        threads = []
+        rounds = 60
+        for i in range(rounds):
+            for prio in ("low", "normal", "high"):
+                t0 = time.perf_counter()
+                try:
+                    fut = handle.options(priority=prio).remote(
+                        service_time(i))
+                except BackpressureError:
+                    with lock:
+                        shed[prio] += 1
+                    continue
+
+                def reap(fut=fut, prio=prio, t0=t0):
+                    try:
+                        fut.result(timeout=120)
+                        with lock:
+                            lat[prio].append(
+                                (time.perf_counter() - t0) * 1e3)
+                    except BackpressureError:
+                        with lock:
+                            shed[prio] += 1
+
+                t = threading.Thread(target=reap, daemon=True)
+                t.start()
+                threads.append(t)
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=180)
+        if not lat["high"]:
+            raise RuntimeError("no high-priority request completed")
+        rows.append(_row("serve_p99_ttft_overload_ms",
+                         qos.percentile(lat["high"], 99), "ms"))
+        rows.append(_row("serve_overload_shed_fraction",
+                         sum(shed.values()) / (rounds * 3), "fraction"))
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+        runtime_context.set_core(prev)
+
+
 def bench_many_nodes_actors() -> float:
     """The actor-fleet creation row ALONE on a fresh 16-node cluster.
 
@@ -1245,6 +1326,14 @@ def main():
         bench_elastic(rows)
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "elastic_resume_s", "value": -1,
+                     "unit": f"error: {e}"})
+
+    # serve-plane overload contract: bounded high-priority tail + typed
+    # shedding under sustained mixed-priority overload (ISSUE 10)
+    try:
+        bench_serve_overload(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "serve_p99_ttft_overload_ms", "value": -1,
                      "unit": f"error: {e}"})
 
     # scalability AFTER many_nodes: the 1M-task slab leaves the single
@@ -1434,6 +1523,8 @@ def main():
             ("gcs_failover_recovery_ms", "gcs_failover_recovery_ms",
              False),
             ("elastic_resume_s", "elastic_resume_s", False),
+            ("serve_p99_ttft_overload_ms",
+             "serve_p99_ttft_overload_ms", False),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)

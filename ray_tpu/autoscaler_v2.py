@@ -29,6 +29,42 @@ from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
+#: KV key the serve controller publishes demand under (must match
+#: ray_tpu/serve/controller.py SERVE_DEMAND_KEY): {"ts": wall-clock,
+#: "deployments": {name: {"queue_depth", "ttft_p50_ms", "ttft_p99_ms"}}}
+SERVE_DEMAND_KEY = "serve:demand"
+
+
+def serve_demand_signal(payload, ttft_slo_ms: float, now: float,
+                        max_age_s: float = 5.0) -> Tuple[int, bool]:
+    """Fold the serve controller's published demand into the scale-up
+    signals: (total admission queue depth, TTFT SLO breached?). Pure so
+    the policy is unit-testable without a live GCS. A stale payload
+    (controller gone, publish loop wedged) counts as NO demand — scaling
+    on fossil telemetry would hold the fleet up forever; ``ttft_slo_ms``
+    <= 0 disables the SLO-breach signal."""
+    if not isinstance(payload, dict):
+        return 0, False
+    ts = payload.get("ts")
+    if not isinstance(ts, (int, float)) or now - ts > max_age_s:
+        return 0, False
+    depth = 0
+    breached = False
+    deployments = payload.get("deployments")
+    if not isinstance(deployments, dict):
+        return 0, False
+    for d in deployments.values():
+        if not isinstance(d, dict):
+            continue
+        try:
+            depth += max(0, int(d.get("queue_depth", 0)))
+            if ttft_slo_ms > 0 and float(d.get("ttft_p99_ms", 0.0)) \
+                    > ttft_slo_ms:
+                breached = True
+        except (TypeError, ValueError):
+            continue
+    return depth, breached
+
 
 class InstanceStatus(str, enum.Enum):
     """Reference: instance_manager.proto Instance.InstanceStatus."""
@@ -265,10 +301,13 @@ class Reconciler:
 class AutoscalerV2:
     """Live loop: feeds the reconciler GCS + provider views (the v2
     analogue of AutoscalerMonitor; reference: autoscaler/v2/monitor.py).
-    Demand policy is the v1 monitor's (sustained task queueing OR a
-    pending placement group grows the target, sustained idleness
-    shrinks it) — v2's contribution is the audited instance lifecycle
-    underneath it.
+    Demand policy extends the v1 monitor's (sustained task queueing OR
+    a pending placement group grows the target, sustained idleness
+    shrinks it) with serving-plane pressure — admission queue depth and
+    TTFT-SLO breaches published by the serve controller to the
+    ``serve:demand`` KV key — so an overloaded serving fleet counts as
+    demand even when node task queues are empty. v2's contribution is
+    the audited instance lifecycle underneath it.
 
     Nodes present at the first tick (the head and any statically
     launched peers) are OUT of scope: they are never matched to
@@ -350,6 +389,23 @@ class AutoscalerV2:
                 continue
         return queued, pending_pgs, ok
 
+    def _serve_demand(self) -> Tuple[int, bool]:
+        """Serving-plane demand from the controller's KV publish:
+        (admission queue depth, TTFT p99 over SLO?). Task queues and
+        pending PGs miss serve pressure entirely — requests queue in
+        routers, not node task queues — so without this signal an
+        overloaded serving fleet looks idle to the autoscaler."""
+        from ray_tpu.core.cluster.rpc import RpcError
+        from ray_tpu.core.config import config
+
+        try:
+            payload = self._gcs.call(("kv", "get", SERVE_DEMAND_KEY, None))
+        except (RpcError, ConnectionError, TimeoutError, OSError,
+                EOFError):
+            return 0, False  # GCS hiccup: inconclusive, not demand
+        return serve_demand_signal(payload, config.serve_ttft_slo_ms,
+                                   time.time())
+
     def _tick(self):
         view = self._gcs.call(("list_nodes", True))
         addrs = [tuple(n["address"]) for n in view["nodes"]]
@@ -365,7 +421,9 @@ class AutoscalerV2:
         dyn_addrs = [a for a in addrs if a not in self._static]
 
         queued, pending_pgs, ok = self._demand(addrs)
-        busy = queued > 0 or pending_pgs > 0
+        serve_depth, slo_breached = self._serve_demand()
+        busy = (queued > 0 or pending_pgs > 0 or serve_depth > 0
+                or slo_breached)
         if busy:
             # ANY demand resets idleness — even at max capacity, where
             # no further scale-up is possible (a loaded-at-max fleet
@@ -384,6 +442,8 @@ class AutoscalerV2:
                                 "desired": self._desired,
                                 "queued": queued,
                                 "pending_pgs": pending_pgs,
+                                "serve_queue_depth": serve_depth,
+                                "serve_slo_breached": slo_breached,
                                 "ts": time.time()})
         if (self._idle_ticks >= self._down_after
                 and self._desired > self._min):

@@ -162,6 +162,38 @@ _FLAGS: List[Flag] = [
          "How long WorkerGroup.start waits for the gang's placement "
          "group before failing with PlacementGroupError; the error "
          "names the first bundle the cluster cannot satisfy."),
+    # ---- serve / overload ------------------------------------------------
+    Flag("serve_max_queue_depth", int, 0,
+         "Default per-deployment admission cap: router-local requests in "
+         "flight (admitted, not yet completed) beyond which new requests "
+         "are shed with BackpressureError, lowest priority class first "
+         "(low sheds at 1/3 of the cap, normal at 2/3, high at the full "
+         "cap). 0 = unbounded — admission is a no-op, exactly the "
+         "pre-QoS behavior. Per-deployment 'max_queue_depth' config "
+         "overrides this default."),
+    Flag("serve_replica_wait_s", float, 30.0,
+         "How long the router waits for a running replica to appear "
+         "before failing the request with ReplicaUnavailableError "
+         "(deployment deleted, never deployed, or all replicas down)."),
+    Flag("serve_shutdown_grace_s", float, 15.0,
+         "How long serve controller shutdown waits for backgrounded "
+         "replica stops (graceful_shutdown + kill) to finish before "
+         "returning; past it, stop threads are abandoned."),
+    Flag("serve_ttft_ewma_alpha", float, 0.3,
+         "Smoothing factor for the router's per-replica TTFT EWMA (the "
+         "admission-control wait estimator): higher reacts faster to "
+         "load shifts, lower resists outliers."),
+    Flag("serve_ttft_slo_ms", float, 0.0,
+         "Serving TTFT SLO for the autoscaler demand signal: when > 0, "
+         "a deployment whose recent TTFT p99 (published by the serve "
+         "controller on the 'serve:demand' KV key) exceeds this counts "
+         "as cluster demand even with an empty task queue. 0 disables "
+         "the SLO signal (queue depth still counts)."),
+    Flag("serve_worker_poll_deadline_s", float, 12.0,
+         "In-worker routers drain the controller long-poll ref with "
+         "non-blocking probes for at most this long before re-arming "
+         "(a blocking get would head-of-line block the replica's "
+         "serialized owner connection)."),
     # ---- cluster plane ---------------------------------------------------
     Flag("fetch_chunk_bytes", int, 16 << 20,
          "Chunk size for ranged node-to-node object transfer "

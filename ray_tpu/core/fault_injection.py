@@ -59,6 +59,17 @@ site           key                      actions
                                         Fires driver-side inside
                                         BackendExecutor, so in-process
                                         ``inject`` works
+``serve_overload``  deployment name     ``shed`` — the serve router's
+                                        admission check rejects the
+                                        matching request with
+                                        BackpressureError as if the
+                                        deployment were saturated
+                                        (deterministic overload: the
+                                        typed-shed path fires without
+                                        needing real queue pressure).
+                                        Fires in the router (driver or
+                                        proxy process), so in-process
+                                        ``inject`` works
 =============  =======================  ==================================
 
 Env/config surface: ``RTPU_FAULT_<SITE>=<action>[:<times>[:<match>]]``
@@ -85,7 +96,7 @@ from typing import Dict, List, Optional
 from ray_tpu.util.debug_lock import make_lock
 
 SITES = ("get", "spill", "dispatch", "task", "actor_call",
-         "actor_worker_kill", "gcs_kill", "gang_resize")
+         "actor_worker_kill", "gcs_kill", "gang_resize", "serve_overload")
 
 _lock = make_lock("fault_injection._lock")
 _specs: Dict[str, List[dict]] = {}

@@ -77,6 +77,67 @@ class GcsUnavailableError(RayTpuError, _RpcError):
     treating it as a connectivity failure."""
 
 
+class BackpressureError(RayTpuError):
+    """The serving plane rejected (shed) the request under overload.
+
+    Raised at ADMISSION by the router — before any replica work starts —
+    when the deployment's queue depth exceeds the priority class's share
+    of ``max_queue_depth``, or when the TTFT estimate says the request's
+    deadline cannot be met; and mid-flight when a request's deadline
+    expires (the stream is closed and the engine request cancelled).
+    Structured fields survive pickling across processes via
+    ``__reduce__``: ``deployment`` names the shedding deployment,
+    ``queue_depth`` the router-local depth at rejection,
+    ``estimated_wait_s`` the TTFT-EWMA-based wait estimate, and
+    ``retry_after_s`` a client hint (the HTTP proxy maps this error to
+    429 with a ``Retry-After`` header)."""
+
+    def __init__(self, message: str = "", deployment: str = "",
+                 queue_depth: int = 0, estimated_wait_s: float = 0.0,
+                 retry_after_s: float = 1.0):
+        self.deployment = deployment
+        self.queue_depth = int(queue_depth)
+        self.estimated_wait_s = float(estimated_wait_s)
+        self.retry_after_s = float(retry_after_s)
+        self._message = message
+        detail = []
+        if deployment:
+            detail.append(f"deployment: {deployment!r}")
+        detail.append(f"queue depth: {self.queue_depth}")
+        detail.append(f"estimated wait: {self.estimated_wait_s:.3f}s")
+        detail.append(f"retry after: {self.retry_after_s:.3f}s")
+        super().__init__((message or "request shed under overload")
+                         + " (" + "; ".join(detail) + ")")
+
+    def __reduce__(self):
+        # default exception pickling re-calls __init__ with the COMPOSED
+        # message as args[0], doubling the detail suffix and zeroing the
+        # structured fields — rebuild from the originals instead
+        return (type(self), (self._message, self.deployment,
+                             self.queue_depth, self.estimated_wait_s,
+                             self.retry_after_s))
+
+
+class ReplicaUnavailableError(RayTpuError):
+    """No running replica could be found for a deployment within the
+    router's wait window (``serve_replica_wait_s``): the deployment was
+    deleted, never deployed, or every replica is down/restarting. Unlike
+    ``BackpressureError`` this is not load-dependent — retrying sooner
+    will not help until the control plane brings replicas back. The HTTP
+    proxy maps it to 503."""
+
+    def __init__(self, message: str = "", deployment: str = ""):
+        self.deployment = deployment
+        if not message:
+            message = (f"no running replicas for deployment {deployment!r}"
+                       if deployment else "no running replicas")
+        self._message = message
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (type(self), (self._message, self.deployment))
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     """``get`` did not complete within the requested timeout."""
 

@@ -14,8 +14,18 @@ with STATIC shapes — a fixed set of sequence slots and a preallocated
 per-slot KV cache — because XLA compiles one decode step once and reuses
 it; vLLM-style dynamic paging is a GPU-ism that forces recompilation or
 gather-heavy kernels on TPU (see serve/llm_engine.py).
+
+Overload behavior: deployments carry QoS config (priority class,
+``max_queue_depth``, ``deadline_s``); routers run admission control and
+shed with typed ``BackpressureError`` (429 + Retry-After at the HTTP
+proxy) while a missing replica set surfaces ``ReplicaUnavailableError``
+(503) — both re-exported here.
 """
 
+from ray_tpu.exceptions import (  # noqa: F401
+    BackpressureError,
+    ReplicaUnavailableError,
+)
 from ray_tpu.serve.api import (  # noqa: F401
     Deployment,
     DeploymentHandle,
@@ -40,7 +50,8 @@ from ray_tpu.serve.multiplex import (  # noqa: F401
 )
 
 __all__ = [
-    "Deployment", "DeploymentHandle", "LLMPipeline", "PipelineDeployment",
+    "BackpressureError", "Deployment", "DeploymentHandle", "LLMPipeline",
+    "PipelineDeployment", "ReplicaUnavailableError",
     "batch", "delete", "deploy_config", "deployment",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
     "run", "shutdown", "start", "start_grpc", "status", "stop_grpc",

@@ -62,13 +62,20 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return DeploymentResponse(self._get_router().request(args, kwargs))
 
-    def options(self, *, multiplexed_model_id: Optional[str] = None
-                ) -> "_OptionedHandle":
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                priority: Union[str, int, None] = None,
+                deadline_s: Optional[float] = None) -> "_OptionedHandle":
         """Per-request routing options (reference: handle.options):
         ``multiplexed_model_id`` routes to a replica that already holds
         that model variant and exposes the id to the deployment via
-        serve.get_multiplexed_model_id()."""
-        return _OptionedHandle(self, multiplexed_model_id)
+        serve.get_multiplexed_model_id(). ``priority`` ("low"/"normal"/
+        "high" or 0..2) and ``deadline_s`` override the deployment's QoS
+        defaults for requests issued through the returned handle view —
+        under overload, lower classes shed first and requests whose
+        deadline the router estimates unmeetable are rejected with
+        BackpressureError."""
+        return _OptionedHandle(self, multiplexed_model_id,
+                               priority=priority, deadline_s=deadline_s)
 
     def stream(self, *args, **kwargs):
         """Streaming responses: for generator deployments (the callable
@@ -96,27 +103,51 @@ class DeploymentHandle:
 
 
 class _OptionedHandle:
-    """Handle view carrying per-request options (multiplexed model id).
-    Supports the full handle surface: remote/stream/options chaining."""
+    """Handle view carrying per-request options (multiplexed model id,
+    priority class, deadline). Supports the full handle surface:
+    remote/stream/options chaining."""
 
     def __init__(self, handle: DeploymentHandle,
-                 multiplexed_model_id: Optional[str]):
+                 multiplexed_model_id: Optional[str],
+                 priority: Union[str, int, None] = None,
+                 deadline_s: Optional[float] = None):
+        from ray_tpu.serve.qos import normalize_priority
+
         self._handle = handle
         self._model_id = multiplexed_model_id
+        # validate eagerly so a typo'd class name fails at .options(),
+        # not deep in a router thread
+        self._priority = (None if priority is None
+                          else normalize_priority(priority))
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be positive (got {deadline_s})")
+        self._deadline_s = deadline_s
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return DeploymentResponse(self._handle._get_router().request(
-            args, kwargs, model_id=self._model_id))
+            args, kwargs, model_id=self._model_id,
+            priority=self._priority, deadline_s=self._deadline_s))
 
-    def options(self, *, multiplexed_model_id: Optional[str] = None
-                ) -> "_OptionedHandle":
-        return _OptionedHandle(self._handle, multiplexed_model_id)
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                priority: Union[str, int, None] = None,
+                deadline_s: Optional[float] = None) -> "_OptionedHandle":
+        # unset fields inherit from this view so chained .options()
+        # calls compose instead of resetting
+        return _OptionedHandle(
+            self._handle,
+            (multiplexed_model_id if multiplexed_model_id is not None
+             else self._model_id),
+            priority=priority if priority is not None else self._priority,
+            deadline_s=(deadline_s if deadline_s is not None
+                        else self._deadline_s))
 
     def stream(self, *args, **kwargs):
         # the router rejects model_id only where it genuinely can't be
         # honored (engine mailbox); generator streams route mux-aware
         return self._handle._get_router().stream_request(
-            args, kwargs, model_id=self._model_id)
+            args, kwargs, model_id=self._model_id,
+            priority=self._priority, deadline_s=self._deadline_s)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
@@ -145,6 +176,11 @@ class Deployment:
     def options(self, **kwargs) -> "Deployment":
         d = Deployment(self._target, kwargs.pop("name", self.name),
                        {**self.config, **kwargs})
+        if any(k in d.config for k in ("priority", "max_queue_depth",
+                                       "deadline_s")):
+            from ray_tpu.serve.qos import qos_from_config
+
+            qos_from_config(d.config)  # validate eagerly, not at deploy
         d._init_args, d._init_kwargs = self._init_args, self._init_kwargs
         return d
 
@@ -163,8 +199,20 @@ def deployment(_target=None, *, name: Optional[str] = None,
                num_replicas: int = 1, num_cpus: float = 0.1,
                num_tpus: float = 0, resources: Optional[dict] = None,
                max_batch_size: int = 0, batch_wait_timeout_s: float = 0.01,
-               engine: bool = False, **extra):
-    """Decorator: wrap a class or function as a Deployment."""
+               engine: bool = False,
+               priority: Union[str, int, None] = None,
+               max_queue_depth: Optional[int] = None,
+               deadline_s: Optional[float] = None, **extra):
+    """Decorator: wrap a class or function as a Deployment.
+
+    QoS knobs (overload behavior; all optional, all overridable per
+    request via ``handle.options()``): ``priority`` is the deployment's
+    default priority class ("low"/"normal"/"high" or 0..2 — lower
+    classes shed first under pressure), ``max_queue_depth`` bounds the
+    per-router admission queue (0/unset = unbounded, falling back to the
+    ``serve_max_queue_depth`` flag), ``deadline_s`` is a default
+    end-to-end completion deadline — requests the router estimates
+    unmeetable are rejected at admission with BackpressureError."""
     def wrap(target):
         if extra.get("autoscaling_config") and num_replicas != 1:
             raise ValueError(
@@ -179,6 +227,17 @@ def deployment(_target=None, *, name: Optional[str] = None,
             cfg["num_tpus"] = num_tpus
         if resources:
             cfg["resources"] = resources
+        if priority is not None:
+            cfg["priority"] = priority
+        if max_queue_depth is not None:
+            cfg["max_queue_depth"] = max_queue_depth
+        if deadline_s is not None:
+            cfg["deadline_s"] = deadline_s
+        if any(k in cfg for k in ("priority", "max_queue_depth",
+                                  "deadline_s")):
+            from ray_tpu.serve.qos import qos_from_config
+
+            qos_from_config(cfg)  # validate at decoration time
         return Deployment(target, name or target.__name__, cfg)
     return wrap(_target) if _target is not None else wrap
 

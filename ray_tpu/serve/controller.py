@@ -12,12 +12,18 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
 
 HEALTH_CHECK_PERIOD_S = 1.0
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+#: KV rendezvous key the controller publishes serve demand under; the
+#: cluster autoscaler (autoscaler_v2) reads it so serve queue depth and
+#: TTFT percentiles count as demand alongside task queues + pending PGs.
+SERVE_DEMAND_KEY = "serve:demand"
+_DEMAND_PUBLISH_PERIOD_S = 0.5
 
 
 class _ReplicaInfo:
@@ -48,13 +54,17 @@ class _DeploymentInfo:
         # autoscaling state: router load reports + pending decision
         self.loads: Dict[str, tuple] = {}   # router_id -> (load, ts)
         self.desired_since: Optional[tuple] = None  # (desired, since_ts)
+        # QoS telemetry: router-local admission depths and recent TTFT
+        # samples (ms), aggregated into the serve:demand KV signal
+        self.depths: Dict[str, tuple] = {}  # router_id -> (depth, ts)
+        self.ttft_ms: deque = deque(maxlen=512)
 
     @staticmethod
-    def _initial_target(config: dict) -> int:
-        au = config.get("autoscaling_config")
+    def _initial_target(cfg: dict) -> int:
+        au = cfg.get("autoscaling_config")
         if au:
             return int(au.get("min_replicas", 1))
-        return int(config.get("num_replicas", 1))
+        return int(cfg.get("num_replicas", 1))
 
 
 class ServeController:
@@ -112,14 +122,24 @@ class ServeController:
             info.target = int(num_replicas)
             info.config["num_replicas"] = int(num_replicas)
 
-    def report_load(self, name: str, router_id: str, load: int) -> None:
+    def report_load(self, name: str, router_id: str, load: int,
+                    queue_depth: Optional[int] = None,
+                    ttft_ms: Optional[List[float]] = None) -> None:
         """Routers push their in-flight count per deployment (reference:
         handles push autoscaling metrics to the controller); reports
-        expire so a vanished router stops counting."""
+        expire so a vanished router stops counting. QoS-era routers also
+        carry their admission queue depth and the TTFT samples observed
+        since the last report — both default None so old-signature
+        callers keep working."""
         with self._lock:
             info = self._deployments.get(name)
             if info is not None:
-                info.loads[router_id] = (int(load), time.monotonic())
+                now = time.monotonic()
+                info.loads[router_id] = (int(load), now)
+                if queue_depth is not None:
+                    info.depths[router_id] = (int(queue_depth), now)
+                if ttft_ms:
+                    info.ttft_ms.extend(float(x) for x in ttft_ms)
 
     def get_replicas(self, name: str):
         """(version, [(replica_id, actor_name)]) for router refresh."""
@@ -196,6 +216,8 @@ class ServeController:
             return dict(info.config) if info else None
 
     def status(self) -> Dict[str, Any]:
+        from ray_tpu.serve.qos import percentile
+
         with self._lock:
             return {
                 name: {
@@ -206,9 +228,33 @@ class ServeController:
                                     if r.state == "STARTING"),
                     "version": info.version,
                     "deleting": info.deleting,
+                    "queue_depth": sum(d for d, _ in info.depths.values()),
+                    "ttft_p50_ms": percentile(info.ttft_ms, 50),
+                    "ttft_p99_ms": percentile(info.ttft_ms, 99),
                 }
                 for name, info in self._deployments.items()
             }
+
+    def demand_snapshot(self) -> Dict[str, Any]:
+        """The serve-demand signal as published to the ``serve:demand``
+        KV key (minus the timestamp): per-deployment admission queue
+        depth (summed over live routers) and TTFT percentiles over the
+        recent sample window."""
+        from ray_tpu.serve.qos import percentile
+
+        now = time.monotonic()
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, info in self._deployments.items():
+                for rid, (_, ts) in list(info.depths.items()):
+                    if now - ts >= 3.0:  # vanished router: expire like loads
+                        del info.depths[rid]
+                out[name] = {
+                    "queue_depth": sum(d for d, _ in info.depths.values()),
+                    "ttft_p50_ms": percentile(info.ttft_ms, 50),
+                    "ttft_p99_ms": percentile(info.ttft_ms, 99),
+                }
+        return out
 
     def wait_healthy(self, name: str, timeout: float = 60.0) -> bool:
         deadline = time.monotonic() + timeout
@@ -234,21 +280,46 @@ class ServeController:
         # wait for the backgrounded stops: returning before replicas (and
         # their DAG stage actors) are gone would leak them past process
         # teardown
-        deadline = time.monotonic() + 15.0
+        from ray_tpu.core.config import config
+
+        deadline = time.monotonic() + config.serve_shutdown_grace_s
         for t in getattr(self, "_stop_threads", []):
             t.join(max(0.1, deadline - time.monotonic()))
 
     # --------------------------------------------------------- control loop
 
     def _control_loop(self):
+        last_publish = 0.0
         while not self._stop:
             try:
                 self._reconcile()
                 self._health_check()
                 self._notify_topology_changes()
+                now = time.monotonic()
+                if now - last_publish >= _DEMAND_PUBLISH_PERIOD_S:
+                    last_publish = now
+                    self._publish_demand()
             except Exception:  # noqa: BLE001 — the loop must survive
                 pass
             time.sleep(0.1)
+
+    def _publish_demand(self):
+        """Push the serve-demand signal to the cluster KV so the node
+        autoscaler sees serving pressure (queue depth, TTFT percentiles)
+        as demand, not just task queues and pending placement groups.
+        Best-effort: a missing core (unit tests instantiate the
+        controller in-process) or KV hiccup skips the publish — the next
+        tick retries."""
+        from ray_tpu.core import runtime_context
+
+        core = runtime_context.get_core_or_none()
+        if core is None:
+            return
+        payload = {"ts": time.time(), "deployments": self.demand_snapshot()}
+        try:
+            core.kv_op("put", SERVE_DEMAND_KEY, payload)
+        except Exception:  # noqa: BLE001 — telemetry only, never fatal
+            pass
 
     def _notify_topology_changes(self):
         """Push side of the long-poll channel: one fingerprint sweep per

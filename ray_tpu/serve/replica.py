@@ -61,6 +61,7 @@ class ReplicaActor:
     def handle(self, args: tuple, kwargs: dict) -> Any:
         from ray_tpu.serve.multiplex import _MUX_KWARG, _current_model_id
 
+        self._check_deadline(kwargs)
         mid = kwargs.pop(_MUX_KWARG, None)
         if mid is not None:
             token = _current_model_id.set(mid)
@@ -69,6 +70,24 @@ class ReplicaActor:
             finally:
                 _current_model_id.reset(token)
         return self._call(*args, **kwargs)
+
+    @staticmethod
+    def _check_deadline(kwargs: dict) -> None:
+        """Requests carry their wall-clock deadline in an internal kwarg
+        (the router injects it); one already expired by the time it
+        reaches the replica — queued behind slow work — is shed here
+        with BackpressureError instead of burning compute on a result
+        the client stopped waiting for."""
+        import time
+
+        from ray_tpu.exceptions import BackpressureError
+        from ray_tpu.serve.router import _DEADLINE_KWARG
+
+        deadline = kwargs.pop(_DEADLINE_KWARG, None)
+        if deadline is not None and time.time() > deadline:
+            raise BackpressureError(
+                "request shed at replica: deadline expired before "
+                "execution started")
 
     def handle_stream(self, args: tuple, kwargs: dict):
         """Generator deployments: invoked with num_returns="streaming" so

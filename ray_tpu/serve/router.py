@@ -1,10 +1,20 @@
-"""Request router: replica choice, dynamic batching, engine polling.
+"""Request router: replica choice, admission control, dynamic batching,
+engine polling.
 
 Power-of-two-choices over router-local in-flight counts (reference:
 serve/_private/replica_scheduler/pow_2_scheduler.py:51 — the reference
 also uses caller-local accounting). Batching buffers requests per
 deployment and flushes on max_batch_size or batch_wait_timeout_s
 (reference: serve/batching.py:80 _BatchQueue).
+
+Overload: when the deployment carries QoS config (priority /
+max_queue_depth / deadline_s — see serve/qos.py), every request and
+stream passes an admission check BEFORE any replica work starts: depth
+over the priority class's share of max_queue_depth, or an estimated
+wait (TTFT EWMA x queue depth) past the request's deadline, sheds the
+request with a typed BackpressureError carrying the depth, the
+estimate, and a retry-after hint. With no QoS config the admission path
+is a no-op — exactly the pre-QoS router.
 """
 
 from __future__ import annotations
@@ -18,7 +28,40 @@ from typing import Any, Dict, List, Optional, Tuple
 import weakref
 
 import ray_tpu
-from ray_tpu.exceptions import ActorDiedError
+from ray_tpu.core import fault_injection
+from ray_tpu.core.config import config
+from ray_tpu.exceptions import (ActorDiedError, BackpressureError,
+                                ReplicaUnavailableError, TaskError)
+from ray_tpu.serve.qos import (TtftEstimator, depth_limit,
+                               normalize_priority, qos_from_config,
+                               retry_after_hint)
+
+#: internal kwarg carrying a request's wall-clock deadline to the
+#: replica (popped in ReplicaActor.handle, same pattern as _MUX_KWARG)
+_DEADLINE_KWARG = "__rtpu_deadline_wall__"
+
+
+class _DepthToken:
+    """One admitted request's depth accounting. ``release`` is
+    idempotent, usable directly as a Future done-callback, and also
+    fires from ``__del__`` so an abandoned (never-iterated) stream
+    generator cannot leak queue depth."""
+
+    __slots__ = ("_router", "_released")
+
+    def __init__(self, router: "Router"):
+        self._router = router
+        self._released = False
+
+    def release(self, *_):
+        if self._released:
+            return
+        self._released = True
+        r = self._router
+        with r._lock:
+            r._depth = max(0, r._depth - 1)
+
+    __del__ = release
 
 # process-local registry so serve.delete/shutdown can stop the reporting
 # threads of routers whose handles are still alive in this process
@@ -62,12 +105,24 @@ class Router:
         self._batch_thread: Optional[threading.Thread] = None
         self._engine_state: Dict[str, Any] = {}
         self._req_seq = 0
-        # load reporting feeds controller autoscaling (reference: handles
-        # push autoscaling metrics); only started when the deployment has
-        # an autoscaling_config
+        # QoS: deployment-level priority class, admission depth cap, and
+        # default deadline; the TTFT estimator drives deadline admission
+        # and feeds percentiles to the controller's demand signal
+        self._qos = qos_from_config(cfg)
+        self._depth = 0  # admitted, not yet completed (all paths)
+        self._ttft = TtftEstimator(config.serve_ttft_ewma_alpha)
+        qos_active = (self._qos["max_queue_depth"] > 0
+                      or self._qos["deadline_s"] is not None
+                      or "priority" in cfg)
+        # load reporting feeds controller autoscaling and the serve
+        # demand signal (reference: handles push autoscaling metrics);
+        # started when the deployment autoscales OR carries QoS config
+        # (the controller aggregates depth + TTFT percentiles for the
+        # autoscaler's serve:demand KV key)
         self._autoscaling = bool(cfg.get("autoscaling_config"))
+        self._report_enabled = self._autoscaling or qos_active
         self._report_thread: Optional[threading.Thread] = None
-        if self._autoscaling:
+        if self._report_enabled:
             import os as _os
             import uuid as _uuid
 
@@ -76,6 +131,68 @@ class Router:
             self._router_id = f"router-{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
             self._ensure_report_thread()
         self._ensure_topology_thread()
+
+    # ----------------------------------------------------------- admission
+
+    def _resolve_qos(self, priority, deadline_s) -> Tuple[int, Optional[float]]:
+        """Per-request QoS: handle.options overrides beat the
+        deployment-level defaults."""
+        pr = (self._qos["priority"] if priority is None
+              else normalize_priority(priority))
+        dl = (self._qos["deadline_s"] if deadline_s is None
+              else float(deadline_s))
+        if dl is not None and dl <= 0:
+            raise ValueError(f"deadline_s must be positive (got {dl})")
+        return pr, dl
+
+    def _shed(self, message: str, depth: int) -> BackpressureError:
+        mean = self._ttft.mean_ttft_s()
+        with self._lock:
+            n = max(1, len(self._replicas))
+        est = self._ttft.estimated_wait_s(depth, n)
+        return BackpressureError(
+            message, deployment=self._name, queue_depth=depth,
+            estimated_wait_s=est,
+            retry_after_s=retry_after_hint(est, mean))
+
+    def _admit(self, priority: int,
+               deadline_s: Optional[float]) -> Optional[_DepthToken]:
+        """Admission check, run BEFORE any replica work: sheds with
+        BackpressureError, or returns the depth token the caller must
+        release at completion (None when QoS is off — the counter is
+        then never touched, keeping the pre-QoS path byte-identical)."""
+        if fault_injection.enabled():
+            action = fault_injection.fire("serve_overload", self._name)
+            if action == "shed":
+                with self._lock:
+                    depth = self._depth
+                raise self._shed(
+                    "request shed (injected serve_overload)", depth)
+        max_depth = self._qos["max_queue_depth"]
+        if max_depth <= 0 and deadline_s is None:
+            return None
+        # wait estimate outside the router lock (the estimator has its
+        # own); the depth check+increment is one critical section so
+        # concurrent admissions cannot both pass the last slot
+        limit = depth_limit(max_depth, priority)
+        with self._lock:
+            depth = self._depth
+            n = max(1, len(self._replicas))
+        est = self._ttft.estimated_wait_s(depth, n)
+        if deadline_s is not None and est > deadline_s:
+            raise self._shed(
+                f"request shed: estimated wait {est:.3f}s exceeds the "
+                f"{deadline_s:.3f}s deadline", depth)
+        with self._lock:
+            if limit and self._depth >= limit:
+                depth = self._depth
+            else:
+                self._depth += 1
+                return _DepthToken(self)
+        raise self._shed(
+            "request shed: queue depth at the priority class's "
+            f"admission share ({depth}/{limit} of "
+            f"max_queue_depth={max_depth})", depth)
 
     def _ensure_topology_thread(self):
         """(Re)start the long-poll topology listener. Replica-set and
@@ -116,7 +233,8 @@ class Router:
                 ref = self._controller.listen_for_change.remote(
                     {key: self._snapshot}, 10.0)
                 if in_worker:
-                    deadline = time.monotonic() + 12.0
+                    deadline = (time.monotonic()
+                                + config.serve_worker_poll_deadline_s)
                     while (not self._stop_reporting
                            and time.monotonic() < deadline):
                         ready, _ = ray_tpu.wait([ref], num_returns=1,
@@ -168,7 +286,7 @@ class Router:
         deployment, controller outage, stop() — but that then routes NEW
         traffic must become visible to the autoscaler again, or its
         in-flight load is invisible and replicas scale to min under load."""
-        if not self._autoscaling:
+        if not self._report_enabled:
             return
         with self._lock:  # check-then-start must not race concurrent calls
             t = self._report_thread
@@ -188,8 +306,10 @@ class Router:
                 try:
                     with self._lock:
                         load = sum(self._inflight.values())
+                        depth = self._depth
                     ref = self._controller.report_load.remote(
-                        self._name, self._router_id, load)
+                        self._name, self._router_id, load,
+                        max(load, depth), self._ttft.drain_samples())
                     if prev_ref is not None:
                         # free the previous report's return entry — a
                         # periodic fire-and-forget would otherwise grow
@@ -226,6 +346,11 @@ class Router:
         and serve.delete/shutdown via the process-local registry)."""
         self._stop_reporting = True
 
+    def _observe_ttft(self, rid: str, dt_s: float):
+        """Feed an observed TTFT (streams: submit to first chunk; unary
+        paths: full call latency as the proxy) into the estimator."""
+        self._ttft.observe(rid, dt_s)
+
     # ------------------------------------------------------------- replicas
 
     def _refresh(self, force: bool = False):
@@ -258,7 +383,7 @@ class Router:
         multiplexed ``model_id``, prefer the replica that already loaded
         that variant (reference: multiplex-aware replica scheduler) unless
         it is clearly overloaded vs the pow-2 alternative."""
-        deadline = time.monotonic() + 30
+        deadline = time.monotonic() + config.serve_replica_wait_s
         while True:
             self._refresh()
             with self._lock:
@@ -266,8 +391,7 @@ class Router:
             if replicas:
                 break
             if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no running replicas for deployment {self._name!r}")
+                raise ReplicaUnavailableError(deployment=self._name)
             time.sleep(0.05)
         if model_id is not None:
             with self._lock:
@@ -299,11 +423,13 @@ class Router:
         with self._lock:
             self._replicas = [r for r in self._replicas if r[0] != rid]
             self._inflight.pop(rid, None)
+        self._ttft.drop_replica(rid)
 
     # --------------------------------------------------------------- routing
 
     def request(self, args: tuple, kwargs: dict,
-                model_id: Optional[str] = None) -> Future:
+                model_id: Optional[str] = None,
+                priority=None, deadline_s: Optional[float] = None) -> Future:
         self._ensure_report_thread()
         if model_id is not None and (self._engine or self._max_batch > 1):
             # engine mailboxes and dynamic batches mix requests across
@@ -312,7 +438,14 @@ class Router:
             raise ValueError(
                 "multiplexed_model_id is not supported for engine or "
                 "batched deployments")
+        pr, dl = self._resolve_qos(priority, deadline_s)
+        token = self._admit(pr, dl)  # sheds with BackpressureError
         fut: Future = Future()
+        if token is not None:
+            fut.add_done_callback(token.release)
+        # wall-clock (cross-process) completion deadline: the replica
+        # rejects requests that are already late at execution start
+        deadline_wall = None if dl is None else time.time() + dl
         if self._engine:
             threading.Thread(target=self._engine_request,
                              args=(args, kwargs, fut), daemon=True).start()
@@ -325,11 +458,15 @@ class Router:
                     self._batch_thread.start()
         else:
             threading.Thread(target=self._unary_request,
-                             args=(args, kwargs, fut, model_id),
+                             args=(args, kwargs, fut, model_id,
+                                   deadline_wall),
                              daemon=True).start()
         return fut
 
     def call_method(self, method: str, args: tuple, kwargs: dict) -> Future:
+        # control-plane calls (handle.<method>.remote): no admission —
+        # shedding management traffic under data-plane overload would
+        # block the operator's way out
         self._ensure_report_thread()
         fut: Future = Future()
 
@@ -338,7 +475,7 @@ class Router:
             for _ in range(3):
                 try:
                     rid, handle = self._pick()
-                except RuntimeError as e:
+                except ReplicaUnavailableError as e:
                     fut.set_exception(e)
                     return
                 with self._lock:
@@ -363,28 +500,40 @@ class Router:
         threading.Thread(target=run, daemon=True).start()
         return fut
 
-    def _unary_request(self, args, kwargs, fut: Future, model_id=None):
+    def _unary_request(self, args, kwargs, fut: Future, model_id=None,
+                       deadline_wall: Optional[float] = None):
         from ray_tpu.serve.multiplex import _MUX_KWARG
 
         if model_id is not None:
             kwargs = dict(kwargs, **{_MUX_KWARG: model_id})
+        if deadline_wall is not None:
+            kwargs = dict(kwargs, **{_DEADLINE_KWARG: deadline_wall})
         err: Optional[BaseException] = None
         for _ in range(3):  # retry across replicas on replica death
             try:
                 rid, handle = self._pick(model_id)
-            except RuntimeError as e:
+            except ReplicaUnavailableError as e:
                 fut.set_exception(e)
                 return
             with self._lock:
                 self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            t0 = time.monotonic()
             try:
                 out = ray_tpu.get(handle.handle.remote(args, kwargs))
+                self._observe_ttft(rid, time.monotonic() - t0)
                 fut.set_result(out)
                 return
             except ActorDiedError as e:
                 self._drop_replica(rid)
                 self._refresh(force=True)
                 err = e
+            except TaskError as e:
+                # surface the replica's typed shed (deadline expired
+                # before execution) unwrapped, like a router-side shed
+                cause = e.cause
+                fut.set_exception(
+                    cause if isinstance(cause, BackpressureError) else e)
+                return
             except BaseException as e:  # noqa: BLE001 — application error
                 fut.set_exception(e)
                 return
@@ -422,13 +571,16 @@ class Router:
         for _ in range(3):
             try:
                 rid, handle = self._pick()
-            except RuntimeError as e:
-                err = e
-                break
+            except ReplicaUnavailableError as e:
+                for f in futs:
+                    f.set_exception(e)
+                return
             with self._lock:
                 self._inflight[rid] = self._inflight.get(rid, 0) + len(batch)
+            t0 = time.monotonic()
             try:
                 outs = ray_tpu.get(handle.handle_batch.remote(reqs))
+                self._observe_ttft(rid, time.monotonic() - t0)
                 for f, o in zip(futs, outs):
                     f.set_result(o)
                 return
@@ -449,17 +601,27 @@ class Router:
     # ---------------------------------------------------------------- engine
 
     def stream_request(self, args, kwargs, timeout_s: float = 600.0,
-                       model_id: Optional[str] = None):
+                       model_id: Optional[str] = None,
+                       priority=None, deadline_s: Optional[float] = None):
         """Streaming entry point. Generator deployments (the callable
         uses ``yield``) ride ``num_returns="streaming"`` actor calls:
         each yielded item seals into the object store as produced and is
         pulled here via ObjectRefGenerator. Engine deployments (LLM
         continuous batching) fall back to the submit/peek mailbox. A
-        deployment that is neither fails with a clear TypeError."""
+        deployment that is neither fails with a clear TypeError.
+
+        Admission runs EAGERLY — in this call, not on first iteration —
+        so a shed surfaces as a raised BackpressureError the caller (and
+        the HTTP proxy's status-line mapping) sees before any bytes
+        stream. A request whose deadline expires mid-stream is shed
+        typed too: the stream raises BackpressureError after cancelling
+        the replica-side work."""
         self._ensure_report_thread()
+        pr, dl = self._resolve_qos(priority, deadline_s)
         if self._streaming and not self._engine:
+            token = self._admit(pr, dl)
             return self._generator_stream(args, kwargs, timeout_s,
-                                          model_id)
+                                          model_id, token, dl)
         if not self._engine:
             raise TypeError(
                 f"deployment {self._name!r} is neither a generator nor "
@@ -471,10 +633,13 @@ class Router:
             raise ValueError(
                 "multiplexed_model_id is not supported for engine "
                 "streaming deployments")
-        return self._engine_stream(args, kwargs, timeout_s)
+        token = self._admit(pr, dl)
+        return self._engine_stream(args, kwargs, timeout_s, token, dl)
 
     def _generator_stream(self, args, kwargs, timeout_s: float,
-                          model_id: Optional[str]):
+                          model_id: Optional[str],
+                          token: Optional[_DepthToken] = None,
+                          deadline_s: Optional[float] = None):
         """Consume a generator replica: one streaming actor call, yield
         each item as its ref arrives (backpressure rides the stream's
         credit window, so a slow consumer stalls the replica's yields)."""
@@ -486,14 +651,27 @@ class Router:
         rid, handle = self._pick(model_id)
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
-        deadline = time.monotonic() + timeout_s
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        req_deadline = None if deadline_s is None else t0 + deadline_s
+        first = True
         gen = None
         try:
             gen = handle.handle_stream.options(
                 num_returns="streaming").remote(args, kwargs)
             while True:
-                remaining = deadline - time.monotonic()
+                now = time.monotonic()
+                remaining = deadline - now
+                if req_deadline is not None:
+                    remaining = min(remaining, req_deadline - now)
                 if remaining <= 0:
+                    if (req_deadline is not None
+                            and req_deadline <= deadline):
+                        # mid-flight shed: deadline expired while
+                        # streaming — close typed, not a generic timeout
+                        raise self._shed(
+                            f"stream shed: {deadline_s:.3f}s deadline "
+                            f"expired mid-flight", self._depth)
                     raise TimeoutError(
                         f"stream exceeded {timeout_s}s")
                 try:
@@ -502,8 +680,10 @@ class Router:
                     gen = None  # drained: nothing to cancel
                     return
                 except ObjectTimeoutError:
-                    raise TimeoutError(
-                        f"stream exceeded {timeout_s}s") from None
+                    continue  # deadline check at loop top decides
+                if first:
+                    first = False
+                    self._observe_ttft(rid, time.monotonic() - t0)
                 yield ray_tpu.get(ref)
         except ActorDiedError:
             self._drop_replica(rid)
@@ -519,20 +699,29 @@ class Router:
             with self._lock:
                 if rid in self._inflight:  # dropped replicas stay dropped
                     self._inflight[rid] = max(0, self._inflight[rid] - 1)
+            if token is not None:
+                token.release()
 
-    def _engine_stream(self, args, kwargs, timeout_s: float):
+    def _engine_stream(self, args, kwargs, timeout_s: float,
+                       token: Optional[_DepthToken] = None,
+                       deadline_s: Optional[float] = None):
         """Generator over an engine request's progress: yields lists of
         NEW tokens as they are generated, ending after the final chunk
         (reference: serve streaming responses / vLLM token streaming).
         Requires an engine with ``peek`` (the LLM engine); bounded by
-        ``timeout_s`` overall."""
+        ``timeout_s`` overall and, when the request carries a deadline,
+        shed typed (BackpressureError, generation cancelled) the moment
+        the deadline expires mid-flight."""
         with self._lock:
             self._req_seq += 1
             req_id = f"s{id(self)}-{self._req_seq}"
         rid, handle = self._pick()
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
-        deadline = time.monotonic() + timeout_s
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        req_deadline = None if deadline_s is None else t0 + deadline_s
+        first = True
         collected = False
         try:
             ray_tpu.get(handle.submit.remote(req_id, *args, **kwargs))
@@ -555,6 +744,10 @@ class Router:
                         raise RuntimeError(snap["error"])
                     new = snap["tokens"]
                     if new:
+                        if first:
+                            first = False
+                            self._observe_ttft(rid,
+                                               time.monotonic() - t0)
                         yield new
                         sent = snap["offset"] + len(new)
                     if snap["done"]:
@@ -562,7 +755,14 @@ class Router:
                         ray_tpu.get(handle.collect.remote([req_id]),
                                     timeout=60)
                         return
-                if time.monotonic() > deadline:
+                now = time.monotonic()
+                if req_deadline is not None and now > req_deadline:
+                    # mid-flight shed: the finally block cancels the
+                    # engine request so no generation leaks
+                    raise self._shed(
+                        f"stream shed: {deadline_s:.3f}s deadline "
+                        f"expired mid-flight", self._depth)
+                if now > deadline:
                     raise TimeoutError(
                         f"stream {req_id} exceeded {timeout_s}s")
                 time.sleep(0.005)
@@ -580,6 +780,8 @@ class Router:
             with self._lock:
                 if rid in self._inflight:  # dropped replicas stay dropped
                     self._inflight[rid] = max(0, self._inflight[rid] - 1)
+            if token is not None:
+                token.release()
 
     def _engine_request(self, args, kwargs, fut: Future):
         """Submit to an engine replica's mailbox and poll its collect()."""
@@ -588,9 +790,14 @@ class Router:
             req_id = f"r{id(self)}-{self._req_seq}"
         try:
             rid, handle = self._pick()
-        except RuntimeError as e:
+        except ReplicaUnavailableError as e:
             fut.set_exception(e)
             return
+        t0 = time.monotonic()
+        fut.add_done_callback(
+            lambda f: (f.exception() is None
+                       and self._observe_ttft(rid,
+                                              time.monotonic() - t0)))
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             st = self._engine_state.setdefault(rid, {

@@ -30,6 +30,16 @@ These shapes are flagged:
    lost run. Same handling test as ActorDiedError, with the resize verbs
    (resize, shrink, grow, abort, interrupt, drain) also counting as
    routing.
+
+5. Dropped ``BackpressureError`` / ``ReplicaUnavailableError`` (checked
+   in ``ray_tpu/serve/`` too — via ``analyze``'s ``signal_files``
+   argument, which applies ONLY the typed-signal checks, not the broad
+   catch/swallow rules: serve is full of legitimate best-effort
+   cleanup): the overload contract routes every shed to the caller as a
+   typed error — a handler that swallows one turns a deliberate 429/503
+   into a silent hang or a dropped request. The routing/shedding verbs
+   (shed, reject, admit, requeue, set_exception, backpressure) count as
+   handling alongside the restart verbs.
 """
 
 from __future__ import annotations
@@ -46,6 +56,8 @@ _RESTART_HINTS = ("restart", "retry", "resubmit", "replay", "resolve",
                   "convert")
 _RESIZE_HINTS = _RESTART_HINTS + ("resize", "shrink", "grow", "abort",
                                   "interrupt", "drain")
+_QOS_HINTS = _RESTART_HINTS + ("shed", "reject", "admit", "requeue",
+                               "set_exception", "backpressure")
 
 
 def _exc_names(type_node: Optional[ast.AST]) -> List[str]:
@@ -118,6 +130,25 @@ def _handles_actor_death(handler: ast.ExceptHandler) -> bool:
     return _handles_signal(handler, _RESTART_HINTS)
 
 
+def _signal_findings(sf: SourceFile, node: ast.ExceptHandler,
+                     names: List[str], fn: Optional[str]
+                     ) -> List[Finding]:
+    """The typed-overload-signal checks, shared between the full
+    recovery-surface pass and the serve/ signal-only pass."""
+    findings: List[Finding] = []
+    for sig in ("BackpressureError", "ReplicaUnavailableError"):
+        if sig in names and not _handles_signal(node, _QOS_HINTS):
+            if fn is None:
+                fn = enclosing_function_name(sf.tree, node)
+            findings.append(Finding(
+                "L4", sf.relpath, node.lineno,
+                f"{fn}: catches {sig} without re-raising, converting, "
+                f"or routing it to the caller (shed/reject/"
+                f"set_exception) — swallowing a typed shed turns a "
+                f"deliberate rejection into a silent drop"))
+    return findings
+
+
 def analyze_file(sf: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
     for node in ast.walk(sf.tree):
@@ -164,11 +195,30 @@ def analyze_file(sf: SourceFile) -> List[Finding]:
                     f"{fn}: catches {sig} without re-raising, converting, "
                     f"or routing into gang resize/restart — swallowing "
                     f"the signal strands the surviving ranks"))
+        findings.extend(_signal_findings(sf, node, names, fn))
     return findings
 
 
-def analyze(files: List[SourceFile]) -> List[Finding]:
+def analyze_signals_file(sf: SourceFile) -> List[Finding]:
+    """Signal-only pass for ``ray_tpu/serve/``: flag dropped
+    BackpressureError/ReplicaUnavailableError handlers without imposing
+    the recovery surface's broad-catch rules on serve's best-effort
+    cleanup idiom."""
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        findings.extend(
+            _signal_findings(sf, node, _exc_names(node.type), None))
+    return findings
+
+
+def analyze(files: List[SourceFile],
+            signal_files: Optional[List[SourceFile]] = None
+            ) -> List[Finding]:
     out: List[Finding] = []
     for sf in files:
         out.extend(analyze_file(sf))
+    for sf in signal_files or []:
+        out.extend(analyze_signals_file(sf))
     return out

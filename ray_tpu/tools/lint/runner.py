@@ -8,7 +8,10 @@ Scopes (mirroring where each invariant lives):
 - L4 runs over ``ray_tpu/core/``, ``ray_tpu/train/``, and
   ``ray_tpu/parallel/`` (the recovery-contract surface — elastic
   training extends the contract to TrainingWorkerError and
-  CollectiveAbortedError);
+  CollectiveAbortedError), plus ``ray_tpu/serve/`` for the
+  typed-overload-signal checks ONLY (dropped BackpressureError /
+  ReplicaUnavailableError handlers — serve's best-effort cleanup idiom
+  is exempt from the broad-catch rules);
 - L3 runs over the whole ``ray_tpu/`` package (flags are read
   everywhere) plus ``tests/`` for the fault-site coverage check;
 - L5 runs over ``ray_tpu/core/`` (including ``core/cluster/``) and
@@ -70,7 +73,8 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
         return by_rel.get(rel)
 
     core_files: List[SourceFile] = []
-    recovery_files: List[SourceFile] = []   # L4 scope
+    recovery_files: List[SourceFile] = []   # L4 scope (full rules)
+    serve_files: List[SourceFile] = []      # L4 scope (signal-only)
     lock_files: List[SourceFile] = []       # L5 scope
     thread_files: List[SourceFile] = []     # L6 scope
     all_files: List[SourceFile] = []
@@ -85,6 +89,8 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
         if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
                            "ray_tpu/parallel/")):
             recovery_files.append(sf)
+        if rel.startswith("ray_tpu/serve/"):
+            serve_files.append(sf)
         if rel.startswith(("ray_tpu/core/", "ray_tpu/train/")):
             lock_files.append(sf)
         if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
@@ -117,7 +123,8 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
                 l3_config.analyze(config_sf, fault_sf, all_files)
                 + l3_config.fault_site_coverage(fault_sf, test_files))
     if "L4" in rules:
-        thunks["L4"] = lambda: l4_exceptions.analyze(recovery_files)
+        thunks["L4"] = lambda: l4_exceptions.analyze(
+            recovery_files, signal_files=serve_files)
     if "L5" in rules:
         thunks["L5"] = lambda: l5_lock_order.analyze(lock_files)
     if "L6" in rules:

@@ -327,6 +327,76 @@ def test_l4_object_lost_rereaised_or_reconstructed_ok():
         ''')]) == []
 
 
+def test_l4_backpressure_swallowed_flagged():
+    findings = l4_exceptions.analyze([_sf('''\
+        from ray_tpu.exceptions import BackpressureError
+        def f():
+            try:
+                g()
+            except BackpressureError:
+                result = None
+        ''')])
+    assert any("BackpressureError" in f.message for f in findings)
+
+
+def test_l4_serve_signal_only_scope():
+    # serve/ files ride the signal_files argument: dropped typed-shed
+    # handlers are flagged, but serve's best-effort broad catches are
+    # exempt from the swallow rule
+    sf = _sf('''\
+        from ray_tpu.exceptions import BackpressureError
+        def f():
+            try:
+                g()
+            except BackpressureError:
+                result = None
+        def cleanup():
+            try:
+                g()
+            except Exception:
+                pass
+        ''', "ray_tpu/serve/sample.py")
+    findings = l4_exceptions.analyze([], signal_files=[sf])
+    assert len(findings) == 1
+    assert "BackpressureError" in findings[0].message
+
+
+def test_l4_shed_verbs_count_as_handling():
+    # routing the typed error to the caller (set_exception), shedding,
+    # or rejecting all count as handling; so does re-raising
+    assert l4_exceptions.analyze([], signal_files=[_sf('''\
+        from ray_tpu.exceptions import BackpressureError
+        from ray_tpu.exceptions import ReplicaUnavailableError
+        def f(fut):
+            try:
+                g()
+            except ReplicaUnavailableError as e:
+                fut.set_exception(e)
+        def h(self):
+            try:
+                g()
+            except BackpressureError:
+                self._reject_backpressure()
+        def k():
+            try:
+                g()
+            except BackpressureError:
+                raise
+        ''', "ray_tpu/serve/sample.py")]) == []
+
+
+def test_l4_replica_unavailable_swallowed_flagged():
+    findings = l4_exceptions.analyze([], signal_files=[_sf('''\
+        from ray_tpu.exceptions import ReplicaUnavailableError
+        def f():
+            try:
+                g()
+            except ReplicaUnavailableError:
+                pass
+        ''', "ray_tpu/serve/sample.py")])
+    assert any("ReplicaUnavailableError" in f.message for f in findings)
+
+
 # ------------------------------------------------------- suppression
 
 
