@@ -757,7 +757,13 @@ def bench_core(rows: list):
     rows.append(_row("single_client_wait_1k_refs", rate, "waits/s",
                      BASE["single_client_wait_1k_refs"]))
 
-    # compiled-DAG pipeline dispatch latency vs 3 chained actor calls
+    # compiled-DAG lane. dag_pipeline_latency_us stays the historical
+    # 3-stage BLOCK-mode row (spin_us=0, so the spin default can't move
+    # it); the spin-vs-block A/B runs on a 1-stage echo (one roundtrip =
+    # 2 channel hops) and is INTERLEAVED in-process — across process
+    # restarts this box drifts more than the spin effect, so only an
+    # interleaved comparison is honest. Per-hop = roundtrip / 2.
+    from ray_tpu.core.config import config as _dag_config
     from ray_tpu.dag import compile_pipeline
 
     @ray_tpu.remote
@@ -775,15 +781,37 @@ def bench_core(rows: list):
         for a_ in stages:
             v = ray_tpu.get(a_.step.remote(v))
     actor_lat = (time.perf_counter() - t0) / n
-    dag = compile_pipeline([(a_, "step") for a_ in stages])
+
+    def _dag_lat(d, reps):
+        t0 = time.perf_counter()
+        for i in range(reps):
+            d.execute(i)
+        return (time.perf_counter() - t0) / reps
+
+    dag = compile_pipeline([(a_, "step") for a_ in stages], spin_us=0)
     dag.execute(0)
-    t0 = time.perf_counter()
-    for i in range(n):
-        dag.execute(i)
-    dag_lat = (time.perf_counter() - t0) / n
+    dag_lat = min(_dag_lat(dag, n), _dag_lat(dag, n))
     dag.teardown()
     rows.append(_row("dag_pipeline_latency_us", dag_lat * 1e6, "us"))
     rows.append(_row("dag_vs_actor_call_speedup", actor_lat / dag_lat, "x"))
+
+    spin_us = _dag_config.dag_spin_us or 200
+    d_block = compile_pipeline([(stages[0], "step")], spin_us=0)
+    d_spin = compile_pipeline([(stages[0], "step")], spin_us=spin_us)
+    d_block.execute(0)
+    d_spin.execute(0)
+    block_rt, spin_rt = [], []
+    for _ in range(5):
+        block_rt.append(_dag_lat(d_block, 200))
+        spin_rt.append(_dag_lat(d_spin, 200))
+    d_block.teardown()
+    d_spin.teardown()
+    block_us, spin_us_rt = min(block_rt) * 1e6, min(spin_rt) * 1e6
+    rows.append(_row("dag_compiled_roundtrip_us", spin_us_rt, "us"))
+    rows.append(_row("dag_compiled_roundtrip_block_us", block_us, "us"))
+    rows.append(_row("dag_compiled_per_hop_us", spin_us_rt / 2, "us"))
+    rows.append(_row("dag_spin_vs_block_speedup",
+                     block_us / spin_us_rt, "x"))
 
     # streaming returns: time-to-first-ref of a 100-yield generator task
     # vs the whole task's completion — the number the subsystem exists to
@@ -1525,6 +1553,12 @@ def main():
             ("elastic_resume_s", "elastic_resume_s", False),
             ("serve_p99_ttft_overload_ms",
              "serve_p99_ttft_overload_ms", False),
+            ("dag_pipeline_latency_us", "dag_pipeline_latency_us",
+             False),
+            ("dag_compiled_roundtrip_us", "dag_compiled_roundtrip_us",
+             False),
+            ("dag_compiled_roundtrip_block_us",
+             "dag_compiled_roundtrip_block_us", False),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)

@@ -94,6 +94,24 @@ _FLAGS: List[Flag] = [
          "How long wait_for_workers waits for the pool to come up."),
     Flag("worker_shutdown_grace_s", float, 2.0,
          "Grace period for workers to exit at shutdown before SIGKILL."),
+    # ---- compiled dags ---------------------------------------------------
+    Flag("dag_compile_actor_wait_s", float, 5.0,
+         "compile_dag deadline for a bound actor to finish registering "
+         "with the cluster (actor creation is async; the DAG compiler "
+         "races it). Lookup failures past the deadline name the actor."),
+    Flag("dag_device_channels", str, "auto",
+         "On-device DAG edges: 'auto' uses a DeviceChannel (jax Array "
+         "handed off on device, doorbell-only shm) for edges between "
+         "stages of the same TPU actor process, falling back to shm "
+         "channels on CPU; 'off' forces shm everywhere; 'force' uses "
+         "device edges for any same-process edge regardless of backend "
+         "(tests exercise the handoff under JAX_PLATFORMS=cpu)."),
+    Flag("dag_spin_us", int, 50,
+         "Busy-poll budget in microseconds for compiled-DAG channel "
+         "waits before falling back to the condvar (0 = pure block). "
+         "The spin loop yields the CPU each poll round, so the default "
+         "is safe on 1-core hosts; raise toward ~200 on multi-core "
+         "hosts where the peer runs truly in parallel."),
     # ---- observability ---------------------------------------------------
     Flag("log_to_driver", bool, True,
          "Stream worker stdout/stderr lines to the driver's stderr with "
@@ -163,6 +181,10 @@ _FLAGS: List[Flag] = [
          "group before failing with PlacementGroupError; the error "
          "names the first bundle the cluster cannot satisfy."),
     # ---- serve / overload ------------------------------------------------
+    Flag("serve_dag_spin_us", int, -1,
+         "Busy-poll budget for serve dag_mode pipelines (the replica->"
+         "engine hot path compiled onto DAG channels); -1 inherits "
+         "dag_spin_us, 0 forces pure-block channels for serve only."),
     Flag("serve_max_queue_depth", int, 0,
          "Default per-deployment admission cap: router-local requests in "
          "flight (admitted, not yet completed) beyond which new requests "
@@ -340,6 +362,10 @@ WIRING_ENV_VARS: Dict[str, str] = {
                      "inversions and callbacks fired under a tracked "
                      "lock (read at import, inherited by workers)",
     "RTPU_STORE": "object-store shm segment name handed to workers",
+    "RTPU_TPU_CHIPS": "comma-separated TPU chip ids the runtime pinned "
+                      "into a TPU actor's worker (set at spawn alongside "
+                      "TPU_VISIBLE_CHIPS; the DAG device-placement probe "
+                      "reads it to tag the actor as TPU-resident)",
     "RTPU_WORKER_ID": "id the spawner assigned this worker process",
     "RTPU_WORKER_PIP_KEY": "cache key of the pip runtime env a worker "
                            "was launched under (env pool accounting)",

@@ -25,6 +25,7 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <sched.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -406,6 +407,12 @@ void rtpu_store_destroy(const char* name) { shm_unlink(name); }
 struct ChanHeader {
   uint64_t ctr[2];  // [0]=seqno, [1]=ack
   uint64_t len;     // payload length of the current message
+  // parked-waiter count: a post only takes the mutex and broadcasts when
+  // someone is actually parked on the cond. With a spinning (or absent)
+  // peer a post is a pure release-store — no mutex, no futex wake — which
+  // is what makes a hot pipelined hop syscall-free on BOTH sides. Field
+  // sits after len so the Python side's len offset (16) is unchanged.
+  uint64_t waiters;
   pthread_mutex_t mu;
   pthread_cond_t cv;
 };
@@ -421,6 +428,7 @@ int rtpu_chan_init(void* handle, uint64_t offset) {
   ChanHeader* c = chan_at(handle, offset);
   c->ctr[0] = c->ctr[1] = 0;
   c->len = 0;
+  c->waiters = 0;
   pthread_mutexattr_t mattr;
   pthread_mutexattr_init(&mattr);
   pthread_mutexattr_setpshared(&mattr, PTHREAD_PROCESS_SHARED);
@@ -447,12 +455,21 @@ uint64_t rtpu_chan_seqno(void* handle, uint64_t offset, int which) {
   return v;
 }
 
-// Publish: release-store the counter (payload writes become visible
-// before it), then wake this channel's peer.
+// Publish: store the counter (payload writes become visible before it),
+// then wake this channel's peer — but only if one is actually PARKED.
+// seq_cst on the counter store and the waiters load pairs with seq_cst
+// on the waiter's registration store and counter re-check (Dekker
+// pattern): at least one side observes the other, so either the post
+// sees waiters>0 and broadcasts under the mutex, or the waiter's
+// re-check (done before parking, under the mutex) sees the new value.
+// The waiter's 50ms timedwait backstop self-heals any residual miss.
 void rtpu_chan_post(void* handle, uint64_t offset, int which,
                     uint64_t value) {
   ChanHeader* c = chan_at(handle, offset);
-  __atomic_store(&c->ctr[which], &value, __ATOMIC_RELEASE);
+  __atomic_store(&c->ctr[which], &value, __ATOMIC_SEQ_CST);
+  uint64_t w;
+  __atomic_load(&c->waiters, &w, __ATOMIC_SEQ_CST);
+  if (w == 0) return;  // spinning or absent peer: no futex round-trip
   chan_lock(c);
   pthread_cond_broadcast(&c->cv);
   pthread_mutex_unlock(&c->mu);
@@ -468,29 +485,80 @@ uint64_t rtpu_chan_wait(void* handle, uint64_t offset, int which,
   struct timespec deadline;
   if (timeout_ms > 0) timespec_in(&deadline, timeout_ms);
   chan_lock(c);
+  // register as PARKED before the re-check: a post that misses this
+  // increment happened before it, so the re-check below sees its value
+  // (seq_cst pairing with rtpu_chan_post)
+  __atomic_add_fetch(&c->waiters, 1, __ATOMIC_SEQ_CST);
   for (;;) {
-    v = rtpu_chan_seqno(handle, offset, which);
+    uint64_t u;
+    __atomic_load(&c->ctr[which], &u, __ATOMIC_SEQ_CST);
+    v = u;
     if (v > last) {
+      __atomic_sub_fetch(&c->waiters, 1, __ATOMIC_SEQ_CST);
       pthread_mutex_unlock(&c->mu);
       return v;
     }
     if (timeout_ms == 0) {
+      __atomic_sub_fetch(&c->waiters, 1, __ATOMIC_SEQ_CST);
       pthread_mutex_unlock(&c->mu);
       return 0;
     }
     // Bounded waits even for timeout<0: a post can slip between the
     // atomic check and the cond wait; a 50ms re-check caps that stall
-    // (posts under the mutex make it near-impossible, this is a backstop).
+    // (the seq_cst waiters handshake makes it near-impossible, this is
+    // a backstop).
     struct timespec tick;
     timespec_in(&tick, 50);
     int rc = pthread_cond_timedwait(&c->cv, &c->mu,
                                     timeout_ms < 0 ? &tick : &deadline);
     if (rc == ETIMEDOUT && timeout_ms > 0) {
       v = rtpu_chan_seqno(handle, offset, which);
+      __atomic_sub_fetch(&c->waiters, 1, __ATOMIC_SEQ_CST);
       pthread_mutex_unlock(&c->mu);
       return v > last ? v : 0;
     }
   }
+}
+
+// Adaptive spin-then-block wait: busy-poll the counter atomic for up to
+// `spin_us` microseconds before falling back to the condvar path above.
+// A pipelined hop whose peer posts within the budget costs a cache-line
+// read instead of a futex sleep + wakeup + ~18us context switch. Each
+// poll round does a short burst of CPU pause hints then sched_yield()s:
+// on a single-core host the peer NEEDS this core to post the counter, so
+// an unyielding spin would stall the very event it waits for — yield
+// keeps the round-trip at scheduler-quantum cost, still well under the
+// futex path. spin_us == 0 degenerates to rtpu_chan_wait exactly.
+uint64_t rtpu_chan_wait_spin(void* handle, uint64_t offset, int which,
+                             uint64_t last, int timeout_ms,
+                             uint32_t spin_us) {
+  ChanHeader* c = chan_at(handle, offset);
+  uint64_t v;
+  __atomic_load(&c->ctr[which], &v, __ATOMIC_ACQUIRE);
+  if (v > last) return v;
+  if (spin_us > 0 && timeout_ms != 0) {
+    struct timespec start, now;
+    clock_gettime(CLOCK_MONOTONIC, &start);
+    const int64_t budget_ns = static_cast<int64_t>(spin_us) * 1000;
+    for (;;) {
+      for (int i = 0; i < 64; ++i) {
+#if defined(__x86_64__) || defined(__i386__)
+        __builtin_ia32_pause();
+#elif defined(__aarch64__)
+        asm volatile("yield" ::: "memory");
+#endif
+        __atomic_load(&c->ctr[which], &v, __ATOMIC_ACQUIRE);
+        if (v > last) return v;
+      }
+      clock_gettime(CLOCK_MONOTONIC, &now);
+      int64_t elapsed_ns =
+          (now.tv_sec - start.tv_sec) * 1000000000LL +
+          (now.tv_nsec - start.tv_nsec);
+      if (elapsed_ns >= budget_ns) break;
+      sched_yield();  // single-core: hand the peer the CPU to post
+    }
+  }
+  return rtpu_chan_wait(handle, offset, which, last, timeout_ms);
 }
 
 uint8_t* rtpu_store_base(void* handle) { return static_cast<Store*>(handle)->base; }

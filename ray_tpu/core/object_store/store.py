@@ -31,6 +31,9 @@ def _load_lib() -> ctypes.CDLL:
         # a shipped/cached binary can be ABI-incompatible with this host
         # (built against a newer glibc); recompile from source and retry
         lib = ctypes.CDLL(ensure_built(force=True))
+    if not hasattr(lib, "rtpu_chan_wait_spin") and not override:
+        # cached .so predates the spin entry point; rebuild from source
+        lib = ctypes.CDLL(ensure_built(force=True))
     lib.rtpu_store_create.restype = ctypes.c_void_p
     lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
     lib.rtpu_store_connect.restype = ctypes.c_void_p
@@ -75,6 +78,13 @@ def _load_lib() -> ctypes.CDLL:
     lib.rtpu_chan_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                    ctypes.c_int, ctypes.c_uint64,
                                    ctypes.c_int]
+    if hasattr(lib, "rtpu_chan_wait_spin"):
+        # an RTPU_STORE_LIB override built before the spin entry point
+        # stays usable: chan_wait_spin falls back to the blocking wait
+        lib.rtpu_chan_wait_spin.restype = ctypes.c_uint64
+        lib.rtpu_chan_wait_spin.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_uint32]
     return lib
 
 
@@ -247,6 +257,18 @@ class ShmObjectStore:
                   timeout_ms: int) -> int:
         return int(_get_lib().rtpu_chan_wait(self._h(), offset, which, last,
                                              timeout_ms))
+
+    def chan_wait_spin(self, offset: int, which: int, last: int,
+                       timeout_ms: int, spin_us: int) -> int:
+        """chan_wait with a busy-poll budget of ``spin_us`` microseconds
+        before the condvar fallback (0 = pure block). Degrades to
+        chan_wait under an RTPU_STORE_LIB override lacking the symbol."""
+        lib = _get_lib()
+        if spin_us <= 0 or not hasattr(lib, "rtpu_chan_wait_spin"):
+            return int(lib.rtpu_chan_wait(self._h(), offset, which, last,
+                                          timeout_ms))
+        return int(lib.rtpu_chan_wait_spin(self._h(), offset, which, last,
+                                           timeout_ms, spin_us))
 
     def view(self, offset: int, size: int) -> memoryview:
         return self._mv[offset: offset + size]

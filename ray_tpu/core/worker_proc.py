@@ -506,6 +506,27 @@ class WorkerCore:
                          name=f"dag-{method}").start()
         return "ok"
 
+    @staticmethod
+    def _dag_devinfo() -> tuple:
+        """(pid, is_tpu) for the __rtpu_dag_devinfo__ compile probe. TPU
+        detection is env-first (the runtime pins chips into TPU actors'
+        env before jax ever imports) so the probe never forces a jax
+        backend init on a worker that doesn't need one."""
+        import os as _os
+
+        import sys as _sys
+
+        is_tpu = bool(_os.environ.get("RTPU_TPU_CHIPS")
+                      or _os.environ.get("TPU_VISIBLE_CHIPS"))
+        if not is_tpu and "jax" in _sys.modules:
+            # only consult jax if the actor already imported it — the
+            # probe must not pay a cold backend init on plain actors
+            try:
+                is_tpu = _sys.modules["jax"].default_backend() == "tpu"
+            except Exception:  # noqa: BLE001 — backend init failed: not TPU
+                is_tpu = False
+        return (_os.getpid(), is_tpu)
+
     def _send_results(self, task_id_b: bytes, result, num_returns: int,
                       return_id_bytes: List[bytes]):
         if self._async_dirty:
@@ -811,6 +832,11 @@ class WorkerCore:
                 # the user class — the worker hosts the loop thread
                 fn = lambda in_d, out_d, m: self._dag_start(  # noqa: E731
                     instance, in_d, out_d, m)
+            elif method == "__rtpu_dag_devinfo__":
+                # compile-time placement probe: (pid, is_tpu). Device
+                # edges require both stages in ONE process (jax Arrays
+                # pass by reference), so the compiler compares pids.
+                fn = lambda: self._dag_devinfo()  # noqa: E731
             else:
                 fn = getattr(instance, method)
             args, kwargs = self._decode_args(args_payload, inline_values)

@@ -29,13 +29,15 @@ call N is downstream.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import ray_tpu
 from ray_tpu.core import runtime_context
-from ray_tpu.dag.channel import (Channel, ChannelClosed, SocketChannel,
-                                 open_endpoint)
+from ray_tpu.core.config import config
+from ray_tpu.dag.channel import (Channel, ChannelClosed, DeviceChannel,
+                                 SocketChannel, open_endpoint)
+from ray_tpu.exceptions import ActorError
+from ray_tpu.util.debug_lock import make_lock
 
 
 class InputNode:
@@ -65,8 +67,11 @@ class _BoundStage:
         self.method = method
         self.upstreams = list(upstreams)
 
-    def experimental_compile(self, capacity: int = 1 << 20) -> "CompiledDag":
-        return compile_dag(self, capacity=capacity)
+    def experimental_compile(self, capacity: int = 1 << 20,
+                             spin_us: Optional[int] = None,
+                             device: Optional[str] = None) -> "CompiledDag":
+        return compile_dag(self, capacity=capacity, spin_us=spin_us,
+                           device=device)
 
 
 def bind(actor, method: str, *upstreams) -> _BoundStage:
@@ -84,12 +89,25 @@ class CompiledDag:
     """A compiled static graph. One channel per EDGE; the driver owns the
     input-edge writers and output-edge readers."""
 
-    def __init__(self, output, capacity: int = 1 << 20):
+    def __init__(self, output, capacity: int = 1 << 20,
+                 spin_us: Optional[int] = None,
+                 device: Optional[str] = None):
         outputs = (output.nodes if isinstance(output, MultiOutputNode)
                    else [output])
         if not outputs or not all(isinstance(o, _BoundStage)
                                   for o in outputs):
             raise ValueError("compile_dag needs _BoundStage output(s)")
+        # channel-wait mode: busy-poll budget before the condvar fallback
+        # (descriptors carry it, so stage loops and driver endpoints both
+        # ride the spin lane); 0 = pure block
+        self._spin_us = max(0, int(config.dag_spin_us if spin_us is None
+                                   else spin_us))
+        dev_mode = (config.dag_device_channels if device is None
+                    else device)
+        if dev_mode not in ("off", "auto", "force"):
+            raise ValueError(
+                f"dag_device_channels must be off/auto/force, "
+                f"got {dev_mode!r}")
         core = runtime_context.get_core()
         self._core = core
         self._store = getattr(core, "store", None) \
@@ -121,27 +139,56 @@ class CompiledDag:
         self._stages = stages
 
         # ---- placement: which node hosts each endpoint ----
-        def node_of(actor) -> Any:
+        def node_of(actor, method: str = "?") -> Any:
             import time as _time
+
+            from ray_tpu.core.cluster.rpc import RpcError
 
             aid = _actor_id_of(actor)
             fn = getattr(core, "_actor_addr", None)
             if fn is None:
                 return "local"  # embedded runtime: everything same-node
-            # brief retry: a just-created actor's registration may still
-            # be racing compile; an actor that never appears fails the
-            # COMPILE loudly (a guessed host would surface as an
-            # undiagnosable execute() timeout instead)
+            # bounded retry on the TYPED lookup failures only (actor
+            # registration racing compile, or a GCS blip): anything else
+            # is a real bug and propagates immediately. An actor that
+            # never appears within the deadline fails the COMPILE loudly
+            # (a guessed host would surface as an undiagnosable
+            # execute() timeout instead).
+            wait_s = config.dag_compile_actor_wait_s
+            deadline = _time.monotonic() + wait_s
             last: Any = None
-            for _ in range(25):
+            while True:
                 try:
                     return tuple(fn(aid))
-                except Exception as e:  # noqa: BLE001
+                except (ActorError, RpcError) as e:
                     last = e
-                    _time.sleep(0.2)
+                    if _time.monotonic() >= deadline:
+                        break
+                    _time.sleep(0.05)
             raise ValueError(
-                f"cannot compile DAG: actor {aid} has no known node "
-                f"(dead, or never registered): {last!r}") from last
+                f"cannot compile DAG: actor {aid} (stage .{method}) has "
+                f"no known node after {wait_s:.1f}s "
+                f"(dead, or never registered; raise "
+                f"dag_compile_actor_wait_s if creation is slow): "
+                f"{last!r}") from last
+
+        # ---- device placement probe: (pid, is_tpu) per actor ----
+        # jax Arrays can only be handed off by reference INSIDE one
+        # process (one actor per worker), so a device edge requires both
+        # stages bound to the same actor process.
+        devinfo_cache: Dict[Any, tuple] = {}
+
+        def devinfo(actor) -> tuple:
+            aid = _actor_id_of(actor)
+            if aid not in devinfo_cache:
+                try:
+                    ref = core.submit_actor_task(
+                        aid, "__rtpu_dag_devinfo__", (), {}, 1)[0]
+                    devinfo_cache[aid] = tuple(ray_tpu.get(ref, timeout=30))
+                except Exception:  # noqa: BLE001 — probe is best-effort:
+                    # any failure just means "no device edge", shm works
+                    devinfo_cache[aid] = (None, False)
+            return devinfo_cache[aid]
         driver_node = getattr(core, "_home", "local")
         if driver_node != "local":
             driver_node = tuple(driver_node)
@@ -157,11 +204,26 @@ class CompiledDag:
         stage_in: Dict[int, List] = {id(s): [] for s in stages}
         stage_out: Dict[int, List] = {id(s): [] for s in stages}
 
-        def make_edge(prod_node, cons_node):
+        def make_edge(prod_node, cons_node, prod_actor=None,
+                      cons_actor=None):
             same = (prod_node == cons_node == driver_node
                     or prod_node == cons_node == "local")
             if same and self._store is not None:
-                ch = Channel.create(self._store, capacity)
+                # on-device edge: both stages in ONE actor process, on a
+                # TPU backend ('force' skips the backend check so the
+                # handoff is testable under JAX_PLATFORMS=cpu); anything
+                # else transparently falls back to a plain shm channel
+                if (dev_mode != "off" and prod_actor is not None
+                        and cons_actor is not None):
+                    p_pid, p_tpu = devinfo(prod_actor)
+                    c_pid, c_tpu = devinfo(cons_actor)
+                    if (p_pid is not None and p_pid == c_pid
+                            and (dev_mode == "force"
+                                 or (p_tpu and c_tpu))):
+                        dch = DeviceChannel.create(self._store, capacity,
+                                                   self._spin_us)
+                        return dch.descriptor(), dch
+                ch = Channel.create(self._store, capacity, self._spin_us)
                 return ch.descriptor(), ch
             # descriptor carries the READER's (consumer's) node host: the
             # reader publishes only its port to the KV
@@ -172,28 +234,32 @@ class CompiledDag:
 
         self._shm_chans: List[Channel] = []
         for s in stages:
-            s_node = node_of(s.actor)
+            s_node = node_of(s.actor, s.method)
             for up in s.upstreams:
                 if isinstance(up, InputNode):
                     desc, ch = make_edge(driver_node, s_node)
                     stage_in[id(s)].append(desc)
                     self._in_edges.append((desc, ch))
                 else:
-                    desc, ch = make_edge(node_of(up.actor), s_node)
+                    desc, ch = make_edge(node_of(up.actor, up.method),
+                                         s_node, prod_actor=up.actor,
+                                         cons_actor=s.actor)
                     stage_in[id(s)].append(desc)
                     stage_out[id(up)].append(desc)
                     if ch is not None:
                         self._shm_chans.append(ch)
         for o in outputs:
-            desc, ch = make_edge(node_of(o.actor), driver_node)
+            desc, ch = make_edge(node_of(o.actor, o.method), driver_node)
             stage_out[id(o)].append(desc)
             self._out_edges.append((desc, ch))
 
         # Separate writer/reader locks: a write blocked on the input
         # channel's ack gate (pipeline at capacity) must not stop a reader
         # from draining the output channel — that drain is what unblocks it.
-        self._wlock = threading.Lock()
-        self._rlock = threading.Lock()
+        # Routed through the lock factory so RTPU_SANITIZE=1 puts this
+        # pairing under the runtime lock-order sanitizer.
+        self._wlock = make_lock("dag.CompiledDag._wlock")
+        self._rlock = make_lock("dag.CompiledDag._rlock")
         self._down = False
         self._broken = False
         self._n_out = len(outputs)
@@ -291,11 +357,18 @@ class CompiledDag:
         try:
             for ch in self._inputs:
                 ch.close()
-            # close sentinels cascade through every stage loop
+            # close sentinels cascade through every stage loop; drain
+            # each output until ITS sentinel (ChannelClosed) arrives —
+            # pipelined calls still in flight at teardown would otherwise
+            # leave sealed messages (and their shm slots) behind, since a
+            # single read consumes at most one of them
             with self._rlock:
                 for ch in self._outputs:
                     try:
-                        ch.read(timeout_ms=5000)
+                        while True:
+                            ch.read(timeout_ms=5000)
+                    except ChannelClosed:
+                        pass  # fully drained
                     except Exception:  # noqa: BLE001 — draining best-effort
                         pass
         finally:
@@ -303,15 +376,26 @@ class CompiledDag:
                 ch.release()
 
 
-def compile_dag(output, capacity: int = 1 << 20) -> CompiledDag:
-    """Compile a bound graph (single output node or MultiOutputNode)."""
-    return CompiledDag(output, capacity=capacity)
+def compile_dag(output, capacity: int = 1 << 20,
+                spin_us: Optional[int] = None,
+                device: Optional[str] = None) -> CompiledDag:
+    """Compile a bound graph (single output node or MultiOutputNode).
+
+    ``spin_us`` is the per-wait busy-poll budget before the condvar
+    fallback (None = ``config.dag_spin_us``; 0 = pure block).
+    ``device`` selects on-device edges: off/auto/force
+    (None = ``config.dag_device_channels``)."""
+    return CompiledDag(output, capacity=capacity, spin_us=spin_us,
+                       device=device)
 
 
 def compile_pipeline(stages: Sequence[Tuple[Any, str]],
-                     capacity: int = 1 << 20) -> CompiledDag:
+                     capacity: int = 1 << 20,
+                     spin_us: Optional[int] = None,
+                     device: Optional[str] = None) -> CompiledDag:
     """Linear chain convenience over compile_dag."""
     node: Any = InputNode()
     for actor, method in stages:
         node = _BoundStage(actor, method, [node])
-    return compile_dag(node, capacity=capacity)
+    return compile_dag(node, capacity=capacity, spin_us=spin_us,
+                       device=device)

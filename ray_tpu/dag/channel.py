@@ -21,6 +21,7 @@ import struct
 from typing import Any, Tuple
 
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.util.debug_lock import make_lock
 
 
 def _chan_dumps(value: Any) -> bytes:
@@ -46,36 +47,48 @@ class Channel:
     """One endpoint of an SPSC channel (create on the writer side, open
     from a descriptor anywhere attached to the same store)."""
 
-    def __init__(self, store, oid: ObjectID, capacity: int):
+    def __init__(self, store, oid: ObjectID, capacity: int,
+                 spin_us: int = 0):
         self._store = store
         self._oid = oid
         self._capacity = capacity
         self._offset = store.object_offset(oid)  # pins the object
         self._hdr = store.chan_header_size()
         self._seq = 0   # last seqno this endpoint saw/wrote
+        # busy-poll budget before the condvar fallback (0 = pure block);
+        # carried in the descriptor so BOTH endpoints of a hot edge spin
+        self._spin_us = int(spin_us)
 
     # -- construction --------------------------------------------------------
 
     @classmethod
-    def create(cls, store, capacity: int = 1 << 20) -> "Channel":
+    def create(cls, store, capacity: int = 1 << 20,
+               spin_us: int = 0) -> "Channel":
         oid = ObjectID.from_random()
         hdr = store.chan_header_size()
         store.create_object(oid, hdr + capacity)
         store.seal(oid)
-        ch = cls(store, oid, capacity)
+        ch = cls(store, oid, capacity, spin_us)
         store.chan_init(ch._offset)
         return ch
 
-    def descriptor(self) -> Tuple[str, bytes, int]:
+    def descriptor(self) -> Tuple[str, bytes, int, int]:
         """Picklable descriptor; open with Channel.open on any process
         attached to the same store."""
-        return ("shm", self._oid.binary(), self._capacity)
+        return ("shm", self._oid.binary(), self._capacity, self._spin_us)
 
     @classmethod
     def open(cls, store, desc) -> "Channel":
         if desc[0] == "shm":
-            return cls(store, ObjectID(desc[1]), desc[2])
+            spin_us = desc[3] if len(desc) > 3 else 0
+            return cls(store, ObjectID(desc[1]), desc[2], spin_us)
         return cls(store, ObjectID(desc[0]), desc[1])  # legacy 2-tuple
+
+    def _wait(self, which: int, last: int, timeout_ms: int) -> int:
+        if self._spin_us > 0:
+            return self._store.chan_wait_spin(
+                self._offset, which, last, timeout_ms, self._spin_us)
+        return self._store.chan_wait(self._offset, which, last, timeout_ms)
 
     # -- data plane ----------------------------------------------------------
 
@@ -103,8 +116,7 @@ class Channel:
                 f"({self._capacity}B)")
         # overwrite gate: previous message must be consumed
         if self._seq:
-            acked = self._store.chan_wait(
-                self._offset, _ACK, self._seq - 1, timeout_ms)
+            acked = self._wait(_ACK, self._seq - 1, timeout_ms)
             if acked == 0:
                 raise TimeoutError("channel reader did not ack in time")
         body = self._store.view(self._offset + self._hdr, len(data))
@@ -116,8 +128,7 @@ class Channel:
     def read(self, timeout_ms: int = 10_000) -> Any:
         """Block for the next message; deserializes a COPY (the slot is
         acked + reusable immediately after return)."""
-        seq = self._store.chan_wait(self._offset, _SEQ, self._seq,
-                                    timeout_ms)
+        seq = self._wait(_SEQ, self._seq, timeout_ms)
         if seq == 0:
             raise TimeoutError("channel read timed out")
         self._seq = seq
@@ -135,8 +146,7 @@ class Channel:
         ack gate so an unconsumed in-flight message is never clobbered."""
         if self._seq:
             # best effort: a dead reader must not make close() hang
-            self._store.chan_wait(self._offset, _ACK, self._seq - 1,
-                                  timeout_ms)
+            self._wait(_ACK, self._seq - 1, timeout_ms)
         self._set_len(_CLOSE_LEN)
         self._seq += 1
         self._store.chan_post(self._offset, _SEQ, self._seq)
@@ -146,6 +156,108 @@ class Channel:
             self._store.release(self._oid)
         except Exception:  # noqa: BLE001
             pass
+
+
+# -- on-device channels -------------------------------------------------------
+#
+# Process-local handoff registry for DeviceChannel: jax Arrays passed by
+# REFERENCE between stages of the same process (bound methods of one TPU
+# actor), keyed (channel oid bytes, seqno) so pipelined messages never
+# collide. Only a tiny doorbell record crosses shm.
+_DEVICE_HANDOFF: dict = {}
+_DEVICE_HANDOFF_LOCK = make_lock("dag.device_handoff")
+
+
+def _is_device_array(value: Any) -> bool:
+    """True for a jax Array (the only payload DeviceChannel keeps on
+    device); anything else rides the inner pickled shm path."""
+    try:
+        import jax
+
+        return isinstance(value, jax.Array)
+    except Exception:  # noqa: BLE001 — jax absent: nothing is on-device
+        return False
+
+
+def donating_jit(fn, donate_argnums=(0,)):
+    """jit a stage method so the listed array arguments are DONATED: the
+    consumer stage reuses the producer's device buffer in place instead
+    of allocating a copy — the zero-copy half of a DeviceChannel hop
+    (reference: pjit's donation_vector/rebase_donate_argnums machinery).
+    On CPU jax warns and ignores donation; semantics are unchanged."""
+    import jax
+
+    return jax.jit(fn, donate_argnums=donate_argnums)
+
+
+class DeviceChannel:
+    """DAG edge whose payload stays on device: both stages are methods of
+    the same TPU actor process, so the producer's output jax Array is
+    handed off by reference through :data:`_DEVICE_HANDOFF` — donation
+    semantics, the producer must not reuse the value after write — and
+    only a ("d",) doorbell record crosses the inner shm channel.
+
+    Non-array payloads (host values, ("e", exc) error records, the close
+    sentinel) pass through the inner channel unchanged, so the stage loop
+    is oblivious to the edge type. Opening both endpoints in DIFFERENT
+    processes is a compile-placement bug and surfaces as a RuntimeError
+    at read time (the registry is process-local by design)."""
+
+    def __init__(self, inner: "Channel"):
+        self._inner = inner
+        self._key = inner._oid.binary()
+
+    @classmethod
+    def create(cls, store, capacity: int = 1 << 20,
+               spin_us: int = 0) -> "DeviceChannel":
+        return cls(Channel.create(store, capacity, spin_us))
+
+    def descriptor(self) -> Tuple[str, tuple]:
+        return ("dev", self._inner.descriptor())
+
+    @classmethod
+    def open(cls, store, desc) -> "DeviceChannel":
+        return cls(Channel.open(store, desc[1]))
+
+    def write(self, value: Any, timeout_ms: int = 10_000):
+        if (isinstance(value, tuple) and len(value) == 2
+                and value[0] == "v" and _is_device_array(value[1])):
+            seq = self._inner._seq + 1
+            with _DEVICE_HANDOFF_LOCK:
+                _DEVICE_HANDOFF[(self._key, seq)] = value[1]
+            try:
+                self._inner.write(("d", None), timeout_ms)
+            except BaseException:
+                with _DEVICE_HANDOFF_LOCK:
+                    _DEVICE_HANDOFF.pop((self._key, seq), None)
+                raise
+            return
+        self._inner.write(value, timeout_ms)
+
+    def read(self, timeout_ms: int = 10_000) -> Any:
+        value = self._inner.read(timeout_ms)
+        if isinstance(value, tuple) and len(value) == 2 \
+                and value[0] == "d":
+            with _DEVICE_HANDOFF_LOCK:
+                arr = _DEVICE_HANDOFF.pop(
+                    (self._key, self._inner._seq), None)
+            if arr is None:
+                raise RuntimeError(
+                    "DeviceChannel doorbell with no device buffer: reader "
+                    "and writer are not in the same process (compile "
+                    "placement bug — device edges require both stages on "
+                    "one actor)")
+            return ("v", arr)
+        return value
+
+    def close(self, timeout_ms: int = 5000):
+        self._inner.close(timeout_ms)
+
+    def release(self):
+        with _DEVICE_HANDOFF_LOCK:
+            for k in [k for k in _DEVICE_HANDOFF if k[0] == self._key]:
+                del _DEVICE_HANDOFF[k]
+        self._inner.release()
 
 
 def _recv_n(conn, n: int) -> bytes:
@@ -456,4 +568,6 @@ def open_endpoint(desc, store=None, kv=None, role: str = "reader",
                              authkey=authkey)
     if store is None:
         raise RuntimeError("shm channel endpoint needs a store")
+    if desc[0] == "dev":
+        return DeviceChannel.open(store, desc)
     return Channel.open(store, desc)

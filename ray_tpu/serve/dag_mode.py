@@ -33,9 +33,19 @@ class PipelineDeployment:
     cross-node edges compile to socket channels) and compiled into a
     resident pipeline."""
 
-    def __init__(self, stages: Sequence[Tuple], capacity: int = 1 << 20):
+    def __init__(self, stages: Sequence[Tuple], capacity: int = 1 << 20,
+                 spin_us: Optional[int] = None):
+        from ray_tpu.core.config import config
         from ray_tpu.dag import compile_pipeline
 
+        # the replica->engine hot path rides the compiled SPIN lane so
+        # TTFT inherits the per-hop win; serve_dag_spin_us = -1 inherits
+        # the global dag_spin_us, 0 forces pure-block for serve only
+        if spin_us is None:
+            spin_us = config.serve_dag_spin_us
+            if spin_us < 0:
+                spin_us = config.dag_spin_us
+        self._spin_us = max(0, int(spin_us))
         self._actors = []
         compiled_stages = []
         ready_refs = []
@@ -56,9 +66,26 @@ class PipelineDeployment:
                 ready_refs.append(a.ready.remote())
         for ref in ready_refs:
             ray_tpu.get(ref, timeout=120)
-        self._dag = compile_pipeline(compiled_stages, capacity=capacity)
+        self._dag = compile_pipeline(compiled_stages, capacity=capacity,
+                                     spin_us=self._spin_us)
 
-    def __call__(self, value: Any, timeout_ms: int = 60_000) -> Any:
+    def __call__(self, value: Any, timeout_ms: int = 60_000,
+                 _deadline: Optional[float] = None) -> Any:
+        """One request = one dag.execute on the compiled lane. When the
+        router's deadline kwarg survives to here (see ReplicaActor.handle),
+        the remaining budget caps the execute timeout so an expired
+        request can't pin the pipeline for the full default."""
+        if _deadline is not None:
+            import time as _time
+
+            remaining_ms = int((_deadline - _time.time()) * 1000)
+            if remaining_ms <= 0:
+                from ray_tpu.exceptions import BackpressureError
+
+                raise BackpressureError(
+                    "request shed at pipeline: deadline expired before "
+                    "the DAG hop")
+            timeout_ms = min(timeout_ms, remaining_ms)
         return self._dag.execute(value, timeout_ms=timeout_ms)
 
     def shutdown(self):

@@ -31,6 +31,11 @@ class ReplicaActor:
         self._is_engine = (self._instance is not None
                            and hasattr(self._instance, "submit")
                            and hasattr(self._instance, "collect"))
+        # DAG-mode pipeline deployments get the request's REMAINING
+        # deadline forwarded into dag.execute (compiled spin lane)
+        from ray_tpu.serve.dag_mode import PipelineDeployment
+
+        self._is_pipeline = isinstance(self._instance, PipelineDeployment)
         self._collect_takes_ids = False
         if self._is_engine:
             import inspect
@@ -61,7 +66,9 @@ class ReplicaActor:
     def handle(self, args: tuple, kwargs: dict) -> Any:
         from ray_tpu.serve.multiplex import _MUX_KWARG, _current_model_id
 
-        self._check_deadline(kwargs)
+        deadline = self._check_deadline(kwargs)
+        if deadline is not None and self._is_pipeline:
+            kwargs["_deadline"] = deadline
         mid = kwargs.pop(_MUX_KWARG, None)
         if mid is not None:
             token = _current_model_id.set(mid)
@@ -72,12 +79,14 @@ class ReplicaActor:
         return self._call(*args, **kwargs)
 
     @staticmethod
-    def _check_deadline(kwargs: dict) -> None:
+    def _check_deadline(kwargs: dict):
         """Requests carry their wall-clock deadline in an internal kwarg
         (the router injects it); one already expired by the time it
         reaches the replica — queued behind slow work — is shed here
         with BackpressureError instead of burning compute on a result
-        the client stopped waiting for."""
+        the client stopped waiting for. Returns the deadline (or None)
+        so pipeline deployments can cap their DAG hop timeout with the
+        remaining budget."""
         import time
 
         from ray_tpu.exceptions import BackpressureError
@@ -88,6 +97,7 @@ class ReplicaActor:
             raise BackpressureError(
                 "request shed at replica: deadline expired before "
                 "execution started")
+        return deadline
 
     def handle_stream(self, args: tuple, kwargs: dict):
         """Generator deployments: invoked with num_returns="streaming" so

@@ -4,7 +4,9 @@ suppression comments and baselines.
 Scopes (mirroring where each invariant lives):
 
 - L1 runs over ``core/protocol.py`` plus the three dispatcher files;
-- L2 runs over ``ray_tpu/core/`` (the event-loop/lock surface);
+- L2 runs over ``ray_tpu/core/`` and ``ray_tpu/dag/`` (the
+  event-loop/lock surface; the DAG driver holds its writer/reader
+  locks across channel ops);
 - L4 runs over ``ray_tpu/core/``, ``ray_tpu/train/``, and
   ``ray_tpu/parallel/`` (the recovery-contract surface — elastic
   training extends the contract to TrainingWorkerError and
@@ -14,8 +16,10 @@ Scopes (mirroring where each invariant lives):
   is exempt from the broad-catch rules);
 - L3 runs over the whole ``ray_tpu/`` package (flags are read
   everywhere) plus ``tests/`` for the fault-site coverage check;
-- L5 runs over ``ray_tpu/core/`` (including ``core/cluster/``) and
-  ``ray_tpu/train/`` — the multi-threaded lock surface;
+- L5 runs over ``ray_tpu/core/`` (including ``core/cluster/``),
+  ``ray_tpu/train/``, and ``ray_tpu/dag/`` — the multi-threaded lock
+  surface (the CompiledDag wlock/rlock pairing is exactly the shape
+  L5 guards);
 - L6 runs over L5's scope plus ``ray_tpu/serve/`` and ``ray_tpu/dag/``
   (the async request paths the sync-in-async check guards).
 
@@ -72,7 +76,7 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
             by_rel[rel] = sf
         return by_rel.get(rel)
 
-    core_files: List[SourceFile] = []
+    core_files: List[SourceFile] = []    # L2 scope
     recovery_files: List[SourceFile] = []   # L4 scope (full rules)
     serve_files: List[SourceFile] = []      # L4 scope (signal-only)
     lock_files: List[SourceFile] = []       # L5 scope
@@ -84,14 +88,15 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
         if sf is None:
             continue
         all_files.append(sf)
-        if rel.startswith("ray_tpu/core/"):
+        if rel.startswith(("ray_tpu/core/", "ray_tpu/dag/")):
             core_files.append(sf)
         if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
                            "ray_tpu/parallel/")):
             recovery_files.append(sf)
         if rel.startswith("ray_tpu/serve/"):
             serve_files.append(sf)
-        if rel.startswith(("ray_tpu/core/", "ray_tpu/train/")):
+        if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
+                           "ray_tpu/dag/")):
             lock_files.append(sf)
         if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
                            "ray_tpu/serve/", "ray_tpu/dag/")):

@@ -40,7 +40,7 @@ import pytest
 # DebugLock, so an acquisition-order inversion or a callback fired
 # under a tracked lock fails the test at the offending site instead of
 # hanging CI. The env var makes spawned workers arm themselves too.
-_SANITIZED_MODULES = {"test_fault_tolerance", "test_ha",
+_SANITIZED_MODULES = {"test_dag_spin", "test_fault_tolerance", "test_ha",
                       "test_regressions"}
 
 
