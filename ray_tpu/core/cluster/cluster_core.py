@@ -253,8 +253,10 @@ class ClusterCore:
         dead entries must stop counting against the lineage budget).
         freed_check at reconstruction time remains the authority; this
         is the eager path."""
+        with self._lock:
+            since = self._freed_seq
         try:
-            msgs = self.gcs.call(("poll", "freed", self._freed_seq, 0.0))
+            msgs = self.gcs.call(("poll", "freed", since, 0.0))
         except (RpcError, OSError):
             return
         if not msgs:
@@ -276,9 +278,10 @@ class ClusterCore:
         the new incarnation's node; RESTARTING is remembered so call
         retries wait out the restart window instead of failing fast;
         DEAD is terminal (buffable-and-wait would hang forever)."""
+        with self._lock:
+            since = self._actor_state_seq
         try:
-            msgs = self.gcs.call(
-                ("poll", "actor_state", self._actor_state_seq, 0.0))
+            msgs = self.gcs.call(("poll", "actor_state", since, 0.0))
         except (RpcError, OSError):
             return
         if not msgs:
@@ -349,8 +352,9 @@ class ClusterCore:
         # table once the restart lands.
         with self._lock:
             lost = [aid for aid, a in self._actor_node.items() if a == addr]
+            specs = {aid: self._actor_spec.get(aid) for aid in lost}
         for aid in lost:
-            spec = self._actor_spec.get(aid)
+            spec = specs.get(aid)
             opts = (spec[3] if spec else {}) or {}
             restartable = (opts.get("max_restarts", 0) != 0
                            or opts.get("lifetime") == "detached")
@@ -419,7 +423,8 @@ class ClusterCore:
         Callers confirm delivery with _mark_shipped AFTER the RPC succeeds."""
         if fn_id in self._shipped.setdefault(addr, set()):
             return None
-        return self._functions.get(fn_id)
+        with self._lock:
+            return self._functions.get(fn_id)
 
     def _mark_shipped(self, addr: Tuple[str, int], fn_id: bytes):
         self._shipped.setdefault(addr, set()).add(fn_id)
@@ -440,16 +445,17 @@ class ClusterCore:
         # for pipelined chains without hiding publication for long
         out: Dict[bytes, Tuple[List[Tuple[str, int]], Optional[int]]] = {}
         missing: List[bytes] = []
-        for b in oid_bs:
-            ent = None if fresh else self._loc_cache.get(b)
-            if ent is not None:
-                addrs, ts = ent
-                if addrs and now - ts < ttl:
-                    out[b] = (addrs, self._obj_size.get(b))
-                    continue
-                if not addrs and now - ts < neg_ttl:
-                    continue  # recently confirmed absent
-            missing.append(b)
+        with self._lock:
+            for b in oid_bs:
+                ent = None if fresh else self._loc_cache.get(b)
+                if ent is not None:
+                    addrs, ts = ent
+                    if addrs and now - ts < ttl:
+                        out[b] = (addrs, self._obj_size.get(b))
+                        continue
+                    if not addrs and now - ts < neg_ttl:
+                        continue  # recently confirmed absent
+                missing.append(b)
         cache_hits = len(out)
         got = {}
         if missing:
@@ -622,8 +628,11 @@ class ClusterCore:
         dep_locs = (self._locate_deps(dep_bs)
                     if dep_bs and config.locality_aware_scheduling else {})
         locations = {}
+        with self._lock:
+            hints = {b: self._ref_node.get(b) for b in dep_bs}
+            sizes = {b: self._obj_size.get(b) for b in dep_bs}
         for b in dep_bs:
-            hint = self._ref_node.get(b)
+            hint = hints[b]
             addrs, nbytes = dep_locs.get(b, ([], None))
             if hint is not None and hint not in addrs:
                 # the owner hint covers deps the directory hasn't seen
@@ -631,7 +640,7 @@ class ClusterCore:
                 # where the object WILL appear
                 addrs = list(addrs) + [hint]
             if nbytes is None:
-                nbytes = self._obj_size.get(b)
+                nbytes = sizes[b]
             if addrs:
                 dep_locs[b] = (addrs, nbytes)
                 locations[b] = tuple(addrs[0]) if hint is None else hint
@@ -720,12 +729,24 @@ class ClusterCore:
             self._loc_cache[oid_b] = ([self._home], time.monotonic())
         return ObjectRef(ObjectID(oid_b), core=self)
 
+    def _route(self, oid_b: bytes, default=None):
+        """Locked single-probe read of the owner-routing table. Every
+        read of _ref_node goes through here (or holds _lock inline) so
+        routing lookups never observe a torn compound update."""
+        with self._lock:
+            return self._ref_node.get(oid_b, default)
+
     def get_objects(self, refs: List[ObjectRef],
                     timeout: Optional[float] = None) -> List[Any]:
         out: Dict[bytes, Any] = {}
         groups: Dict[Tuple[str, int], List[bytes]] = {}
         for ref in refs:
             b = ref.binary()
+            # rtpu-lint: disable=L7 — deliberate lock-free tombstone
+            # probe on the hot get() path: note_freed only ever ADDS
+            # tombstones, a dict-membership read is GIL-atomic, and this
+            # loop blocks on ev.wait() so holding self._lock here would
+            # stall every other driver thread (and violate L2)
             if b in self._freed:
                 raise ObjectLostError(
                     f"object {b.hex()} was freed by ray_tpu.free() and is "
@@ -736,7 +757,7 @@ class ClusterCore:
                     raise GetTimeoutError("get() timed out")
                 out[b] = cell[0]
                 continue
-            addr = self._ref_node.get(b, self._home)
+            addr = self._route(b, self._home)
             groups.setdefault(addr, []).append(b)
         errs: List[BaseException] = []
 
@@ -827,7 +848,7 @@ class ClusterCore:
         # no surviving copy: reconstruct through lineage by resubmitting the
         # creating task (recursively reconstructing lost deps first)
         if self._reconstruct(oid_b):
-            payloads = self._nodes.get(self._ref_node[oid_b]).call(
+            payloads = self._nodes.get(self._route(oid_b)).call(
                 ("get", [oid_b], timeout, False))
             return self._decode(payloads[oid_b])
         raise ObjectLostError(
@@ -843,19 +864,19 @@ class ClusterCore:
         # "free means dead": an eagerly-freed object (driver- OR
         # worker-originated) must never be resurrected, directly or as a
         # recursively-reconstructed dependency
-        if oid_b in self._freed:
+        with self._lock:
+            freed = oid_b in self._freed
+            lineage = self._lineage.get(oid_b)
+            n = self._reconstructions.get(oid_b, 0)
+        if freed or lineage is None or n >= config.max_reconstructions:
             return False
         try:
+            # the GCS freed-set is authoritative for worker-originated
+            # frees the driver hasn't drained yet
             if self.gcs.call(("freed_check", oid_b)):
                 return False
         except RpcError:
             pass
-        lineage = self._lineage.get(oid_b)
-        if lineage is None:
-            return False
-        n = self._reconstructions.get(oid_b, 0)
-        if n >= config.max_reconstructions:
-            return False
         fn_id, payload, deps_b, nested_b, return_ids_b, options = lineage
         # deps that are lost themselves get reconstructed first; with
         # several deps one loc_get_batch replaces the per-id loop
@@ -921,7 +942,7 @@ class ClusterCore:
                     if self._local[b][0].is_set():
                         ready_set.add(b)
                     continue
-                groups.setdefault(self._ref_node.get(b, self._home),
+                groups.setdefault(self._route(b, self._home),
                                   []).append(b)
             if len(ready_set) >= num_returns:
                 break
@@ -991,7 +1012,7 @@ class ClusterCore:
         addr = self._pick_node(opts, is_actor=True)
         opts2 = self._localize_pg(opts, addr)
         pickled_cls = self._ship_fn(addr, cls_fn_id)
-        locations = {d.binary(): self._ref_node.get(d.binary()) for d in deps}
+        locations = {d.binary(): self._route(d.binary()) for d in deps}
         locations = {k: v for k, v in locations.items() if v is not None}
         dep_b = [d.binary() for d in deps]
         # driver-chosen actor id + per-request nonce: a retried
@@ -1043,7 +1064,8 @@ class ClusterCore:
         return actor_id
 
     def _actor_addr(self, actor_id: ActorID) -> Tuple[str, int]:
-        addr = self._actor_node.get(actor_id)
+        with self._lock:
+            addr = self._actor_node.get(actor_id)
         if addr is None:
             info = self.gcs.call(("list_actors",)).get(actor_id.binary())
             if info is None or "node" not in info:
@@ -1269,7 +1291,7 @@ class ClusterCore:
     # -------------------------------------------------------------- misc api
 
     def cancel_task(self, ref: ObjectRef, force: bool = False):
-        addr = self._ref_node.get(ref.binary(), self._home)
+        addr = self._route(ref.binary(), self._home)
         try:
             self._nodes.get(addr).call(("cancel", ref.binary(), force))
         except RpcError:
@@ -1280,15 +1302,14 @@ class ClusterCore:
     def stream_owner(self, seed: bytes) -> Optional[Tuple[str, int]]:
         """Node address owning a stream's state (captured into the
         ObjectRefGenerator so it keeps routing after cross-node pickling)."""
-        return self._ref_node.get(seed)
+        return self._route(seed)
 
     def stream_next(self, seed: bytes, index: int,
                     timeout: Optional[float] = None, owner=None):
         """Driver-side consumption: poll the owning node in bounded slices
         (same contract as Runtime.stream_next — ("ref", rid_b) or
         ("end", count), ObjectTimeoutError past the deadline)."""
-        addr = tuple(owner) if owner else self._ref_node.get(
-            seed, self._home)
+        addr = tuple(owner) if owner else self._route(seed, self._home)
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         while True:
@@ -1316,8 +1337,7 @@ class ClusterCore:
         """Advance the consumer watermark (backpressure credit) on the
         owning node; best-effort — a lost credit only delays the producer
         by one poll slice."""
-        addr = tuple(owner) if owner else self._ref_node.get(
-            seed, self._home)
+        addr = tuple(owner) if owner else self._route(seed, self._home)
         try:
             self._nodes.get(addr).call(("stream_consumed", seed, index))
         except RpcError:

@@ -92,6 +92,16 @@ class GcsServer:
     process crash); set ``RTPU_GCS_WAL_FSYNC=1`` to fsync per append and
     additionally survive host/OS crashes."""
 
+    # L7 lock-protection intent for fields whose majority-use lock is
+    # NOT their guard:
+    # - _pdir: persistence dir path, write-once in __init__, immutable.
+    # - _epoch: incarnation marker, write-once in __init__, immutable.
+    # - _wal: the BINDING doubles as the "persistence enabled" flag —
+    #   set before serving starts and nulled once at close(); readers
+    #   probe it lock-free by design (lock order forbids _wal_lock under
+    #   self._lock). The file CONTENTS are serialized by _wal_lock.
+    _guarded_by_ = {"_pdir": None, "_epoch": None, "_wal": None}
+
     def __init__(self, port: int = 0, authkey: Optional[bytes] = None,
                  persistence_path: Optional[str] = None):
         self._authkey = authkey or cluster_authkey()
@@ -192,35 +202,42 @@ class GcsServer:
             }
 
     def _restore_state(self, s: dict):
-        for node_id, address, resources, topology, labels, state in \
-                s.get("nodes", []):
-            info = _NodeInfo(node_id, address, resources, topology, labels)
-            info.state = state
-            # ALIVE nodes get a fresh grace period: the health monitor
-            # re-marks truly-dead ones after the heartbeat timeout, live
-            # ones heartbeat in (and re-register if they were marked DEAD
-            # during the outage)
-            self._nodes[node_id] = info
-        self._kv = dict(s.get("kv", {}))
-        self._named_actors = dict(s.get("named_actors", {}))
-        self._actor_table = {k: dict(v)
-                             for k, v in s.get("actor_table", {}).items()}
-        self._locations = {k: list(map(tuple, v))
-                           for k, v in s.get("locations", {}).items()}
-        self._obj_sizes = dict(s.get("obj_sizes", {}))
-        self._functions = dict(s.get("functions", {}))
-        self._actor_specs = {k: dict(v)
-                             for k, v in s.get("actor_specs", {}).items()}
-        self._freed = dict(s.get("freed", {}))
-        self._deaths = [tuple(d) for d in s.get("deaths", [])]
-        self._death_seq = s.get("death_seq", 0)
-        self._driver_deaths = [tuple(d)
-                               for d in s.get("driver_deaths", [])]
-        self._driver_death_seq = s.get("driver_death_seq", 0)
-        self._channel_seq = dict(s.get("channel_seq", {}))
-        self._channels = {k: [tuple(e) for e in v]
-                          for k, v in s.get("channels", {}).items()}
-        self._view_version = s.get("view_version", 0) + 1
+        # startup path (before the RPC server and health monitor exist),
+        # but cheap to hold the lock anyway — so the guarded-field
+        # invariant is uniform instead of "except during restore"
+        with self._lock:
+            for node_id, address, resources, topology, labels, state in \
+                    s.get("nodes", []):
+                info = _NodeInfo(node_id, address, resources, topology,
+                                 labels)
+                info.state = state
+                # ALIVE nodes get a fresh grace period: the health monitor
+                # re-marks truly-dead ones after the heartbeat timeout,
+                # live ones heartbeat in (and re-register if they were
+                # marked DEAD during the outage)
+                self._nodes[node_id] = info
+            self._kv = dict(s.get("kv", {}))
+            self._named_actors = dict(s.get("named_actors", {}))
+            self._actor_table = {k: dict(v)
+                                 for k, v in s.get("actor_table",
+                                                   {}).items()}
+            self._locations = {k: list(map(tuple, v))
+                               for k, v in s.get("locations", {}).items()}
+            self._obj_sizes = dict(s.get("obj_sizes", {}))
+            self._functions = dict(s.get("functions", {}))
+            self._actor_specs = {k: dict(v)
+                                 for k, v in s.get("actor_specs",
+                                                   {}).items()}
+            self._freed = dict(s.get("freed", {}))
+            self._deaths = [tuple(d) for d in s.get("deaths", [])]
+            self._death_seq = s.get("death_seq", 0)
+            self._driver_deaths = [tuple(d)
+                                   for d in s.get("driver_deaths", [])]
+            self._driver_death_seq = s.get("driver_death_seq", 0)
+            self._channel_seq = dict(s.get("channel_seq", {}))
+            self._channels = {k: [tuple(e) for e in v]
+                              for k, v in s.get("channels", {}).items()}
+            self._view_version = s.get("view_version", 0) + 1
 
     def _load_persisted(self):
         snap_path = os.path.join(self._pdir, "snapshot.pkl")
@@ -251,9 +268,10 @@ class GcsServer:
                         break  # torn tail record from a crash: stop here
                     try:
                         if op == "__death__":
-                            info = self._nodes.get(args[0])
-                            if info is not None and info.state == "ALIVE":
-                                with self._lock:
+                            with self._lock:
+                                info = self._nodes.get(args[0])
+                                if info is not None \
+                                        and info.state == "ALIVE":
                                     self._mark_dead_locked(info)
                         elif op == "__driver_death__":
                             # keep the seq monotonic across restarts so
@@ -295,6 +313,10 @@ class GcsServer:
     def _flush_pending_deaths(self):
         """Health-loop hook: persist buffered __death__ records. Runs
         WITHOUT self._lock so the _wal_lock -> self._lock order holds."""
+        # rtpu-lint: disable=L7 — deliberate lock-free emptiness probe:
+        # a stale read only delays the flush one health-loop tick; the
+        # authoritative swap happens under self._lock in
+        # _wal_write_locked
         if self._wal is None or not self._wal_pending:
             return
         with self._wal_lock:

@@ -83,11 +83,7 @@ class HaGcsClient:
             result = self._rpc.call(msg)
         except RpcError as e:
             return self._ride_through(msg, e)
-        # reconnects moved: the transport silently re-dialed mid-call
-        # (fast head restart that never surfaced an error) — the peer may
-        # be a different GCS incarnation, so verify the epoch
-        if self._epoch is None or self._saw_outage \
-                or self._rpc.reconnects != r0:
+        if self._epoch_suspect(r0):
             self._check_epoch()
         return result
 
@@ -101,10 +97,18 @@ class HaGcsClient:
             with self._lock:
                 self._saw_outage = True
             return default
-        if self._epoch is None or self._saw_outage \
-                or self._rpc.reconnects != r0:
+        if self._epoch_suspect(r0):
             self._check_epoch()
         return result
+
+    def _epoch_suspect(self, r0: int) -> bool:
+        """True when the GCS incarnation needs re-verifying: never seen
+        an epoch, a call failed since the last check, or the transport
+        silently re-dialed mid-call (fast head restart that never
+        surfaced an error — the peer may be a different incarnation)."""
+        with self._lock:
+            return self._epoch is None or self._saw_outage \
+                or self._rpc.reconnects != r0
 
     def _ride_through(self, msg: Any, first_err: RpcError) -> Any:
         op = msg[0] if isinstance(msg, tuple) and msg else msg
@@ -189,12 +193,14 @@ class HaGcsClient:
 
     @property
     def epoch(self) -> Optional[str]:
-        return self._epoch
+        with self._lock:
+            return self._epoch
 
     @property
     def buffered(self) -> int:
         """Calls currently parked in the ride-through buffer."""
-        return self._buffered
+        with self._lock:
+            return self._buffered
 
     def close(self):
         # parked ride-through loops notice _closed at their next wakeup

@@ -671,44 +671,55 @@ class NodeServer:
                               (size + chunk - 1) // chunk))
         offsets = list(range(0, size, chunk))
         dst = None
-        if oid_bytes not in rt._freed and not rt.store.contains(oid):
-            try:
-                dst = rt.store.create_object(oid, size)
-            except (ObjectStoreFullError, ValueError, OSError):
-                dst = None  # heap-assembly fallback below
-        buf = None if dst is not None else bytearray(size)
-        out = dst if dst is not None else memoryview(buf)
-        failed: List[str] = []
-        idx_lock = make_lock("NodeServer._fetch_ranged.<idx>")
-        next_idx = [0]
+        try:
+            if oid_bytes not in rt._freed and not rt.store.contains(oid):
+                try:
+                    dst = rt.store.create_object(oid, size)
+                except (ObjectStoreFullError, ValueError, OSError):
+                    dst = None  # heap-assembly fallback below
+            buf = None if dst is not None else bytearray(size)
+            out = dst if dst is not None else memoryview(buf)
+            failed: List[str] = []
+            idx_lock = make_lock("NodeServer._fetch_ranged.<idx>")
+            next_idx = [0]
 
-        client = self._peers.get(addr)  # pooled: N concurrent calls use
-        # N connections, kept for future transfers to the same peer
+            client = self._peers.get(addr)  # pooled: N concurrent calls
+            # use N connections, kept for future transfers to the same peer
 
-        def puller():
-            try:
-                while not failed:
-                    with idx_lock:
-                        if next_idx[0] >= len(offsets):
+            def puller():
+                try:
+                    while not failed:
+                        with idx_lock:
+                            if next_idx[0] >= len(offsets):
+                                return
+                            off = offsets[next_idx[0]]
+                            next_idx[0] += 1
+                        n = min(chunk, size - off)
+                        part = client.call(
+                            ("fetch_range", oid_bytes, off, n))
+                        if part is None or len(part) != n:
+                            failed.append(f"range {off}+{n} unavailable")
                             return
-                        off = offsets[next_idx[0]]
-                        next_idx[0] += 1
-                    n = min(chunk, size - off)
-                    part = client.call(("fetch_range", oid_bytes, off, n))
-                    if part is None or len(part) != n:
-                        failed.append(f"range {off}+{n} unavailable")
-                        return
-                    out[off:off + n] = part
-            except Exception as e:  # noqa: BLE001
-                failed.append(repr(e))
+                        out[off:off + n] = part
+                except Exception as e:  # noqa: BLE001
+                    failed.append(repr(e))
 
-        threads = [threading.Thread(target=puller, daemon=True,
-                                    name="node-fetch-range")
-                   for _ in range(nstreams)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+            threads = [threading.Thread(target=puller, daemon=True,
+                                        name="node-fetch-range")
+                       for _ in range(nstreams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        except BaseException:
+            # transfer machinery failed before the verdict below (e.g.
+            # dialing the peer raised): an unsealed allocation is
+            # invisible to getters and reclaimed only at store close —
+            # abort it before surfacing
+            if dst is not None:
+                rt.store.release(oid)
+                rt.store.delete(oid)
+            raise
         if failed:
             if dst is not None:
                 # abort the unsealed allocation: drop the creator ref,

@@ -361,9 +361,9 @@ class RpcClient:
                 delay = min(delay * 2, 0.5)
 
     def call(self, msg: Any) -> Any:
-        if self._closed:
-            raise RpcError("client closed")
         with self._lock:
+            if self._closed:
+                raise RpcError("client closed")
             conn = self._pool.pop() if self._pool else None
         if conn is None:
             conn = self._connect()

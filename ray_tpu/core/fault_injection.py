@@ -209,27 +209,42 @@ def _oid_bytes(ref) -> bytes:
     return ref.binary()  # ObjectID
 
 
-def evict_object(core, ref) -> bool:
+def evict_object(core, ref, timeout_s: float = 2.0) -> bool:
     """Evict a sealed object's shm container exactly as LRU pressure
     would: drop the owner's tracking pin and delete the container. The
     object-table entry keeps its stale ("shm", id) payload, so the next
-    read surfaces ObjectLostError (or triggers reconstruction)."""
+    read surfaces ObjectLostError (or triggers reconstruction).
+
+    Retries through the result-adoption handoff: _store_payload sets
+    the entry event before the pin registration runs, and inside that
+    window the container still holds its retained creator ref, so
+    delete refuses. A getter woken by the event (or the interleaving
+    fuzzer stretching the window) would otherwise see the injected
+    loss silently no-op."""
+    import time
+
     from ray_tpu.core.ids import ObjectID
 
     oid_b = _oid_bytes(ref)
     oid = ObjectID(oid_b)
-    with core._spill_lock:
-        pinned = core._pinned.pop(oid_b, None) is not None
-    try:
-        if pinned:
-            core.store.release(oid)
-        core.store.delete(oid)
-    # rtpu-lint: disable=L4 — chaos helper: the object being already
-    # evicted/spilled/closed-with-the-store all count as "gone", which
-    # is the success condition checked below
-    except Exception:  # noqa: BLE001
-        pass
-    return not core.store.contains(oid)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        with core._spill_lock:
+            pinned = core._pinned.pop(oid_b, None) is not None
+        try:
+            if pinned:
+                core.store.release(oid)
+            core.store.delete(oid)
+        # rtpu-lint: disable=L4 — chaos helper: the object being already
+        # evicted/spilled/closed-with-the-store all count as "gone",
+        # which is the success condition checked below
+        except Exception:  # noqa: BLE001
+            pass
+        if not core.store.contains(oid):
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.001)
 
 
 def spill_object(core, ref) -> bool:

@@ -175,6 +175,7 @@ def serialize_value(value: Any, store=None) -> Payload:
 def _store_or_inline(pickled, views, total, store) -> Payload:
     if store is not None and total > serialization.inline_threshold():
         oid = ObjectID.from_random()
+        dst = None
         try:
             # invokes the store's need_space hook (spilling) when full;
             # retain-seal hands the creator ref to the owner's tracking pin
@@ -183,7 +184,20 @@ def _store_or_inline(pickled, views, total, store) -> Payload:
             store.seal(oid, retain=True)
             return ("shm", oid.binary())
         except (ObjectStoreFullError, ValueError, OSError):
-            pass  # store full/closed even after spilling: fall back to inline
+            if dst is not None:
+                # allocation succeeded but the write/seal window failed:
+                # an unsealed object is invisible to getters and only
+                # reclaimed at store close — abort it (drop the creator
+                # ref, then free) before falling back to inline
+                try:
+                    store.release(oid)
+                    store.delete(oid)
+                # rtpu-lint: disable=L4 — abort of a slot the store may
+                # have concurrently closed under us; inline fallback is
+                # the contract either way
+                except Exception:  # noqa: BLE001
+                    pass
+            # store full/closed even after spilling: fall back to inline
     out = bytearray(total)
     serialization.write_container(memoryview(out), pickled, views)
     return ("inline", bytes(out))

@@ -532,7 +532,8 @@ class Runtime:
         self._zygote_lock = make_lock("Runtime._zygote_lock")
         if config.worker_zygote:
             try:
-                self._start_zygote_locked()
+                with self._zygote_lock:
+                    self._start_zygote_locked()
             except Exception:  # noqa: BLE001 — fall back to cold spawns
                 self._zygote = None
         for _ in range(self.num_workers):
@@ -644,7 +645,9 @@ class Runtime:
             out_path, err_path = worker_log_paths(self.log_dir,
                                                   worker_id.hex())
         proc = None
-        if not tpu and python_exe is None and self._zygote is not None:
+        with self._zygote_lock:
+            warm = self._zygote is not None
+        if not tpu and python_exe is None and warm:
             # fast path: fork from the warm template. TPU workers need a
             # fresh interpreter (PJRT plugin registration is env-driven
             # at startup), so they always cold-spawn.
@@ -1010,9 +1013,9 @@ class Runtime:
 
     def _ensure_fn_on_worker(self, w: _Worker, fn_id: bytes):
         if fn_id not in w.registered_fns:
-            self._send_msg(
-                w, (protocol.MSG_REGISTER_FN, fn_id, self._functions[fn_id])
-            )
+            with self._lock:
+                pickled = self._functions[fn_id]
+            self._send_msg(w, (protocol.MSG_REGISTER_FN, fn_id, pickled))
             w.registered_fns.add(fn_id)
 
     # ------------------------------------------------------------ object dir
@@ -1173,9 +1176,12 @@ class Runtime:
             # keeps later gets erroring instead of hanging
             with self._lock:
                 e = self._objects.pop(oid, None)
-            if e is not None and not e.event.is_set():
-                # concurrent waiters on a just-freed id: resolve them
-                self._objects[oid] = e
+                unresolved = e is not None and not e.event.is_set()
+                if unresolved:
+                    # concurrent waiters on a just-freed id: re-insert so
+                    # _store_error below resolves them with the error
+                    self._objects[oid] = e
+            if unresolved:
                 self._store_error(
                     [oid], ObjectLostError(f"object {oid} was freed"))
             self._cancellable.pop(oid_b, None)
@@ -1697,7 +1703,8 @@ class Runtime:
     def _spec_pg_removed(self, spec) -> bool:
         if spec.pg_wire is None:
             return False
-        pg = self._pgs.get(PlacementGroupID(spec.pg_wire[1]))
+        with self._lock:
+            pg = self._pgs.get(PlacementGroupID(spec.pg_wire[1]))
         return pg is None or pg.removed
 
     def _queue_ready(self, spec: _TaskSpec):
@@ -2088,8 +2095,10 @@ class Runtime:
         shipping a read that is known to fail worker-side."""
         out: Dict[bytes, Any] = {}
         lost: List[bytes] = []
+        with self._lock:
+            entries = {dep: self._objects[dep] for dep in deps}
         for dep in deps:
-            e = self._objects[dep]
+            e = entries[dep]
             payload = e.payload
             if payload is None:
                 # entry reset: its reconstruction is already in flight
@@ -2465,7 +2474,9 @@ class Runtime:
                 if not e.event.is_set():
                     e.callbacks.append(notify)
         while True:
-            ready = [r for r in refs if self._objects[r.id].event.is_set()]
+            with self._lock:
+                ready = [r for r in refs
+                         if self._objects[r.id].event.is_set()]
             if len(ready) >= num_returns:
                 break
             remaining = None if deadline is None else deadline - time.monotonic()
@@ -2842,9 +2853,10 @@ class Runtime:
         call — its side effect already happened exactly once."""
         if spec.cancelled or spec.stream is not None:
             return False
+        with self._lock:
+            entries = [self._objects.get(rid) for rid in spec.return_ids]
         sealed = True
-        for rid in spec.return_ids:
-            e = self._objects.get(rid)
+        for e in entries:
             if e is None or not e.event.is_set():
                 sealed = False
                 break
@@ -3227,14 +3239,16 @@ class Runtime:
 
     def wait_placement_group(self, pg_id: PlacementGroupID,
                              timeout: float) -> bool:
-        state = self._pgs.get(pg_id)
+        with self._lock:
+            state = self._pgs.get(pg_id)
         if state is None:
             raise PlacementGroupError(f"unknown placement group {pg_id}")
         return state.ready_event.wait(timeout)
 
     def placement_group_chips(self, pg_id: PlacementGroupID,
                               index: int) -> List[int]:
-        state = self._pgs.get(pg_id)
+        with self._lock:
+            state = self._pgs.get(pg_id)
         if state is None:
             raise PlacementGroupError(f"unknown placement group {pg_id}")
         return list(state.bundles[index].chips)
@@ -3738,9 +3752,9 @@ class Runtime:
         services; here the runtime answers directly)."""
         from ray_tpu.core.proc_stats import CpuTracker
 
-        if not hasattr(self, "_cpu_tracker"):
-            self._cpu_tracker = CpuTracker()
         with self._lock:
+            if not hasattr(self, "_cpu_tracker"):
+                self._cpu_tracker = CpuTracker()
             self._cpu_tracker.prune(
                 w.proc.pid for w in self._workers.values()
                 if w.proc is not None)
@@ -3777,6 +3791,9 @@ class Runtime:
             objects = len(self._objects)
             resolved = sum(1 for e in self._objects.values()
                            if e.event.is_set())
+            resources = {"total": self._total.to_dict(),
+                         "available": self._avail.to_dict()}
+            n_pgs = len(self._pgs)
         with self._spill_lock:
             pinned = len(self._pinned)
             spilled_bytes = self._spilled_bytes
@@ -3787,10 +3804,9 @@ class Runtime:
             "tasks": {"queued": queued, "running": running},
             "objects": {"tracked": objects, "resolved": resolved,
                         "pinned": pinned, "spilled_bytes": spilled_bytes},
-            "resources": {"total": self._total.to_dict(),
-                          "available": self._avail.to_dict()},
+            "resources": resources,
             "store": self.store.stats(),
-            "placement_groups": len(self._pgs),
+            "placement_groups": n_pgs,
         }
 
     def kv_op(self, op: str, key: str, value=None):
@@ -3979,13 +3995,17 @@ class Runtime:
                 w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 w.proc.kill()
-        if self._zygote is not None:
+        with self._zygote_lock:
+            # claim the zygote under its lock: a concurrent respawn can
+            # drop/replace it (_fork_from_zygote nulls a wedged zygote),
+            # so an unlocked check-then-terminate races to AttributeError
+            zygote, self._zygote = self._zygote, None
+        if zygote is not None:
             try:
-                self._zygote.stdin.close()  # EOF -> zygote exits
-                self._zygote.terminate()
+                zygote.stdin.close()  # EOF -> zygote exits
+                zygote.terminate()
             except (OSError, ValueError):
                 pass  # pipe already broken / zygote already gone
-            self._zygote = None
         try:
             self._listener.close()
         except OSError:

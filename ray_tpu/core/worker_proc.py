@@ -553,6 +553,7 @@ class WorkerCore:
             self.store is not None
             and total > serialization.inline_threshold()
         ):
+            dst = None
             try:
                 dst = self.store.create_object_with_pressure(rid, total)
                 serialization.write_container(dst, pickled, views)
@@ -560,7 +561,19 @@ class WorkerCore:
                 self.store.seal(rid, retain=True)
                 return ("shm", rid.binary())
             except (ObjectStoreFullError, ValueError, OSError):
-                pass  # store full/closed even after spilling: go inline
+                if dst is not None:
+                    # write/seal failed after allocation: abort the
+                    # unsealed slot (invisible to getters, reclaimed
+                    # only at close otherwise) before going inline
+                    try:
+                        self.store.release(rid)
+                        self.store.delete(rid)
+                    # rtpu-lint: disable=L4 — abort of a slot the store
+                    # may have concurrently closed; inline fallback is
+                    # the contract either way
+                    except Exception:  # noqa: BLE001
+                        pass
+                # store full/closed even after spilling: go inline
         out = bytearray(total)
         serialization.write_container(memoryview(out), pickled, views)
         return ("inline", bytes(out))

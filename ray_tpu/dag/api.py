@@ -233,57 +233,84 @@ class CompiledDag:
             return ("sock", cid, host), None
 
         self._shm_chans: List[Channel] = []
-        for s in stages:
-            s_node = node_of(s.actor, s.method)
-            for up in s.upstreams:
-                if isinstance(up, InputNode):
-                    desc, ch = make_edge(driver_node, s_node)
-                    stage_in[id(s)].append(desc)
-                    self._in_edges.append((desc, ch))
-                else:
-                    desc, ch = make_edge(node_of(up.actor, up.method),
-                                         s_node, prod_actor=up.actor,
-                                         cons_actor=s.actor)
-                    stage_in[id(s)].append(desc)
-                    stage_out[id(up)].append(desc)
-                    if ch is not None:
-                        self._shm_chans.append(ch)
-        for o in outputs:
-            desc, ch = make_edge(node_of(o.actor, o.method), driver_node)
-            stage_out[id(o)].append(desc)
-            self._out_edges.append((desc, ch))
+        self._inputs: List[Any] = []
+        self._outputs: List[Any] = []
+        try:
+            for s in stages:
+                s_node = node_of(s.actor, s.method)
+                for up in s.upstreams:
+                    if isinstance(up, InputNode):
+                        desc, ch = make_edge(driver_node, s_node)
+                        stage_in[id(s)].append(desc)
+                        self._in_edges.append((desc, ch))
+                    else:
+                        desc, ch = make_edge(node_of(up.actor, up.method),
+                                             s_node, prod_actor=up.actor,
+                                             cons_actor=s.actor)
+                        stage_in[id(s)].append(desc)
+                        stage_out[id(up)].append(desc)
+                        if ch is not None:
+                            self._shm_chans.append(ch)
+            for o in outputs:
+                desc, ch = make_edge(node_of(o.actor, o.method),
+                                     driver_node)
+                stage_out[id(o)].append(desc)
+                self._out_edges.append((desc, ch))
 
-        # Separate writer/reader locks: a write blocked on the input
-        # channel's ack gate (pipeline at capacity) must not stop a reader
-        # from draining the output channel — that drain is what unblocks it.
-        # Routed through the lock factory so RTPU_SANITIZE=1 puts this
-        # pairing under the runtime lock-order sanitizer.
-        self._wlock = make_lock("dag.CompiledDag._wlock")
-        self._rlock = make_lock("dag.CompiledDag._rlock")
-        self._down = False
-        self._broken = False
-        self._n_out = len(outputs)
-        self._single = not isinstance(output, MultiOutputNode)
+            # Separate writer/reader locks: a write blocked on the input
+            # channel's ack gate (pipeline at capacity) must not stop a
+            # reader from draining the output channel — that drain is
+            # what unblocks it. Routed through the lock factory so
+            # RTPU_SANITIZE=1 puts this pairing under the runtime
+            # lock-order sanitizer.
+            self._wlock = make_lock("dag.CompiledDag._wlock")
+            self._rlock = make_lock("dag.CompiledDag._rlock")
+            self._down = False
+            self._broken = False
+            self._n_out = len(outputs)
+            self._single = not isinstance(output, MultiOutputNode)
 
-        # ---- start the resident loops ----
-        acks = []
-        for s in stages:
-            acks.append(core.submit_actor_task(
-                _actor_id_of(s.actor), "__rtpu_dag_start__",
-                (stage_in[id(s)], stage_out[id(s)], s.method), {}, 1)[0])
-        for ref in acks:
-            assert ray_tpu.get(ref, timeout=60) == "ok"
+            # ---- start the resident loops ----
+            acks = []
+            for s in stages:
+                acks.append(core.submit_actor_task(
+                    _actor_id_of(s.actor), "__rtpu_dag_start__",
+                    (stage_in[id(s)], stage_out[id(s)], s.method),
+                    {}, 1)[0])
+            for ref in acks:
+                assert ray_tpu.get(ref, timeout=60) == "ok"
 
-        # driver endpoints (socket endpoints rendezvous lazily; stage
-        # loops are already up, so their reader sides publish)
-        self._inputs = [ch if ch is not None else
-                        open_endpoint(desc, kv=self._kv, role="writer",
-                                      authkey=self._chan_authkey)
-                        for desc, ch in self._in_edges]
-        self._outputs = [ch if ch is not None else
-                         open_endpoint(desc, kv=self._kv, role="reader",
-                                       authkey=self._chan_authkey)
-                         for desc, ch in self._out_edges]
+            # driver endpoints (socket endpoints rendezvous lazily; stage
+            # loops are already up, so their reader sides publish;
+            # appended one at a time so a failed rendezvous can still
+            # release the endpoints opened before it)
+            for desc, ch in self._in_edges:
+                self._inputs.append(
+                    ch if ch is not None else
+                    open_endpoint(desc, kv=self._kv, role="writer",
+                                  authkey=self._chan_authkey))
+            for desc, ch in self._out_edges:
+                self._outputs.append(
+                    ch if ch is not None else
+                    open_endpoint(desc, kv=self._kv, role="reader",
+                                  authkey=self._chan_authkey))
+        except BaseException:
+            # half-built DAG: teardown() never runs for an object whose
+            # __init__ raised, so release every channel endpoint created
+            # so far — their shm pins would otherwise outlive the failed
+            # compile until store close
+            edge_chs = [c for _, c in self._in_edges + self._out_edges
+                        if c is not None] + self._shm_chans
+            opened = [c for c in self._inputs + self._outputs
+                      if all(c is not e for e in edge_chs)]
+            for c in edge_chs + opened:
+                try:
+                    c.release()
+                # rtpu-lint: disable=L4 — best-effort unwind of a failed
+                # compile; the original error is what must surface
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
 
     # ------------------------------------------------------------- calls
 
@@ -372,7 +399,9 @@ class CompiledDag:
                     except Exception:  # noqa: BLE001 — draining best-effort
                         pass
         finally:
-            for ch in self._inputs + self._outputs + self._shm_chans:
+            with self._rlock:
+                chans = self._inputs + self._outputs + self._shm_chans
+            for ch in chans:
                 ch.release()
 
 

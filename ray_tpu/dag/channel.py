@@ -67,9 +67,17 @@ class Channel:
         oid = ObjectID.from_random()
         hdr = store.chan_header_size()
         store.create_object(oid, hdr + capacity)
-        store.seal(oid)
-        ch = cls(store, oid, capacity, spin_us)
-        store.chan_init(ch._offset)
+        try:
+            store.seal(oid)
+            ch = cls(store, oid, capacity, spin_us)
+            store.chan_init(ch._offset)
+        except BaseException:
+            # seal/pin/init failed mid-construction: abort the backing
+            # object (drop the ref, then free) instead of stranding an
+            # unsealed or unowned allocation until store close
+            store.release(oid)
+            store.delete(oid)
+            raise
         return ch
 
     def descriptor(self) -> Tuple[str, bytes, int, int]:

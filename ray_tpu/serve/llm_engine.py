@@ -661,9 +661,11 @@ class LLMEngine:
         # slot are dropped at reap by the request match). Doing this
         # here, where slot bookkeeping is single-threaded, means a cancel
         # can never touch a slot recycled to another request.
-        if self._cancelled:
+        with self._done_lock:
+            cancelled = set(self._cancelled)
+        if cancelled:
             for slot, rid in list(self._slot_req.items()):
-                if rid in self._cancelled:
+                if rid in cancelled:
                     self._slot_budget[slot] = 0
                     self._maybe_finish(slot, -1)
             # prune marks for ids this engine never saw (e.g. a failed

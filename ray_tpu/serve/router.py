@@ -63,6 +63,39 @@ class _DepthToken:
 
     __del__ = release
 
+class _TokenStream:
+    """Iterator handed out by ``stream_request``, tying the admission
+    depth token's release to the STREAM OBJECT instead of generator
+    finalization alone. ``generator.close()`` on a never-started
+    generator does not run its ``finally`` block, so an abandoned
+    (never-iterated) stream would hold its queue-depth slot until GC;
+    ``close`` here releases both deterministically and ``__del__``
+    remains only as the backstop."""
+
+    __slots__ = ("_gen", "_token")
+
+    def __init__(self, gen, token: Optional[_DepthToken]):
+        self._gen = gen
+        self._token = token
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self):
+        """Idempotent: finalize the generator (running its finally when
+        iteration started), then release the depth slot either way."""
+        try:
+            self._gen.close()
+        finally:
+            if self._token is not None:
+                self._token.release()
+
+    __del__ = close
+
+
 # process-local registry so serve.delete/shutdown can stop the reporting
 # threads of routers whose handles are still alive in this process
 _ROUTERS: "weakref.WeakSet[Router]" = weakref.WeakSet()
@@ -155,6 +188,12 @@ class Router:
             estimated_wait_s=est,
             retry_after_s=retry_after_hint(est, mean))
 
+    def _depth_now(self) -> int:
+        """Locked read of the current queue depth (diagnostic reads on
+        the stream paths go through here)."""
+        with self._lock:
+            return self._depth
+
     def _admit(self, priority: int,
                deadline_s: Optional[float]) -> Optional[_DepthToken]:
         """Admission check, run BEFORE any replica work: sheds with
@@ -230,8 +269,10 @@ class Router:
         while not self._stop_reporting:
             ref = None
             try:
+                with self._lock:
+                    snap0 = self._snapshot
                 ref = self._controller.listen_for_change.remote(
-                    {key: self._snapshot}, 10.0)
+                    {key: snap0}, 10.0)
                 if in_worker:
                     deadline = (time.monotonic()
                                 + config.serve_worker_poll_deadline_s)
@@ -359,7 +400,9 @@ class Router:
         Forced pulls remain for replica-death recovery (don't wait a
         push round-trip to stop routing at a corpse)."""
         now = time.monotonic()
-        if not force and self._replicas:
+        with self._lock:
+            seeded = bool(self._replicas)
+        if not force and seeded:
             self._ensure_topology_thread()  # revive after outage exit
             return
         snap, version, replicas = ray_tpu.get(
@@ -620,8 +663,9 @@ class Router:
         pr, dl = self._resolve_qos(priority, deadline_s)
         if self._streaming and not self._engine:
             token = self._admit(pr, dl)
-            return self._generator_stream(args, kwargs, timeout_s,
-                                          model_id, token, dl)
+            return _TokenStream(
+                self._generator_stream(args, kwargs, timeout_s,
+                                       model_id, token, dl), token)
         if not self._engine:
             raise TypeError(
                 f"deployment {self._name!r} is neither a generator nor "
@@ -634,7 +678,9 @@ class Router:
                 "multiplexed_model_id is not supported for engine "
                 "streaming deployments")
         token = self._admit(pr, dl)
-        return self._engine_stream(args, kwargs, timeout_s, token, dl)
+        return _TokenStream(
+            self._engine_stream(args, kwargs, timeout_s, token, dl),
+            token)
 
     def _generator_stream(self, args, kwargs, timeout_s: float,
                           model_id: Optional[str],
@@ -671,7 +717,7 @@ class Router:
                         # streaming — close typed, not a generic timeout
                         raise self._shed(
                             f"stream shed: {deadline_s:.3f}s deadline "
-                            f"expired mid-flight", self._depth)
+                            f"expired mid-flight", self._depth_now())
                     raise TimeoutError(
                         f"stream exceeded {timeout_s}s")
                 try:
@@ -761,7 +807,7 @@ class Router:
                     # engine request so no generation leaks
                     raise self._shed(
                         f"stream shed: {deadline_s:.3f}s deadline "
-                        f"expired mid-flight", self._depth)
+                        f"expired mid-flight", self._depth_now())
                 if now > deadline:
                     raise TimeoutError(
                         f"stream {req_id} exceeded {timeout_s}s")

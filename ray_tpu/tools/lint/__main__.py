@@ -29,6 +29,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--jobs", type=int, default=1, metavar="N",
                         help="run rules in parallel on N threads "
                              "(default: 1, serial)")
+    parser.add_argument("--diff", default=None, metavar="GIT_REF",
+                        help="report findings only in files changed vs "
+                             "GIT_REF (committed + working tree); "
+                             "whole-program rules still analyze the "
+                             "full tree — the fast pre-commit gate")
     parser.add_argument("--baseline", default=None, metavar="FILE",
                         help="only fail on findings NOT in this baseline "
                              "file (grandfather existing ones)")
@@ -40,9 +45,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.jobs < 1:
         print("rtpu-lint: --jobs must be >= 1", file=sys.stderr)
         return 2
+    changed = None
+    if args.diff is not None:
+        try:
+            changed = runner.changed_files(
+                args.root or runner.default_root(), args.diff)
+        except RuntimeError as e:
+            print(f"rtpu-lint: --diff: {e}", file=sys.stderr)
+            return 2
+        if not changed:
+            print("rtpu-lint: --diff: no .py files changed, 0 "
+                  "finding(s)")
+            return 0
     try:
         findings, wall_ms = runner.collect_findings_timed(
-            root=args.root, rules=rules, jobs=args.jobs)
+            root=args.root, rules=rules, jobs=args.jobs,
+            changed_only=changed)
     except Exception as e:  # noqa: BLE001 — CLI boundary: fold any
         # analyzer crash into the documented exit-2 contract
         print(f"rtpu-lint: internal error: {e!r}", file=sys.stderr)

@@ -39,6 +39,12 @@ RULES: Dict[str, str] = {
     "L6": "thread context: signal handlers only from main-thread "
           "contexts, no fork/spawn under a held lock, no blocking sync "
           "calls in async bodies",
+    "L7": "guarded fields: accesses to a field whose guard lock is "
+          "inferred (majority of accesses) or declared (_guarded_by_) "
+          "must hold that lock",
+    "L8": "resource lifecycle: acquire/release pairs (shm allocations, "
+          "channel endpoints, depth tokens, sockets) must release on "
+          "exception edges and early returns, not only via __del__",
 }
 
 

@@ -1,0 +1,373 @@
+"""L7 — inferred lock protection for instance fields (GUARDED_BY).
+
+The reference runtime's C++ core gets this from Clang's thread-safety
+annotations: a field marked ``GUARDED_BY(mu_)`` makes any access
+without ``mu_`` held a compile error. This pass recovers the
+capability for the Python reproduction by *inference*: for each class
+it tallies every ``self._x`` access together with the lock set held at
+that program point (reusing L5's held-lock propagation — ``with
+<lock>:`` blocks, paired ``.acquire()``/``.release()`` statements,
+``Condition(lock)`` aliasing — plus an interprocedural entry-held
+fixpoint for private helpers only ever called under a lock). When a
+majority of a field's accesses hold the same lock, that lock is the
+field's inferred guard and every access without it is flagged, citing
+the guard and a witness guarded site.
+
+Explicit intent beats inference: a class-body annotation
+
+    _guarded_by_ = {"_depth": "_lock",     # every access needs _lock
+                    "_stats": None}        # declared single-thread
+
+overrides the tally for the listed fields — ``None`` documents
+single-thread ownership and silences the rule for that field, a lock
+attribute name makes the rule *total* (every non-``__init__`` access
+without that lock is flagged, majority or not).
+
+Approximations (deliberate):
+
+- ``__init__`` bodies are skipped — pre-publication, no other thread
+  can see the object — but nested defs inside ``__init__`` (watcher
+  thread bodies, callbacks) are walked with an EMPTY entry lock set:
+  they run later, when construction locks are long released.
+- Nested defs anywhere are treated as callbacks: lexical ``with``
+  blocks inside them count, the enclosing method's held set does not.
+- A private method's entry-held set is the intersection of
+  ``held-at-call-site ∪ entry(caller)`` over every intra-module call
+  site (optimistic fixpoint). Public methods, dunders, and methods
+  referenced as values (thread targets, stored callbacks) start at
+  the empty set — external callers hold nothing.
+- Fields whose name looks like a lock (L5's ``LOCK_RE``) are exempt:
+  locks guard fields, nothing guards a lock.
+- Inheritance is not modelled: each class tallies its own accesses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.base import Finding, SourceFile
+from ray_tpu.tools.lint.l5_lock_order import (
+    LOCK_RE, _acq_rel_token, _collect_module, _is_wildcard, _Module,
+    _resolve, _Scope)
+
+#: inference needs this many guarded accesses ...
+MIN_GUARDED = 2
+#: ... and strictly more guarded than unguarded ones (majority rule)
+
+#: entry-held fixpoint iterations (call graphs here are shallow)
+FIXPOINT_ITERS = 8
+
+_TOP = None  # lattice top for the optimistic entry-held fixpoint
+
+
+class _Access:
+    __slots__ = ("cls", "field", "fn_key", "line", "write", "nested",
+                 "held")
+
+    def __init__(self, cls: str, field: str, fn_key: str, line: int,
+                 write: bool, nested: bool, held: Tuple[str, ...]):
+        self.cls = cls
+        self.field = field
+        self.fn_key = fn_key
+        self.line = line
+        self.write = write
+        self.nested = nested
+        self.held = held
+
+
+class _ClassInfo:
+    def __init__(self, sf: SourceFile, m: _Module, name: str):
+        self.sf = sf
+        self.m = m
+        self.name = name
+        self.accesses: List[_Access] = []
+        #: field -> lock attr name | None, from _guarded_by_
+        self.declared: Dict[str, Optional[str]] = {}
+        self.declared_line: int = 0
+
+
+def _parse_guarded_by(cls_node: ast.ClassDef, ci: _ClassInfo) -> None:
+    for item in cls_node.body:
+        if not (isinstance(item, ast.Assign) and len(item.targets) == 1
+                and isinstance(item.targets[0], ast.Name)
+                and item.targets[0].id == "_guarded_by_"
+                and isinstance(item.value, ast.Dict)):
+            continue
+        ci.declared_line = item.lineno
+        for k, v in zip(item.value.keys, item.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value,
+                                                               str)):
+                continue
+            if isinstance(v, ast.Constant) and v.value is None:
+                ci.declared[k.value] = None
+            elif isinstance(v, ast.Constant) and isinstance(v.value, str):
+                ci.declared[k.value] = v.value
+
+
+class _Walker:
+    """Held-set walker over ONE method body that records self-attribute
+    accesses (L5's ``_walk_body`` records calls; same propagation)."""
+
+    def __init__(self, ci: _ClassInfo, scope: _Scope,
+                 value_refs: Set[str]):
+        self.ci = ci
+        self.scope = scope
+        self.value_refs = value_refs
+        self.methods = ci.m.methods.get(ci.name, {})
+
+    def walk(self, stmts: List[ast.stmt], held: Tuple[str, ...],
+             fn_key: str, nested: bool, record: bool) -> None:
+        held = tuple(held)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # callback body: runs later, enclosing locks released
+                self.walk(stmt.body, (), f"{fn_key}.{stmt.name}",
+                          True, True)
+                continue
+            tok = _acq_rel_token(stmt, self.scope, "acquire")
+            if tok is not None:
+                tok = self.ci.m.alias.get(tok, tok)
+                if tok not in held:
+                    held = held + (tok,)
+                continue
+            tok = _acq_rel_token(stmt, self.scope, "release")
+            if tok is not None:
+                tok = self.ci.m.alias.get(tok, tok)
+                if tok in held:
+                    held = tuple(t for t in held if t != tok)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in stmt.items:
+                    self._scan(item.context_expr, held, fn_key, nested,
+                               record)
+                    tok = self.scope.lock_token(item.context_expr)
+                    if tok is not None:
+                        tok = self.ci.m.alias.get(tok, tok)
+                        if tok not in inner:
+                            inner = inner + (tok,)
+                self.walk(stmt.body, inner, fn_key, nested, record)
+                continue
+            for field, value in ast.iter_fields(stmt):
+                if field in ("body", "orelse", "finalbody", "handlers"):
+                    continue
+                vals = value if isinstance(value, list) else [value]
+                for v in vals:
+                    if isinstance(v, ast.AST):
+                        self._scan(v, held, fn_key, nested, record)
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(stmt, field, None)
+                if body:
+                    self.walk(body, held, fn_key, nested, record)
+            for handler in getattr(stmt, "handlers", ()):
+                self.walk(handler.body, held, fn_key, nested, record)
+
+    def _scan(self, expr: ast.AST, held: Tuple[str, ...], fn_key: str,
+              nested: bool, record: bool) -> None:
+        stack: List[ast.AST] = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue  # runs later, not at this program point
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    # self._meth(...) is a call, not a field access —
+                    # but self._cb() on a non-method reads a stored field
+                    self._record(f, held, fn_key, nested, record,
+                                 is_call=True)
+                    stack.append(f.value)
+                else:
+                    stack.append(f)
+                stack.extend(node.args)
+                stack.extend(kw.value for kw in node.keywords)
+                continue
+            if isinstance(node, ast.Attribute):
+                self._record(node, held, fn_key, nested, record,
+                             is_call=False)
+                stack.append(node.value)
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _record(self, node: ast.Attribute, held: Tuple[str, ...],
+                fn_key: str, nested: bool, record: bool,
+                is_call: bool) -> None:
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return
+        name = node.attr
+        if name in self.methods:
+            if not is_call:
+                # method used as a value: thread target / callback —
+                # external callers invoke it holding nothing
+                self.value_refs.add(self.methods[name])
+            return
+        if not record:
+            return
+        if not name.startswith("_") or name.startswith("__"):
+            return
+        if name == "_guarded_by_" or LOCK_RE.search(name):
+            return
+        write = isinstance(node.ctx, (ast.Store, ast.Del))
+        self.ci.accesses.append(_Access(
+            self.ci.name, name, fn_key, node.lineno, write, nested, held))
+
+
+def _entry_held(m: _Module,
+                value_refs: Set[str]) -> Dict[str, FrozenSet[str]]:
+    """Lock set every caller of a method is known to hold at entry.
+    Optimistic intersection fixpoint over intra-module call sites."""
+    sites: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+    for key, fi in m.fns.items():
+        for ev in fi.events:
+            callee = _resolve(ev.call, fi, m)
+            if callee is not None:
+                sites.setdefault(callee, []).append((key, ev.held))
+
+    def external(key: str) -> bool:
+        name = key.rsplit(".", 1)[-1]
+        head = key.split(".", 1)[0]
+        top_method = key.count(".") == 1 and head in m.methods
+        return (not top_method                 # module fn / nested def
+                or not name.startswith("_")    # public: called bare
+                or (name.startswith("__") and name.endswith("__"))
+                or key in value_refs           # thread target / callback
+                or key not in sites)           # callers unknown
+
+    entry: Dict[str, object] = {
+        key: (frozenset() if external(key) else _TOP) for key in m.fns}
+    internal = [k for k in m.fns if entry[k] is _TOP]
+
+    for _ in range(FIXPOINT_ITERS):
+        changed = False
+        for key in internal:
+            acc: object = _TOP
+            for caller, held in sites[key]:
+                ce = entry.get(caller, frozenset())
+                if ce is _TOP:
+                    continue  # unresolved caller contributes top
+                contrib = frozenset(held) | ce
+                acc = contrib if acc is _TOP else (acc & contrib)
+            if acc is not _TOP and acc != entry[key]:
+                entry[key] = acc
+                changed = True
+        if not changed:
+            break
+    return {k: (v if v is not _TOP else frozenset())
+            for k, v in entry.items()}
+
+
+def _collect_class(sf: SourceFile, m: _Module, node: ast.ClassDef,
+                   value_refs: Set[str]) -> _ClassInfo:
+    ci = _ClassInfo(sf, m, node.name)
+    _parse_guarded_by(node, ci)
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scope = _Scope(m.mod, node.name, f"{node.name}.{item.name}",
+                       f"{node.name}.{item.name}", set(), m.globals)
+        w = _Walker(ci, scope, value_refs)
+        # __init__ is pre-publication — direct accesses are exempt, its
+        # nested defs (watcher threads, callbacks) are not
+        w.walk(item.body, (), f"{node.name}.{item.name}", False,
+               record=item.name != "__init__")
+    return ci
+
+
+def _guard_token(ci: _ClassInfo, lock_attr: str) -> str:
+    tok = f"{ci.m.mod}.{ci.name}.{lock_attr}"
+    return ci.m.alias.get(tok, tok)
+
+
+def _effective(a: _Access, entry: Dict[str, FrozenSet[str]]
+               ) -> FrozenSet[str]:
+    held = frozenset(a.held)
+    if not a.nested:
+        held |= entry.get(a.fn_key, frozenset())
+    return held
+
+
+def analyze(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        m = _collect_module(sf)
+        value_refs: Set[str] = set()
+        classes: List[_ClassInfo] = []
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                classes.append(_collect_class(sf, m, node, value_refs))
+        entry = _entry_held(m, value_refs)
+        for ci in classes:
+            findings.extend(_class_findings(ci, entry))
+    return findings
+
+
+def _class_findings(ci: _ClassInfo,
+                    entry: Dict[str, FrozenSet[str]]) -> List[Finding]:
+    out: List[Finding] = []
+    by_field: Dict[str, List[_Access]] = {}
+    for a in ci.accesses:
+        by_field.setdefault(a.field, []).append(a)
+    for field in sorted(set(by_field) | set(ci.declared)):
+        accesses = by_field.get(field, [])
+        if field in ci.declared:
+            lock_attr = ci.declared[field]
+            if lock_attr is None:
+                continue  # declared single-thread ownership
+            guard = _guard_token(ci, lock_attr)
+            out.extend(_flag(ci, field, guard, accesses, entry,
+                             declared=True))
+            continue
+        guard, g, u = _infer(ci, field, accesses, entry)
+        if guard is None:
+            continue
+        out.extend(_flag(ci, field, guard, accesses, entry,
+                         declared=False, tally=(g, g + u)))
+    return out
+
+
+def _infer(ci: _ClassInfo, field: str, accesses: List[_Access],
+           entry) -> Tuple[Optional[str], int, int]:
+    counts: Dict[str, int] = {}
+    for a in accesses:
+        for tok in _effective(a, entry):
+            if not _is_wildcard(tok):
+                counts[tok] = counts.get(tok, 0) + 1
+    if not counts:
+        return None, 0, 0
+    guard = max(counts, key=lambda t: (counts[t], t))
+    g = counts[guard]
+    u = sum(1 for a in accesses if guard not in _effective(a, entry))
+    if g >= MIN_GUARDED and g > u:
+        return guard, g, u
+    return None, g, u
+
+
+def _flag(ci: _ClassInfo, field: str, guard: str,
+          accesses: List[_Access], entry, declared: bool,
+          tally: Optional[Tuple[int, int]] = None) -> List[Finding]:
+    witness = next((a for a in accesses
+                    if guard in _effective(a, entry)), None)
+    if witness is not None:
+        cite = f"witness guarded site {ci.sf.relpath}:{witness.line}"
+    elif declared:
+        cite = (f"declared by _guarded_by_ at {ci.sf.relpath}:"
+                f"{ci.declared_line}")
+    else:
+        return []
+    how = ("declared guard" if declared else
+           "inferred guard (%d of %d accesses hold it)" % tally)
+    out = []
+    for a in accesses:
+        if guard in _effective(a, entry):
+            continue
+        verb = "write to" if a.write else "read of"
+        nested_note = (" — inside a nested def that may run after the "
+                       "enclosing lock is released" if a.nested else "")
+        out.append(Finding(
+            "L7", ci.sf.relpath, a.line,
+            f"{a.fn_key}: {verb} self.{field} without holding "
+            f"{guard!r}, its {how}; {cite}{nested_note}"))
+    return out

@@ -1,0 +1,538 @@
+"""L8 — resource lifecycle: acquire/release pairs must survive
+exception edges and early returns.
+
+The runtime's resources are manual pairs: an shm allocation is live
+from ``create_object`` until ``seal`` (and pinned until ``release``),
+a channel endpoint from ``create``/``open_endpoint`` until
+``close``/``release``, an admission depth slot from ``_admit`` until
+``_DepthToken.release``, a socket from ``socket()`` until ``close``.
+Python's GC hides a leak behind a ``__del__`` backstop — until a
+reference cycle, an exception traceback, or interpreter shutdown
+keeps the object alive and the slot/fd/depth unit is gone.
+
+Three finding shapes, each citing the acquire site and the unreleased
+path:
+
+``exception-path``
+    A statement that can raise sits between the acquire and its
+    release (or the release-carrying ``try``), so that edge leaks.
+``early-exit``
+    A ``return``/``raise`` between acquire and release.
+``generator-handoff``
+    The handle is passed into a generator function defined in the
+    same module: its ``finally``-release runs only if iteration
+    starts, so an abandoned generator leaks until GC.
+``del-backstop``
+    A class stores an acquired handle on ``self`` and the only method
+    releasing it is ``__del__``.
+
+Deliberate outs (kept, with rationale, so the rule stays
+low-noise): a handle that ESCAPES — returned, yielded, stored into a
+container/attribute, passed to a non-generator call — transfers
+ownership the analyzer cannot track, and is skipped (attribute stores
+are still covered by the class-level ``del-backstop`` pass); a
+``with``-managed acquire is clean by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.base import Finding, SourceFile
+
+#: handle-style acquires: the RESULT is the resource.
+#: call name (bare or attribute) -> (release method names, kind)
+HANDLE_ACQ: Dict[str, Tuple[FrozenSet[str], str]] = {
+    "socket": (frozenset({"close", "detach"}), "socket"),
+    "open_endpoint": (frozenset({"close", "release"}),
+                      "channel endpoint"),
+    "_admit": (frozenset({"release"}), "admission depth token"),
+    "_DepthToken": (frozenset({"release"}), "admission depth token"),
+}
+
+#: channel constructors: ``<X>Channel.create(...)``
+_CHANNEL_RELEASES = frozenset({"close", "release"})
+
+#: key-style acquires: the resource is named by the FIRST ARGUMENT
+#: (receiver + key identify it; the result is just a view).
+KEY_ACQ: Dict[str, Tuple[FrozenSet[str], str]] = {
+    "create_object": (frozenset({"seal", "abort", "delete", "release"}),
+                      "shm allocation"),
+    "create_object_with_pressure": (
+        frozenset({"seal", "abort", "delete", "release"}),
+        "shm allocation"),
+}
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _handle_acquire(call: ast.Call) -> Optional[Tuple[FrozenSet[str],
+                                                      str]]:
+    name = _call_name(call)
+    if name in HANDLE_ACQ:
+        return HANDLE_ACQ[name]
+    if name == "create" and isinstance(call.func, ast.Attribute):
+        recv = call.func.value
+        if isinstance(recv, ast.Name) and recv.id.endswith("Channel"):
+            return _CHANNEL_RELEASES, f"{recv.id} slot"
+    return None
+
+
+def _key_acquire(call: ast.Call) -> Optional[Tuple[FrozenSet[str], str]]:
+    name = _call_name(call)
+    return KEY_ACQ.get(name)
+
+
+# ------------------------------------------------------------ functions
+
+
+def _functions(tree: ast.AST):
+    """Every function/method (incl. nested), with its enclosing class
+    name (or None) and dotted display name."""
+
+    def visit(node, cls, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name, f"{child.name}.")
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                yield child, cls, f"{prefix}{child.name}"
+                yield from visit(child, cls, f"{prefix}{child.name}.")
+            else:
+                yield from visit(child, cls, prefix)
+
+    yield from visit(tree, None, "")
+
+
+def _is_generator(fn_node) -> bool:
+    """Yield in the function's OWN body (nested defs excluded)."""
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn_node:
+            continue
+        if isinstance(node, (ast.Yield, ast.YieldFrom)) and \
+                _owner_fn(fn_node, node):
+            return True
+    return False
+
+
+def _owner_fn(fn_node, target) -> bool:
+    """True when ``target`` belongs to ``fn_node``'s own frame."""
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                return True
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if visit(child):
+                return True
+        return False
+
+    return visit(fn_node)
+
+
+# ------------------------------------------------------------ releases
+
+
+def _releases_var(node: ast.AST, var: str,
+                  releases: FrozenSet[str]) -> bool:
+    """Any ``var.<release>()`` call (or ``with var:``/``closing(var)``)
+    inside ``node``."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            recv = n.func.value
+            if isinstance(recv, ast.Name) and recv.id == var \
+                    and n.func.attr in releases:
+                return True
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Name) and ce.id == var:
+                    return True
+                if isinstance(ce, ast.Call) and \
+                        _call_name(ce) == "closing" and ce.args and \
+                        isinstance(ce.args[0], ast.Name) and \
+                        ce.args[0].id == var:
+                    return True
+    return False
+
+
+def _releases_key(node: ast.AST, recv_src: str, key_src: str,
+                  releases: FrozenSet[str]) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in releases and n.args:
+            try:
+                if ast.unparse(n.func.value) == recv_src and \
+                        ast.unparse(n.args[0]) == key_src:
+                    return True
+            except Exception:  # noqa: BLE001 — unparse best-effort
+                pass
+    return False
+
+
+def _can_raise(stmt: ast.stmt, releasing) -> Optional[int]:
+    """Line of the first thing in ``stmt`` that can raise (a call that
+    is not itself the release, an explicit raise, an assert), or
+    None."""
+    for n in ast.walk(stmt):
+        if isinstance(n, (ast.Raise, ast.Assert)):
+            return n.lineno
+        if isinstance(n, ast.Call) and not releasing(n):
+            return n.lineno
+    return None
+
+
+# ------------------------------------------------------------- analysis
+
+
+class _Ctx:
+    """Where one acquire statement sits: its block + index, and the
+    chain of enclosing Try statements inside the function."""
+
+    __slots__ = ("block", "index", "trys")
+
+    def __init__(self, block, index, trys):
+        self.block = block
+        self.index = index
+        self.trys = trys
+
+
+def _locate(fn_node, target_stmt) -> Optional[_Ctx]:
+    def visit(block, trys):
+        for i, s in enumerate(block):
+            if s is target_stmt:
+                return _Ctx(block, i, trys)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                child = getattr(s, field, None)
+                if child:
+                    sub = trys + [s] if (isinstance(s, ast.Try)
+                                         and field == "body") else trys
+                    found = visit(child, sub)
+                    if found:
+                        return found
+            for h in getattr(s, "handlers", ()):
+                found = visit(h.body, trys)
+                if found:
+                    return found
+        return None
+
+    return visit(fn_node.body, [])
+
+
+def _scan_forward(ctx: _Ctx, releases_in, can_raise_in):
+    """Walk the acquire's block forward. Returns one of:
+    ("ok",), ("exc", risky_line, release_line),
+    ("early", exit_line), ("end", first_risky_line_or_None)."""
+    risky: Optional[int] = None
+    for j in range(ctx.index + 1, len(ctx.block)):
+        s = ctx.block[j]
+        if isinstance(s, ast.Try):
+            protected = (any(releases_in(t) for t in s.finalbody)
+                         or any(releases_in(t) for h in s.handlers
+                                for t in h.body))
+            if protected:
+                return ("ok",) if risky is None else \
+                    ("exc", risky, s.lineno)
+        if releases_in(s):
+            if risky is not None and not isinstance(s, ast.Try):
+                return ("exc", risky, s.lineno)
+            return ("ok",)
+        if isinstance(s, (ast.Return, ast.Raise)):
+            return ("early", s.lineno)
+        line = can_raise_in(s)
+        if line is not None and risky is None:
+            risky = line
+    return ("end", risky)
+
+
+def _enclosing_protected(ctx: _Ctx, releases_in) -> bool:
+    for t in ctx.trys:
+        if any(releases_in(s) for s in t.finalbody):
+            return True
+        if any(releases_in(s) for h in t.handlers for s in h.body):
+            return True
+    return False
+
+
+def _escapes(fn_node, acquire_stmt, var: str, releases: FrozenSet[str],
+             module_generators: Dict[str, ast.AST]
+             ) -> Tuple[bool, Optional[Tuple[str, int]]]:
+    """(escaped, generator_handoff) for ``var`` anywhere in the
+    function. A pass into a same-module *generator function* is NOT a
+    safe escape — it is reported separately."""
+    gen_handoff: Optional[Tuple[str, int]] = None
+    escaped = False
+    for n in ast.walk(fn_node):
+        if n is acquire_stmt:
+            continue
+        if getattr(n, "lineno", acquire_stmt.lineno) < \
+                acquire_stmt.lineno:
+            continue  # before this acquire: a different lifetime
+        if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = n.value
+            if v is not None and _uses(v, var) and \
+                    not _is_gen_call(v, module_generators):
+                escaped = True
+        elif isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Attribute) and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id == var:
+                continue  # var.method(...): receiver use, not escape
+            args_use = any(_uses(a, var) for a in n.args) or \
+                any(_uses(kw.value, var) for kw in n.keywords)
+            if args_use:
+                gname = _gen_target(n, module_generators)
+                if gname is not None:
+                    gen_handoff = (gname, n.lineno)
+                else:
+                    escaped = True
+        elif isinstance(n, ast.Assign):
+            if _uses(n.value, var) and \
+                    not _is_gen_call(n.value, module_generators):
+                escaped = True
+    return escaped, gen_handoff
+
+
+def _is_gen_call(node: ast.AST,
+                 module_generators: Dict[str, ast.AST]) -> bool:
+    """Returning/storing ``self._gen(var)`` is the generator HANDOFF
+    itself, not an independent escape into an owner — without this the
+    escape-outranks-handoff rule would hide the direct-return case."""
+    return isinstance(node, ast.Call) and \
+        _gen_target(node, module_generators) is not None
+
+
+def _uses(node: ast.AST, var: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == var
+               for n in ast.walk(node))
+
+
+def _gen_target(call: ast.Call,
+                module_generators: Dict[str, ast.AST]) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in module_generators:
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id in ("self", "cls") \
+            and f.attr in module_generators:
+        return f.attr
+    return None
+
+
+def analyze(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        findings.extend(_file_findings(sf))
+    return findings
+
+
+def _file_findings(sf: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    #: function/method NAME -> node, for generator-handoff resolution
+    module_generators: Dict[str, ast.AST] = {
+        fn.name: fn for fn, _, _ in _functions(sf.tree)
+        if _is_generator(fn)}
+
+    #: class -> attr -> (line, kind, releases) for the del-backstop pass
+    attr_acq: Dict[str, Dict[str, Tuple[int, str, FrozenSet[str]]]] = {}
+    #: class -> attr -> set of method names that release it
+    attr_rel: Dict[str, Dict[str, Set[str]]] = {}
+
+    for fn, cls, disp in _functions(sf.tree):
+        out.extend(_fn_findings(sf, fn, disp, module_generators))
+        if cls is None:
+            continue
+        meth = fn.name
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and isinstance(node.targets[0].value, ast.Name) \
+                    and node.targets[0].value.id == "self" \
+                    and isinstance(node.value, ast.Call):
+                pair = _handle_acquire(node.value)
+                if pair is not None:
+                    attr_acq.setdefault(cls, {})[node.targets[0].attr] = \
+                        (node.lineno, pair[1], pair[0])
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Attribute) and \
+                    isinstance(node.func.value.value, ast.Name) and \
+                    node.func.value.value.id == "self":
+                attr_rel.setdefault(cls, {}).setdefault(
+                    node.func.value.attr, set()).add(meth)
+
+    for cls, attrs in attr_acq.items():
+        for attr, (line, kind, releases) in attrs.items():
+            rel_methods = {m for m in attr_rel.get(cls, {}).get(attr, ())}
+            if rel_methods and rel_methods <= {"__del__"}:
+                out.append(Finding(
+                    "L8", sf.relpath, line,
+                    f"{cls}: self.{attr} ({kind}) acquired at "
+                    f"{sf.relpath}:{line} is released only in __del__ — "
+                    f"exception paths and interpreter shutdown leak it; "
+                    f"release deterministically (close()/context "
+                    f"manager) and keep __del__ as backstop"))
+    return out
+
+
+def _fn_findings(sf: SourceFile, fn, disp: str,
+                 module_generators: Dict[str, ast.AST]) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue  # nested defs analyzed as their own functions
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _owner_fn(fn, node):
+            pair = _handle_acquire(node.value)
+            if pair is not None:
+                out.extend(_check_handle(sf, fn, disp, node,
+                                         node.targets[0].id, pair,
+                                         module_generators))
+        if isinstance(node, ast.Expr) and isinstance(node.value,
+                                                     ast.Call) \
+                and _owner_fn(fn, node):
+            pair = _key_acquire(node.value)
+            if pair is not None:
+                out.extend(_check_key(sf, fn, disp, node, node.value,
+                                      pair))
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call) \
+                and _owner_fn(fn, node):
+            pair = _key_acquire(node.value)
+            if pair is not None:
+                out.extend(_check_key(sf, fn, disp, node, node.value,
+                                      pair))
+    return out
+
+
+def _with_managed(fn, var: str) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Name) and ce.id == var:
+                    return True
+    return False
+
+
+def _check_handle(sf, fn, disp, stmt, var, pair,
+                  module_generators) -> List[Finding]:
+    releases, kind = pair
+    line = stmt.lineno
+    call = _call_name(stmt.value) or "?"
+
+    def releasing(n: ast.Call) -> bool:
+        return (isinstance(n.func, ast.Attribute)
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == var and n.func.attr in releases)
+
+    escaped, gen_handoff = _escapes(fn, stmt, var, releases,
+                                    module_generators)
+    # an escape into a NON-generator sink (e.g. a wrapper object that
+    # owns the release) outranks a generator handoff: the handle's
+    # lifetime no longer depends solely on generator finalization
+    if escaped or _with_managed(fn, var):
+        return []
+    if gen_handoff is not None:
+        gname, gline = gen_handoff
+        return [Finding(
+            "L8", sf.relpath, line,
+            f"{disp}: {kind} {var!r} acquired at {sf.relpath}:{line} "
+            f"({call}) is handed to generator function {gname!r} at "
+            f"line {gline}; its finally-release runs only if iteration "
+            f"starts — an abandoned generator leaks the {kind} until "
+            f"GC runs __del__")]
+
+    ctx = _locate(fn, stmt)
+    if ctx is None:
+        return []
+
+    def releases_in(s: ast.AST) -> bool:
+        return _releases_var(s, var, releases)
+
+    def can_raise_in(s: ast.stmt) -> Optional[int]:
+        return _can_raise(s, releasing)
+
+    if _enclosing_protected(ctx, releases_in):
+        return []
+    verdict = _scan_forward(ctx, releases_in, can_raise_in)
+    return _verdict_finding(sf, disp, line, call, kind, var, verdict)
+
+
+def _check_key(sf, fn, disp, stmt, call_node, pair) -> List[Finding]:
+    releases, kind = pair
+    if not call_node.args or not isinstance(call_node.func,
+                                            ast.Attribute):
+        return []
+    try:
+        recv_src = ast.unparse(call_node.func.value)
+        key_src = ast.unparse(call_node.args[0])
+    except Exception:  # noqa: BLE001 — unparse best-effort
+        return []
+    if not isinstance(call_node.args[0], (ast.Name, ast.Attribute)):
+        return []
+    line = stmt.lineno
+    call = _call_name(call_node) or "?"
+
+    ctx = _locate(fn, stmt)
+    if ctx is None:
+        return []
+
+    def releases_in(s: ast.AST) -> bool:
+        return _releases_key(s, recv_src, key_src, releases)
+
+    def releasing(n: ast.Call) -> bool:
+        return (isinstance(n.func, ast.Attribute)
+                and n.func.attr in releases)
+
+    def can_raise_in(s: ast.stmt) -> Optional[int]:
+        return _can_raise(s, releasing)
+
+    if _enclosing_protected(ctx, releases_in):
+        return []
+    verdict = _scan_forward(ctx, releases_in, can_raise_in)
+    return _verdict_finding(sf, disp, line, call, kind, key_src, verdict)
+
+
+def _verdict_finding(sf, disp, line, call, kind, what,
+                     verdict) -> List[Finding]:
+    shape = verdict[0]
+    if shape == "ok":
+        return []
+    site = f"{kind} {what!r} acquired at {sf.relpath}:{line} ({call})"
+    if shape == "exc":
+        _, risky, rel = verdict
+        return [Finding(
+            "L8", sf.relpath, line,
+            f"{disp}: {site} leaks if line {risky} raises before the "
+            f"release at line {rel} — move the release into a "
+            f"try/finally or context manager")]
+    if shape == "early":
+        return [Finding(
+            "L8", sf.relpath, line,
+            f"{disp}: {site} leaks on the early exit at line "
+            f"{verdict[1]} before any release")]
+    # "end": fell off the block without a release in sight
+    risky = verdict[1]
+    path = (f"the fall-through path (first raising statement: line "
+            f"{risky})" if risky is not None else "the fall-through "
+            "path")
+    return [Finding(
+        "L8", sf.relpath, line,
+        f"{disp}: {site} has no reachable release on {path}")]

@@ -21,7 +21,9 @@ Scopes (mirroring where each invariant lives):
   surface (the CompiledDag wlock/rlock pairing is exactly the shape
   L5 guards);
 - L6 runs over L5's scope plus ``ray_tpu/serve/`` and ``ray_tpu/dag/``
-  (the async request paths the sync-in-async check guards).
+  (the async request paths the sync-in-async check guards);
+- L7 and L8 run over L6's scope — every class with a lock-guarded
+  field and every manual acquire/release pair lives there.
 
 Rules run as independent thunks so the CLI can fan them out across a
 thread pool (``--jobs``); each thunk's wall time is reported in the
@@ -38,7 +40,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.tools.lint import l1_protocol, l2_locks, l3_config, \
-    l4_exceptions, l5_lock_order, l6_thread_context
+    l4_exceptions, l5_lock_order, l6_thread_context, l7_guarded_fields, \
+    l8_lifecycle
 from ray_tpu.tools.lint.base import Finding, SourceFile, iter_py_files, \
     load_file
 
@@ -46,7 +49,7 @@ PROTOCOL_PATH = "ray_tpu/core/protocol.py"
 CONFIG_PATH = "ray_tpu/core/config.py"
 FAULT_PATH = "ray_tpu/core/fault_injection.py"
 
-ALL_RULES = ("L1", "L2", "L3", "L4", "L5", "L6")
+ALL_RULES = ("L1", "L2", "L3", "L4", "L5", "L6", "L7", "L8")
 
 BASELINE_VERSION = 1
 
@@ -101,6 +104,10 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
         if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
                            "ray_tpu/serve/", "ray_tpu/dag/")):
             thread_files.append(sf)
+    # L7/L8 share the widest concurrency scope: everything multi-
+    # threaded plus the serve request paths (thread_files covers
+    # core/ incl. cluster/, train/, serve/, dag/)
+    guard_files = thread_files
 
     test_files: List[SourceFile] = []
     if "L3" in rules:
@@ -134,16 +141,46 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
         thunks["L5"] = lambda: l5_lock_order.analyze(lock_files)
     if "L6" in rules:
         thunks["L6"] = lambda: l6_thread_context.analyze(thread_files)
+    if "L7" in rules:
+        thunks["L7"] = lambda: l7_guarded_fields.analyze(guard_files)
+    if "L8" in rules:
+        thunks["L8"] = lambda: l8_lifecycle.analyze(guard_files)
     return thunks, by_rel
+
+
+def changed_files(root: str, ref: str) -> set:
+    """Repo-relative .py paths changed vs ``ref`` (committed diff plus
+    the working tree). Raises RuntimeError when git cannot answer."""
+    import subprocess
+
+    changed: set = set()
+    for extra in ([ref], []):
+        proc = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", *extra],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git diff {' '.join(extra)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        changed |= {ln.strip() for ln in proc.stdout.splitlines()
+                    if ln.strip().endswith(".py")}
+    return changed
 
 
 def collect_findings_timed(
         root: Optional[str] = None,
         rules: Optional[Sequence[str]] = None,
-        jobs: int = 1) -> Tuple[List[Finding], Dict[str, float]]:
+        jobs: int = 1,
+        changed_only: Optional[set] = None
+        ) -> Tuple[List[Finding], Dict[str, float]]:
     """Run the selected analyzers (``jobs`` > 1 fans rules out across a
     thread pool); suppressed findings are dropped. Returns the sorted
-    findings and per-rule wall time in milliseconds."""
+    findings and per-rule wall time in milliseconds.
+
+    ``changed_only`` (a set of repo-relative paths) filters the
+    REPORTED findings to those files; whole-program rules still load
+    and analyze the full tree, so cross-file context (lock-order
+    graphs, guard inference, call resolution) is never truncated."""
     root = root or default_root()
     selected = {r.upper() for r in rules} if rules else set(ALL_RULES)
     thunks, by_rel = _rule_thunks(root, selected)
@@ -171,6 +208,8 @@ def collect_findings_timed(
         sf = by_rel.get(f.path)
         if sf is not None and sf.suppressed(f.line, f.rule):
             continue
+        if changed_only is not None and f.path not in changed_only:
+            continue
         out.append(f)
     out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return out, wall_ms
@@ -178,9 +217,11 @@ def collect_findings_timed(
 
 def collect_findings(root: Optional[str] = None,
                      rules: Optional[Sequence[str]] = None,
-                     jobs: int = 1) -> List[Finding]:
+                     jobs: int = 1,
+                     changed_only: Optional[set] = None) -> List[Finding]:
     """Run the selected analyzers; suppressed findings are dropped."""
-    return collect_findings_timed(root=root, rules=rules, jobs=jobs)[0]
+    return collect_findings_timed(root=root, rules=rules, jobs=jobs,
+                                  changed_only=changed_only)[0]
 
 
 def load_baseline(path: str) -> set:

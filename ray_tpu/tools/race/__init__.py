@@ -1,0 +1,10 @@
+"""rtpu-race: deterministic interleaving fuzzer for thread schedules.
+
+See :mod:`ray_tpu.tools.race.interleave`.
+"""
+
+from ray_tpu.tools.race.interleave import (arm, arm_from_env, disarm,
+                                           parse_env, schedule, sweep)
+
+__all__ = ["arm", "arm_from_env", "disarm", "parse_env", "schedule",
+           "sweep"]

@@ -1,0 +1,221 @@
+"""Deterministic interleaving fuzzer: seeded preemption injection.
+
+The static rules (L5/L7) prove lock DISCIPLINE; this tool attacks lock
+OMISSION dynamically. It installs a ``sys.settrace`` line tracer in
+threads started while armed and, at deterministically-chosen line
+events, forces a context switch (a tiny ``time.sleep`` releases the
+GIL, letting any runnable sibling thread interleave). Races that hide
+behind the GIL's coarse default switch interval — read-modify-write on
+a shared field, check-then-act windows — surface orders of magnitude
+faster under this schedule perturbation, and the same seed replays the
+same per-thread preemption schedule.
+
+Determinism model
+-----------------
+Each traced thread draws from its OWN ``random.Random`` seeded with
+``(seed, thread name)``, so whether thread T preempts at its k-th
+traced line event is a pure function of the seed and T's name — never
+of wall-clock timing or sibling threads. The recorded per-thread
+schedule (the sequence of ``(file, line)`` preemption points) is
+therefore identical across runs of the same seeded workload, which is
+asserted in the fuzzer's own tests. Name your threads.
+
+Protocol
+--------
+``RTPU_INTERLEAVE=<seed>`` arms one deterministic schedule (replay);
+``RTPU_INTERLEAVE=<seed>:<n>`` denotes the bounded sweep ``seed ..
+seed+n-1`` (``parse_env``/:func:`sweep` consume it). On an assertion
+failure or :class:`~ray_tpu.util.debug_lock.LockOrderError` inside
+``sweep``, the failing seed is printed — export it back through
+``RTPU_INTERLEAVE`` to replay that exact schedule under a debugger.
+
+Relation to ``RTPU_SANITIZE``: the sanitizer detects lock-ORDER bugs
+on schedules that happen; the interleaver manufactures adversarial
+schedules. Armed together (the chaos suites do), the fuzzer drives the
+program into orderings where the sanitizer — and plain asserts — can
+see the bug.
+
+Only threads STARTED while armed are traced (``threading.settrace``),
+plus the arming thread itself; instrumentation is restricted to module
+paths matching ``modules`` substrings, and each thread stops preempting
+after ``max_preemptions`` so an armed long-lived suite degrades to
+native speed instead of timing out.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+ENV = "RTPU_INTERLEAVE"
+
+#: default module-path substrings to instrument: the concurrency surface
+DEFAULT_MODULES = (
+    f"ray_tpu{os.sep}core{os.sep}",
+    f"ray_tpu{os.sep}dag{os.sep}",
+    f"ray_tpu{os.sep}serve{os.sep}",
+    f"ray_tpu{os.sep}train{os.sep}",
+)
+
+#: preemption sleep: long enough to release the GIL and let any runnable
+#: sibling run, short enough that hundreds of preemptions stay cheap
+_PREEMPT_SLEEP_S = 0.0002
+
+
+class _State:
+    """One armed session (module-global singleton under ``_STATE``)."""
+
+    def __init__(self, seed: int, modules: Tuple[str, ...],
+                 preempt_prob: float, max_preemptions: int):
+        self.seed = seed
+        self.modules = modules
+        self.preempt_prob = preempt_prob
+        self.max_preemptions = max_preemptions
+        self.local = threading.local()
+        #: thread name -> ordered preemption points (file, line)
+        self.schedule: Dict[str, List[Tuple[str, int]]] = {}
+        self.schedule_lock = threading.Lock()
+
+
+_STATE: Optional[_State] = None
+
+
+def _thread_slot(st: _State):
+    """Per-thread (rng, budget box, schedule list), created on first
+    traced event in the thread. Seeded (seed, thread name): the
+    preemption decisions of a thread depend only on the seed and its
+    own deterministic sequence of traced line events."""
+    slot = getattr(st.local, "slot", None)
+    if slot is None:
+        name = threading.current_thread().name
+        rng = random.Random(f"{st.seed}\x00{name}")
+        sched: List[Tuple[str, int]] = []
+        with st.schedule_lock:
+            # re-used thread names share one recorded lane, appended in
+            # per-thread deterministic order
+            sched = st.schedule.setdefault(name, sched)
+        slot = (rng, [st.max_preemptions], sched)
+        st.local.slot = slot
+    return slot
+
+
+def _local_trace(frame, event, arg):
+    st = _STATE
+    if st is None:
+        return None
+    if event == "line":
+        rng, budget, sched = _thread_slot(st)
+        if budget[0] > 0 and rng.random() < st.preempt_prob:
+            budget[0] -= 1
+            sched.append((os.path.basename(frame.f_code.co_filename),
+                          frame.f_lineno))
+            time.sleep(_PREEMPT_SLEEP_S)
+    return _local_trace
+
+
+def _global_trace(frame, event, arg):
+    st = _STATE
+    if st is None:
+        return None
+    if event != "call":
+        return None
+    fname = frame.f_code.co_filename
+    for frag in st.modules:
+        if frag in fname:
+            return _local_trace
+    return None  # foreign module: do not trace this frame's lines
+
+
+def arm(seed: int, modules: Iterable[str] = DEFAULT_MODULES,
+        preempt_prob: float = 0.05, max_preemptions: int = 500,
+        trace_current: bool = True) -> None:
+    """Start injecting preemptions. Affects threads started from now on
+    (``threading.settrace``) and — with ``trace_current`` — the calling
+    thread too. Re-arming replaces the previous session."""
+    global _STATE
+    _STATE = _State(int(seed), tuple(modules), float(preempt_prob),
+                    int(max_preemptions))
+    threading.settrace(_global_trace)
+    if trace_current:
+        sys.settrace(_global_trace)
+
+
+def disarm() -> None:
+    """Stop injecting. Threads already running keep their (now inert)
+    tracer until they next hit it — ``_STATE is None`` short-circuits,
+    so the residual cost is one attribute load per event."""
+    global _STATE
+    _STATE = None
+    threading.settrace(None)  # type: ignore[arg-type]
+    if sys.gettrace() is _global_trace:
+        sys.settrace(None)
+
+
+def schedule() -> Dict[str, List[Tuple[str, int]]]:
+    """The armed session's recorded preemption points, per thread name.
+    Deterministic for a fixed seed and seeded workload."""
+    st = _STATE
+    if st is None:
+        return {}
+    with st.schedule_lock:
+        return {k: list(v) for k, v in st.schedule.items()}
+
+
+def parse_env(value: Optional[str] = None
+              ) -> Optional[Tuple[int, int]]:
+    """Parse ``RTPU_INTERLEAVE`` into ``(seed, n_seeds)``; ``None`` when
+    unset/empty/malformed. ``"7"`` -> ``(7, 1)``; ``"7:20"`` ->
+    ``(7, 20)``."""
+    raw = os.environ.get(ENV, "") if value is None else value
+    raw = raw.strip()
+    if not raw:
+        return None
+    head, _, tail = raw.partition(":")
+    try:
+        seed = int(head)
+        n = int(tail) if tail else 1
+    except ValueError:
+        return None
+    return (seed, max(1, n))
+
+
+def arm_from_env(**kwargs) -> Optional[int]:
+    """Arm from ``RTPU_INTERLEAVE`` (first seed of a ``seed:n`` range);
+    no-op returning None when the variable is unset. Returns the armed
+    seed for logging."""
+    parsed = parse_env()
+    if parsed is None:
+        return None
+    seed, _ = parsed
+    arm(seed, **kwargs)
+    return seed
+
+
+def sweep(fn: Callable[[], None], seeds: Iterable[int],
+          modules: Iterable[str] = DEFAULT_MODULES,
+          preempt_prob: float = 0.05, max_preemptions: int = 500
+          ) -> int:
+    """Run ``fn`` once per seed under that seed's schedule. On an
+    assertion or lock-order failure the FAILING SEED is printed (replay:
+    ``RTPU_INTERLEAVE=<seed>``) and the error re-raised. Returns the
+    number of seeds that passed."""
+    from ray_tpu.util.debug_lock import LockOrderError
+
+    passed = 0
+    for seed in seeds:
+        arm(seed, modules=modules, preempt_prob=preempt_prob,
+            max_preemptions=max_preemptions)
+        try:
+            fn()
+        except (AssertionError, LockOrderError) as e:
+            print(f"rtpu-race: seed {seed} FAILED ({type(e).__name__}); "
+                  f"replay with {ENV}={seed}", file=sys.stderr)
+            raise
+        finally:
+            disarm()
+        passed += 1
+    return passed

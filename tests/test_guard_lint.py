@@ -1,0 +1,349 @@
+"""rtpu-guard fixture tests: L7 (inferred lock protection) and L8
+(resource lifecycle) on miniature sources, plus the --diff CLI mode.
+
+These pin the analyzers' contracts — what counts as a guard, what a
+declaration overrides, which lifecycle shapes are findings — so a
+refactor of the rules cannot silently widen or narrow them.
+"""
+
+import os
+import subprocess
+import textwrap
+
+from ray_tpu.tools.lint import l7_guarded_fields, l8_lifecycle
+from ray_tpu.tools.lint.__main__ import main as lint_main
+from ray_tpu.tools.lint.base import SourceFile
+
+
+def _sf(text: str, relpath: str = "ray_tpu/core/sample.py") -> SourceFile:
+    return SourceFile(relpath, relpath, text=textwrap.dedent(text))
+
+
+def _l7(text: str):
+    sf = _sf(text)
+    return [f for f in l7_guarded_fields.analyze([sf])
+            if not sf.suppressed(f.line, f.rule)]
+
+
+def _l8(text: str):
+    sf = _sf(text)
+    return [f for f in l8_lifecycle.analyze([sf])
+            if not sf.suppressed(f.line, f.rule)]
+
+
+# ------------------------------------------------------------------ L7
+
+
+_GUARDED = '''\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def a(self):
+        with self._lock:
+            self._count += 1
+
+    def b(self):
+        with self._lock:
+            self._count += 1
+
+    def c(self):
+        self._count += 1
+'''
+
+
+def test_l7_majority_inference_flags_stray_access():
+    findings = _l7(_GUARDED)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "L7" and "C.c" in f.message
+    assert "_count" in f.message and "_lock" in f.message
+    # the finding cites the inferred guard AND a witness guarded site
+    assert "inferred guard" in f.message
+    assert "witness guarded site" in f.message
+
+
+def test_l7_below_majority_stays_quiet():
+    # 1 guarded / 1 unguarded: no majority, no inference, no noise
+    assert _l7('''\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def b(self):
+                self._n += 1
+    ''') == []
+
+
+def test_l7_init_writes_are_exempt():
+    # __init__ seeds fields without the lock by design — the fixture
+    # above would otherwise count two unguarded writes per class
+    findings = _l7(_GUARDED)
+    assert all("__init__" not in f.message for f in findings)
+
+
+def test_l7_callback_write_flagged():
+    findings = _l7('''\
+        import threading
+
+        class D:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def b(self):
+                with self._lock:
+                    self._n += 2
+
+            def go(self, spawn):
+                def cb():
+                    self._n = 5
+                spawn(cb)
+    ''')
+    assert len(findings) == 1
+    # a nested def runs on whatever thread invokes it: lexically held
+    # locks don't transfer, so the write inside cb() is unguarded
+    assert "D.go" in findings[0].message
+    assert "nested def" in findings[0].message
+
+
+def test_l7_explicit_guarded_by_declaration():
+    # _guarded_by_ binds the field to a guard the tally alone would
+    # never infer (no guarded access exists yet)
+    findings = _l7('''\
+        import threading
+
+        class E:
+            _guarded_by_ = {"_q": "_mu"}
+
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._q = []
+
+            def a(self):
+                self._q.append(1)
+    ''')
+    assert len(findings) == 1
+    assert "declared guard" in findings[0].message
+    assert "_mu" in findings[0].message
+
+
+def test_l7_guarded_by_none_suppresses_inference():
+    # the same majority shape as _GUARDED, but the class declares the
+    # field deliberately lock-free — inference must stand down
+    assert _l7(_GUARDED.replace(
+        "class C:",
+        'class C:\n    _guarded_by_ = {"_count": None}\n')) == []
+
+
+def test_l7_waiver_comment_suppresses_site():
+    waived = _GUARDED.replace(
+        "    def c(self):\n        self._count += 1",
+        "    def c(self):\n"
+        "        # rtpu-lint: disable=L7 — racy-read tolerated here\n"
+        "        self._count += 1")
+    assert _l7(waived) == []
+
+
+def test_l7_lock_named_fields_exempt():
+    # fields that ARE locks/conditions are infrastructure, not data
+    assert _l7('''\
+        import threading
+
+        class F:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def a(self):
+                with self._lock:
+                    pass
+
+            def b(self):
+                with self._lock:
+                    pass
+
+            def c(self):
+                return self._cond
+    ''') == []
+
+
+# ------------------------------------------------------------------ L8
+
+
+def test_l8_exception_path_leak_flagged():
+    findings = _l8('''\
+        def store_it(store, oid, payload):
+            dst = store.create_object(oid, len(payload))
+            dst[:] = pack(payload)
+            store.seal(oid)
+    ''')
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "L8"
+    # cites the acquire site and the unreleased path
+    assert "create_object" in f.message and "leaks if line" in f.message
+
+
+def test_l8_release_in_handler_is_clean():
+    assert _l8('''\
+        def store_it(store, oid, payload):
+            dst = store.create_object(oid, len(payload))
+            try:
+                dst[:] = payload
+                store.seal(oid)
+            except ValueError:
+                store.release(oid)
+                store.delete(oid)
+                raise
+    ''') == []
+
+
+def test_l8_early_exit_leak_flagged():
+    findings = _l8('''\
+        def probe(sockmod, addr):
+            s = sockmod.socket()
+            s.connect(addr)
+            return True
+    ''')
+    # the acquire's block falls to a return before any close — flagged
+    # with the exit line
+    assert len(findings) == 1
+    assert "socket" in findings[0].message
+    assert "early exit" in findings[0].message
+
+
+def test_l8_with_managed_is_clean():
+    assert _l8('''\
+        def fetch(sockmod):
+            s = sockmod.socket()
+            with s:
+                return s.recv(1)
+    ''') == []
+
+
+def test_l8_generator_handoff_flagged():
+    findings = _l8('''\
+        class R:
+            def _admit(self):
+                return object()
+
+            def entry(self):
+                token = self._admit()
+                return self._stream(token)
+
+            def _stream(self, token):
+                try:
+                    yield 1
+                finally:
+                    token.release()
+    ''')
+    assert len(findings) == 1
+    assert "generator function" in findings[0].message
+    assert "_stream" in findings[0].message
+
+
+def test_l8_wrapper_escape_outranks_generator_handoff():
+    # handing the token to a wrapper OBJECT that owns release (the
+    # router's _TokenStream shape) transfers ownership: not a finding
+    assert _l8('''\
+        class W:
+            def __init__(self, gen, token):
+                self._gen = gen
+                self._token = token
+
+        class R:
+            def _admit(self):
+                return object()
+
+            def entry(self):
+                token = self._admit()
+                return W(self._stream(token), token)
+
+            def _stream(self, token):
+                try:
+                    yield 1
+                finally:
+                    token.release()
+    ''') == []
+
+
+def test_l8_del_only_release_flagged():
+    findings = _l8('''\
+        class H:
+            def __init__(self, sockmod):
+                self._sock = sockmod.socket()
+
+            def __del__(self):
+                self._sock.close()
+    ''')
+    assert len(findings) == 1
+    assert "__del__" in findings[0].message
+
+
+def test_l8_del_backstop_with_real_release_is_clean():
+    assert _l8('''\
+        class H:
+            def __init__(self, sockmod):
+                self._sock = sockmod.socket()
+
+            def close(self):
+                self._sock.close()
+
+            __del__ = close
+    ''') == []
+
+
+# ----------------------------------------------------------- --diff
+
+
+def _git(root, *args):
+    subprocess.run(["git", "-C", root, *args], check=True,
+                   capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t",
+                        "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_cli_diff_filters_to_changed_files(tmp_path, capsys):
+    root = str(tmp_path / "repo")
+    core = os.path.join(root, "ray_tpu", "core")
+    os.makedirs(core)
+    bad = ("def f():\n    try:\n        g()\n"
+           "    except Exception:\n        pass\n")
+    with open(os.path.join(core, "old.py"), "w") as f:
+        f.write(bad)
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "seed")
+
+    # no changes vs HEAD: clean exit regardless of pre-existing findings
+    assert lint_main(["--root", root, "--diff", "HEAD"]) == 0
+    assert "no .py files changed" in capsys.readouterr().out
+
+    # a NEW bad file is reported; the old finding stays filtered out
+    with open(os.path.join(core, "new.py"), "w") as f:
+        f.write(bad)
+    _git(root, "add", "-A")
+    assert lint_main(["--root", root, "--diff", "HEAD"]) == 1
+    out = capsys.readouterr().out
+    assert "new.py" in out and "old.py" not in out
+
+    # bogus ref is a usage error, not a crash
+    assert lint_main(["--root", root, "--diff", "no-such-ref"]) == 2
+    capsys.readouterr()

@@ -805,7 +805,8 @@ def test_cli_json_output(tmp_path, capsys):
     assert set(findings[0]) == {"rule", "path", "line", "message", "key"}
     # every rule that ran reports its wall time (the mini-tree has no
     # protocol.py/config.py, so L1/L3 are skipped and report none)
-    assert set(data["rule_wall_ms"]) == {"L2", "L4", "L5", "L6"}
+    assert set(data["rule_wall_ms"]) == {"L2", "L4", "L5", "L6", "L7",
+                                         "L8"}
     assert all(ms >= 0 for ms in data["rule_wall_ms"].values())
 
 
