@@ -1088,6 +1088,58 @@ def bench_gcs_failover(rows: list):
             runtime_context.set_core(prev)
 
 
+def bench_partition_heal(rows: list):
+    """partition_heal_recovery_ms: sever the driver<->GCS edge of a live
+    2-node cluster with a netem partition (no process dies — the wire
+    does), poke the control plane so every pooled connection poisons,
+    then heal and time until the cluster fully answers again — a KV
+    write accepted AND an actor call served. This prices the reconnect
+    path (pool teardown + redial + retry weave) that a real switch flap
+    exercises, as opposed to bench_gcs_failover's process-death path.
+    Median of 3 rounds; the partition is held well under the 3 s
+    heartbeat death timeout so no node is declared dead. No reference
+    number — the conservative bar lives in BASELINE.json.published."""
+    import ray_tpu
+    from ray_tpu.core import runtime_context
+    from ray_tpu.core.cluster.fixture import Cluster
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    c = Cluster(num_nodes=2, num_workers_per_node=1,
+                object_store_memory=64 << 20)
+    try:
+        assert c.wait_for_nodes(2, timeout=120)
+        core = c.connect()
+
+        @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+        class P:
+            def ping(self):
+                return 1
+
+        a = P.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+
+        times = []
+        for _ in range(3):
+            c.partition("driver", "gcs")
+            hold = time.perf_counter()
+            while time.perf_counter() - hold < 0.5:
+                # poison the pooled GCS connections so the healed round
+                # has to pay the full redial, not ride a warm socket
+                core.gcs.try_call(("kv", "put", "bench-chaos", 0))
+                time.sleep(0.05)
+            c.heal()
+            t0 = time.perf_counter()
+            core.gcs.call(("kv", "put", "bench-chaos", 1))
+            assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+            times.append((time.perf_counter() - t0) * 1e3)
+        rows.append(_row("partition_heal_recovery_ms",
+                         sorted(times)[1], "ms"))
+    finally:
+        c.shutdown()
+        runtime_context.set_core(prev)
+
+
 def bench_elastic(rows: list):
     """elastic_resume_s: a 4-worker elastic training gang loses its
     highest rank to SIGKILL mid-run (gang_resize fault site) and rides
@@ -1347,6 +1399,14 @@ def main():
         rows.append({"metric": "gcs_failover_recovery_ms", "value": -1,
                      "unit": f"error: {e}"})
 
+    # wire-level chaos recovery on a fresh 2-node cluster (ISSUE 15:
+    # netem partition + heal, nothing dies — prices the reconnect path)
+    try:
+        bench_partition_heal(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "partition_heal_recovery_ms", "value": -1,
+                     "unit": f"error: {e}"})
+
     # elastic gang shrink ride-through (ISSUE 7: SIGKILL a gang worker,
     # resume warm at the smaller world size from the last consistent
     # checkpoint)
@@ -1549,6 +1609,8 @@ def main():
              "locality_scheduling_speedup", True),
             ("cross_node_fetch_gbps", "cross_node_fetch_gbps", True),
             ("gcs_failover_recovery_ms", "gcs_failover_recovery_ms",
+             False),
+            ("partition_heal_recovery_ms", "partition_heal_recovery_ms",
              False),
             ("elastic_resume_s", "elastic_resume_s", False),
             ("serve_p99_ttft_overload_ms",

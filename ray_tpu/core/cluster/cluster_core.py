@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ray_tpu.core import protocol, serialization
+from ray_tpu.core import netem, protocol, serialization
 from ray_tpu.core.cluster.ha import HaGcsClient
 from ray_tpu.core.cluster.rpc import ClientCache, RpcError, cluster_authkey
 from ray_tpu.core.config import config
@@ -62,6 +62,9 @@ class ClusterCore:
     def __init__(self, gcs_address: Tuple[str, int],
                  authkey: Optional[bytes] = None):
         self._authkey = authkey or cluster_authkey()
+        # netem source selector: outbound driver edges match "driver"
+        # role rules (nothing dials the driver, so no listen address)
+        netem.set_identity("driver")
         # ride-through GCS client: calls park (bounded by
         # gcs_op_buffer_max / gcs_reconnect_timeout_s) while the head is
         # down, then fail with the typed GcsUnavailableError; a detected
@@ -1440,3 +1443,9 @@ class ClusterCore:
                 pass
         self._nodes.close_all()
         self.gcs.close()
+        # reap the death-watch: close() wakes any call it has parked in
+        # the ride-through loop, so the thread exits within one poll
+        # period — without the join it outlives shutdown() and bleeds
+        # connect-retry activity into whatever runs next (the seeded
+        # interleave tracer sees that as a schedule mismatch)
+        self._monitor.join(timeout=5.0)

@@ -83,6 +83,10 @@ class Cluster:
         self._nw = num_workers_per_node
         self._gcs_persist_dir = gcs_persist_dir
 
+        # (src, dst) endpoint pairs with netem partition rules armed via
+        # partition(); heal() clears exactly these
+        self._partitions: List[Tuple[object, object]] = []
+
         self._gcs_port = pick_port()
         self._start_gcs()
 
@@ -183,6 +187,69 @@ class Cluster:
                                 timeout))
         finally:
             client.close()
+
+    # ------------------------------------------------ network chaos (netem)
+
+    def _netem_addr(self, ep) -> Optional[Tuple[str, int]]:
+        """Resolve a partition endpoint to its listen address: "gcs",
+        "driver" (no listen address — nothing dials the driver), a
+        NodeProc, or an explicit (host, port) tuple."""
+        if ep == "gcs":
+            return self.gcs_address
+        if ep == "driver":
+            return None
+        if isinstance(ep, NodeProc):
+            return ep.address
+        return tuple(ep)
+
+    def _netem_ctl(self, ep, cmd: str, *args):
+        """Deliver one netem control op to an endpoint's process. The
+        driver is this process (in-process call); nodes and the GCS get
+        a ``("netem", ...)`` RPC over their (unaffected) control edge."""
+        from ray_tpu.core import netem
+
+        if ep == "driver":
+            return netem.control(cmd, *args)
+        addr = self._netem_addr(ep)
+        client = RpcClient(addr, self.authkey, connect_timeout=5.0)
+        try:
+            return client.call(("netem", cmd) + args)
+        finally:
+            client.close()
+
+    def partition(self, a, b, oneway: bool = False):
+        """Sever the network edge a -> b (and b -> a unless ``oneway``)
+        by arming client-side netem partition rules in the source
+        process(es). Endpoints: "gcs", "driver", a NodeProc, or an
+        address tuple. Reversed by heal()."""
+        for src, dst in ((a, b),) if oneway else ((a, b), (b, a)):
+            dst_addr = self._netem_addr(dst)
+            if dst_addr is None:
+                continue  # nothing dials the driver: no inbound edge
+            self._netem_ctl(src, "add", "*",
+                            f"{dst_addr[0]}:{dst_addr[1]}", "partition", {})
+            self._partitions.append((src, dst))
+
+    def heal(self):
+        """Clear every partition armed through partition(). Best-effort
+        per endpoint: a process that died mid-chaos is skipped. Driver-
+        sourced rules clear FIRST — they live in this process and can
+        sever the very control edges the remote clears dial over (e.g.
+        partition(driver, node) + partition(node, gcs): the node's rule
+        is cleared via an RPC the driver's own rule would block)."""
+        parts, self._partitions = self._partitions, []
+        parts.sort(key=lambda p: p[0] != "driver")
+        for src, dst in parts:
+            dst_addr = self._netem_addr(dst)
+            if dst_addr is None:
+                continue
+            try:
+                self._netem_ctl(src, "clear", "*",
+                                f"{dst_addr[0]}:{dst_addr[1]}", "partition")
+            # rtpu-lint: disable=L4 — heal is teardown-adjacent: a dead
+            # endpoint can't hold a partition rule anyway
+            except Exception:  # noqa: BLE001
+                pass
 
     def connect(self):
         """A ClusterCore driver bound to this cluster (also installs it as

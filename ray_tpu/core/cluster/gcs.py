@@ -30,9 +30,10 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.core import fault_injection
+from ray_tpu.core import fault_injection, netem
 from ray_tpu.core.cluster.rpc import RpcServer, cluster_authkey
 from ray_tpu.core.config import config
+from ray_tpu.exceptions import StaleGcsEpochError
 from ray_tpu.util.debug_lock import make_lock
 
 # ops whose effects must survive a GCS restart (heartbeats and reads are
@@ -100,7 +101,16 @@ class GcsServer:
     #   set before serving starts and nulled once at close(); readers
     #   probe it lock-free by design (lock order forbids _wal_lock under
     #   self._lock). The file CONTENTS are serialized by _wal_lock.
-    _guarded_by_ = {"_pdir": None, "_epoch": None, "_wal": None}
+    # - _epoch_seq: monotonic incarnation counter, write-once in
+    #   __init__, immutable.
+    # - _fenced / _fenced_by: one-way False->True split-brain latch.
+    #   Writers hold self._lock; the per-op dispatch check in _handle
+    #   reads it lock-free by design (a latch read can only be one op
+    #   late, and taking self._lock on every dispatch would tax the
+    #   hot path for a test-of-time rarity).
+    _guarded_by_ = {"_pdir": None, "_epoch": None, "_wal": None,
+                    "_epoch_seq": None, "_fenced": None,
+                    "_fenced_by": None}
 
     def __init__(self, port: int = 0, authkey: Optional[bytes] = None,
                  persistence_path: Optional[str] = None):
@@ -145,6 +155,15 @@ class GcsServer:
         # head restarted (even a fast restart between two heartbeats) and
         # trigger a full resync (reference: gcs_server session_name).
         self._epoch = os.urandom(8).hex()
+        # Split-brain fencing latch: set when evidence arrives that a
+        # NEWER GCS incarnation exists (a node reported a higher
+        # epoch_seq, or rejected one of our writes with
+        # StaleGcsEpochError). A fenced head stops restarting actors,
+        # stops marking deaths, and rejects mutating ops — the random
+        # _epoch above detects restarts, the monotonic _epoch_seq
+        # (minted below, after persistence) ORDERS incarnations.
+        self._fenced = False
+        self._fenced_by = 0  # newest epoch_seq that fenced us
         # RECOVERING window: a restart that rehydrated prior state gives
         # known nodes/drivers this long to heartbeat back in before the
         # health loop may declare them DEAD (set in _load_persisted).
@@ -168,11 +187,34 @@ class GcsServer:
             self._load_persisted()
             self._replaying = False
             self._wal = open(os.path.join(persistence_path, "wal.pkl"), "ab")
+        self._epoch_seq = self._mint_epoch_seq()
         self._server = RpcServer(self._handle, self._authkey, port=port)
         self.address = self._server.address
+        netem.set_identity("gcs", self.address)
         self._monitor = threading.Thread(target=self._health_loop,
                                          daemon=True, name="gcs-health")
         self._monitor.start()
+
+    def _mint_epoch_seq(self) -> int:
+        """A strictly increasing incarnation number. With a persist dir
+        it is a durable counter file (incremented per incarnation, so
+        any two heads sharing the dir are totally ordered); without one
+        a millisecond timestamp still orders incarnations across
+        processes well enough for fencing tests."""
+        if self._pdir:
+            path = os.path.join(self._pdir, "epoch_seq")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    prev = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                prev = 0
+            seq = prev + 1
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(str(seq))
+            os.replace(tmp, path)
+            return seq
+        return int(time.time() * 1000)
 
     # ------------------------------------------------------- persistence
 
@@ -351,6 +393,11 @@ class GcsServer:
         while not self._stop:
             time.sleep(min(0.1, timeout / 4))
             now = time.monotonic()
+            if self._fenced:
+                # a newer head exists: marking deaths from this side of
+                # the partition would fork cluster state (the classic
+                # split-brain write) — stand down until killed
+                continue
             if now < self._recovering_until:
                 # RECOVERING: we just rehydrated from snapshot+WAL and the
                 # whole cluster is reconnecting — declaring anything DEAD
@@ -417,6 +464,8 @@ class GcsServer:
             self._peers = ClientCache(self._authkey)
         for aid in actor_ids:
             with self._lock:
+                if self._fenced:
+                    return  # stale head: a newer incarnation owns the FSM
                 spec = self._actor_specs.get(aid)
             if spec is None:
                 continue
@@ -458,7 +507,17 @@ class GcsServer:
                     self._peers.get(addr).call(
                         ("create_actor", spec["cls_fn_id"], pickled,
                          spec["payload"], list(spec.get("deps") or []),
-                         opts, None, aid, nonce, spec.get("owner")))
+                         opts, None, aid, nonce, spec.get("owner"),
+                         self._epoch_seq))
+                except StaleGcsEpochError as fe:
+                    # the node has seen a NEWER head: we are the stale
+                    # half of a split brain — fence ourselves and stop
+                    # writing (the new incarnation owns the restart FSM)
+                    with self._lock:
+                        self._fenced = True
+                        self._fenced_by = max(self._fenced_by,
+                                              fe.current_seq)
+                    return
                 except RpcError:
                     time.sleep(0.5)
                     continue
@@ -495,7 +554,13 @@ class GcsServer:
                     # or it runs orphaned, holding resources forever
                     try:
                         self._peers.get(addr).call(
-                            ("kill_actor", aid, True))
+                            ("kill_actor", aid, True, self._epoch_seq))
+                    except StaleGcsEpochError as fe:
+                        with self._lock:
+                            self._fenced = True
+                            self._fenced_by = max(self._fenced_by,
+                                                  fe.current_seq)
+                        return
                     except RpcError:
                         pass
                 break
@@ -551,6 +616,15 @@ class GcsServer:
         fn = getattr(self, "_op_" + op, None)
         if fn is None:
             raise ValueError(f"unknown GCS op {op!r}")
+        if (self._fenced and op in _WAL_OPS
+                and (op != "kv" or msg[1] in _WAL_KV_MUTATORS)):
+            # stale-writer rejection, server side: once fenced, every
+            # state-mutating op gets the typed error — a client still
+            # talking to this head must fail over to the new one, not
+            # write into a fork
+            raise StaleGcsEpochError(
+                f"GCS mutation {op!r} rejected: this head is fenced",
+                stale_seq=self._epoch_seq, current_seq=self._fenced_by)
         if (self._wal is not None and op in _WAL_OPS
                 and (op != "kv" or msg[1] in _WAL_KV_MUTATORS)):
             # apply + log atomically: concurrent mutators serialize here,
@@ -572,21 +646,32 @@ class GcsServer:
             self._cond.notify_all()
         return True
 
-    def _op_heartbeat(self, node_id: bytes, avail: dict, load: int):
+    def _op_heartbeat(self, node_id: bytes, avail: dict, load: int,
+                      seen_epoch_seq: int = 0):
         # replies carry the GCS epoch so nodes detect a head restart even
         # when every heartbeat is accepted (persisted state restored the
-        # node as ALIVE) and resync their locations/actors/PGs
+        # node as ALIVE) and resync their locations/actors/PGs; they also
+        # carry epoch_seq (fencing order) and the freed-channel head so
+        # a node can cheaply notice frees it missed while partitioned
         with self._lock:
+            if seen_epoch_seq and seen_epoch_seq > self._epoch_seq:
+                # the node has heartbeated a NEWER incarnation: this
+                # head is the stale side of a split brain — fence
+                self._fenced = True
+                self._fenced_by = max(self._fenced_by, seen_epoch_seq)
+            base = {"epoch": self._epoch, "epoch_seq": self._epoch_seq,
+                    "fenced": self._fenced,
+                    "freed_head": self._channel_seq.get("freed", 0)}
             info = self._nodes.get(node_id)
-            if info is None or info.state == "DEAD":
-                # node must re-register
-                return {"accepted": False, "epoch": self._epoch}
+            if self._fenced or info is None or info.state == "DEAD":
+                # node must re-register (or, fenced: go away entirely)
+                return dict(base, accepted=False)
             info.last_heartbeat = time.monotonic()
             if info.avail != avail or info.load != load:
                 info.avail = dict(avail)
                 info.load = load
                 self._view_version += 1
-        return {"accepted": True, "epoch": self._epoch}
+        return dict(base, accepted=True)
 
     def _op_unregister_node(self, node_id: bytes):
         with self._lock:
@@ -887,6 +972,12 @@ class GcsServer:
     def _op_ping(self):
         return "pong"
 
+    def _op_netem(self, cmd: str, *args):
+        """Remote control for the netem shim in THIS process: the test
+        fixture arms/clears partition rules on the GCS side of an edge
+        over a still-healthy path (see core/netem.py)."""
+        return netem.control(cmd, *args)
+
     def _op_gcs_info(self):
         """Identity + recovery status + resync cursors, in one read.
 
@@ -899,6 +990,8 @@ class GcsServer:
         with self._lock:
             return {
                 "epoch": self._epoch,
+                "epoch_seq": self._epoch_seq,
+                "fenced": self._fenced,
                 "recovering": time.monotonic() < self._recovering_until,
                 "view_version": self._view_version,
                 "nodes_alive": sum(1 for i in self._nodes.values()

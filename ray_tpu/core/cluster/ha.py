@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Any, Callable, Optional, Tuple
 
+from ray_tpu.core import netem
 from ray_tpu.core.cluster.rpc import RpcClient, RpcError
 from ray_tpu.core.config import config
 from ray_tpu.exceptions import GcsUnavailableError
@@ -65,6 +66,7 @@ class HaGcsClient:
     def __init__(self, address: Tuple[str, int], authkey: bytes,
                  on_reconnect: Optional[Callable[[dict], None]] = None):
         self.address = tuple(address)
+        netem.tag_peer(self.address, "gcs")  # role-selector rules match it
         self._rpc = RpcClient(self.address, authkey,
                               connect_timeout=_ATTEMPT_TIMEOUT_S,
                               unavailable_exc=GcsUnavailableError)
@@ -231,6 +233,17 @@ def resync_node(server) -> bool:
     try:
         server.gcs.call(server.register_msg())
 
+        # replay the freed channel BEFORE re-publishing locations: frees
+        # broadcast while this node was partitioned must land first, or
+        # the batch below re-advertises a stale copy of a freed object
+        # (and a getter could read it back). An EMPTY restart reset the
+        # channel seq, so clamp the cursor to the head's watermark first.
+        info = server.gcs.call(("gcs_info",))
+        if isinstance(info, dict):
+            server._clamp_freed_cursor(
+                info.get("channel_seq", {}).get("freed", 0))
+        server._drain_freed()
+
         # sealed object locations, with sizes for the locality scorer;
         # collect under the runtime lock, measure + publish outside it
         with rt._lock:
@@ -275,7 +288,7 @@ def resync_node(server) -> bool:
 
         # clamp the driver-death watermark: an EMPTY restart reset the
         # seq to 0, and a cursor left high would skip every future death
-        info = server.gcs.call(("gcs_info",))
+        # (reuses the gcs_info snapshot fetched before the replay above)
         if isinstance(info, dict):
             server._driver_death_seq = min(
                 server._driver_death_seq, info.get("driver_death_seq", 0))

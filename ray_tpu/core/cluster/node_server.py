@@ -26,7 +26,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.core import external_storage, protocol, serialization
+from ray_tpu.core import external_storage, netem, protocol, serialization
 from ray_tpu.core.cluster.pull_manager import (PRIO_GET, PRIO_TASK_ARGS,
                                                PRIO_WAIT)
 from ray_tpu.core.cluster.ha import HaGcsClient, resync_node
@@ -38,7 +38,8 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.runtime import Runtime, _TaskSpec
 from ray_tpu.util.debug_lock import make_condition, make_lock
 from ray_tpu.exceptions import (ActorDiedError, ActorError, ObjectLostError,
-                                ObjectStoreFullError, ObjectTimeoutError)
+                                ObjectStoreFullError, ObjectTimeoutError,
+                                StaleGcsEpochError)
 
 # Tag prefix for ops; kept as plain strings (framed pickle transport).
 
@@ -381,6 +382,22 @@ class NodeServer:
 
         self._server = RpcServer(self._handle, self._authkey, port=port)
         self.address = self._server.address
+        netem.set_identity("node", self.address)
+
+        # split-brain fencing: newest GCS epoch_seq observed in
+        # heartbeat replies. GCS-originated writes (actor restarts,
+        # reaps) carry their sender's seq; a token older than this is a
+        # partitioned stale head and is rejected with
+        # StaleGcsEpochError (see _check_gcs_epoch). Single-writer
+        # (heartbeat thread), lock-free monotonic reads elsewhere.
+        self._gcs_epoch_seq = 0
+        # freed-channel cursor: heartbeat replies piggyback the channel
+        # head, so frees that happened while this node was partitioned
+        # are replayed (copies reclaimed, tombstones applied) within
+        # one heartbeat of heal — and again during resync, BEFORE
+        # locations are re-published (the gcs.py stale-copy hole)
+        self._freed_seq = 0
+        self._freed_cursor_lock = make_lock("NodeServer._freed_cursor_lock")
 
         # sender-side transfer flow control (reference: push_manager.h —
         # cap outbound chunk bytes in flight; requesters queue FIFO-ish
@@ -439,6 +456,14 @@ class NodeServer:
         self._fetch_bytes = 0
         self._fetch_seconds = 0.0
         self._fetch_count = 0
+        # per-peer suspicion for fetch-candidate ordering: addr ->
+        # [latency EWMA s, consecutive transport failures, last-fail
+        # monotonic]. A peer that heartbeats the GCS fine but cannot
+        # serve data (asymmetric partition) accumulates failures and
+        # sinks to the back of every candidate list instead of eating
+        # the pull budget first; surfaced via ("state",).
+        self._peer_health: Dict[Tuple[str, int], list] = {}
+        self._peer_health_lock = make_lock("NodeServer._peer_health_lock")
         # pull admission: bulk transfers reserve their byte size against
         # a store-derived budget, in priority order task-args > get >
         # wait (reference: pull_manager.h:52). Small payloads (below the
@@ -492,8 +517,15 @@ class NodeServer:
                 avail = rt._avail.to_dict()
                 load = len(rt._task_queue)
             reply = self.gcs.try_call(
-                ("heartbeat", self.node_id.binary(), avail, load))
+                ("heartbeat", self.node_id.binary(), avail, load,
+                 self._gcs_epoch_seq))
             if reply is not None:
+                seq = reply.get("epoch_seq")
+                if isinstance(seq, int) and seq > self._gcs_epoch_seq:
+                    self._gcs_epoch_seq = seq
+                head = reply.get("freed_head")
+                if isinstance(head, int):
+                    self._drain_freed(head)
                 epoch = reply.get("epoch")
                 rejected = not reply.get("accepted", True)
                 if self._synced_epoch is None and not rejected:
@@ -501,16 +533,59 @@ class NodeServer:
                     self._synced_epoch = epoch
                 elif rejected or (epoch is not None
                                   and epoch != self._synced_epoch):
-                    # marked dead (long GC pause), or the head restarted
-                    # (possibly from EMPTY state — epoch changed even
-                    # though the rehydrated row accepted us): re-register
-                    # and re-publish locations/actors/PG state
-                    self._resync(epoch)
+                    # marked dead (long GC pause or a healed partition),
+                    # or the head restarted (possibly from EMPTY state —
+                    # epoch changed even though the rehydrated row
+                    # accepted us): re-register and re-publish
+                    # locations/actors/PG state. A rejection forces the
+                    # resync even under an unchanged epoch: the head
+                    # never restarted, it declared US dead, so the
+                    # same-epoch dedup must not swallow the re-register.
+                    self._resync(epoch, force=rejected)
             time.sleep(interval)
 
-    def _resync(self, epoch: Optional[str]):
+    def _clamp_freed_cursor(self, head: int):
+        """Rewind the freed-channel cursor after a head restart from
+        EMPTY state (the channel seq reset below our watermark)."""
+        with self._freed_cursor_lock:
+            self._freed_seq = min(self._freed_seq, int(head))
+
+    def _drain_freed(self, head: Optional[int] = None):
+        """Apply freed-id broadcasts this node may have missed: a
+        driver's free fan-out cannot reach a partitioned node, so on
+        heal (heartbeat piggybacks the channel head) or resync we
+        replay the ``freed`` channel from our cursor — reclaiming local
+        copies and tombstoning the ids so a healed node never serves,
+        re-publishes, or re-fetches a stale copy of a freed object.
+        ``head`` short-circuits the poll when nothing new was freed; a
+        trimmed channel (gap past _CHANNEL_CAP) degrades to the lazy
+        per-fetch freed_check, which stays authoritative."""
+        with self._freed_cursor_lock:
+            since = self._freed_seq
+            if head is not None and head <= since:
+                return
+            msgs = self.gcs.try_call(("poll", "freed", since, 0.0))
+            if not msgs:
+                return
+            freed: List[bytes] = []
+            for seq, oid_list in msgs:
+                if seq > self._freed_seq:
+                    self._freed_seq = seq
+                freed.extend(oid_list)
+        if not freed:
+            return
+        # free BEFORE tombstoning: free_objects skips already-tombstoned
+        # ids (same ordering free_cluster_wide relies on)
+        from ray_tpu.core.runtime import note_freed
+        self._op_free(freed)
+        rt = self.runtime
+        with rt._lock:
+            note_freed(rt._freed, freed)
+
+    def _resync(self, epoch: Optional[str], force: bool = False):
         with self._resync_lock:
-            if epoch is not None and self._synced_epoch == epoch:
+            if not force and epoch is not None \
+                    and self._synced_epoch == epoch:
                 return  # a concurrent trigger already resynced into it
             if resync_node(self):
                 self._synced_epoch = epoch
@@ -627,10 +702,13 @@ class NodeServer:
         # the waiter in place without losing its queue position.
         prio_box = prio_box if prio_box is not None else [PRIO_GET]
         requested_ts = time.time()
-        if not self.pulls.acquire(size, prio_box, timeout=120.0):
+        if not self.pulls.acquire(size, prio_box,
+                                  timeout=cfg.pull_acquire_timeout_s):
             raise _PullAdmissionTimeout(
-                f"pull admission timed out for {size}B (priority "
-                f"{prio_box[0]})")
+                f"pull admission timed out for {size}B from "
+                f"{addr[0]}:{addr[1]} after "
+                f"{cfg.pull_acquire_timeout_s:g}s (priority {prio_box[0]}; "
+                f"flag pull_acquire_timeout_s)")
         priority = prio_box[0]  # class at grant time, for the timeline
         granted_ts = time.time()
         granted_mono = time.monotonic()
@@ -749,11 +827,39 @@ class NodeServer:
             self._fetch_seconds += seconds
             self._fetch_count += 1
 
+    def _note_peer(self, addr, ok: bool, elapsed: float = 0.0):
+        """Update per-peer suspicion after a transfer attempt: latency
+        EWMA plus a consecutive-transport-failure counter. Under an
+        asymmetric partition a peer may accept our TCP connect yet never
+        deliver (one-way netem/blackhole) — the failure streak, not the
+        connect, is what marks it suspect."""
+        addr = tuple(addr)
+        with self._peer_health_lock:
+            h = self._peer_health.setdefault(addr, [0.0, 0, 0.0])
+            if ok:
+                h[0] = elapsed if h[0] == 0.0 else 0.8 * h[0] + 0.2 * elapsed
+                h[1] = 0
+            else:
+                h[1] += 1
+                h[2] = time.monotonic()
+
+    def _peer_suspicion(self, addr) -> Tuple[int, float]:
+        """Sort key for fetch candidates: peers with an active failure
+        streak are tried LAST, ties broken by latency EWMA — a fetch
+        under an asymmetric partition fails over to a reachable copy
+        instead of burning its budget on the severed edge."""
+        with self._peer_health_lock:
+            h = self._peer_health.get(tuple(addr))
+            return (0, 0.0) if h is None else (h[1], h[0])
+
     def _fetch_object(self, oid_bytes: bytes, hint, prio_box=None):
         rt = self.runtime
         oid = ObjectID(oid_bytes)
         prio_box = prio_box if prio_box is not None else [PRIO_GET]
-        deadline = time.monotonic() + 600.0
+        started = time.monotonic()
+        deadline = started + 600.0
+        transport_failures = 0
+        suspects: Dict[Tuple[str, int], str] = {}
         try:
             while not self._stop:
                 e = rt._objects.get(oid)
@@ -765,9 +871,16 @@ class NodeServer:
                 locs = self.gcs.try_call(("loc_get", oid_bytes, 0.5),
                                          default=[])
                 addrs.extend(tuple(a) for a in locs or [])
+                # dedup, then try the least-suspect peers first: under an
+                # asymmetric partition the severed copy fails in
+                # milliseconds and the fetch fails over to a healthy
+                # replica instead of re-dialing the dead edge
+                addrs = sorted(dict.fromkeys(addrs),
+                               key=self._peer_suspicion)
                 for addr in addrs:
                     if addr == self.address:
                         continue
+                    attempt_t0 = time.monotonic()
                     try:
                         data = self._fetch_from(addr, oid_bytes,
                                                 prio_box)
@@ -781,12 +894,25 @@ class NodeServer:
                         deadline = max(deadline,
                                        time.monotonic() + 300.0)
                         continue
-                    except (RpcError, Exception):  # noqa: BLE001
-                        self.gcs.try_call(("loc_drop", oid_bytes, addr))
+                    except (RpcError, Exception) as err:  # noqa: BLE001
+                        self._note_peer(addr, False)
+                        transport_failures += 1
+                        suspects[addr] = f"{type(err).__name__}: {err}"
+                        if self._peer_suspicion(addr)[0] >= 3:
+                            # a sustained streak, not a blip: retract the
+                            # location so other pulls stop dialing it. A
+                            # sub-second partition keeps its directory
+                            # entry and resumes on heal.
+                            self.gcs.try_call(
+                                ("loc_drop", oid_bytes, addr))
                         continue
                     if data is _STORED:
+                        self._note_peer(
+                            addr, True, time.monotonic() - attempt_t0)
                         return  # zero-copy path already sealed + published
                     if data is not None:
+                        self._note_peer(
+                            addr, True, time.monotonic() - attempt_t0)
                         store_incoming(rt, oid, data)
                         return
                 # no copy anywhere: an eagerly-freed object must fail NOW
@@ -800,6 +926,30 @@ class NodeServer:
                             protocol.ErrorValue(ObjectLostError(
                                 f"object {oid} was freed by ray_tpu.free() "
                                 f"and is not reconstructable")), store=None))
+                    finally:
+                        self._unpublished.discard(oid_bytes)
+                    return
+                if transport_failures >= 8 and \
+                        time.monotonic() - started > 2.0:
+                    # every known copy sits behind a severed edge and the
+                    # failure streak has outlived the blip grace: latch
+                    # the loss NOW (naming the unreachable peers) so the
+                    # waiter's reconstruction/retry machinery kicks in
+                    # seconds after the partition, not after the full
+                    # 600s pull budget. A sub-second partition never gets
+                    # here — attempts resume as soon as it heals.
+                    who = "; ".join(
+                        f"{a[0]}:{a[1]} ({why})"
+                        for a, why in sorted(suspects.items()))
+                    self._unpublished.add(oid_bytes)
+                    self._lost_marked.add(oid_bytes)
+                    try:
+                        rt._store_payload(oid, protocol.serialize_value(
+                            protocol.ErrorValue(ObjectLostError(
+                                f"object {oid} unreachable: every known "
+                                f"copy is behind a partitioned peer after "
+                                f"{transport_failures} transport failures"
+                                f" — {who}")), store=None))
                     finally:
                         self._unpublished.discard(oid_bytes)
                     return
@@ -976,7 +1126,31 @@ class NodeServer:
             s["fetch"] = {"bytes": self._fetch_bytes,
                           "seconds": round(self._fetch_seconds, 6),
                           "count": self._fetch_count}
+        s["gcs_epoch_seq"] = self._gcs_epoch_seq  # split-brain fence watermark
+        with self._peer_health_lock:        # per-peer suspicion (EWMA, streak)
+            s["peer_health"] = {
+                f"{a[0]}:{a[1]}": {"ewma_s": round(h[0], 6),
+                                   "fail_streak": h[1]}
+                for a, h in self._peer_health.items()}
         return s
+
+    def _op_netem(self, cmd, *args):
+        """Control plane for the deterministic network-fault shim: the
+        cluster fixture arms/heals partitions in THIS process over an
+        unaffected edge (see core/netem.py)."""
+        return netem.control(cmd, *args)
+
+    def _check_gcs_epoch(self, token):
+        """Reject a GCS-originated write stamped by an incarnation older
+        than the newest this node has seen (split-brain fence: a
+        partitioned-but-alive old head must not restart or reap actors
+        here). ``None`` = pre-epoch caller or node-local path: allowed."""
+        seen = self._gcs_epoch_seq
+        if token is not None and seen and int(token) < seen:
+            raise StaleGcsEpochError(
+                f"write from stale GCS incarnation rejected by node "
+                f"{self.address[0]}:{self.address[1]}",
+                stale_seq=int(token), current_seq=seen)
 
     def _op_stack_dump(self):
         return self.runtime.stack_dump()
@@ -1411,7 +1585,10 @@ class NodeServer:
 
     def _op_create_actor(self, cls_fn_id, pickled_cls, args_payload, deps,
                          opts, locations, actor_id_b=None, nonce=None,
-                         owner=None):
+                         owner=None, gcs_epoch_seq=None):
+        # GCS-driven restarts stamp their epoch_seq; a fenced (stale)
+        # head's restart must not run — it would fork actor state
+        self._check_gcs_epoch(gcs_epoch_seq)
         return self._dedup(nonce, lambda: self._do_create_actor(
             cls_fn_id, pickled_cls, args_payload, deps, opts, locations,
             actor_id_b, owner))
@@ -1492,7 +1669,11 @@ class NodeServer:
         self.runtime.prestart_workers(int(num))
         return True
 
-    def _op_kill_actor(self, actor_id_bytes, no_restart):
+    def _op_kill_actor(self, actor_id_bytes, no_restart,
+                       gcs_epoch_seq=None):
+        # a stale head reaping an actor it believes dead would kill a
+        # healthy incarnation the NEW head is tracking
+        self._check_gcs_epoch(gcs_epoch_seq)
         self.runtime.kill_actor(ActorID(actor_id_bytes), no_restart=no_restart)
         return True
 

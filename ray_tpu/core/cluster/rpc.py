@@ -22,6 +22,8 @@ from multiprocessing.connection import Connection as _MpConnection
 from multiprocessing.connection import answer_challenge, deliver_challenge
 from typing import Any, Callable, List, Optional, Tuple
 
+from ray_tpu.core import netem
+from ray_tpu.core.config import config
 from ray_tpu.util.debug_lock import make_lock
 
 
@@ -42,11 +44,8 @@ class RemoteError(Exception):
     """Application-level error raised by the remote handler."""
 
 
-HANDSHAKE_TIMEOUT_S = 15.0
-
-
 def _timed_handshake(conn, authkey: bytes, *, server_side: bool,
-                     timeout: float = HANDSHAKE_TIMEOUT_S):
+                     timeout: Optional[float] = None):
     """Run the HMAC challenge with a hard deadline.
 
     ``multiprocessing``'s challenge reads have NO timeout; worse, its
@@ -57,7 +56,12 @@ def _timed_handshake(conn, authkey: bytes, *, server_side: bool,
     fetch threads stuck mid-connect while pooled connections kept
     working. A watchdog closes the connection at the deadline, which
     unblocks the in-flight read with EOF/OSError.
+
+    The default deadline is the ``rpc_handshake_timeout_s`` flag, so
+    partition tests can shrink it cluster-wide through the env.
     """
+    if timeout is None:
+        timeout = config.rpc_handshake_timeout_s
     done = threading.Event()
 
     def watchdog():
@@ -201,6 +205,12 @@ class RpcServer:
         try:
             while not self._stop:
                 msg = conn.recv()
+                if netem.enabled():
+                    # at=server rules: inbound delay sleeps here; an
+                    # inbound fault raises NetemFault (an OSError),
+                    # severing this connection mid-exchange — the peer
+                    # observes a sent-but-unanswered request
+                    netem.plan_dispatch()
                 try:
                     reply = ("ok", self._handler(msg, ctx))
                 except BaseException as e:  # noqa: BLE001
@@ -347,7 +357,11 @@ class RpcClient:
                         raise RpcError(
                             f"authentication rejected by "
                             f"{self.address}: {he}") from he
-                    raise OSError("authkey handshake failed/timed out")
+                    raise OSError(
+                        f"authkey handshake with {self.address[0]}:"
+                        f"{self.address[1]} failed/timed out "
+                        f"(rpc_handshake_timeout_s="
+                        f"{config.rpc_handshake_timeout_s:g})")
                 return conn
             except (ConnectionRefusedError, OSError) as e:
                 if time.monotonic() >= deadline:
@@ -369,9 +383,26 @@ class RpcClient:
             conn = self._connect()
         sent = False
         try:
+            # Netem weave: a fault rule (drop/partition/blackhole)
+            # raises NetemFault — an OSError — BEFORE any bytes move,
+            # landing in the sent=False safe-retry arm below exactly
+            # like a refused connect; "dup" double-sends the request on
+            # this pipelined connection (the server applies it twice,
+            # back-to-back); "lost_reply" raises AFTER the send so the
+            # sent=True / maybe_applied machinery is exercised for real.
+            plan = netem.plan_send(self.address, msg) \
+                if netem.enabled() else None
             conn.send(msg)
+            if plan == "dup":
+                conn.send(msg)
             sent = True
+            if plan == "lost_reply":
+                raise netem.NetemFault(
+                    f"netem lost_reply: reply from {self.address[0]}:"
+                    f"{self.address[1]} discarded")
             tag, value = conn.recv()
+            if plan == "dup":
+                conn.recv()  # drain the duplicate's reply
         except (EOFError, OSError, BrokenPipeError) as e:
             try:
                 conn.close()
@@ -400,9 +431,24 @@ class RpcClient:
                 conn = self._connect()
                 sent2 = False
                 try:
+                    # the retry passes through netem too: a partition
+                    # blocks the built-in same-address retry as well,
+                    # so the caller sees a fast typed failure instead
+                    # of an accidental escape hatch around the chaos
+                    plan2 = netem.plan_send(self.address, msg) \
+                        if netem.enabled() else None
                     conn.send(msg)
+                    if plan2 == "dup":
+                        conn.send(msg)
                     sent2 = True
+                    if plan2 == "lost_reply":
+                        raise netem.NetemFault(
+                            f"netem lost_reply: reply from "
+                            f"{self.address[0]}:{self.address[1]} "
+                            f"discarded")
                     tag, value = conn.recv()
+                    if plan2 == "dup":
+                        conn.recv()  # drain the duplicate's reply
                 except (EOFError, OSError, BrokenPipeError) as e2:
                     try:
                         conn.close()

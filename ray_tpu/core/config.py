@@ -258,6 +258,11 @@ _FLAGS: List[Flag] = [
          "A node missing heartbeats for this long is marked DEAD "
          "(reference: health_check_timeout_ms, "
          "gcs_health_check_manager.h)."),
+    Flag("pull_acquire_timeout_s", float, 120.0,
+         "How long a bulk object pull waits for admission (store-memory "
+         "reservation) before timing out and re-planning from fresh "
+         "locations. Shrink in partition tests so a blocked pull fails "
+         "over in seconds, not minutes; errors name the peer address."),
     Flag("pull_admission_fraction", float, 0.5,
          "Fraction of object-store capacity that concurrent bulk pulls "
          "may reserve; excess pulls queue by priority task-args > get > "
@@ -311,6 +316,11 @@ _FLAGS: List[Flag] = [
          "death-marking of known nodes/drivers for this long so they can "
          "heartbeat back in before the health loop declares them DEAD "
          "(reference: gcs_failover_worker_reconnect_timeout)."),
+    Flag("rpc_handshake_timeout_s", float, 15.0,
+         "Hard deadline on the cluster RPC authkey handshake (client and "
+         "server side): a half-open peer that stalls mid-challenge is "
+         "cut off after this long instead of wedging the connect path "
+         "(see rpc._timed_handshake). Timeout errors name the peer."),
     Flag("driver_heartbeat_interval_s", float, 0.5,
          "Driver -> GCS owner-liveness heartbeat period."),
     Flag("driver_heartbeat_timeout_s", float, 3.0,
@@ -354,6 +364,10 @@ WIRING_ENV_VARS: Dict[str, str] = {
     "RTPU_CLUSTER_AUTHKEY": "hex authkey shared by every cluster "
                             "process (see rpc.cluster_authkey: no "
                             "default, deliberately)",
+    "RTPU_NETEM": "seeded deterministic network-fault plan "
+                  "'<seed>:<spec>' armed at import in every cluster "
+                  "process (rule grammar and replay protocol in "
+                  "core/netem.py; wire-level sibling of RTPU_FAULT_*)",
     "RTPU_NODE_ID": "id of the node a spawned worker belongs to",
     "RTPU_PKG_DIR": "working-dir package root a worker unpacked its "
                     "runtime env into (set by runtime_env activation)",

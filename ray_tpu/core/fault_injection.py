@@ -84,6 +84,12 @@ The module also exposes direct helpers (``evict_object``,
 ``kill_producing_worker``) that apply a fault to a runtime immediately —
 for tests that want to mutate state between calls rather than arm a
 site.
+
+This module covers *application-level* sites (a named operation loses
+its object / worker / process). WIRE-level faults — partitions, drops,
+delays, duplicate deliveries, bandwidth caps on a chosen network edge —
+live in :mod:`ray_tpu.core.netem`, armed via the sibling ``RTPU_NETEM``
+env protocol with the same seeded-determinism contract.
 """
 
 from __future__ import annotations

@@ -77,6 +77,41 @@ class GcsUnavailableError(RayTpuError, _RpcError):
     treating it as a connectivity failure."""
 
 
+class StaleGcsEpochError(RayTpuError, _RpcError):
+    """A write from a fenced (stale) GCS incarnation was rejected.
+
+    Split-brain guard: every GCS incarnation carries a monotonic
+    ``epoch_seq`` (persisted counter, stamped on heartbeat replies and
+    ``gcs_info``), and nodes remember the highest value they have seen.
+    A GCS-originated write (actor restart, reap) carrying a LOWER seq
+    than the receiver has observed is the signature of a
+    partitioned-but-alive old head still trying to mutate the cluster —
+    the node rejects it with this error, and the stale head fences
+    itself on seeing the rejection (stops restarts, death-marking, and
+    table writes). Structured fields survive pickling via
+    ``__reduce__``: ``stale_seq`` is the writer's epoch_seq,
+    ``current_seq`` the newest the rejecting side has seen. Subclasses
+    the transport ``RpcError`` so best-effort ``except RpcError``
+    handlers treat a fenced head like an unreachable one.
+    """
+
+    def __init__(self, message: str = "", stale_seq: int = 0,
+                 current_seq: int = 0):
+        self.stale_seq = int(stale_seq)
+        self.current_seq = int(current_seq)
+        self._message = message or "stale GCS incarnation fenced"
+        super().__init__(
+            f"{self._message} (writer epoch_seq {self.stale_seq} < "
+            f"newest seen {self.current_seq})")
+
+    def __reduce__(self):
+        # rebuild from the original fields: default exception pickling
+        # would re-call __init__ with the composed message, doubling
+        # the suffix and zeroing the structured fields
+        return (type(self), (self._message, self.stale_seq,
+                             self.current_seq))
+
+
 class BackpressureError(RayTpuError):
     """The serving plane rejected (shed) the request under overload.
 

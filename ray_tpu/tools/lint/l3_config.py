@@ -125,6 +125,48 @@ def fault_site_coverage(fault_sf: Optional[SourceFile],
     return findings
 
 
+def netem_policy_coverage(netem_sf: Optional[SourceFile],
+                          test_files: List[SourceFile]) -> List[Finding]:
+    """Every fault kind in ``netem.KINDS`` must be armed by at least one
+    test — same contract as :func:`fault_site_coverage` for the wire-
+    level chaos shim: a policy kind no test ever arms is dead chaos
+    machinery whose product weave (rpc.py) can rot silently. A test arms
+    a kind via a quoted literal (``add_rule(..., "drop")``, a control
+    op, a parse_spec string) or an ``=<kind>`` rule in an ``RTPU_NETEM``
+    spec. Findings anchor at the ``KINDS`` row."""
+    if netem_sf is None:
+        return []
+    kinds: Dict[str, int] = {}
+    for node in ast.walk(netem_sf.tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "KINDS"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    kinds[e.value] = node.lineno
+    corpus = "\n".join(sf.text for sf in test_files)
+    findings: List[Finding] = []
+    for kind, lineno in sorted(kinds.items()):
+        # quote- or spec-anchored so e.g. kind "drop" does not match the
+        # word "drop" in a comment: a quoted literal covers add_rule /
+        # control / parse_spec call sites, "=<kind>" covers rules inside
+        # an RTPU_NETEM spec string ("a->b=drop,p=0.5" / "...=drop;")
+        patterns = (f'"{kind}"', f"'{kind}'", f"={kind},", f"={kind};",
+                    f'={kind}"', f"={kind}'")
+        if any(p in corpus for p in patterns):
+            continue
+        if netem_sf.suppressed(lineno, "L3"):
+            continue
+        findings.append(Finding(
+            "L3", netem_sf.relpath, lineno,
+            f"netem fault kind {kind!r} is declared in KINDS but no test "
+            f"under tests/ arms it (add_rule/control/parse_spec literal "
+            f"or an '=<kind>' RTPU_NETEM spec rule); an unexercised "
+            f"policy is dead chaos machinery"))
+    return findings
+
+
 def _config_aliases(tree: ast.AST) -> Set[str]:
     """Names the config singleton is bound to in this module."""
     aliases: Set[str] = set()

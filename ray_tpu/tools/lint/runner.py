@@ -48,6 +48,7 @@ from ray_tpu.tools.lint.base import Finding, SourceFile, iter_py_files, \
 PROTOCOL_PATH = "ray_tpu/core/protocol.py"
 CONFIG_PATH = "ray_tpu/core/config.py"
 FAULT_PATH = "ray_tpu/core/fault_injection.py"
+NETEM_PATH = "ray_tpu/core/netem.py"
 
 ALL_RULES = ("L1", "L2", "L3", "L4", "L5", "L6", "L7", "L8")
 
@@ -130,10 +131,12 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
     if "L3" in rules:
         config_sf = get(CONFIG_PATH)
         fault_sf = get(FAULT_PATH)
+        netem_sf = get(NETEM_PATH)
         if config_sf is not None:
             thunks["L3"] = lambda: (
                 l3_config.analyze(config_sf, fault_sf, all_files)
-                + l3_config.fault_site_coverage(fault_sf, test_files))
+                + l3_config.fault_site_coverage(fault_sf, test_files)
+                + l3_config.netem_policy_coverage(netem_sf, test_files))
     if "L4" in rules:
         thunks["L4"] = lambda: l4_exceptions.analyze(
             recovery_files, signal_files=serve_files)
