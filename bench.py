@@ -1299,6 +1299,134 @@ def bench_serve_overload(rows: list):
         runtime_context.set_core(prev)
 
 
+def bench_node_drain(rows: list):
+    """node_drain_ms: cordon-to-DRAINED wall time for a 2-node cluster
+    whose draining node hosts a restartable actor — the window covers
+    the cordon, the actor's quiesce-then-reap migration to the healthy
+    node, and the node's own idle self-report. Median of 3 rounds (a
+    fresh cluster per round: drain is terminal for the node). No
+    reference number — the conservative bar lives in
+    BASELINE.json.published."""
+    import ray_tpu
+    from ray_tpu.core import runtime_context
+    from ray_tpu.core.cluster.fixture import Cluster
+
+    prev = runtime_context.get_core_or_none()
+    times = []
+    for _ in range(3):
+        runtime_context.set_core(None)
+        c = Cluster(num_nodes=2, num_workers_per_node=1,
+                    object_store_memory=64 << 20)
+        try:
+            assert c.wait_for_nodes(2, timeout=120)
+            c.connect()
+
+            @ray_tpu.remote(max_restarts=1)
+            class P:
+                def where(self):
+                    return os.environ.get("RTPU_NODE_ID")
+
+            a = P.remote()
+            host = ray_tpu.get(a.where.remote(), timeout=60)
+            target = next(n for n in c.nodes
+                          if c._node_id_of(n).hex() == host)
+            t0 = time.perf_counter()
+            assert c.drain(target)
+            assert c.wait_node_state(target, "DRAINED", timeout=60)
+            times.append((time.perf_counter() - t0) * 1e3)
+            # the migrated actor must still answer on the survivor
+            assert ray_tpu.get(a.where.remote(), timeout=60) != host
+        finally:
+            c.shutdown()
+            runtime_context.set_core(prev)
+    rows.append(_row("node_drain_ms", sorted(times)[1], "ms"))
+
+
+def bench_job_orphan(rows: list):
+    """job_orphan_recovery_ms: SIGKILL a (subprocess) job agent mid-job
+    and time from the kill to the job reaching a terminal SUCCEEDED via
+    the lease-expiry orphan path — lease timeout + GCS re-queue +
+    rescuer claim + payload re-run. Median of 3 rounds on one GCS. No
+    reference number — the conservative bar lives in
+    BASELINE.json.published."""
+    import subprocess
+    import sys
+    import tempfile
+
+    from ray_tpu.core.cluster.gcs import GcsServer
+    from ray_tpu.core.cluster.rpc import RpcClient
+    from ray_tpu.core.config import config
+    from ray_tpu.job.agent import JobAgent
+    from ray_tpu.job.client import JobStatus, JobSubmissionClient
+
+    key = b"bench-job-key"
+    old_ttl = os.environ.get("RTPU_JOB_LEASE_TTL_S")
+    os.environ["RTPU_JOB_LEASE_TTL_S"] = "0.6"
+    config.reload()
+    times = []
+    try:
+        with tempfile.TemporaryDirectory() as logs:
+            gcs = GcsServer(authkey=key)
+            addr = f"{gcs.address[0]}:{gcs.address[1]}"
+            client = JobSubmissionClient(addr, authkey=key)
+            try:
+                for i in range(3):
+                    env = dict(os.environ,
+                               RTPU_CLUSTER_AUTHKEY=key.hex())
+                    proc = subprocess.Popen(
+                        [sys.executable, "-m", "ray_tpu.job.agent",
+                         "--gcs", addr, "--agent-id", f"doomed-{i}",
+                         "--poll", "0.05", "--log-dir", logs],
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.DEVNULL, env=env)
+                    assert proc.stdout.readline().decode().startswith(
+                        "AGENT_READY")
+                    jid = client.submit_job(
+                        entrypoint="sleep 30", max_restarts=1,
+                        backoff=0.05, submission_id=f"bench-orphan-{i}")
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        info = client.get_job_info(jid)
+                        if info["status"] == JobStatus.RUNNING.value \
+                                and info.get("pid"):
+                            break
+                        time.sleep(0.02)
+                    assert info.get("pid"), "agent never claimed"
+                    proc.kill()
+                    proc.wait()
+                    t0 = time.perf_counter()
+                    # the retry's entrypoint completes immediately: the
+                    # timed window prices the ORPHAN MACHINERY (lease
+                    # expiry + re-queue + claim), not the payload
+                    client._gcs.call(("kv", "merge", f"job/{jid}",
+                                      {"entrypoint": "true"}))
+                    rescuer = JobAgent(
+                        RpcClient(gcs.address, key), gcs.address,
+                        agent_id=f"rescuer-{i}", log_dir=logs,
+                        poll_s=0.05)
+                    try:
+                        deadline = time.monotonic() + 60
+                        while time.monotonic() < deadline:
+                            st = client.get_job_status(jid)
+                            if st == JobStatus.SUCCEEDED:
+                                break
+                            time.sleep(0.02)
+                        assert st == JobStatus.SUCCEEDED, st
+                    finally:
+                        rescuer.close()
+                    times.append((time.perf_counter() - t0) * 1e3)
+            finally:
+                client.close()
+                gcs.close()
+    finally:
+        if old_ttl is None:
+            os.environ.pop("RTPU_JOB_LEASE_TTL_S", None)
+        else:
+            os.environ["RTPU_JOB_LEASE_TTL_S"] = old_ttl
+        config.reload()
+    rows.append(_row("job_orphan_recovery_ms", sorted(times)[1], "ms"))
+
+
 def bench_many_nodes_actors() -> float:
     """The actor-fleet creation row ALONE on a fresh 16-node cluster.
 
@@ -1422,6 +1550,22 @@ def main():
         bench_serve_overload(rows)
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "serve_p99_ttft_overload_ms", "value": -1,
+                     "unit": f"error: {e}"})
+
+    # planned-removal lifecycle: cordon -> actor migration -> DRAINED
+    # (ISSUE 16: drain must move work, not kill it)
+    try:
+        bench_node_drain(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "node_drain_ms", "value": -1,
+                     "unit": f"error: {e}"})
+
+    # supervised-job orphan path: agent SIGKILL -> lease expiry ->
+    # re-queue -> rescuer completes (ISSUE 16)
+    try:
+        bench_job_orphan(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "job_orphan_recovery_ms", "value": -1,
                      "unit": f"error: {e}"})
 
     # scalability AFTER many_nodes: the 1M-task slab leaves the single
@@ -1621,6 +1765,9 @@ def main():
              False),
             ("dag_compiled_roundtrip_block_us",
              "dag_compiled_roundtrip_block_us", False),
+            ("node_drain_ms", "node_drain_ms", False),
+            ("job_orphan_recovery_ms", "job_orphan_recovery_ms",
+             False),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)

@@ -203,12 +203,22 @@ class Reconciler:
 
     def __init__(self, manager: InstanceManager, provider,
                  request_timeout_s: float = 30.0,
-                 max_allocation_retries: int = 2):
+                 max_allocation_retries: int = 2,
+                 drain=None, drained=None):
         self.im = manager
         self.provider = provider
         self.request_timeout_s = request_timeout_s
         self.max_retries = max_allocation_retries
         self._retries: Dict[str, int] = {}
+        # drain-before-kill: with both callables supplied, scale-down
+        # first asks the GCS to drain the node (``drain(addr)``) and
+        # only calls provider.terminate_node once ``drained(addr)``
+        # reports the drain completed — running work finishes and
+        # actors migrate instead of dying with the instance. Without
+        # them, scale-down terminates directly (v1 behavior).
+        self.drain = drain
+        self.drained = drained
+        self._draining: set = set()
 
     def reconcile(self, desired_count: int,
                   cloud_instance_count: int,
@@ -277,16 +287,35 @@ class Reconciler:
             self.im.transition(inst, InstanceStatus.RAY_RUNNING,
                                address=free_addrs.pop(0))
 
-        # ---- converge downward: drain newest-idle first
+        # ---- converge downward: drain newest-idle first. With a drain
+        # hook the instance is handed to the GCS lifecycle (DRAINING ->
+        # DRAINED) and termination waits for the drain to finish below;
+        # otherwise terminate directly.
         running = self.im.instances(InstanceStatus.RAY_RUNNING)
         excess = len(running) - desired_count
         for inst in running[:max(0, excess)]:
             try:
                 if inst.address:
-                    self.provider.terminate_node(inst.address)
+                    if self.drain is not None and self.drained is not None:
+                        self.drain(inst.address)
+                        self._draining.add(inst.instance_id)
+                    else:
+                        self.provider.terminate_node(inst.address)
             except Exception:  # noqa: BLE001 — retried next pass
                 continue
             self.im.transition(inst, InstanceStatus.RAY_STOPPING)
+
+        # ---- drained instances can now actually be terminated
+        if self._draining:
+            for inst in self.im.instances(InstanceStatus.RAY_STOPPING):
+                if inst.instance_id not in self._draining:
+                    continue
+                try:
+                    if inst.address and self.drained(inst.address):
+                        self.provider.terminate_node(inst.address)
+                        self._draining.discard(inst.instance_id)
+                except Exception:  # noqa: BLE001 — retried next pass
+                    continue
 
         # ---- stopping instances leave once the provider forgets them
         stopping = self.im.instances(InstanceStatus.RAY_STOPPING)
@@ -329,8 +358,13 @@ class AutoscalerV2:
         self._nodes = ClientCache(self._authkey)
         self.provider = provider
         self.im = InstanceManager()
+        # prefer drain over kill: scale-down hands the node to the GCS
+        # drain lifecycle and terminates only after DRAINED (or once the
+        # GCS forgot it entirely)
         self.reconciler = Reconciler(self.im, provider,
-                                     request_timeout_s=request_timeout_s)
+                                     request_timeout_s=request_timeout_s,
+                                     drain=self._drain_addr,
+                                     drained=self._addr_drained)
         self._min = min_nodes
         self._max = max_nodes
         self._desired = min_nodes
@@ -406,7 +440,25 @@ class AutoscalerV2:
         return serve_demand_signal(payload, config.serve_ttft_slo_ms,
                                    time.time())
 
+    def _node_row(self, addr) -> Optional[dict]:
+        listing = self._gcs.call(("list_nodes", False))
+        for n in listing["nodes"]:
+            if tuple(n["address"]) == tuple(addr):
+                return n
+        return None
+
+    def _drain_addr(self, addr):
+        row = self._node_row(addr)
+        if row is not None:
+            self._gcs.call(("drain_node", row["node_id"]))
+
+    def _addr_drained(self, addr) -> bool:
+        row = self._node_row(addr)
+        return row is None or row["state"] in ("DRAINED", "DEAD")
+
     def _tick(self):
+        # list_nodes(alive_only=True): DRAINING/QUARANTINED/DRAINED
+        # capacity is already excluded from the demand + cloud views
         view = self._gcs.call(("list_nodes", True))
         addrs = [tuple(n["address"]) for n in view["nodes"]]
         if self._static is None:

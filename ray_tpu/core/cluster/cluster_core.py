@@ -539,10 +539,25 @@ class ClusterCore:
         if not fit:
             if strict:
                 raise RuntimeError("no node satisfies the resource request")
-            # No node's totals fit: park the task on the least-loaded node,
-            # whose queue holds it until resources appear (matches the
-            # reference's infeasible-task pending queue).
-            fit = [n for n in nodes if tuple(n["address"]) not in exclude]
+            # No ALIVE node's totals fit. A QUARANTINED node is cordoned
+            # but not condemned — when it is the ONLY host whose totals
+            # can ever satisfy the request, placing there beats parking
+            # on a node whose queue would hold the task forever (the
+            # quarantine shed load from a suspect node; it must not
+            # strand work that is resource-bound to it). DRAINING /
+            # DRAINED nodes stay excluded: they are leaving.
+            if req:
+                listing = self.gcs.call(("list_nodes", False))
+                fit = [n for n in listing["nodes"]
+                       if n["state"] == "QUARANTINED"
+                       and tuple(n["address"]) not in exclude
+                       and all(n["resources"].get(k, 0) >= v
+                               for k, v in req.items())]
+            if not fit:
+                # park the task on the least-loaded node, whose queue
+                # holds it until resources appear (matches the
+                # reference's infeasible-task pending queue)
+                fit = [n for n in nodes if tuple(n["address"]) not in exclude]
         if not fit:
             raise RuntimeError("no alive nodes in cluster")
 
@@ -1362,8 +1377,12 @@ class ClusterCore:
         """Fan eager deletion out to every node holding a copy; returns
         the count of UNIQUE objects freed anywhere."""
         freed: set = set()
-        addrs = {tuple(n["address"])
-                 for n in self._cluster_view(force=True)["nodes"]}
+        # full listing, not the schedulable view: DRAINING/QUARANTINED
+        # nodes are cordoned from NEW placement but still hold copies —
+        # a free that skips them leaves stale bytes to be served later
+        listing = self.gcs.call(("list_nodes", False))
+        addrs = {tuple(n["address"]) for n in listing["nodes"]
+                 if n["state"] != "DEAD"}
         for addr in addrs:
             try:
                 freed.update(self._nodes.get(addr).call(
@@ -1425,6 +1444,20 @@ class ClusterCore:
 
     def nodes(self) -> List[dict]:
         return self._cluster_view(force=True)["nodes"]
+
+    def drain_node(self, node_id: bytes) -> bool:
+        """Begin planned removal of a node (ALIVE -> DRAINING): the
+        scheduler cordon is immediate, actors migrate via the GCS
+        restart FSM, and running tasks get node_drain_grace_s before
+        the node is declared DRAINED and can deregister cleanly."""
+        return bool(self.gcs.call(("drain_node", node_id)))
+
+    def node_states(self) -> Dict[str, str]:
+        """{node_id hex: lifecycle state} for every node the GCS knows
+        (including DRAINING/QUARANTINED/DRAINED/DEAD ones the scheduling
+        view filters out)."""
+        listing = self.gcs.call(("list_nodes", False))
+        return {n["node_id"].hex(): n["state"] for n in listing["nodes"]}
 
     def wait_for_workers(self, count: Optional[int] = None,
                          timeout: Optional[float] = None):

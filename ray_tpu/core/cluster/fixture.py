@@ -83,9 +83,9 @@ class Cluster:
         self._nw = num_workers_per_node
         self._gcs_persist_dir = gcs_persist_dir
 
-        # (src, dst) endpoint pairs with netem partition rules armed via
-        # partition(); heal() clears exactly these
-        self._partitions: List[Tuple[object, object]] = []
+        # netem rules armed via partition()/gray(): (src endpoint,
+        # src selector, dst selector, kind); heal() clears exactly these
+        self._partitions: List[Tuple[object, str, str, str]] = []
 
         self._gcs_port = pick_port()
         self._start_gcs()
@@ -226,30 +226,84 @@ class Cluster:
             dst_addr = self._netem_addr(dst)
             if dst_addr is None:
                 continue  # nothing dials the driver: no inbound edge
-            self._netem_ctl(src, "add", "*",
-                            f"{dst_addr[0]}:{dst_addr[1]}", "partition", {})
-            self._partitions.append((src, dst))
+            dst_sel = f"{dst_addr[0]}:{dst_addr[1]}"
+            self._netem_ctl(src, "add", "*", dst_sel, "partition", {})
+            self._partitions.append((src, "*", dst_sel, "partition"))
+
+    def gray(self, node: "NodeProc", ms: float = 300.0,
+             jitter: float = 300.0, p: float = 0.05):
+        """Make ``node`` a gray-failing node: every RPC it SENDS (its
+        heartbeats included) takes drop probability ``p`` plus
+        ``ms`` + U(0, ``jitter``) of delay — alive on the control plane,
+        flaky on the wire. The GCS health scorer should QUARANTINE it
+        while healthy nodes stay ALIVE. Reversed by heal()."""
+        self._netem_ctl(node, "add", "*", "*", "delay",
+                        {"ms": ms, "jitter": jitter})
+        self._partitions.append((node, "*", "*", "delay"))
+        if p > 0:
+            self._netem_ctl(node, "add", "*", "*", "drop", {"p": p})
+            self._partitions.append((node, "*", "*", "drop"))
 
     def heal(self):
-        """Clear every partition armed through partition(). Best-effort
-        per endpoint: a process that died mid-chaos is skipped. Driver-
-        sourced rules clear FIRST — they live in this process and can
-        sever the very control edges the remote clears dial over (e.g.
-        partition(driver, node) + partition(node, gcs): the node's rule
-        is cleared via an RPC the driver's own rule would block)."""
+        """Clear every netem rule armed through partition()/gray().
+        Best-effort per endpoint: a process that died mid-chaos is
+        skipped. Driver-sourced rules clear FIRST — they live in this
+        process and can sever the very control edges the remote clears
+        dial over (e.g. partition(driver, node) + partition(node, gcs):
+        the node's rule is cleared via an RPC the driver's own rule
+        would block)."""
         parts, self._partitions = self._partitions, []
         parts.sort(key=lambda p: p[0] != "driver")
-        for src, dst in parts:
-            dst_addr = self._netem_addr(dst)
-            if dst_addr is None:
-                continue
+        for src, src_sel, dst_sel, kind in parts:
             try:
-                self._netem_ctl(src, "clear", "*",
-                                f"{dst_addr[0]}:{dst_addr[1]}", "partition")
+                self._netem_ctl(src, "clear", src_sel, dst_sel, kind)
             # rtpu-lint: disable=L4 — heal is teardown-adjacent: a dead
             # endpoint can't hold a partition rule anyway
             except Exception:  # noqa: BLE001
                 pass
+
+    # --------------------------------------------- drain / lifecycle
+
+    def _node_id_of(self, node: "NodeProc") -> bytes:
+        client = RpcClient(self.gcs_address, self.authkey)
+        try:
+            listing = client.call(("list_nodes", False))
+        finally:
+            client.close()
+        for n in listing["nodes"]:
+            if tuple(n["address"]) == tuple(node.address):
+                return n["node_id"]
+        raise KeyError(f"node {node.address} not in the GCS table")
+
+    def drain(self, node: "NodeProc") -> bool:
+        """Begin planned removal of ``node`` (ALIVE -> DRAINING)."""
+        node_id = self._node_id_of(node)
+        client = RpcClient(self.gcs_address, self.authkey)
+        try:
+            return bool(client.call(("drain_node", node_id)))
+        finally:
+            client.close()
+
+    def node_state(self, node: "NodeProc") -> Optional[str]:
+        """The GCS lifecycle state of ``node`` (None once deregistered)."""
+        client = RpcClient(self.gcs_address, self.authkey)
+        try:
+            listing = client.call(("list_nodes", False))
+        finally:
+            client.close()
+        for n in listing["nodes"]:
+            if tuple(n["address"]) == tuple(node.address):
+                return n["state"]
+        return None
+
+    def wait_node_state(self, node: "NodeProc", state: str,
+                        timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.node_state(node) == state:
+                return True
+            time.sleep(0.05)
+        return False
 
     def connect(self):
         """A ClusterCore driver bound to this cluster (also installs it as

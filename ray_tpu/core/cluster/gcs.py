@@ -44,14 +44,23 @@ _WAL_OPS = frozenset({
     "drop_actor_name", "register_actor", "register_actor_spec",
     "drop_actor_spec", "loc_add", "loc_add_batch",
     "loc_drop", "freed_add", "publish", "register_fn",
+    "drain_node", "node_drained",
 })
+
+# node lifecycle: ALIVE -> DRAINING -> DRAINED (planned removal, clean
+# deregistration) and ALIVE <-> QUARANTINED (gray-failure cordon). Only
+# ALIVE nodes are schedulable; DRAINING/QUARANTINED/DRAINED nodes keep
+# heartbeating (their data plane stays up) but receive no new work.
+_LIVE_STATES = ("ALIVE", "DRAINING", "QUARANTINED")
 _WAL_KV_MUTATORS = frozenset({"put", "del", "merge", "cas_merge"})
 _WAL_SNAPSHOT_EVERY = 50_000  # records between compactions
 
 
 class _NodeInfo:
     __slots__ = ("node_id", "address", "resources", "topology", "labels",
-                 "state", "last_heartbeat", "avail", "load", "death_seq")
+                 "state", "last_heartbeat", "avail", "load", "death_seq",
+                 "drain_deadline", "jitter_ewma", "fail_total", "fail_ewma",
+                 "clean_since", "last_probe")
 
     def __init__(self, node_id: bytes, address, resources, topology, labels):
         self.node_id = node_id
@@ -64,6 +73,20 @@ class _NodeInfo:
         self.avail = dict(resources)           # latest reported availability
         self.load = 0                          # queued+running tasks
         self.death_seq = None
+        # drain: absolute monotonic deadline for the grace window
+        self.drain_deadline = None
+        # gray-failure health signals (EWMAs updated per heartbeat):
+        # jitter = excess heartbeat interval over the expected cadence,
+        # fail = per-tick unexpected worker-death delta. fail_total is
+        # the last cumulative counter the node reported.
+        self.jitter_ewma = 0.0
+        self.fail_total = 0
+        self.fail_ewma = 0.0
+        # quarantine hysteresis: when the score first dropped below the
+        # recovery threshold (None while still dirty) and the last time
+        # the un-quarantine probe pinged this node
+        self.clean_since = None
+        self.last_probe = 0.0
 
     def view(self) -> dict:
         return {
@@ -122,6 +145,11 @@ class GcsServer:
         self._lock = make_lock("GcsServer._lock")
         self._cond = threading.Condition(self._lock)
         self._nodes: Dict[bytes, _NodeInfo] = {}
+        # condensed peer_health suspicion reports, keyed by reporter
+        # node_id -> {"host:port": recent-failure streak}; folded into
+        # the per-node health score (transient — not persisted)
+        self._peer_reports: Dict[bytes, Dict[str, int]] = {}
+        self._next_orphan_scan = 0.0  # health-loop cadence (monotonic)
         self._kv: Dict[str, Any] = {}
         self._named_actors: Dict[str, Tuple[bytes, tuple]] = {}
         self._actor_table: Dict[bytes, dict] = {}
@@ -253,6 +281,12 @@ class GcsServer:
                 info = _NodeInfo(node_id, address, resources, topology,
                                  labels)
                 info.state = state
+                if state == "DRAINING":
+                    # re-arm the grace window: the pre-crash deadline was
+                    # monotonic (meaningless across processes), and the
+                    # node reports node_drained itself when it goes idle
+                    info.drain_deadline = (time.monotonic()
+                                           + config.node_drain_grace_s)
                 # ALIVE nodes get a fresh grace period: the health monitor
                 # re-marks truly-dead ones after the heartbeat timeout,
                 # live ones heartbeat in (and re-register if they were
@@ -405,15 +439,33 @@ class GcsServer:
                 # nodes that are merely mid-reconnect
                 self._flush_pending_deaths()
                 continue
+            probe_targets = []
             with self._lock:
-                for info in self._nodes.values():
-                    if (info.state == "ALIVE"
+                for info in list(self._nodes.values()):
+                    if (info.state in _LIVE_STATES
                             and now - info.last_heartbeat > timeout):
                         self._mark_dead_locked(info)
+                    elif (info.state == "DRAINING"
+                            and info.drain_deadline is not None
+                            and now >= info.drain_deadline):
+                        # grace window over: whatever was still running
+                        # had its chance — declare the drain complete so
+                        # the node can deregister cleanly
+                        self._apply_drained_locked(info)
+                        if self._wal is not None:
+                            self._wal_pending.append(
+                                ("node_drained", (info.node_id,)))
                 for did, last in list(self._drivers.items()):
                     if now - last > drv_timeout:
                         self._mark_driver_dead_locked(did)
+                probe_targets = self._quarantine_scan_locked(now)
             self._flush_pending_deaths()
+            if probe_targets:
+                self._probe_quarantined(probe_targets)
+            if now >= self._next_orphan_scan:
+                self._next_orphan_scan = now + max(
+                    0.1, config.job_lease_ttl_s / 4)
+                self._scan_orphan_jobs()
 
     def _mark_dead_locked(self, info: _NodeInfo):
         # timeout-detected deaths are state too (explicit unregisters are
@@ -422,6 +474,8 @@ class GcsServer:
         # forbids taking _wal_lock here).
         if self._wal is not None:
             self._wal_pending.append(("__death__", (info.node_id,)))
+        self._peer_reports.pop(info.node_id, None)
+        info.drain_deadline = None
         info.state = "DEAD"
         self._death_seq += 1
         info.death_seq = self._death_seq
@@ -454,14 +508,192 @@ class GcsServer:
                              daemon=True, name="gcs-actor-restart").start()
         self._cond.notify_all()
 
-    # ----------------------------------------------- actor restart FSM
+    # --------------------------------------- drain / quarantine lifecycle
 
-    def _restart_actors(self, actor_ids: List[bytes],
-                        timeout: float = 300.0):
-        from ray_tpu.core.cluster.rpc import ClientCache, RpcError
+    def _apply_drained_locked(self, info: _NodeInfo):
+        """DRAINING -> DRAINED (self._lock held). The node's data plane
+        stays up (objects remain fetchable) but it is out of every
+        scheduling pool; its eventual unregister is the quiet path — no
+        death event, no lineage reconstruction. Callers that reach this
+        from the health loop must buffer the ``node_drained`` WAL record
+        themselves (the RPC path is logged by _handle)."""
+        if info.state != "DRAINING":
+            return
+        info.state = "DRAINED"
+        info.drain_deadline = None
+        self._publish_locked("node_state", {
+            "node_id": info.node_id, "address": list(info.address),
+            "state": "DRAINED"})
+        self._view_version += 1
+        self._cond.notify_all()
+
+    def _quarantine_scan_locked(self, now: float) -> List[tuple]:
+        """Score every live node and flip gray ones to QUARANTINED
+        (self._lock held). Returns the [(node_id, address)] of
+        quarantined nodes due for an un-quarantine liveness probe —
+        probing is an RPC, so the caller does it after releasing the
+        lock.
+
+        Score = heartbeat-jitter EWMA + worker-death-rate EWMA + peer
+        suspicion. Suspicion sums the recent-failure streaks other nodes
+        report about this one (capped per reporter), discounted by the
+        reporter's OWN jitter/failure score — a node that is itself gray
+        cannot quarantine its healthy peers by blaming them for its own
+        flaky edges."""
+        thr = config.quarantine_score_threshold
+        if thr <= 0:
+            return []
+        probes: List[tuple] = []
+        for info in self._nodes.values():
+            if info.state not in ("ALIVE", "QUARANTINED"):
+                continue
+            addr_str = f"{info.address[0]}:{info.address[1]}"
+            susp = 0.0
+            for rid, reports in self._peer_reports.items():
+                if rid == info.node_id:
+                    continue
+                streak = reports.get(addr_str, 0)
+                if streak <= 0:
+                    continue
+                reporter = self._nodes.get(rid)
+                own = (reporter.jitter_ewma + reporter.fail_ewma
+                       if reporter is not None else 0.0)
+                susp += min(streak, 5) / (1.0 + own)
+            score = info.jitter_ewma + info.fail_ewma + susp
+            if info.state == "ALIVE":
+                if score >= thr:
+                    info.state = "QUARANTINED"
+                    info.clean_since = None
+                    self._publish_locked("node_state", {
+                        "node_id": info.node_id,
+                        "address": list(info.address),
+                        "state": "QUARANTINED", "score": score})
+                    self._view_version += 1
+                    self._cond.notify_all()
+                continue
+            # QUARANTINED: hysteresis — the score must stay below half
+            # the threshold for quarantine_recover_s AND the node must
+            # answer a liveness probe before it rejoins the pool
+            if score >= thr / 2:
+                info.clean_since = None
+                continue
+            if info.clean_since is None:
+                info.clean_since = now
+            if (now - info.clean_since >= config.quarantine_recover_s
+                    and now - info.last_probe
+                    >= max(0.1, config.quarantine_recover_s / 2)):
+                info.last_probe = now
+                probes.append((info.node_id, info.address))
+        return probes
+
+    def _probe_quarantined(self, targets: List[tuple]):
+        """Liveness-probe quarantined nodes whose score has stayed clean
+        through the hysteresis window; a successful ping restores them
+        to ALIVE. Runs WITHOUT self._lock (it is an RPC)."""
+        from ray_tpu.core.cluster.rpc import RpcError
+
+        self._ensure_peers()
+        for node_id, address in targets:
+            try:
+                self._peers.get(tuple(address)).call(("ping",))
+            except (RpcError, OSError):
+                continue
+            with self._lock:
+                info = self._nodes.get(node_id)
+                if info is None or info.state != "QUARANTINED" \
+                        or info.clean_since is None:
+                    continue
+                info.state = "ALIVE"
+                info.clean_since = None
+                info.jitter_ewma = 0.0
+                info.fail_ewma = 0.0
+                self._publish_locked("node_state", {
+                    "node_id": node_id, "address": list(info.address),
+                    "state": "ALIVE"})
+                self._view_version += 1
+                self._cond.notify_all()
+
+    def _ensure_peers(self):
+        from ray_tpu.core.cluster.rpc import ClientCache
 
         if self._peers is None:
             self._peers = ClientCache(self._authkey)
+
+    # ------------------------------------------- supervised-job orphans
+
+    def _scan_orphan_jobs(self):
+        """Re-queue (or fail, per max_restarts policy) RUNNING jobs whose
+        agent lease expired — a SIGKILLed agent can no longer strand
+        them. Candidates are collected under self._lock; the mutation
+        itself is a WAL'd cas_merge keyed on the exact expired lease, so
+        a racing agent renewal (or a concurrent scan on another thread)
+        safely loses."""
+        from ray_tpu.job.backoff import delay_for
+
+        now = time.time()
+        with self._lock:
+            candidates = [(key, dict(spec), spec.get("lease_expires_at"))
+                          for key, spec in self._kv.items()
+                          if key.startswith("job/")
+                          and isinstance(spec, dict)
+                          and spec.get("status") == "RUNNING"
+                          and spec.get("lease_expires_at")
+                          and spec["lease_expires_at"] < now]
+        for key, spec, lease in candidates:
+            expect = {"status": "RUNNING", "lease_expires_at": lease}
+            restarts = int(spec.get("restarts") or 0)
+            max_restarts = int(spec.get("max_restarts") or 0)
+            if spec.get("stop_requested"):
+                # stop semantics hold across the orphan boundary: the
+                # agent died before honoring the stop — finish the job
+                # as STOPPED instead of resurrecting it
+                updates = {"status": "STOPPED", "lease_expires_at": None,
+                           "agent": None,
+                           "message": "stopped (agent lost)"}
+            elif restarts < max_restarts:
+                bo = spec.get("backoff") or {}
+                delay = delay_for(spec.get("submission_id") or key,
+                                  restarts, bo.get("base_s", 1.0),
+                                  bo.get("max_s", 30.0))
+                updates = {"status": "PENDING", "agent": None,
+                           "restarts": restarts + 1,
+                           "next_eligible_at": now + delay,
+                           "lease_expires_at": None, "orphaned": True,
+                           "backoff_history":
+                               list(spec.get("backoff_history") or [])
+                               + [delay],
+                           "message": "orphaned (agent lease expired); "
+                                      "re-queued"}
+            else:
+                updates = {"status": "FAILED", "lease_expires_at": None,
+                           "agent": None,
+                           "message": "job agent lost (lease expired)"}
+            self._kv_mutate_internal("cas_merge", key, (expect, updates))
+
+    def _kv_mutate_internal(self, op: str, key: str, value=None):
+        """A GCS-originated kv mutation with the same apply+log
+        discipline _handle gives client ops (callers must NOT hold
+        self._lock — lock order is _wal_lock then self._lock)."""
+        if self._wal is not None:
+            with self._wal_lock:
+                result = self._op_kv(op, key, value)
+                self._wal_write_locked("kv", (op, key, value))
+            return result
+        return self._op_kv(op, key, value)
+
+    # ----------------------------------------------- actor restart FSM
+
+    def _restart_actors(self, actor_ids: List[bytes],
+                        timeout: float = 300.0, migrate_from=None):
+        """Restart (node death) or migrate (``migrate_from`` = the
+        draining node's address) the given actors. Migration rides the
+        same FSM but is free: no restart-budget charge, no terminal
+        branch at budget 0 — the actor is healthy, its host is merely
+        being retired — and the live copy is evicted first so exactly
+        one incarnation ever runs."""
+        from ray_tpu.core.cluster.rpc import RpcError
+
+        self._ensure_peers()
         for aid in actor_ids:
             with self._lock:
                 if self._fenced:
@@ -472,18 +704,45 @@ class GcsServer:
             opts = dict(spec.get("opts") or {})
             restarts = int(opts.get("max_restarts", 0))
             detached = opts.get("lifetime") == "detached"
-            if restarts == 0 and not detached:
-                # budget exhausted: terminal — subscribers must fail
-                # buffered calls with ActorDiedError, not keep waiting
-                with self._lock:
-                    self._actor_table.setdefault(aid, {})["state"] = "DEAD"
-                    self._publish_actor_state_locked(aid, "DEAD", spec, opts)
-                continue
-            if restarts > 0:
-                opts["max_restarts"] = restarts - 1
+            if migrate_from is None:
+                if restarts == 0 and not detached:
+                    # budget exhausted: terminal — subscribers must fail
+                    # buffered calls with ActorDiedError, not keep waiting
+                    with self._lock:
+                        self._actor_table.setdefault(
+                            aid, {})["state"] = "DEAD"
+                        self._publish_actor_state_locked(aid, "DEAD", spec,
+                                                         opts)
+                    continue
+                if restarts > 0:
+                    opts["max_restarts"] = restarts - 1
             with self._lock:
                 self._publish_actor_state_locked(aid, "RESTARTING", spec,
                                                  opts)
+            if migrate_from is not None:
+                # planned drain: quiesce-then-reap the live copy before
+                # the new one exists — queued and in-flight calls finish
+                # (bounded by the drain grace), nothing is failed, and
+                # exactly one incarnation ever runs. Past the grace the
+                # reap turns forceful: the window is a promise to the
+                # cluster, not to one chatty actor.
+                try:
+                    peer = self._peers.get(tuple(migrate_from))
+                    grace = time.monotonic() + config.node_drain_grace_s
+                    while not peer.call(("evict_actor", aid,
+                                         self._epoch_seq, 0.5)):
+                        if time.monotonic() >= grace or self._stop:
+                            peer.call(("kill_actor", aid, True,
+                                       self._epoch_seq))
+                            break
+                except StaleGcsEpochError as fe:
+                    with self._lock:
+                        self._fenced = True
+                        self._fenced_by = max(self._fenced_by,
+                                              fe.current_seq)
+                    return
+                except (RpcError, OSError):
+                    pass  # node gone mid-drain: death path takes over
             deadline = time.monotonic() + timeout
             nonce = os.urandom(16)
             restarted = False
@@ -640,19 +899,32 @@ class GcsServer:
     def _op_register_node(self, node_id: bytes, address, resources,
                           topology, labels=None):
         with self._lock:
-            self._nodes[node_id] = _NodeInfo(node_id, address, resources,
-                                             topology, labels)
+            prev = self._nodes.get(node_id)
+            info = _NodeInfo(node_id, address, resources, topology, labels)
+            if prev is not None and prev.state in ("DRAINING",
+                                                   "QUARANTINED"):
+                # a resync re-register must not launder a cordoned node
+                # back into the scheduling pool
+                info.state = prev.state
+                info.drain_deadline = prev.drain_deadline
+                info.jitter_ewma = prev.jitter_ewma
+                info.fail_ewma = prev.fail_ewma
+            self._nodes[node_id] = info
             self._view_version += 1
             self._cond.notify_all()
         return True
 
     def _op_heartbeat(self, node_id: bytes, avail: dict, load: int,
-                      seen_epoch_seq: int = 0):
+                      seen_epoch_seq: int = 0, stats: dict = None):
         # replies carry the GCS epoch so nodes detect a head restart even
         # when every heartbeat is accepted (persisted state restored the
         # node as ALIVE) and resync their locations/actors/PGs; they also
-        # carry epoch_seq (fencing order) and the freed-channel head so
-        # a node can cheaply notice frees it missed while partitioned
+        # carry epoch_seq (fencing order), the freed-channel head so a
+        # node can cheaply notice frees it missed while partitioned, and
+        # the node's lifecycle state so a DRAINING node starts winding
+        # down. ``stats`` (optional) feeds the gray-failure scorer:
+        # {"task_failures": cumulative worker-death count,
+        #  "peer_health": {"host:port": recent-failure streak}}.
         with self._lock:
             if seen_epoch_seq and seen_epoch_seq > self._epoch_seq:
                 # the node has heartbeated a NEWER incarnation: this
@@ -666,18 +938,100 @@ class GcsServer:
             if self._fenced or info is None or info.state == "DEAD":
                 # node must re-register (or, fenced: go away entirely)
                 return dict(base, accepted=False)
-            info.last_heartbeat = time.monotonic()
+            now = time.monotonic()
+            expected = max(1e-3, config.gcs_heartbeat_interval_s)
+            # excess interval ratio over 1.5x the cadence (clamped so one
+            # huge gap cannot poison the EWMA forever)
+            excess = max(0.0, (now - info.last_heartbeat) / expected - 1.5)
+            info.jitter_ewma = (0.7 * info.jitter_ewma
+                                + 0.3 * min(excess, 10.0))
+            info.last_heartbeat = now
+            if stats:
+                failures = int(stats.get("task_failures") or 0)
+                delta = max(0, failures - info.fail_total)
+                info.fail_total = failures
+                info.fail_ewma = (0.7 * info.fail_ewma
+                                  + 0.3 * min(delta, 10.0))
+                peer = stats.get("peer_health")
+                if peer:
+                    self._peer_reports[node_id] = dict(peer)
+                else:
+                    self._peer_reports.pop(node_id, None)
             if info.avail != avail or info.load != load:
                 info.avail = dict(avail)
                 info.load = load
                 self._view_version += 1
-        return dict(base, accepted=True)
+            state = info.state
+        return dict(base, accepted=True, state=state)
 
     def _op_unregister_node(self, node_id: bytes):
         with self._lock:
             info = self._nodes.get(node_id)
-            if info is not None and info.state == "ALIVE":
+            if info is None:
+                return True
+            if info.state == "DRAINED":
+                # clean deregistration: the drain already migrated the
+                # actors and let running work finish, so this is NOT a
+                # death — no event on node_deaths, no restart FSM, no
+                # lineage reconstruction storm. Its remaining locations
+                # drop quietly (consumers fetched during the grace).
+                del self._nodes[node_id]
+                self._peer_reports.pop(node_id, None)
+                dead_addr = info.address
+                for oid, locs in list(self._locations.items()):
+                    kept = [a for a in locs if a != dead_addr]
+                    if kept:
+                        self._locations[oid] = kept
+                    else:
+                        del self._locations[oid]
+                        self._obj_sizes.pop(oid, None)
+                self._publish_locked("node_state", {
+                    "node_id": node_id, "address": list(info.address),
+                    "state": "REMOVED"})
+                self._view_version += 1
+                self._cond.notify_all()
+            elif info.state in _LIVE_STATES:
                 self._mark_dead_locked(info)
+        return True
+
+    def _op_drain_node(self, node_id: bytes):
+        """Begin planned removal: ALIVE/QUARANTINED -> DRAINING. The
+        scheduler cordon is immediate (only ALIVE nodes are placement
+        candidates); restartable/detached actors migrate via the restart
+        FSM; running tasks get ``node_drain_grace_s`` to finish before
+        the health loop forces DRAINED (the node reports node_drained
+        itself as soon as it goes idle)."""
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or info.state == "DEAD":
+                return False
+            if info.state in ("DRAINING", "DRAINED"):
+                return True  # idempotent: re-drain is a no-op
+            info.state = "DRAINING"
+            info.drain_deadline = (time.monotonic()
+                                   + config.node_drain_grace_s)
+            self._publish_locked("node_state", {
+                "node_id": node_id, "address": list(info.address),
+                "state": "DRAINING"})
+            self._view_version += 1
+            addr = info.address
+            moving = [aid for aid, spec in self._actor_specs.items()
+                      if tuple((self._actor_table.get(aid) or {})
+                               .get("node", ())) == addr]
+            self._cond.notify_all()
+        if moving and not self._stop and not self._replaying:
+            threading.Thread(target=self._restart_actors, args=(moving,),
+                             kwargs={"migrate_from": addr}, daemon=True,
+                             name="gcs-drain-migrate").start()
+        return True
+
+    def _op_node_drained(self, node_id: bytes):
+        """The node (or the grace-window deadline) reports the drain
+        finished: all queued/running work completed."""
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is not None:
+                self._apply_drained_locked(info)
         return True
 
     def _op_list_nodes(self, alive_only: bool = False):

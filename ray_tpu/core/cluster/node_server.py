@@ -487,6 +487,11 @@ class NodeServer:
         # known remote actors: actor_id -> node address
         self._remote_actors: Dict[ActorID, Tuple[str, int]] = {}
 
+        # drain wind-down: latched once when a heartbeat reply says the
+        # GCS moved this node to DRAINING (guarded by _drain_lock)
+        self._drain_started = False
+        self._drain_lock = make_lock("NodeServer._drain_lock")
+
         self.gcs.call(self.register_msg())
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="node-heartbeat")
@@ -516,9 +521,20 @@ class NodeServer:
             with rt._lock:
                 avail = rt._avail.to_dict()
                 load = len(rt._task_queue)
+                failures = getattr(rt, "_worker_death_count", 0)
+            # condensed per-peer suspicion: only peers with a RECENT
+            # failure streak ride the heartbeat, so healed edges decay
+            # out of the GCS health score instead of pinning it forever
+            now = time.monotonic()
+            recent = config.gcs_heartbeat_timeout_s
+            with self._peer_health_lock:
+                peer = {f"{h}:{p}": int(st[1])
+                        for (h, p), st in self._peer_health.items()
+                        if st[1] > 0 and now - st[2] < recent}
             reply = self.gcs.try_call(
                 ("heartbeat", self.node_id.binary(), avail, load,
-                 self._gcs_epoch_seq))
+                 self._gcs_epoch_seq,
+                 {"task_failures": failures, "peer_health": peer}))
             if reply is not None:
                 seq = reply.get("epoch_seq")
                 if isinstance(seq, int) and seq > self._gcs_epoch_seq:
@@ -542,7 +558,37 @@ class NodeServer:
                     # never restarted, it declared US dead, so the
                     # same-epoch dedup must not swallow the re-register.
                     self._resync(epoch, force=rejected)
+                if reply.get("state") == "DRAINING":
+                    self._begin_drain()
             time.sleep(interval)
+
+    def _begin_drain(self):
+        """Heartbeat said the GCS is draining this node: wind down —
+        wait for the local queue and in-flight work to empty (actors
+        were migrated by the GCS restart FSM; the scheduler cordon
+        stops new arrivals), then report node_drained. The process
+        stays up serving fetches so consumers can pull results; the
+        actual removal is a later (clean) unregister."""
+        with self._drain_lock:
+            if self._drain_started:
+                return
+            self._drain_started = True
+
+        def monitor():
+            rt = self.runtime
+            idle_beats = 0
+            while not self._stop and idle_beats < 3:
+                with rt._lock:
+                    busy = (len(rt._task_queue)
+                            + sum(len(w.inflight)
+                                  for w in rt._workers.values()))
+                idle_beats = idle_beats + 1 if busy == 0 else 0
+                time.sleep(0.05)
+            if not self._stop:
+                self.gcs.try_call(("node_drained", self.node_id.binary()))
+
+        threading.Thread(target=monitor, daemon=True,
+                         name="node-drain-monitor").start()
 
     def _clamp_freed_cursor(self, head: int):
         """Rewind the freed-channel cursor after a head restart from
@@ -1648,6 +1694,12 @@ class NodeServer:
             # through _fail_stream rather than landing on the seed id
             rt._register_stream(ret_ids[0].binary())
         if state.dead:
+            if state.migrated:
+                # planned-drain eviction: the actor lives on elsewhere —
+                # reject at submit so the caller re-routes through the
+                # actor_state channel instead of consuming a dead result
+                raise ActorDiedError(
+                    f"actor {actor_id} migrated off this node")
             rt._store_error(ret_ids, rt._actor_dead_error(state))
             return True
         spec = _TaskSpec(task_id, None, args_payload,
@@ -1676,6 +1728,14 @@ class NodeServer:
         self._check_gcs_epoch(gcs_epoch_seq)
         self.runtime.kill_actor(ActorID(actor_id_bytes), no_restart=no_restart)
         return True
+
+    def _op_evict_actor(self, actor_id_bytes, gcs_epoch_seq=None,
+                        wait_s=0.5):
+        # drain migration: same fencing as kill_actor, but the reap
+        # waits for in-flight calls to settle and fails nothing
+        self._check_gcs_epoch(gcs_epoch_seq)
+        return self.runtime.evict_actor(ActorID(actor_id_bytes),
+                                        wait_s=wait_s)
 
     # -- placement groups (node-local; the driver composes cluster PGs)
 

@@ -331,6 +331,36 @@ _FLAGS: List[Flag] = [
     Flag("cluster_view_refresh_s", float, 0.25,
          "Driver-side cluster view (node table + loads) max staleness "
          "before re-fetching from the GCS."),
+    Flag("node_drain_grace_s", float, 10.0,
+         "Bounded grace window for a DRAINING node: the scheduler stops "
+         "placing new work immediately, restartable/detached actors "
+         "migrate, and running tasks get this long to finish before the "
+         "GCS declares the node DRAINED (reference: DrainNodeRequest "
+         "deadline, gcs_node_manager). A drained node deregisters "
+         "cleanly — no death event, no lineage reconstruction."),
+    Flag("quarantine_score_threshold", float, 2.0,
+         "Per-node health score (heartbeat-interval jitter EWMA + "
+         "task-failure-rate EWMA + peer suspicion reports) above which "
+         "the GCS auto-QUARANTINES a gray-failing node: cordoned from "
+         "scheduling, existing work allowed to finish, periodically "
+         "probed for recovery. 0 disables quarantining."),
+    Flag("quarantine_recover_s", float, 1.0,
+         "Hysteresis window for un-quarantine: a QUARANTINED node "
+         "returns to ALIVE only after its health score has stayed below "
+         "half the quarantine threshold for this long AND the GCS's "
+         "periodic liveness probe succeeds — so a flapping node cannot "
+         "oscillate in and out of the schedulable set."),
+    Flag("job_lease_ttl_s", float, 2.0,
+         "Heartbeat lease a job agent holds on every claimed job; the "
+         "agent renews it each poll tick, and the GCS orphan detector "
+         "re-queues (or fails, per the job's max_restarts policy) any "
+         "RUNNING job whose lease expired — a SIGKILLed agent can no "
+         "longer strand jobs forever."),
+    Flag("job_max_restarts_default", int, 0,
+         "Default max_restarts for submit_job when the caller does not "
+         "pass one: how many times a crash-looping entrypoint (nonzero "
+         "exit, or an orphaned claim) is re-queued with exponential "
+         "backoff + full jitter before the job goes FAILED."),
     # ---- chaos / testing -------------------------------------------------
     Flag("testing_rpc_delay_ms", int, 0,
          "If > 0, injects a uniform random delay up to this many ms into "

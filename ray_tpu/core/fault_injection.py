@@ -70,6 +70,15 @@ site           key                      actions
                                         Fires in the router (driver or
                                         proxy process), so in-process
                                         ``inject`` works
+``job_claim``  job id                   ``drop`` — the job agent
+                                        abandons a claim right after the
+                                        PENDING -> RUNNING cas succeeds,
+                                        without spawning the entrypoint
+                                        (an agent that died mid-claim);
+                                        the lease-expiry orphan detector
+                                        must recover the job. Fires in
+                                        the agent's process, so
+                                        in-process ``inject`` works
 =============  =======================  ==================================
 
 Env/config surface: ``RTPU_FAULT_<SITE>=<action>[:<times>[:<match>]]``
@@ -102,7 +111,8 @@ from typing import Dict, List, Optional
 from ray_tpu.util.debug_lock import make_lock
 
 SITES = ("get", "spill", "dispatch", "task", "actor_call",
-         "actor_worker_kill", "gcs_kill", "gang_resize", "serve_overload")
+         "actor_worker_kill", "gcs_kill", "gang_resize", "serve_overload",
+         "job_claim")
 
 _lock = make_lock("fault_injection._lock")
 _specs: Dict[str, List[dict]] = {}

@@ -326,7 +326,7 @@ class _ActorState:
         "restarts_left", "name", "creation_event", "request", "pg_wire",
         "acquired_bundle", "chips", "resources_acquired", "capacity",
         "restarting", "restarting_since", "incarnation", "next_seq",
-        "seq_watermark", "completed_seqs",
+        "seq_watermark", "completed_seqs", "migrated",
     )
 
     def __init__(self, actor_id, cls_fn_id, args_payload, deps, opts):
@@ -368,6 +368,10 @@ class _ActorState:
         self.next_seq = 0
         self.seq_watermark = 0
         self.completed_seqs: set = set()
+        # set by evict_actor (planned drain): the actor is dead HERE but
+        # lives on elsewhere — reject racing calls at submit instead of
+        # failing their results, so callers re-route
+        self.migrated = False
 
 
 def _reap_stale_shm_arenas():
@@ -819,6 +823,11 @@ class Runtime:
             if not w.alive:
                 return
             w.alive = False
+            # cumulative unexpected-death count: the node server reports
+            # it on heartbeats as the per-node task-failure signal the
+            # GCS health scorer folds into quarantine decisions
+            self._worker_death_count = getattr(
+                self, "_worker_death_count", 0) + 1
             if not w.ready:
                 # died before MSG_READY: release the spawning slot it
                 # held, or scale-up/pool-repay gates stay closed forever
@@ -1723,7 +1732,20 @@ class Runtime:
         if spec.actor_id is not None:
             state = self._actors[spec.actor_id]
             with self._lock:
-                state.queue.append(spec)
+                # the submit-path migrated check and the evict mark are
+                # not atomic; re-check under the lock the eviction marks
+                # under, so a call racing the mark gets a RETRYABLE
+                # error instead of joining a queue nothing will drain
+                if state.dead and state.migrated:
+                    evicted = True
+                else:
+                    evicted = False
+                    state.queue.append(spec)
+            if evicted:
+                self._store_error(spec.return_ids, ActorUnavailableError(
+                    "actor migrated off this node mid-submit; the new "
+                    "incarnation is registering — retry"))
+                return
             self._dispatch_actor(state)
         else:
             with self._lock:
@@ -3121,6 +3143,56 @@ class Runtime:
                 w.proc.kill()
             except OSError:
                 pass
+
+    def evict_actor(self, actor_id: ActorID, wait_s: float = 0.5) -> bool:
+        """Planned-migration eviction (node drain): remove the local
+        incarnation only once its queued and in-flight calls have
+        settled — unlike kill_actor, nothing pending is failed and no
+        DEAD state is published (the drain migrator already published
+        RESTARTING and recreates the actor elsewhere). Returns False
+        while calls are still settling, so the caller can keep polling
+        inside the drain grace window."""
+        state = self._actors.get(actor_id)
+        if state is None or state.dead:
+            return True
+        deadline = time.monotonic() + wait_s
+        while True:
+            with self._lock:
+                w = state.worker
+                busy = len(state.queue) + (
+                    len(w.inflight) if w is not None else 0)
+                if not busy:
+                    # settle-and-mark under one hold: a call racing in
+                    # after this point fails at submit admission, where
+                    # the driver's actor_state retry path re-routes it
+                    # to the new incarnation
+                    state.dead = True
+                    state.migrated = True
+                    state.ready = False
+                    state.restarting = False
+                    state.death_cause = ActorDiedError(
+                        "actor migrated off a draining node")
+                    if state.name and self._named_actors.get(
+                            state.name) == state.actor_id:
+                        del self._named_actors[state.name]
+                    self._release_actor_locked(state)
+                    try:
+                        self._pending_actors.remove(state)
+                    except ValueError:
+                        pass
+                    break
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        state.creation_event.set()
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.terminate()
+                w.proc.kill()
+            except OSError:
+                pass
+        self._dispatch()
+        return True
 
     def get_actor_method_opts(self, actor_id: ActorID) -> dict:
         state = self._actors.get(actor_id)

@@ -37,16 +37,42 @@ class JobSubmissionClient:
     def submit_job(self, *, entrypoint: str,
                    runtime_env: Optional[dict] = None,
                    submission_id: Optional[str] = None,
-                   metadata: Optional[dict] = None) -> str:
+                   metadata: Optional[dict] = None,
+                   max_restarts: Optional[int] = None,
+                   backoff=None) -> str:
+        """Submit an entrypoint for supervised execution.
+
+        ``max_restarts`` bounds how many times a crash-looping
+        entrypoint (nonzero exit, or an orphaned claim after the agent
+        died) is re-queued — each retry waits exponential backoff with
+        full jitter. ``backoff`` tunes the schedule: a float (base
+        seconds) or {"base_s", "max_s"}. Defaults come from
+        config.job_max_restarts_default / 1s base, 30s cap."""
+        from ray_tpu.core.config import config
+
         job_id = submission_id or f"job_{uuid.uuid4().hex[:10]}"
+        if max_restarts is None:
+            max_restarts = config.job_max_restarts_default
+        if backoff is None:
+            backoff = {}
+        elif isinstance(backoff, (int, float)):
+            backoff = {"base_s": float(backoff)}
+        bo = {"base_s": float(backoff.get("base_s", 1.0)),
+              "max_s": float(backoff.get("max_s", 30.0))}
         spec = {
             "job_id": job_id,
+            "submission_id": job_id,
             "entrypoint": entrypoint,
             "env": (runtime_env or {}).get("env_vars", {}),
             "metadata": metadata or {},
             "status": JobStatus.PENDING.value,
             "submitted_at": time.time(),
             "agent": None,
+            "max_restarts": int(max_restarts),
+            "backoff": bo,
+            "restarts": 0,
+            "next_eligible_at": 0.0,
+            "lease_expires_at": None,
         }
         if self._gcs.call(("kv", "exists", f"job/{job_id}")):
             raise ValueError(f"job {job_id!r} already exists")
@@ -64,7 +90,10 @@ class JobSubmissionClient:
 
     def list_jobs(self) -> List[dict]:
         keys = self._gcs.call(("kv", "keys", "job/"))
-        return [self._gcs.call(("kv", "get", k)) for k in keys]
+        # a job deleted between the keys scan and the per-key get reads
+        # back as None — skip it instead of handing callers a None row
+        jobs = (self._gcs.call(("kv", "get", k)) for k in keys)
+        return [j for j in jobs if j is not None]
 
     def get_job_logs(self, job_id: str) -> str:
         info = self.get_job_info(job_id)

@@ -7,11 +7,12 @@ Scopes (mirroring where each invariant lives):
 - L2 runs over ``ray_tpu/core/`` and ``ray_tpu/dag/`` (the
   event-loop/lock surface; the DAG driver holds its writer/reader
   locks across channel ops);
-- L4 runs over ``ray_tpu/core/``, ``ray_tpu/train/``, and
-  ``ray_tpu/parallel/`` (the recovery-contract surface — elastic
-  training extends the contract to TrainingWorkerError and
-  CollectiveAbortedError), plus ``ray_tpu/serve/`` for the
-  typed-overload-signal checks ONLY (dropped BackpressureError /
+- L4 runs over ``ray_tpu/core/``, ``ray_tpu/train/``,
+  ``ray_tpu/parallel/``, and ``ray_tpu/job/`` (the recovery-contract
+  surface — elastic training extends the contract to
+  TrainingWorkerError and CollectiveAbortedError; the job agent's
+  supervision loop is recovery machinery too), plus ``ray_tpu/serve/``
+  for the typed-overload-signal checks ONLY (dropped BackpressureError /
   ReplicaUnavailableError handlers — serve's best-effort cleanup idiom
   is exempt from the broad-catch rules);
 - L3 runs over the whole ``ray_tpu/`` package (flags are read
@@ -22,8 +23,9 @@ Scopes (mirroring where each invariant lives):
   L5 guards);
 - L6 runs over L5's scope plus ``ray_tpu/serve/`` and ``ray_tpu/dag/``
   (the async request paths the sync-in-async check guards);
-- L7 and L8 run over L6's scope — every class with a lock-guarded
-  field and every manual acquire/release pair lives there.
+- L7 and L8 run over L6's scope plus ``ray_tpu/job/`` — every class
+  with a lock-guarded field and every manual acquire/release pair
+  lives there (the job agent holds subprocess + fd lifecycles).
 
 Rules run as independent thunks so the CLI can fan them out across a
 thread pool (``--jobs``); each thunk's wall time is reported in the
@@ -85,6 +87,7 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
     serve_files: List[SourceFile] = []      # L4 scope (signal-only)
     lock_files: List[SourceFile] = []       # L5 scope
     thread_files: List[SourceFile] = []     # L6 scope
+    job_files: List[SourceFile] = []        # extends L4 + L7/L8
     all_files: List[SourceFile] = []
     for path in iter_py_files(root, "ray_tpu"):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
@@ -99,16 +102,21 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
             recovery_files.append(sf)
         if rel.startswith("ray_tpu/serve/"):
             serve_files.append(sf)
+        if rel.startswith("ray_tpu/job/"):
+            job_files.append(sf)
         if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
                            "ray_tpu/dag/")):
             lock_files.append(sf)
         if rel.startswith(("ray_tpu/core/", "ray_tpu/train/",
                            "ray_tpu/serve/", "ray_tpu/dag/")):
             thread_files.append(sf)
+    # the job agent's supervision loop is recovery machinery (L4) and
+    # holds subprocess/fd lifecycles (L8)
+    recovery_files = recovery_files + job_files
     # L7/L8 share the widest concurrency scope: everything multi-
     # threaded plus the serve request paths (thread_files covers
-    # core/ incl. cluster/, train/, serve/, dag/)
-    guard_files = thread_files
+    # core/ incl. cluster/, train/, serve/, dag/) plus job/
+    guard_files = thread_files + job_files
 
     test_files: List[SourceFile] = []
     if "L3" in rules:

@@ -40,8 +40,9 @@ import pytest
 # DebugLock, so an acquisition-order inversion or a callback fired
 # under a tracked lock fails the test at the offending site instead of
 # hanging CI. The env var makes spawned workers arm themselves too.
-_SANITIZED_MODULES = {"test_dag_spin", "test_fault_tolerance", "test_ha",
-                      "test_netem", "test_regressions"}
+_SANITIZED_MODULES = {"test_dag_spin", "test_drain", "test_fault_tolerance",
+                      "test_ha", "test_job", "test_netem",
+                      "test_regressions"}
 
 
 @pytest.fixture(autouse=True, scope="module")
@@ -71,7 +72,8 @@ def _lock_sanitizer(request):
 # (GCS/worker subprocesses are exercised by RTPU_SANITIZE instead).
 # Override with RTPU_INTERLEAVE=<seed>[:<n>] to replay a failing seed
 # printed by a sweep, or to widen the schedule search locally.
-_INTERLEAVED_MODULES = {"test_fault_tolerance", "test_ha", "test_netem"}
+_INTERLEAVED_MODULES = {"test_drain", "test_fault_tolerance", "test_ha",
+                        "test_job", "test_netem"}
 _INTERLEAVE_SEED = 1  # default chaos-suite schedule; env var overrides
 _INTERLEAVE_MAX_PREEMPTIONS = 200
 

@@ -1,0 +1,276 @@
+"""Supervised jobs: submit/status/logs/stop, concurrent-claim cas
+races, agent SIGKILL -> lease-expiry orphan recovery, deterministic
+crash-loop backoff, and stop-across-restart semantics.
+
+All tests run an in-process GcsServer (real RPC server on localhost)
+plus in-process JobAgents; the SIGKILL drill runs the agent as a real
+``python -m ray_tpu.job.agent`` subprocess so the kill is honest.
+"""
+
+import contextlib
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu.core import fault_injection
+from ray_tpu.core.cluster.gcs import GcsServer
+from ray_tpu.core.cluster.rpc import RpcClient
+from ray_tpu.job.agent import JobAgent
+from ray_tpu.job.backoff import delay_for
+from ray_tpu.job.client import JobStatus, JobSubmissionClient
+
+KEY = b"job-test-key"
+
+
+@contextlib.contextmanager
+def _config(**overrides):
+    """Set RTPU_* env overrides and reload the config, restoring both
+    afterwards (flags are resolved once at import)."""
+    from ray_tpu.core.config import config
+
+    saved = {}
+    for name, value in overrides.items():
+        var = "RTPU_" + name.upper()
+        saved[var] = os.environ.get(var)
+        os.environ[var] = str(value)
+    config.reload()
+    try:
+        yield
+    finally:
+        for var, old in saved.items():
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+        config.reload()
+
+
+@contextlib.contextmanager
+def _gcs_and_client():
+    gcs = GcsServer(authkey=KEY)
+    addr = f"{gcs.address[0]}:{gcs.address[1]}"
+    client = JobSubmissionClient(addr, authkey=KEY)
+    try:
+        yield gcs, client
+    finally:
+        client.close()
+        gcs.close()
+
+
+def _make_agent(gcs, tmp_path, agent_id="agent-a", poll_s=0.05):
+    rpc = RpcClient(gcs.address, KEY)
+    return JobAgent(rpc, gcs.address, agent_id=agent_id,
+                    log_dir=str(tmp_path / "logs"), poll_s=poll_s)
+
+
+def _wait_status(client, job_id, statuses, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = client.get_job_status(job_id)
+        if st in statuses:
+            return st
+        time.sleep(0.05)
+    raise AssertionError(
+        f"job {job_id} stuck in {client.get_job_status(job_id)}, "
+        f"wanted {statuses}")
+
+
+def test_submit_status_logs_stop_roundtrip(tmp_path):
+    with _gcs_and_client() as (gcs, client):
+        agent = _make_agent(gcs, tmp_path)
+        try:
+            ok = client.submit_job(entrypoint="echo job-says-hello")
+            assert client.get_job_status(ok) in (JobStatus.PENDING,
+                                                 JobStatus.RUNNING,
+                                                 JobStatus.SUCCEEDED)
+            assert _wait_status(client, ok,
+                                {JobStatus.SUCCEEDED}) \
+                == JobStatus.SUCCEEDED
+            assert "job-says-hello" in client.get_job_logs(ok)
+            info = client.get_job_info(ok)
+            assert info["returncode"] == 0
+            assert info["lease_expires_at"] is None
+
+            long = client.submit_job(entrypoint="sleep 60")
+            _wait_status(client, long, {JobStatus.RUNNING})
+            assert client.stop_job(long)
+            assert _wait_status(client, long, {JobStatus.STOPPED}) \
+                == JobStatus.STOPPED
+        finally:
+            agent.close()
+
+
+def test_list_jobs_skips_concurrently_deleted(tmp_path):
+    """Regression: a job deleted between the ``kv keys`` scan and the
+    per-key ``kv get`` must be skipped, not returned as None."""
+    with _gcs_and_client() as (gcs, client):
+        client.submit_job(entrypoint="true", submission_id="job_keep")
+        client.submit_job(entrypoint="true", submission_id="job_gone")
+
+        real_call = client._gcs.call
+
+        def racing_call(msg):
+            result = real_call(msg)
+            if msg[:2] == ("kv", "keys"):
+                real_call(("kv", "del", "job/job_gone"))
+            return result
+
+        client._gcs.call = racing_call
+        jobs = client.list_jobs()
+        assert None not in jobs
+        assert [j["job_id"] for j in jobs] == ["job_keep"]
+
+
+def test_concurrent_claim_runs_each_job_exactly_once(tmp_path):
+    """Two agents race every claim through the PENDING->RUNNING cas:
+    each job's entrypoint runs exactly once."""
+    out = tmp_path / "claims.txt"
+    with _gcs_and_client() as (gcs, client):
+        a1 = _make_agent(gcs, tmp_path, agent_id="agent-a")
+        a2 = _make_agent(gcs, tmp_path, agent_id="agent-b")
+        try:
+            ids = [client.submit_job(
+                entrypoint=f"echo run-{i} >> {out}")
+                for i in range(6)]
+            for jid in ids:
+                _wait_status(client, jid, {JobStatus.SUCCEEDED})
+        finally:
+            a1.close()
+            a2.close()
+        lines = sorted(out.read_text().split())
+        assert lines == sorted(f"run-{i}" for i in range(6))
+        agents = {client.get_job_info(j)["agent"] for j in ids}
+        assert agents <= {"agent-a", "agent-b"}
+
+
+def test_agent_sigkill_orphan_recovered_exactly_once(tmp_path):
+    """SIGKILL the (subprocess) agent mid-job: the lease expires, the
+    GCS orphan detector re-queues the job, a second agent reaps the
+    stale process group and re-runs it — the payload lands exactly
+    once."""
+    out = tmp_path / "done.txt"
+    with _config(job_lease_ttl_s=0.6), _gcs_and_client() as (gcs, client):
+        addr = f"{gcs.address[0]}:{gcs.address[1]}"
+        env = dict(os.environ, RTPU_CLUSTER_AUTHKEY=KEY.hex(),
+                   RTPU_JOB_LEASE_TTL_S="0.6")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.job.agent", "--gcs", addr,
+             "--agent-id", "doomed", "--poll", "0.1",
+             "--log-dir", str(tmp_path / "logs")],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
+        try:
+            assert proc.stdout.readline().decode().startswith(
+                "AGENT_READY")
+            jid = client.submit_job(
+                entrypoint=f"sleep 3 && echo done >> {out}",
+                max_restarts=1, backoff=0.05)
+            # wait until the doomed agent claimed it and recorded the pid
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                info = client.get_job_info(jid)
+                if info["status"] == JobStatus.RUNNING.value \
+                        and info.get("pid"):
+                    break
+                time.sleep(0.05)
+            assert info.get("pid"), "agent never claimed the job"
+            proc.kill()
+            proc.wait()
+
+            rescuer = _make_agent(gcs, tmp_path, agent_id="rescuer")
+            try:
+                assert _wait_status(client, jid, {JobStatus.SUCCEEDED},
+                                    timeout=60) == JobStatus.SUCCEEDED
+            finally:
+                rescuer.close()
+            info = client.get_job_info(jid)
+            assert info["orphaned"] is True
+            assert info["restarts"] == 1
+            assert info["agent"] == "rescuer"
+            # exactly once: the first attempt's process group was
+            # reaped mid-sleep, so only the retry wrote its line
+            time.sleep(0.3)
+            assert out.read_text().split() == ["done"]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def test_crash_loop_backoff_schedule_is_deterministic(tmp_path):
+    """A crash-looping entrypoint is re-queued max_restarts times with
+    the exact full-jitter schedule delay_for computes, then FAILED."""
+    with _gcs_and_client() as (gcs, client):
+        agent = _make_agent(gcs, tmp_path)
+        try:
+            jid = client.submit_job(entrypoint="exit 3", max_restarts=3,
+                                    backoff={"base_s": 0.05,
+                                             "max_s": 0.2})
+            assert _wait_status(client, jid, {JobStatus.FAILED},
+                                timeout=60) == JobStatus.FAILED
+        finally:
+            agent.close()
+        info = client.get_job_info(jid)
+        assert info["restarts"] == 3
+        assert info["returncode"] == 3
+        expected = [delay_for(jid, n, 0.05, 0.2) for n in range(3)]
+        assert info["backoff_history"] == pytest.approx(expected)
+
+
+def test_stop_holds_across_restart_boundary(tmp_path):
+    """stop_job against a job sitting in its crash-loop backoff window
+    (PENDING, restarts > 0) stops it for good — the agent must not
+    claim it again."""
+    # full jitter draws uniform(0, 30) for attempt 0 — pick a submission
+    # id whose (deterministic) first delay is long, so the job provably
+    # sits PENDING-in-backoff when we stop it
+    sid = next(s for s in (f"stop-hold-{i}" for i in range(100))
+               if delay_for(s, 0, 30.0, 60.0) > 15.0)
+    with _gcs_and_client() as (gcs, client):
+        agent = _make_agent(gcs, tmp_path)
+        try:
+            jid = client.submit_job(entrypoint="exit 7", max_restarts=5,
+                                    submission_id=sid,
+                                    backoff={"base_s": 30.0,
+                                             "max_s": 60.0})
+            # first crash -> re-queued with a long backoff window
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                info = client.get_job_info(jid)
+                if info["status"] == JobStatus.PENDING.value \
+                        and info.get("restarts"):
+                    break
+                time.sleep(0.05)
+            assert info.get("restarts") == 1
+            assert client.stop_job(jid)
+            assert _wait_status(client, jid, {JobStatus.STOPPED}) \
+                == JobStatus.STOPPED
+            time.sleep(0.5)  # several agent polls
+            info = client.get_job_info(jid)
+            assert info["status"] == JobStatus.STOPPED.value
+            assert info["restarts"] == 1  # never ran again
+        finally:
+            agent.close()
+
+
+def test_job_claim_fault_site_recovers_via_lease(tmp_path):
+    """Chaos site ``job_claim``: the agent abandons a claim right after
+    the cas (an agent that died mid-claim). Lease expiry must re-queue
+    the job and the next claim completes it."""
+    with _config(job_lease_ttl_s=0.5), _gcs_and_client() as (gcs, client):
+        fault_injection.inject("job_claim", "drop", times=1)
+        agent = _make_agent(gcs, tmp_path)
+        try:
+            jid = client.submit_job(entrypoint="echo recovered",
+                                    max_restarts=1, backoff=0.05)
+            assert _wait_status(client, jid, {JobStatus.SUCCEEDED},
+                                timeout=60) == JobStatus.SUCCEEDED
+        finally:
+            agent.close()
+            fault_injection.clear()
+        info = client.get_job_info(jid)
+        assert info["orphaned"] is True
+        assert info["restarts"] == 1
+        assert "recovered" in client.get_job_logs(jid)
