@@ -1226,6 +1226,10 @@ class ClusterCore:
         except Exception:
             for addr, local_pg_b in created:
                 try:
+                    # rtpu-lint: disable=L9 — per-node rollback fan-out,
+                    # not a re-send: each iteration targets a DIFFERENT
+                    # node, and removing an already-removed local group
+                    # is a no-op on the node
                     self._nodes.get(addr).call(("pg", "remove", local_pg_b))
                 # rtpu-lint: disable=L4 — best-effort rollback of the
                 # partially created group; the original placement error
@@ -1285,6 +1289,9 @@ class ClusterCore:
             return
         for addr, local_pg_b in pg.node_pgs.items():
             try:
+                # rtpu-lint: disable=L9 — per-node fan-out, not a
+                # re-send: each iteration removes a DIFFERENT node's
+                # slice, and a double remove is a no-op on the node
                 self._nodes.get(addr).call(("pg", "remove", local_pg_b))
             # rtpu-lint: disable=L4 — removal on a dead/unreachable node
             # is moot (its reservations died with it); remove the rest
@@ -1357,6 +1364,10 @@ class ClusterCore:
         by one poll slice."""
         addr = tuple(owner) if owner else self._route(seed, self._home)
         try:
+            # rtpu-lint: disable=L9 — the credit is a MONOTONIC
+            # watermark: the producer takes max(old, new), so a lost or
+            # double-applied advance can only under-report consumption
+            # (one poll-slice stall), never corrupt the stream
             self._nodes.get(addr).call(("stream_consumed", seed, index))
         except RpcError:
             pass
@@ -1385,6 +1396,10 @@ class ClusterCore:
                  if n["state"] != "DEAD"}
         for addr in addrs:
             try:
+                # rtpu-lint: disable=L9 — per-node fan-out, not a
+                # re-send; free of an unknown/tombstoned id is a no-op,
+                # and the freed_add tombstone published below is the
+                # authority a missed node converges on via _drain_freed
                 freed.update(self._nodes.get(addr).call(
                     ("free", oid_bytes_list)) or [])
             except RpcError:

@@ -167,6 +167,9 @@ class Cluster:
         """Remove a node; ungraceful kill exercises failure detection."""
         if graceful:
             try:
+                # rtpu-lint: disable=L9 — test-fixture teardown: whether
+                # the shutdown RPC applied is moot, kill() below ends
+                # the process unconditionally
                 RpcClient(node.address, self.authkey, connect_timeout=2.0
                           ).call(("shutdown_node",))
             # rtpu-lint: disable=L4 — graceful is best-effort: the node

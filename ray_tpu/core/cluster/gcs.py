@@ -20,6 +20,14 @@ capability level:
   (function_manager.py exports to GCS in the reference)
 
 Run as ``python -m ray_tpu.core.cluster.gcs --port N``.
+
+Wire semantics of every ``_op_*`` arm here — may a client re-send it
+after a lost reply, and how does its state resync after failover — are
+declared in ``WIRE_CONTRACT``/``RESYNC_COVERAGE`` (protocol_meta.py),
+the single source of truth the transport whitelist derives from. Add a
+new op there first; the L9/L10 lint rules fail on unclassified arms,
+on persisted tables missing from ``_WAL_OPS``/the snapshot round-trip,
+and on nondeterminism inside WAL-replayed apply bodies.
 """
 
 from __future__ import annotations
@@ -729,6 +737,10 @@ class GcsServer:
                 try:
                     peer = self._peers.get(tuple(migrate_from))
                     grace = time.monotonic() + config.node_drain_grace_s
+                    # rtpu-lint: disable=L9 — deliberate poll-until-done
+                    # loop, and the op is epoch-fenced (_epoch_seq): a
+                    # duplicate eviction of an already-evicted actor is
+                    # a no-op, a stale epoch is rejected by the node
                     while not peer.call(("evict_actor", aid,
                                          self._epoch_seq, 0.5)):
                         if time.monotonic() >= grace or self._stop:
@@ -900,6 +912,12 @@ class GcsServer:
                           topology, labels=None):
         with self._lock:
             prev = self._nodes.get(node_id)
+            # rtpu-lint: disable=L10 — _NodeInfo stamps last_heartbeat
+            # with time.monotonic(): transient liveness state, NOT
+            # replayed table data. Replay MUST grant a fresh grace
+            # window — replaying the original wall-clock stamp would
+            # declare every node dead the moment the health loop runs
+            # (the recovery grace in _load_persisted depends on this).
             info = _NodeInfo(node_id, address, resources, topology, labels)
             if prev is not None and prev.state in ("DRAINING",
                                                    "QUARANTINED"):
@@ -960,6 +978,11 @@ class GcsServer:
             if info.avail != avail or info.load != load:
                 info.avail = dict(avail)
                 info.load = load
+                # rtpu-lint: disable=L10 — _view_version is a monotonic
+                # cache-invalidation counter, not table data: it is
+                # persisted only so a restore resumes PAST every seen
+                # value (+1 in _restore_state); losing heartbeat bumps
+                # to compaction timing can never roll a client backward
                 self._view_version += 1
             state = info.state
         return dict(base, accepted=True, state=state)
@@ -1008,6 +1031,11 @@ class GcsServer:
             if info.state in ("DRAINING", "DRAINED"):
                 return True  # idempotent: re-drain is a no-op
             info.state = "DRAINING"
+            # rtpu-lint: disable=L10 — drain_deadline is transient
+            # pacing (monotonic clock is meaningless across processes):
+            # replay and _restore_state both deliberately re-arm a
+            # FRESH grace window; the durable fact is only the DRAINING
+            # state itself
             info.drain_deadline = (time.monotonic()
                                    + config.node_drain_grace_s)
             self._publish_locked("node_state", {

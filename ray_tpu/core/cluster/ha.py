@@ -11,8 +11,9 @@ right after it comes back:
   park and retry (with backoff+jitter) until ``gcs_reconnect_timeout_s``
   elapses or ``gcs_op_buffer_max`` calls are already parked, then fail
   with the typed ``GcsUnavailableError`` — the cluster-level mirror of
-  ``ActorUnavailableError``'s bounded-buffering semantics. Only ops on
-  rpc.py's retry-after-apply whitelist are ever replayed once their
+  ``ActorUnavailableError``'s bounded-buffering semantics. Only ops
+  ``WIRE_CONTRACT`` (protocol_meta.py — the single source of truth for
+  wire retry classes) marks retry-safe are ever replayed once their
   request may have been applied (lost reply), so at-least-once delivery
   stays indistinguishable from exactly-once.
 - Epoch tracking: every GCS process mints a fresh ``epoch``

@@ -216,6 +216,10 @@ class NodeRuntime(Runtime):
                 _, seed, index, owner = msg
                 if seed not in self._streams and owner is not None:
                     try:
+                        # rtpu-lint: disable=L9 — forwarded credit: a
+                        # MONOTONIC watermark (owner takes max), so a
+                        # lost/duplicate advance only stalls the
+                        # producer one poll slice, never corrupts
                         srv._peers.get(tuple(owner)).call(
                             ("stream_consumed", seed, index))
                     except RpcError:
@@ -585,6 +589,10 @@ class NodeServer:
                 idle_beats = idle_beats + 1 if busy == 0 else 0
                 time.sleep(0.05)
             if not self._stop:
+                # rtpu-lint: disable=L9 — state-machine edge: the GCS
+                # applies node_drained only while the node is DRAINING,
+                # and a lost reply is healed by the drain-deadline
+                # backstop in the GCS monitor (forces DRAINED at grace)
                 self.gcs.try_call(("node_drained", self.node_id.binary()))
 
         threading.Thread(target=monitor, daemon=True,
@@ -1523,6 +1531,10 @@ class NodeServer:
         for info in self._alive_peers():
             addr = tuple(info["address"])
             try:
+                # rtpu-lint: disable=L9 — per-peer fan-out, not a
+                # re-send; free of an unknown/tombstoned id is a no-op
+                # and the freed_add tombstones published below are the
+                # authority a missed peer converges on
                 freed.update(self._peers.get(addr).call(
                     ("free", list(oid_bytes_list))) or [])
             except RpcError:

@@ -8,6 +8,11 @@ the wire format is an implementation detail hidden behind RpcClient/serve.
 
 Blocking RPCs (e.g. a get that waits for a task) hold one pooled connection
 for their duration; the pool grows on demand and idles out.
+
+Retry semantics are NOT decided here: ``WIRE_CONTRACT`` in
+``protocol_meta.py`` is the single source of truth classifying every wire
+op as idempotent / retry-after-apply / dedup-keyed / non-retryable, and
+``_retry_safe_after_apply`` below merely consults the sets derived from it.
 """
 
 from __future__ import annotations
@@ -23,6 +28,7 @@ from multiprocessing.connection import answer_challenge, deliver_challenge
 from typing import Any, Callable, List, Optional, Tuple
 
 from ray_tpu.core import netem
+from ray_tpu.core.cluster import protocol_meta
 from ray_tpu.core.config import config
 from ray_tpu.util.debug_lock import make_lock
 
@@ -265,34 +271,16 @@ class RpcServer:
 
 
 # Ops that are safe to retry after the request may have been APPLIED once
-# (reply lost: send succeeded, recv failed). Everything here is a read or a
-# set-style write where apply-twice == apply-once. Deliberately excluded:
-# submit / create_actor / actor_call (side effects run twice), publish
-# (duplicate pubsub event), free/release (refcount double-decrement),
-# kv merge/cas_merge (double-merge) — see the kv sub-op check below.
+# (reply lost: send succeeded, recv failed): every op WIRE_CONTRACT in
+# protocol_meta.py classifies as a read, a set-style write where
+# apply-twice == apply-once, or dedup-keyed exactly-once. That table is
+# the single source of truth — classify new ops THERE, never here; the
+# L9 lint rule rejects retry paths that disagree with it.
 # The reference splits the same way: gRPC retries are enabled per-method
 # only for idempotent GCS reads (src/ray/rpc/gcs_server/gcs_rpc_client.h).
-_IDEMPOTENT_OPS = frozenset({
-    # reads / polls
-    "ping", "status", "state", "stack_dump", "task_events", "list_logs",
-    "get_log", "list_nodes", "wait_nodes", "deaths_since", "freed_check",
-    "get_named_actor", "list_actors", "loc_get", "loc_get_batch", "poll",
-    "get_fn",
-    "get", "fetch", "fetch_size", "fetch_range", "has", "wait",
-    "actor_opts",
-    # set/last-writer-wins writes (apply-twice == apply-once)
-    "register_node", "heartbeat", "unregister_node", "freed_add",
-    "name_actor", "drop_actor_name", "register_actor",
-    "register_actor_spec", "drop_actor_spec", "loc_add", "loc_add_batch",
-    "loc_drop", "register_fn", "cancel", "kill_actor", "prestart_workers",
-    "register_driver", "driver_heartbeat", "unregister_driver",
-    "driver_deaths_since", "owner_cleanup", "gcs_info",
-    # exactly-once via server-side dedup on the caller-chosen id
-    # (NodeServer._dedup): re-apply is a no-op
-    "submit", "actor_call", "create_actor",
-})
+_IDEMPOTENT_OPS = protocol_meta.RETRY_SAFE_OPS
 
-_IDEMPOTENT_KV_SUBOPS = frozenset({"put", "get", "del", "exists", "keys"})
+_IDEMPOTENT_KV_SUBOPS = protocol_meta.RETRY_SAFE_KV_SUBOPS
 
 
 def _retry_safe_after_apply(msg) -> bool:

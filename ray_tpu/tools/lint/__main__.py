@@ -26,6 +26,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit a JSON object: {'findings': [...], "
                              "'rule_wall_ms': {rule: ms}}")
+    parser.add_argument("--sarif", action="store_true", dest="as_sarif",
+                        help="emit a SARIF 2.1.0 log; waived sites are "
+                             "included with suppressions kind=inSource "
+                             "(they never count toward the exit code)")
     parser.add_argument("--jobs", type=int, default=1, metavar="N",
                         help="run rules in parallel on N threads "
                              "(default: 1, serial)")
@@ -45,6 +49,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.jobs < 1:
         print("rtpu-lint: --jobs must be >= 1", file=sys.stderr)
         return 2
+    if args.as_sarif and args.as_json:
+        print("rtpu-lint: --sarif and --json are mutually exclusive",
+              file=sys.stderr)
+        return 2
     changed = None
     if args.diff is not None:
         try:
@@ -60,15 +68,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         findings, wall_ms = runner.collect_findings_timed(
             root=args.root, rules=rules, jobs=args.jobs,
-            changed_only=changed)
+            changed_only=changed, include_suppressed=args.as_sarif)
+    except runner.RuleCrash as e:
+        # a rule blew up mid-analysis: name the rule and the file it
+        # was chewing on — an actionable exit 2, not a silent pass
+        print(f"rtpu-lint: {e}", file=sys.stderr)
+        return 2
     except Exception as e:  # noqa: BLE001 — CLI boundary: fold any
         # analyzer crash into the documented exit-2 contract
         print(f"rtpu-lint: internal error: {e!r}", file=sys.stderr)
         return 2
 
+    # waived sites only reach `findings` under --sarif (annotated, so
+    # viewers show them as suppressed-in-source); everything that
+    # gates — baselines, the exit code — sees open findings only
+    open_findings = [f for f in findings if not f.suppressed]
+
     if args.write_baseline:
-        runner.write_baseline(args.write_baseline, findings)
-        print(f"rtpu-lint: wrote {len(findings)} finding key(s) to "
+        runner.write_baseline(args.write_baseline, open_findings)
+        print(f"rtpu-lint: wrote {len(open_findings)} finding key(s) to "
               f"{args.write_baseline}")
         return 0
 
@@ -80,8 +98,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{e}", file=sys.stderr)
             return 2
         findings = runner.apply_baseline(findings, baseline)
+        open_findings = [f for f in findings if not f.suppressed]
 
-    if args.as_json:
+    if args.as_sarif:
+        print(json.dumps(runner.to_sarif(findings), indent=1))
+    elif args.as_json:
         print(json.dumps({"findings": [f.to_dict() for f in findings],
                           "rule_wall_ms": wall_ms}, indent=1))
     else:
@@ -89,7 +110,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f.render())
         word = "new finding(s)" if args.baseline else "finding(s)"
         print(f"rtpu-lint: {len(findings)} {word}")
-    return 1 if findings else 0
+    return 1 if open_findings else 0
 
 
 if __name__ == "__main__":

@@ -45,6 +45,14 @@ RULES: Dict[str, str] = {
     "L8": "resource lifecycle: acquire/release pairs (shm allocations, "
           "channel endpoints, depth tokens, sockets) must release on "
           "exception edges and early returns, not only via __del__",
+    "L9": "wire contract: every dispatch arm and protocol tag is "
+          "classified in WIRE_CONTRACT, retry paths only re-send "
+          "retry-safe ops, dedup_keyed claims have a server-side dedup "
+          "structure, maybe_applied errors are never swallowed",
+    "L10": "durability & resync: every _WAL_OPS table round-trips "
+           "through snapshot+restore, persisted tables are only "
+           "written by WAL ops, replayed apply bodies are "
+           "deterministic, every WAL op declares resync coverage",
 }
 
 
@@ -57,6 +65,10 @@ class Finding:
     line: int
     message: str
     key: str = field(default="")
+    #: set by the runner when a ``# rtpu-lint: disable=`` waiver covers
+    #: the site (only surfaced when suppressed findings are requested,
+    #: e.g. for --sarif; never counts toward the exit code)
+    suppressed: bool = field(default=False, compare=False)
 
     def __post_init__(self):
         if not self.key:

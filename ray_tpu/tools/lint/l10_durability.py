@@ -1,0 +1,452 @@
+"""L10 — durability & resync coverage for the GCS WAL.
+
+The GCS survives restarts by replaying ``snapshot.pkl`` +
+``wal.pkl`` through the very same ``_op_*`` bodies that applied the
+ops live, and the cluster re-converges through ``resync_node`` plus the
+``gcs_info`` cursor clamps. Four invariants keep that machinery honest,
+and each one is a hand-synchronized pair of tables today — this rule
+checks them against each other:
+
+1. **Snapshot coverage** — every table a ``_WAL_OPS`` member mutates
+   (including ``_WAL_KV_MUTATORS`` sub-ops, via ``_op_kv``) must be
+   serialized by ``_snapshot_state`` and restored by
+   ``_restore_state``; otherwise compaction silently DROPS the state
+   the WAL was supposed to protect (the WAL truncates at snapshot
+   time).
+2. **WAL coverage** — conversely, an ``_op_*`` arm that writes a
+   persisted table while absent from ``_WAL_OPS`` produces writes that
+   exist in snapshots only by luck of compaction timing and never in
+   the log.
+3. **Replay determinism** — WAL replay re-executes apply bodies, so
+   wall-clock reads, ``random``, ``os.urandom``, and env reads inside
+   them (or helpers they call, or constructors they run) make a
+   replayed GCS diverge from the live one.
+4. **Resync coverage** — every WAL op must declare, in
+   ``RESYNC_COVERAGE`` (protocol_meta.py), how its state re-converges
+   when the head restarts EMPTY: re-pushed by ``resync_node``
+   (``resync:<literal>`` / ``helper:<fn>``), re-cut at a ``gcs_info``
+   cursor (``cursor:<key>``), or snapshot-only (``durable``, justified
+   in the table). Declarations are verified against the code they
+   name; drift (a stale entry, a renamed cursor, a helper that no
+   longer sends the op) is flagged.
+
+Approximations (deliberate): mutation detection sees direct
+assignments/augments/deletes on ``self._x`` (including subscripts),
+mutating method calls (``.append``/``.update``/...), ``self._x``
+passed positionally to a non-builtin function, and recurses into
+same-class ``self._helper()`` calls — it does not track aliases bound
+to locals or follow ``Thread(target=...)`` values. Time reads that are
+genuinely transient (drain grace deadlines, liveness stamps) are
+waived per site with the argument why replay divergence is harmless.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.base import Finding, SourceFile
+
+#: transient GCS bookkeeping that is rebuilt, not persisted — mutating
+#: these from any op is fine and never a durability gap
+EXEMPT_ATTRS = frozenset({
+    "_wal", "_wal_pending", "_wal_count", "_peer_reports", "_drivers",
+    "_fenced", "_fenced_by", "_next_orphan_scan", "_recovering_until",
+    "_epoch", "_epoch_seq", "_stop", "_lock", "_wal_lock", "_cond",
+})
+
+#: container methods that mutate their receiver
+MUTATORS = frozenset({
+    "append", "add", "update", "setdefault", "pop", "popitem", "clear",
+    "remove", "extend", "insert", "discard", "appendleft",
+})
+
+#: calls that only read their arguments — passing self._x to these is
+#: not a mutation
+SAFE_CALLS = frozenset({
+    "list", "dict", "tuple", "set", "frozenset", "len", "sorted", "str",
+    "int", "float", "bool", "bytes", "max", "min", "sum", "enumerate",
+    "zip", "map", "filter", "iter", "next", "repr", "print",
+    "isinstance", "any", "all", "id", "hash", "getattr", "hasattr",
+    "reversed", "range",
+})
+
+#: dotted call patterns that read wall clock / randomness / environment
+NONDET_CALLS = {
+    ("time", "time"), ("time", "monotonic"), ("time", "time_ns"),
+    ("time", "monotonic_ns"), ("time", "perf_counter"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("date", "today"),
+    ("os", "urandom"), ("os", "getenv"), ("os", "getpid"),
+    ("random", "random"), ("random", "randint"), ("random", "choice"),
+    ("random", "shuffle"), ("random", "uniform"), ("random", "randrange"),
+    ("random", "getrandbits"), ("uuid", "uuid1"), ("uuid", "uuid4"),
+    ("secrets", "token_bytes"), ("secrets", "token_hex"),
+}
+
+#: WAL records that replay through a helper instead of an ``_op_``
+#: (gcs.py _load_persisted special-cases them)
+PSEUDO_WAL_HELPERS = ("_mark_dead_locked",)
+
+
+# ------------------------------------------------------------- gcs model
+
+def frozenset_literal(tree: ast.AST, name: str) -> Dict[str, int]:
+    """Module-level ``NAME = frozenset({...})`` -> {value: line}."""
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Call)):
+            continue
+        for arg in node.value.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str):
+                    out.setdefault(sub.value, sub.lineno)
+    return out
+
+
+def _find_fn(tree: ast.AST, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _methods(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    out.setdefault(item.name, item)
+    return out
+
+
+def _classes(tree: ast.AST) -> Dict[str, ast.ClassDef]:
+    return {node.name: node for node in ast.walk(tree)
+            if isinstance(node, ast.ClassDef)}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self._x`` or ``self._x[...]`` -> ``_x``."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                      ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def snapshot_attrs(gcs_sf: SourceFile) -> Set[str]:
+    fn = _find_fn(gcs_sf.tree, "_snapshot_state")
+    out: Set[str] = set()
+    if fn is not None:
+        for node in ast.walk(fn):
+            attr = _self_attr(node)
+            if attr is not None:
+                out.add(attr)
+    return out - {"_lock"}
+
+
+def restored_attrs(gcs_sf: SourceFile) -> Set[str]:
+    fn = _find_fn(gcs_sf.tree, "_restore_state")
+    out: Set[str] = set()
+    if fn is not None:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                    for e in elts:
+                        attr = _self_attr(e)
+                        if attr is not None:
+                            out.add(attr)
+    return out
+
+
+# --------------------------------------------------------- mutation scan
+
+def mutated_attrs(fn: ast.FunctionDef, methods: Dict[str, ast.FunctionDef],
+                  visited: Optional[Set[str]] = None) -> Dict[str, int]:
+    """attr -> witness line for every ``self._x`` this function (or a
+    same-class helper it calls) mutates."""
+    if visited is None:
+        visited = set()
+    if fn.name in visited:
+        return {}
+    visited.add(fn.name)
+    out: Dict[str, int] = {}
+
+    def note(attr: Optional[str], line: int) -> None:
+        if attr is not None:
+            out.setdefault(attr, line)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for e in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                    note(_self_attr(e), node.lineno)
+        elif isinstance(node, ast.AugAssign):
+            note(_self_attr(node.target), node.lineno)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                note(_self_attr(t), node.lineno)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in MUTATORS:
+                    note(_self_attr(f.value), node.lineno)
+                helper = None
+                if isinstance(f.value, ast.Name) and f.value.id == "self":
+                    helper = f.attr
+                if helper in methods and helper not in visited:
+                    for attr, line in mutated_attrs(
+                            methods[helper], methods, visited).items():
+                        note(attr, line)
+            elif isinstance(f, ast.Name) and f.id not in SAFE_CALLS:
+                # note_freed(self._freed, ids): positional self-attr
+                # args handed to an unknown callable count as writes
+                for arg in node.args:
+                    note(_self_attr(arg), node.lineno)
+    return out
+
+
+# --------------------------------------------------- nondeterminism scan
+
+def nondet_sites(fn: ast.FunctionDef, methods: Dict[str, ast.FunctionDef],
+                 classes: Dict[str, ast.ClassDef],
+                 visited: Optional[Set[str]] = None
+                 ) -> List[Tuple[int, str]]:
+    if visited is None:
+        visited = set()
+    key = "fn:" + fn.name
+    if key in visited:
+        return []
+    visited.add(key)
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr == "environ" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "os":
+            out.append((node.lineno, "os.environ read"))
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if (f.value.id, f.attr) in NONDET_CALLS:
+                out.append((node.lineno, f"{f.value.id}.{f.attr}()"))
+            elif f.value.id == "self" and f.attr in methods \
+                    and "fn:" + f.attr not in visited:
+                out.extend(nondet_sites(methods[f.attr], methods,
+                                        classes, visited))
+        elif isinstance(f, ast.Name) and f.id in classes \
+                and "cls:" + f.id not in visited:
+            visited.add("cls:" + f.id)
+            init = next(
+                (i for i in classes[f.id].body
+                 if isinstance(i, ast.FunctionDef)
+                 and i.name == "__init__"), None)
+            if init is not None:
+                for _, what in nondet_sites(init, methods, classes,
+                                            visited):
+                    out.append((node.lineno,
+                                f"{f.id}() constructor runs {what}"))
+    return out
+
+
+# -------------------------------------------------------- resync surface
+
+def _resync_literals(ha_sf: SourceFile) -> Set[str]:
+    fn = _find_fn(ha_sf.tree, "resync_node")
+    out: Set[str] = set()
+    if fn is not None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             str):
+                out.add(node.value)
+    return out
+
+
+def _resync_called(ha_sf: SourceFile) -> Set[str]:
+    fn = _find_fn(ha_sf.tree, "resync_node")
+    out: Set[str] = set()
+    if fn is not None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    out.add(node.func.attr)
+                elif isinstance(node.func, ast.Name):
+                    out.add(node.func.id)
+    return out
+
+
+def _gcs_info_keys(gcs_sf: SourceFile) -> Set[str]:
+    fn = _find_fn(gcs_sf.tree, "_op_gcs_info")
+    out: Set[str] = set()
+    if fn is not None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str):
+                        out.add(k.value)
+    return out
+
+
+def load_resync_coverage(meta_sf: SourceFile) -> Dict[str, Tuple[str,
+                                                                 int]]:
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in meta_sf.tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name) and node.value is not None:
+            target, value = node.target.id, node.value
+        if target != "RESYNC_COVERAGE" or not isinstance(value, ast.Dict):
+            continue
+        for k, v in zip(value.keys, value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str):
+                out[k.value] = (v.value, k.lineno)
+    return out
+
+
+# --------------------------------------------------------------- checks
+
+def analyze(meta_sf: SourceFile, gcs_sf: SourceFile, ha_sf: SourceFile,
+            node_server_sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    wal_ops = frozenset_literal(gcs_sf.tree, "_WAL_OPS")
+    persisted = snapshot_attrs(gcs_sf)
+    restored = restored_attrs(gcs_sf)
+    methods = _methods(gcs_sf.tree)
+    classes = _classes(gcs_sf.tree)
+
+    # persisted/restored drift is its own gap
+    for attr in sorted(persisted - restored):
+        findings.append(Finding(
+            "L10", gcs_sf.relpath, 1,
+            f"_snapshot_state serializes self.{attr} but _restore_state "
+            f"never restores it — snapshots silently drop it on reload"))
+
+    # (1) WAL op mutations must round-trip through the snapshot
+    for op in sorted(wal_ops):
+        fn = methods.get(f"_op_{op}")
+        if fn is None:
+            findings.append(Finding(
+                "L10", gcs_sf.relpath, wal_ops[op],
+                f"_WAL_OPS lists {op!r} but no _op_{op} handler exists "
+                f"— replay of its records is a no-op"))
+            continue
+        for attr, line in sorted(mutated_attrs(fn, methods).items()):
+            if attr in EXEMPT_ATTRS:
+                continue
+            if attr not in persisted:
+                findings.append(Finding(
+                    "L10", gcs_sf.relpath, line,
+                    f"WAL op {op!r} mutates self.{attr}, which "
+                    f"_snapshot_state does not serialize — compaction "
+                    f"discards the state the WAL protects"))
+            elif attr not in restored:
+                findings.append(Finding(
+                    "L10", gcs_sf.relpath, line,
+                    f"WAL op {op!r} mutates self.{attr}, which "
+                    f"_restore_state never restores"))
+
+    # (2) non-WAL ops must not write persisted tables
+    for name, fn in sorted(methods.items()):
+        if not name.startswith("_op_") or name[4:] in wal_ops:
+            continue
+        for attr, line in sorted(mutated_attrs(fn, methods).items()):
+            if attr in EXEMPT_ATTRS or attr not in persisted:
+                continue
+            findings.append(Finding(
+                "L10", gcs_sf.relpath, line,
+                f"{name} writes persisted table self.{attr} but "
+                f"{name[4:]!r} is not in _WAL_OPS — the write reaches "
+                f"snapshots only by compaction timing and never the "
+                f"log"))
+
+    # (3) replay determinism
+    replayed = [(op, methods.get(f"_op_{op}")) for op in sorted(wal_ops)]
+    replayed += [(h, methods.get(h)) for h in PSEUDO_WAL_HELPERS]
+    for op, fn in replayed:
+        if fn is None:
+            continue
+        for line, what in sorted(set(nondet_sites(fn, methods, classes))):
+            findings.append(Finding(
+                "L10", gcs_sf.relpath, line,
+                f"WAL-replayed body of {fn.name} reaches {what} — "
+                f"replay must be deterministic or the rehydrated GCS "
+                f"diverges from the live one"))
+
+    # (4) resync coverage
+    coverage = load_resync_coverage(meta_sf)
+    resync_lits = _resync_literals(ha_sf)
+    resync_calls = _resync_called(ha_sf)
+    cursor_keys = _gcs_info_keys(gcs_sf)
+    ns_methods = _methods(node_server_sf.tree)
+    for op in sorted(wal_ops):
+        if op not in coverage:
+            findings.append(Finding(
+                "L10", gcs_sf.relpath, wal_ops[op],
+                f"WAL op {op!r} has no RESYNC_COVERAGE entry — declare "
+                f"how its state re-converges after a restart from "
+                f"EMPTY (resync:/helper:/cursor:/durable)"))
+    for op, (decl, line) in sorted(coverage.items()):
+        if op not in wal_ops:
+            findings.append(Finding(
+                "L10", meta_sf.relpath, line,
+                f"RESYNC_COVERAGE entry {op!r} is not a _WAL_OPS "
+                f"member — stale entry"))
+            continue
+        scheme, _, arg = decl.partition(":")
+        if scheme == "durable":
+            continue
+        if scheme == "resync":
+            if arg not in resync_lits:
+                findings.append(Finding(
+                    "L10", meta_sf.relpath, line,
+                    f"RESYNC_COVERAGE claims {op!r} is re-pushed as "
+                    f"{arg!r} but resync_node (ha.py) never sends that "
+                    f"op"))
+        elif scheme == "helper":
+            helper = ns_methods.get(arg) or (
+                _find_fn(node_server_sf.tree, arg))
+            sends = set()
+            if helper is not None:
+                sends = {n.value for n in ast.walk(helper)
+                         if isinstance(n, ast.Constant)
+                         and isinstance(n.value, str)}
+            if arg not in resync_calls:
+                findings.append(Finding(
+                    "L10", meta_sf.relpath, line,
+                    f"RESYNC_COVERAGE claims {op!r} resyncs via helper "
+                    f"{arg!r} but resync_node never calls it"))
+            elif helper is None or op not in sends:
+                findings.append(Finding(
+                    "L10", meta_sf.relpath, line,
+                    f"RESYNC_COVERAGE claims {op!r} resyncs via helper "
+                    f"{arg!r} but that helper builds no {op!r} message"))
+        elif scheme == "cursor":
+            if arg not in cursor_keys:
+                findings.append(Finding(
+                    "L10", meta_sf.relpath, line,
+                    f"RESYNC_COVERAGE claims {op!r} re-cuts at gcs_info "
+                    f"cursor {arg!r}, which _op_gcs_info does not "
+                    f"report"))
+        else:
+            findings.append(Finding(
+                "L10", meta_sf.relpath, line,
+                f"RESYNC_COVERAGE entry {op!r} uses unknown scheme "
+                f"{decl!r}"))
+    return findings

@@ -1,0 +1,490 @@
+"""L9 — wire-contract totality and retry-path conformance.
+
+``WIRE_CONTRACT`` (core/cluster/protocol_meta.py) classifies every wire
+op as idempotent / retry_after_apply / dedup_keyed(<key>) /
+non_retryable, and the transport retry weave derives its whitelist from
+it. This rule makes the table load-bearing in four directions:
+
+(a) **Totality** — every ``_op_*`` dispatch arm in the GCS and node
+    server, every ``MSG_*``/``REQ_*`` tag in core/protocol.py, and (for
+    ``kv``) every sub-op literal compared inside ``_op_kv`` must have a
+    classification; conversely a table entry matching no arm and no tag
+    is drift and is flagged.
+(b) **Retry paths** — a client-side send (``.call``/``.try_call``)
+    whose message resolves to an op NOT classified retry-safe, sitting
+    on a retry path (inside a loop with an RPC-error handler, or inside
+    an RPC-error handler as a fallback re-send), can run a side effect
+    twice. Functions that consult the contract (``maybe_applied`` /
+    ``_retry_safe_after_apply`` / ``retry_safe``) are trusted; in an
+    unguarded function a retry path re-sending an *unresolvable*
+    message is flagged too — the rule cannot prove it safe.
+(c) **Dedup claims** — ``dedup_keyed(<key>)`` promises a server-side
+    dedup structure: the ``_op_<name>`` handler must take a ``<key>``
+    parameter and route through ``self._dedup(<key>, ...)`` in a class
+    that maintains ``self._applied``. A claim with no such handler is
+    exactly-once theater.
+(d) **Swallowed maybe_applied** — sending a non-retry-safe op through
+    ``.try_call`` (which flattens every RpcError to None), or through
+    ``.call`` inside a ``try`` whose handler absorbs RpcError without
+    re-raising or consulting ``maybe_applied``, silently discards the
+    "may have been applied once" signal the transport went to the
+    trouble of raising.
+
+Approximations (deliberate): messages are resolved only from tuple
+literals at the send site or a same-function single assignment; loops
+over *peers* with a per-peer error swallow look like retry loops (the
+static view cannot distinguish fan-out from re-send) — waive genuine
+best-effort fan-outs per site with justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.base import Finding, SourceFile
+from ray_tpu.tools.lint.l1_protocol import CONST_RE
+
+#: exception names whose handlers count as absorbing transport errors.
+#: Deliberately NOT plain OSError/ConnectionError: the transport wraps
+#: those into RpcError before they escape, so an ``except OSError``
+#: around os.killpg / file IO is not RPC error handling (an OSError
+#: caught in a TUPLE with RpcError still matches via the RpcError name).
+ERRORISH = ("RpcError", "NetemFault", "GcsUnavailableError",
+            "ActorUnavailableError", "Exception", "BaseException")
+
+#: names whose presence in a function marks it contract-aware (guarded)
+GUARD_NAMES = ("_retry_safe_after_apply", "retry_safe", "RETRY_SAFE_OPS",
+               "maybe_applied")
+
+SEND_ATTRS = ("call", "try_call")
+
+
+# --------------------------------------------------------- contract load
+
+class Contract:
+    def __init__(self) -> None:
+        self.ops: Dict[str, str] = {}
+        self.kv_subops: Dict[str, str] = {}
+        self.line: Dict[str, int] = {}
+
+    def classify(self, op: str, subop: Optional[str]) -> Optional[str]:
+        c = self.ops.get(op)
+        if c == "per_subop":
+            if subop is None:
+                return None  # unresolvable sub-op: caller decides
+            return self.kv_subops.get(subop)
+        return c
+
+    def retry_safe(self, c: Optional[str]) -> bool:
+        return c in ("idempotent", "retry_after_apply") or (
+            c is not None and c.startswith("dedup_keyed:"))
+
+
+def load_contract(meta_sf: SourceFile) -> Contract:
+    """Evaluate WIRE_CONTRACT / KV_SUBOP_CONTRACT from the module AST
+    (constant names resolved through module-level ``X = "str"``
+    assigns; ``dedup_keyed("k")`` calls folded to ``dedup_keyed:k``)."""
+    consts: Dict[str, str] = {}
+    dicts: Dict[str, ast.Dict] = {}
+    for node in meta_sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            name, value = node.target.id, node.value
+        else:
+            continue
+        if isinstance(value, ast.Constant) and isinstance(
+                value.value, str):
+            consts[name] = value.value
+        elif isinstance(value, ast.Dict):
+            dicts[name] = value
+
+    def fold(v: ast.AST) -> Optional[str]:
+        if isinstance(v, ast.Name):
+            return consts.get(v.id)
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                and v.func.id == "dedup_keyed" and v.args
+                and isinstance(v.args[0], ast.Constant)):
+            return "dedup_keyed:" + str(v.args[0].value)
+        return None
+
+    ct = Contract()
+    for table, out in (("WIRE_CONTRACT", ct.ops),
+                       ("KV_SUBOP_CONTRACT", ct.kv_subops)):
+        d = dicts.get(table)
+        if d is None:
+            continue
+        for k, v in zip(d.keys, d.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                c = fold(v)
+                if c is not None:
+                    out[k.value] = c
+                    ct.line[k.value] = k.lineno
+    return ct
+
+
+# ------------------------------------------------------------ (a) totality
+
+def _op_defs(sf: SourceFile) -> Dict[str, int]:
+    """op wire-string -> first def line, from ``_op_<name>`` defs."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.startswith("_op_"):
+            out.setdefault(node.name[4:], node.lineno)
+    return out
+
+
+def _protocol_tags(protocol_sf: SourceFile) -> Dict[str, Tuple[str, int]]:
+    """tag -> (constant name, line)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for lineno, line in enumerate(protocol_sf.lines, start=1):
+        m = CONST_RE.match(line)
+        if m:
+            out.setdefault(m.group(2), (m.group(1), lineno))
+    return out
+
+
+def _kv_subop_literals(gcs_sf: SourceFile) -> Dict[str, int]:
+    """String literals compared (Eq/In) inside gcs ``_op_kv``."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(gcs_sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "_op_kv":
+            for cmp_ in ast.walk(node):
+                if not isinstance(cmp_, ast.Compare):
+                    continue
+                if not any(isinstance(o, (ast.Eq, ast.In))
+                           for o in cmp_.ops):
+                    continue
+                for side in [cmp_.left] + cmp_.comparators:
+                    for sub in ast.walk(side):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                                sub.value, str):
+                            out.setdefault(sub.value, sub.lineno)
+    return out
+
+
+def check_totality(ct: Contract, meta_sf: SourceFile,
+                   protocol_sf: SourceFile,
+                   dispatchers: Dict[str, SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    seen_ops: Set[str] = set()
+    for path, sf in sorted(dispatchers.items()):
+        for op, lineno in sorted(_op_defs(sf).items()):
+            seen_ops.add(op)
+            if op not in ct.ops:
+                findings.append(Finding(
+                    "L9", path, lineno,
+                    f"dispatch arm _op_{op} has no WIRE_CONTRACT entry "
+                    f"for {op!r} — classify it in protocol_meta.py"))
+    tags = _protocol_tags(protocol_sf)
+    for tag, (name, lineno) in sorted(tags.items()):
+        seen_ops.add(tag)
+        if tag not in ct.ops:
+            findings.append(Finding(
+                "L9", protocol_sf.relpath, lineno,
+                f"protocol tag {name} ({tag!r}) has no WIRE_CONTRACT "
+                f"entry — classify it in protocol_meta.py"))
+    for op in sorted(ct.ops):
+        if op not in seen_ops:
+            findings.append(Finding(
+                "L9", meta_sf.relpath, ct.line.get(op, 1),
+                f"WIRE_CONTRACT entry {op!r} matches no _op_ dispatch "
+                f"arm and no protocol tag — stale entry"))
+    gcs_sf = next((sf for p, sf in dispatchers.items()
+                   if p.endswith("gcs.py")), None)
+    if gcs_sf is not None:
+        lits = _kv_subop_literals(gcs_sf)
+        for sub, lineno in sorted(lits.items()):
+            if sub not in ct.kv_subops:
+                findings.append(Finding(
+                    "L9", gcs_sf.relpath, lineno,
+                    f"kv sub-op {sub!r} dispatched in _op_kv has no "
+                    f"KV_SUBOP_CONTRACT entry"))
+        for sub in sorted(ct.kv_subops):
+            if lits and sub not in lits:
+                findings.append(Finding(
+                    "L9", meta_sf.relpath, ct.line.get(sub, 1),
+                    f"KV_SUBOP_CONTRACT entry {sub!r} matches no "
+                    f"comparison in _op_kv — stale entry"))
+    return findings
+
+
+# ---------------------------------------------------- (c) dedup structure
+
+def check_dedup_claims(ct: Contract, meta_sf: SourceFile,
+                       dispatchers: Dict[str, SourceFile]
+                       ) -> List[Finding]:
+    findings: List[Finding] = []
+    claims = sorted((op, c.split(":", 1)[1])
+                    for op, c in ct.ops.items()
+                    if c.startswith("dedup_keyed:"))
+    for op, key in claims:
+        ok = False
+        witness: Optional[Tuple[str, int, str]] = None
+        for path, sf in sorted(dispatchers.items()):
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                        and node.name == f"_op_{op}"):
+                    continue
+                args = [a.arg for a in node.args.args] + \
+                    [a.arg for a in node.args.kwonlyargs]
+                has_key = key in args
+                routes = any(
+                    isinstance(c, ast.Call)
+                    and isinstance(c.func, ast.Attribute)
+                    and c.func.attr == "_dedup"
+                    and c.args and isinstance(c.args[0], ast.Name)
+                    and c.args[0].id == key
+                    for c in ast.walk(node))
+                table = any(
+                    isinstance(n, ast.Attribute) and n.attr == "_applied"
+                    for n in ast.walk(sf.tree))
+                if has_key and routes and table:
+                    ok = True
+                elif witness is None:
+                    why = ("missing a %r parameter" % key if not has_key
+                           else "never calls self._dedup(%s, ...)" % key
+                           if not routes else
+                           "file maintains no self._applied dedup table")
+                    witness = (path, node.lineno, why)
+        if ok:
+            continue
+        if witness is not None:
+            path, lineno, why = witness
+            findings.append(Finding(
+                "L9", path, lineno,
+                f"op {op!r} is classified dedup_keyed({key!r}) but "
+                f"_op_{op} {why} — the exactly-once claim is "
+                f"unenforced"))
+        else:
+            findings.append(Finding(
+                "L9", meta_sf.relpath, ct.line.get(op, 1),
+                f"op {op!r} is classified dedup_keyed({key!r}) but no "
+                f"dispatcher defines _op_{op} — nothing implements the "
+                f"dedup"))
+    return findings
+
+
+# ------------------------------------------- (b)+(d) client-side sends
+
+class _Send:
+    __slots__ = ("node", "attr", "op", "subop", "line")
+
+    def __init__(self, node: ast.Call, attr: str, op: Optional[str],
+                 subop: Optional[str], line: int):
+        self.node = node
+        self.attr = attr
+        self.op = op
+        self.subop = subop
+        self.line = line
+
+
+def _own_walk(fn: ast.AST):
+    """ast.walk over a function body that does NOT descend into nested
+    function/lambda bodies (those are analyzed as their own scope)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _tuple_op(expr: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """(op, subop) from a tuple/list literal message, else (None, None)."""
+    if isinstance(expr, (ast.Tuple, ast.List)) and expr.elts:
+        first = expr.elts[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                          str):
+            sub = None
+            if len(expr.elts) > 1:
+                second = expr.elts[1]
+                if isinstance(second, ast.Constant) and isinstance(
+                        second.value, str):
+                    sub = second.value
+            return first.value, sub
+    return None, None
+
+
+def _resolve_msg(fn: ast.AST, arg: ast.AST
+                 ) -> Tuple[Optional[str], Optional[str]]:
+    op, sub = _tuple_op(arg)
+    if op is not None:
+        return op, sub
+    if isinstance(arg, ast.Name):
+        resolved: Set[Tuple[Optional[str], Optional[str]]] = set()
+        for node in _own_walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == arg.id:
+                        resolved.add(_tuple_op(node.value))
+        if len(resolved) == 1:
+            return resolved.pop()
+    return None, None
+
+
+def _sends_in(fn: ast.AST, scope: ast.AST) -> List[_Send]:
+    out = []
+    for node in _own_walk(scope):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in SEND_ATTRS and node.args):
+            op, sub = _resolve_msg(fn, node.args[0])
+            out.append(_Send(node, node.func.attr, op, sub, node.lineno))
+    return out
+
+
+def _handler_errorish(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True  # bare except absorbs everything
+    names = [n.id for n in ast.walk(h.type) if isinstance(n, ast.Name)]
+    names += [n.attr for n in ast.walk(h.type)
+              if isinstance(n, ast.Attribute)]
+    return any(any(e in name for e in ERRORISH) for name in names)
+
+
+def _handler_swallows(h: ast.ExceptHandler) -> bool:
+    """True unless the handler's sole job is to re-raise."""
+    return not (len(h.body) == 1 and isinstance(h.body[0], ast.Raise))
+
+
+def _handler_reraises_or_consults(h: ast.ExceptHandler) -> bool:
+    for node in ast.walk(h):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == \
+                "maybe_applied":
+            return True
+    return False
+
+
+def _guarded(fn: ast.AST) -> bool:
+    for node in _own_walk(fn):
+        if isinstance(node, ast.Name) and node.id in GUARD_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in GUARD_NAMES:
+            return True
+        if isinstance(node, ast.Constant) and node.value in GUARD_NAMES:
+            return True  # getattr(e, "maybe_applied", False)
+    return False
+
+
+def check_client_sends(ct: Contract,
+                       clients: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in clients:
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            findings.extend(_check_fn(ct, sf, fn))
+    return findings
+
+
+def _check_fn(ct: Contract, sf: SourceFile,
+              fn: ast.AST) -> List[Finding]:
+    out: List[Finding] = []
+    guarded = _guarded(fn)
+    flagged: Set[Tuple[int, str]] = set()
+
+    def flag(send: _Send, msg: str) -> None:
+        key = (send.line, send.op or "?")
+        if key not in flagged:
+            flagged.add(key)
+            out.append(Finding("L9", sf.relpath, send.line,
+                               f"{fn.name}: {msg}"))
+
+    def unsafe(send: _Send) -> Tuple[bool, str]:
+        """(definitely-not-retry-safe, classification label)."""
+        if send.op is None:
+            return False, "?"
+        c = ct.classify(send.op, send.subop)
+        if send.op in ct.ops and c is None:
+            # per_subop with unresolvable sub-op: conservatively unsafe
+            return True, "per_subop(unresolved sub-op)"
+        if c is None:
+            return False, "?"  # unclassified op: totality check owns it
+        return not ct.retry_safe(c), c
+
+    # (b) retry loops: a loop body holding both a send and an
+    # error-absorbing handler re-sends on failure
+    for loop in _own_walk(fn):
+        if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+            continue
+        handlers = [h for t in _own_walk(loop)
+                    if isinstance(t, ast.Try) for h in t.handlers
+                    if _handler_errorish(h) and _handler_swallows(h)]
+        if not handlers:
+            continue
+        for send in _sends_in(fn, loop):
+            bad, c = unsafe(send)
+            if bad:
+                flag(send, f"retry path re-sends {send.op!r} "
+                           f"(classified {c}) — a lost reply means the "
+                           f"side effect can run twice; WIRE_CONTRACT "
+                           f"does not mark it retry-safe")
+            elif send.op is None and not guarded:
+                flag(send, "retry path re-sends an unresolvable message "
+                           "in a function that never consults the wire "
+                           "contract (maybe_applied / "
+                           "_retry_safe_after_apply)")
+    # (b) fallback re-send from inside an error handler
+    for t in _own_walk(fn):
+        if not isinstance(t, ast.Try):
+            continue
+        for h in t.handlers:
+            if not _handler_errorish(h):
+                continue
+            for send in _sends_in(fn, h):
+                bad, c = unsafe(send)
+                if bad:
+                    flag(send, f"error-handler fallback re-sends "
+                               f"{send.op!r} (classified {c}) after a "
+                               f"possible apply — not retry-safe per "
+                               f"WIRE_CONTRACT")
+    # (d) swallowed maybe_applied
+    for send in _sends_in(fn, fn):
+        bad, c = unsafe(send)
+        if not bad:
+            continue
+        if send.attr == "try_call":
+            flag(send, f"try_call of {send.op!r} (classified {c}) "
+                       f"flattens RpcError.maybe_applied to None — the "
+                       f"caller cannot tell a lost reply from a "
+                       f"never-sent request")
+    for t in _own_walk(fn):
+        if not isinstance(t, ast.Try):
+            continue
+        swallowing = [h for h in t.handlers
+                      if _handler_errorish(h)
+                      and not _handler_reraises_or_consults(h)]
+        if not swallowing:
+            continue
+        for stmt in t.body:
+            for send in _sends_in(fn, stmt):
+                bad, c = unsafe(send)
+                if bad and send.attr == "call":
+                    flag(send, f"RpcError from {send.op!r} (classified "
+                               f"{c}) is swallowed without consulting "
+                               f"maybe_applied — a possibly-applied "
+                               f"mutation is silently dropped")
+    return out
+
+
+def analyze(meta_sf: SourceFile, protocol_sf: SourceFile,
+            dispatchers: Dict[str, SourceFile],
+            clients: List[SourceFile]) -> List[Finding]:
+    ct = load_contract(meta_sf)
+    findings = check_totality(ct, meta_sf, protocol_sf, dispatchers)
+    findings.extend(check_dedup_claims(ct, meta_sf, dispatchers))
+    findings.extend(check_client_sends(ct, clients))
+    return findings

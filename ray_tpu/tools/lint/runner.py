@@ -43,18 +43,54 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.tools.lint import l1_protocol, l2_locks, l3_config, \
     l4_exceptions, l5_lock_order, l6_thread_context, l7_guarded_fields, \
-    l8_lifecycle
-from ray_tpu.tools.lint.base import Finding, SourceFile, iter_py_files, \
-    load_file
+    l8_lifecycle, l9_wire_contract, l10_durability
+from ray_tpu.tools.lint.base import Finding, RULES, SourceFile, \
+    iter_py_files, load_file
 
 PROTOCOL_PATH = "ray_tpu/core/protocol.py"
 CONFIG_PATH = "ray_tpu/core/config.py"
 FAULT_PATH = "ray_tpu/core/fault_injection.py"
 NETEM_PATH = "ray_tpu/core/netem.py"
+PROTOCOL_META_PATH = "ray_tpu/core/cluster/protocol_meta.py"
+GCS_PATH = "ray_tpu/core/cluster/gcs.py"
+HA_PATH = "ray_tpu/core/cluster/ha.py"
+NODE_SERVER_PATH = "ray_tpu/core/cluster/node_server.py"
 
-ALL_RULES = ("L1", "L2", "L3", "L4", "L5", "L6", "L7", "L8")
+#: dispatcher files whose _op_* arms L9 holds to the contract table
+L9_DISPATCHER_FILES = (GCS_PATH, NODE_SERVER_PATH)
+
+ALL_RULES = ("L1", "L2", "L3", "L4", "L5", "L6", "L7", "L8", "L9",
+             "L10")
 
 BASELINE_VERSION = 1
+
+
+class RuleCrash(Exception):
+    """A rule raised mid-analysis. Carries the rule id and (when a
+    SourceFile was in scope in the crashing frame) the file it was
+    chewing on, so the CLI can name both and exit 2 instead of leaking
+    a traceback."""
+
+    def __init__(self, rule: str, file: Optional[str],
+                 cause: BaseException):
+        self.rule = rule
+        self.file = file
+        self.cause = cause
+        where = f" analyzing {file}" if file else ""
+        super().__init__(f"rule {rule} crashed{where}: {cause!r}")
+
+
+def _crash_file(exc: BaseException) -> Optional[str]:
+    """Deepest SourceFile local on the crash's traceback — the file the
+    rule was analyzing when it died."""
+    found: Optional[str] = None
+    tb = exc.__traceback__
+    while tb is not None:
+        for v in tb.tb_frame.f_locals.values():
+            if isinstance(v, SourceFile):
+                found = v.relpath
+        tb = tb.tb_next
+    return found
 
 
 def default_root() -> str:
@@ -66,9 +102,15 @@ def default_root() -> str:
 
 
 def _rule_thunks(root: str, rules: set) -> Tuple[
-        Dict[str, Callable[[], List[Finding]]], Dict[str, SourceFile]]:
-    """Load the tree once, return one zero-arg thunk per selected rule
-    plus the relpath -> SourceFile map (for suppression filtering)."""
+        Dict[str, Callable[[], List[Finding]]], Dict[str, SourceFile],
+        float]:
+    """Load + parse the tree ONCE (every rule receives the same
+    SourceFile objects, hence the same parsed AST), return one zero-arg
+    thunk per selected rule, the relpath -> SourceFile map (for
+    suppression filtering), and the shared load/parse wall time in ms
+    (reported as ``_parse`` next to the per-rule timings — the cost no
+    rule pays again)."""
+    t_load = time.perf_counter()
     by_rel: Dict[str, SourceFile] = {}
 
     def get(rel: str) -> Optional[SourceFile]:
@@ -122,7 +164,7 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
     if "L3" in rules:
         for path in iter_py_files(root, "tests"):
             rel = os.path.relpath(path, root).replace(os.sep, "/")
-            sf = load_file(path, root)
+            sf = get(rel)  # through the shared cache: parse once
             if sf is not None:
                 test_files.append(sf)
 
@@ -156,7 +198,28 @@ def _rule_thunks(root: str, rules: set) -> Tuple[
         thunks["L7"] = lambda: l7_guarded_fields.analyze(guard_files)
     if "L8" in rules:
         thunks["L8"] = lambda: l8_lifecycle.analyze(guard_files)
-    return thunks, by_rel
+    if "L9" in rules:
+        meta_sf = get(PROTOCOL_META_PATH)
+        proto_sf = get(PROTOCOL_PATH)
+        if meta_sf is not None and proto_sf is not None:
+            l9_dispatchers = {rel: sf for rel in L9_DISPATCHER_FILES
+                              if (sf := get(rel)) is not None}
+            # the wire's client side: the cluster plane + the job agent
+            l9_clients = [sf for sf in all_files
+                          if sf.relpath.startswith(
+                              ("ray_tpu/core/cluster/", "ray_tpu/job/"))]
+            thunks["L9"] = lambda: l9_wire_contract.analyze(
+                meta_sf, proto_sf, l9_dispatchers, l9_clients)
+    if "L10" in rules:
+        l10_meta = get(PROTOCOL_META_PATH)
+        gcs_sf = get(GCS_PATH)
+        ha_sf = get(HA_PATH)
+        ns_sf = get(NODE_SERVER_PATH)
+        if None not in (l10_meta, gcs_sf, ha_sf, ns_sf):
+            thunks["L10"] = lambda: l10_durability.analyze(
+                l10_meta, gcs_sf, ha_sf, ns_sf)
+    parse_ms = (time.perf_counter() - t_load) * 1000.0
+    return thunks, by_rel, parse_ms
 
 
 def changed_files(root: str, ref: str) -> set:
@@ -182,11 +245,17 @@ def collect_findings_timed(
         root: Optional[str] = None,
         rules: Optional[Sequence[str]] = None,
         jobs: int = 1,
-        changed_only: Optional[set] = None
+        changed_only: Optional[set] = None,
+        include_suppressed: bool = False
         ) -> Tuple[List[Finding], Dict[str, float]]:
     """Run the selected analyzers (``jobs`` > 1 fans rules out across a
-    thread pool); suppressed findings are dropped. Returns the sorted
-    findings and per-rule wall time in milliseconds.
+    thread pool); suppressed findings are dropped — or, with
+    ``include_suppressed``, kept with ``.suppressed = True`` so output
+    modes that annotate waivers (--sarif) can surface them. Returns the
+    sorted findings and per-rule wall time in milliseconds (plus the
+    shared ``_parse`` entry: the one-time load+parse cost every rule
+    reuses). A rule that raises surfaces as :class:`RuleCrash` naming
+    the rule and the file under analysis.
 
     ``changed_only`` (a set of repo-relative paths) filters the
     REPORTED findings to those files; whole-program rules still load
@@ -194,16 +263,25 @@ def collect_findings_timed(
     graphs, guard inference, call resolution) is never truncated."""
     root = root or default_root()
     selected = {r.upper() for r in rules} if rules else set(ALL_RULES)
-    thunks, by_rel = _rule_thunks(root, selected)
+    thunks, by_rel, parse_ms = _rule_thunks(root, selected)
 
     findings: List[Finding] = []
-    wall_ms: Dict[str, float] = {}
+    wall_ms: Dict[str, float] = {"_parse": round(parse_ms, 3)}
 
     def run(rule: str) -> Tuple[str, List[Finding], float]:
         t0 = time.perf_counter()
-        result = thunks[rule]()
+        try:
+            result = thunks[rule]()
+        except RuleCrash:
+            raise
+        except Exception as e:  # noqa: BLE001 — any analyzer bug lands
+            # here; fold it into the typed crash the CLI reports
+            raise RuleCrash(rule, _crash_file(e), e) from e
         return rule, result, (time.perf_counter() - t0) * 1000.0
 
+    # findings are re-sorted below and timings keyed by rule, so pool
+    # completion order cannot leak into the output: --jobs N is
+    # deterministic by construction
     order = [r for r in ALL_RULES if r in thunks]
     if jobs > 1 and len(order) > 1:
         with ThreadPoolExecutor(max_workers=min(jobs, len(order))) as ex:
@@ -218,7 +296,9 @@ def collect_findings_timed(
     for f in findings:
         sf = by_rel.get(f.path)
         if sf is not None and sf.suppressed(f.line, f.rule):
-            continue
+            if not include_suppressed:
+                continue
+            f.suppressed = True
         if changed_only is not None and f.path not in changed_only:
             continue
         out.append(f)
@@ -255,3 +335,47 @@ def write_baseline(path: str, findings: List[Finding]) -> None:
 def apply_baseline(findings: List[Finding], baseline: set) -> List[Finding]:
     """Keep only findings NOT present in the baseline (new violations)."""
     return [f for f in findings if f.key not in baseline]
+
+
+def to_sarif(findings: List[Finding]) -> dict:
+    """SARIF 2.1.0 log for ``findings`` (include suppressed ones —
+    collected with ``include_suppressed=True`` — to have waived sites
+    show up annotated rather than vanish: a waived finding carries
+    ``suppressions: [{"kind": "inSource"}]``, which SARIF viewers and
+    code-scanning UIs render as 'suppressed in source' instead of an
+    open result)."""
+    rule_ids = sorted({f.rule for f in findings} | set(RULES),
+                      key=lambda r: (len(r), r))
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line},
+                },
+            }],
+            "partialFingerprints": {"rtpuLintKey/v1": f.key},
+        }
+        if f.suppressed:
+            result["suppressions"] = [{"kind": "inSource"}]
+        results.append(result)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "rtpu-lint",
+                "rules": [{"id": r,
+                           "shortDescription":
+                               {"text": RULES.get(r, r)}}
+                          for r in rule_ids],
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }

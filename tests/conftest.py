@@ -42,7 +42,7 @@ import pytest
 # hanging CI. The env var makes spawned workers arm themselves too.
 _SANITIZED_MODULES = {"test_dag_spin", "test_drain", "test_fault_tolerance",
                       "test_ha", "test_job", "test_netem",
-                      "test_regressions"}
+                      "test_regressions", "test_wal_replay"}
 
 
 @pytest.fixture(autouse=True, scope="module")
@@ -73,7 +73,7 @@ def _lock_sanitizer(request):
 # Override with RTPU_INTERLEAVE=<seed>[:<n>] to replay a failing seed
 # printed by a sweep, or to widen the schedule search locally.
 _INTERLEAVED_MODULES = {"test_drain", "test_fault_tolerance", "test_ha",
-                        "test_job", "test_netem"}
+                        "test_job", "test_netem", "test_wal_replay"}
 _INTERLEAVE_SEED = 1  # default chaos-suite schedule; env var overrides
 _INTERLEAVE_MAX_PREEMPTIONS = 200
 

@@ -13,7 +13,8 @@ import textwrap
 from ray_tpu.tools.lint import (collect_findings, apply_baseline,
                                 load_baseline, write_baseline)
 from ray_tpu.tools.lint import l1_protocol, l2_locks, l3_config, \
-    l4_exceptions, l5_lock_order, l6_thread_context, runner
+    l4_exceptions, l5_lock_order, l6_thread_context, l9_wire_contract, \
+    l10_durability, runner
 from ray_tpu.tools.lint.__main__ import main as lint_main
 from ray_tpu.tools.lint.base import Finding, SourceFile
 
@@ -804,9 +805,11 @@ def test_cli_json_output(tmp_path, capsys):
     assert findings and findings[0]["rule"] == "L4"
     assert set(findings[0]) == {"rule", "path", "line", "message", "key"}
     # every rule that ran reports its wall time (the mini-tree has no
-    # protocol.py/config.py, so L1/L3 are skipped and report none)
-    assert set(data["rule_wall_ms"]) == {"L2", "L4", "L5", "L6", "L7",
-                                         "L8"}
+    # protocol.py/config.py/gcs.py, so L1/L3/L9/L10 are skipped and
+    # report none), plus the shared one-time load/parse cost — proof
+    # the rules reuse one AST per file instead of re-parsing
+    assert set(data["rule_wall_ms"]) == {"_parse", "L2", "L4", "L5",
+                                         "L6", "L7", "L8"}
     assert all(ms >= 0 for ms in data["rule_wall_ms"].values())
 
 
@@ -843,3 +846,529 @@ def test_cli_bad_baseline_is_usage_error(tmp_path, capsys):
     missing = str(tmp_path / "nope.json")
     assert lint_main(["--root", bad, "--baseline", missing]) == 2
     capsys.readouterr()
+
+
+def test_cli_rule_crash_names_rule_and_file_exit_2(tmp_path, capsys,
+                                                  monkeypatch):
+    bad = str(tmp_path / "bad")
+    _seed_tree(bad, bad=True)
+
+    def boom(files):
+        sf = files[0]  # a SourceFile local: the crash report names it
+        raise ValueError("kaboom")
+
+    monkeypatch.setattr(runner.l2_locks, "analyze", boom)
+    assert lint_main(["--root", bad, "--rules", "L2"]) == 2
+    err = capsys.readouterr().err
+    assert "rule L2 crashed" in err
+    assert "ray_tpu/core/mod.py" in err
+    assert "kaboom" in err
+    # crashes surface identically through the thread pool
+    assert lint_main(["--root", bad, "--rules", "L2,L4",
+                      "--jobs", "2"]) == 2
+    assert "rule L2 crashed" in capsys.readouterr().err
+
+
+def test_cli_sarif_output_and_waiver_annotation(tmp_path, capsys):
+    bad = str(tmp_path / "bad")
+    _seed_tree(bad, bad=True)
+    assert lint_main(["--root", bad, "--sarif"]) == 1
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "rtpu-lint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} >= {
+        "L9", "L10"}
+    results = run["results"]
+    assert results and all("suppressions" not in r for r in results)
+    assert {r["ruleId"] for r in results} == {"L4"}
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "ray_tpu/core/mod.py"
+    assert loc["region"]["startLine"] >= 1
+    # waive the finding in source: it stays visible in the SARIF log,
+    # annotated suppressed-in-source, but stops gating the exit code
+    mod = os.path.join(bad, "ray_tpu", "core", "mod.py")
+    with open(mod) as f:
+        src = f.read()
+    with open(mod, "w") as f:
+        f.write(src.replace(
+            "    except Exception as e:",
+            "    # rtpu-lint: disable=L4 — test waiver\n"
+            "    except Exception as e:"))
+    assert lint_main(["--root", bad, "--sarif"]) == 0
+    log = json.loads(capsys.readouterr().out)
+    results = log["runs"][0]["results"]
+    assert results
+    assert results[0]["suppressions"] == [{"kind": "inSource"}]
+
+
+def test_cli_sarif_and_json_mutually_exclusive(capsys):
+    assert lint_main(["--sarif", "--json"]) == 2
+    assert "mutually exclusive" in capsys.readouterr().err
+
+
+# ------------------------------------------------------------------- L9
+
+
+_L9_META = '''\
+IDEMPOTENT = "idempotent"
+RETRY_AFTER_APPLY = "retry_after_apply"
+NON_RETRYABLE = "non_retryable"
+PER_SUBOP = "per_subop"
+
+
+def dedup_keyed(key):
+    return "dedup_keyed:" + key
+
+
+WIRE_CONTRACT = {
+    "ping": IDEMPOTENT,
+    "put": NON_RETRYABLE,
+    "submit": dedup_keyed("nonce"),
+    "kv": PER_SUBOP,
+}
+KV_SUBOP_CONTRACT = {
+    "get": IDEMPOTENT,
+    "merge": NON_RETRYABLE,
+}
+'''
+
+_L9_PROTO = '''\
+"""Test protocol."""
+# client -> gcs
+MSG_PING = "ping"
+MSG_PUT = "put"
+MSG_SUBMIT = "submit"
+MSG_KV = "kv"
+'''
+
+_L9_GCS = '''\
+class Gcs:
+    def _op_ping(self):
+        return "pong"
+
+    def _op_put(self, key, value):
+        self._store[key] = value
+
+    def _op_kv(self, sub, *args):
+        if sub == "get":
+            return self._kv.get(args[0])
+        if sub == "merge":
+            self._kv[args[0]].update(args[1])
+
+    def _op_submit(self, spec, nonce=None):
+        return self._dedup(nonce, lambda: self._run(spec))
+
+    def _dedup(self, nonce, fn):
+        if nonce in self._applied:
+            return self._applied[nonce]
+        out = fn()
+        self._applied[nonce] = out
+        return out
+'''
+
+
+def _l9(meta=_L9_META, proto=_L9_PROTO, gcs=_L9_GCS, clients=()):
+    meta_sf = _sf(meta, "ray_tpu/core/cluster/protocol_meta.py")
+    proto_sf = _sf(proto, "ray_tpu/core/protocol.py")
+    gcs_sf = _sf(gcs, "ray_tpu/core/cluster/gcs.py")
+    client_sfs = [_sf(src, f"ray_tpu/core/cluster/client{i}.py")
+                  for i, src in enumerate(clients)]
+    return l9_wire_contract.analyze(
+        meta_sf, proto_sf, {gcs_sf.relpath: gcs_sf}, client_sfs)
+
+
+def test_l9_fixture_is_clean():
+    assert _l9() == []
+
+
+def test_l9_unclassified_dispatch_arm_flagged():
+    findings = _l9(gcs=_L9_GCS + "\n    def _op_extra(self):\n"
+                                 "        pass\n")
+    assert len(findings) == 1
+    assert "_op_extra" in findings[0].message
+    assert "no WIRE_CONTRACT entry" in findings[0].message
+    assert findings[0].path.endswith("gcs.py")
+
+
+def test_l9_unclassified_protocol_tag_flagged():
+    findings = _l9(proto=_L9_PROTO + 'MSG_EXTRA = "extra"\n')
+    assert len(findings) == 1
+    assert "MSG_EXTRA" in findings[0].message
+    assert findings[0].path == "ray_tpu/core/protocol.py"
+
+
+def test_l9_stale_contract_entry_flagged():
+    meta = _L9_META.replace('    "kv": PER_SUBOP,',
+                            '    "kv": PER_SUBOP,\n'
+                            '    "ghost": IDEMPOTENT,')
+    findings = _l9(meta=meta)
+    assert len(findings) == 1
+    assert "'ghost'" in findings[0].message
+    assert "stale entry" in findings[0].message
+    assert findings[0].path.endswith("protocol_meta.py")
+
+
+def test_l9_kv_subop_drift_flagged_both_directions():
+    # a dispatched sub-op with no contract entry ...
+    gcs = _L9_GCS.replace('        if sub == "get":',
+                          '        if sub == "cas":\n'
+                          '            return None\n'
+                          '        if sub == "get":')
+    findings = _l9(gcs=gcs)
+    assert len(findings) == 1 and "'cas'" in findings[0].message
+    # ... and a contract entry matching no comparison in _op_kv
+    meta = _L9_META.replace('    "merge": NON_RETRYABLE,',
+                            '    "merge": NON_RETRYABLE,\n'
+                            '    "del": NON_RETRYABLE,')
+    findings = _l9(meta=meta)
+    assert len(findings) == 1 and "'del'" in findings[0].message
+    assert "stale" in findings[0].message
+
+
+def test_l9_dedup_claim_without_structure_flagged():
+    # handler exists but takes no nonce: exactly-once theater
+    gcs = _L9_GCS.replace("def _op_submit(self, spec, nonce=None):",
+                          "def _op_submit(self, spec):")
+    findings = _l9(gcs=gcs)
+    assert len(findings) == 1
+    assert "dedup_keyed('nonce')" in findings[0].message
+    assert "missing a 'nonce' parameter" in findings[0].message
+
+
+def test_l9_dedup_claim_with_no_handler_flagged():
+    gcs = '''\
+class Gcs:
+    def _op_ping(self):
+        return "pong"
+
+    def _op_put(self, key, value):
+        self._store[key] = value
+
+    def _op_kv(self, sub, *args):
+        if sub == "get":
+            return self._kv.get(args[0])
+        if sub == "merge":
+            self._kv[args[0]].update(args[1])
+'''
+    findings = _l9(gcs=gcs)
+    assert len(findings) == 1
+    assert "nothing implements the dedup" in findings[0].message
+
+
+def test_l9_retry_loop_resend_flagged_idempotent_clean():
+    findings = _l9(clients=['''\
+class C:
+    def flaky_put(self, key, value):
+        while True:
+            try:
+                return self._gcs.call(("put", key, value))
+            except RpcError:
+                pass
+
+    def flaky_ping(self):
+        while True:
+            try:
+                return self._gcs.call(("ping",))
+            except RpcError:
+                pass
+'''])
+    assert len(findings) == 1
+    assert "flaky_put" in findings[0].message
+    assert "retry path re-sends 'put'" in findings[0].message
+
+
+def test_l9_unresolvable_retry_needs_contract_consult():
+    findings = _l9(clients=['''\
+class C:
+    def guarded_retry(self, msg):
+        if not _retry_safe_after_apply(msg):
+            raise ValueError(msg)
+        while True:
+            try:
+                return self._gcs.call(msg)
+            except RpcError:
+                pass
+
+    def unguarded_retry(self, msg):
+        while True:
+            try:
+                return self._gcs.call(msg)
+            except RpcError:
+                pass
+'''])
+    assert len(findings) == 1
+    assert "unguarded_retry" in findings[0].message
+    assert "unresolvable message" in findings[0].message
+
+
+def test_l9_per_subop_send_resolution():
+    findings = _l9(clients=['''\
+class C:
+    def kv_retry_opaque(self, sub, k):
+        while True:
+            try:
+                return self._gcs.call(("kv", sub, k))
+            except RpcError:
+                pass
+
+    def kv_retry_read(self, k):
+        while True:
+            try:
+                return self._gcs.call(("kv", "get", k))
+            except RpcError:
+                pass
+
+    def kv_retry_mutate(self, k, patch):
+        while True:
+            try:
+                return self._gcs.call(("kv", "merge", k, patch))
+            except RpcError:
+                pass
+'''])
+    msgs = sorted(f.message for f in findings)
+    assert len(msgs) == 2
+    assert any("kv_retry_mutate" in m and "non_retryable" in m
+               for m in msgs)
+    assert any("kv_retry_opaque" in m
+               and "per_subop(unresolved sub-op)" in m for m in msgs)
+
+
+def test_l9_try_call_of_mutator_flagged():
+    findings = _l9(clients=['''\
+class C:
+    def fire_and_forget(self, key, value):
+        self._gcs.try_call(("put", key, value))
+
+    def probe(self):
+        self._gcs.try_call(("ping",))
+'''])
+    assert len(findings) == 1
+    assert "try_call of 'put'" in findings[0].message
+    assert "maybe_applied" in findings[0].message
+
+
+def test_l9_swallowed_maybe_applied_flagged_consult_clean():
+    findings = _l9(clients=['''\
+class C:
+    def fire(self, k, v):
+        try:
+            self._gcs.call(("put", k, v))
+        except RpcError:
+            pass
+
+    def fire_consulting(self, k, v):
+        try:
+            self._gcs.call(("put", k, v))
+        except RpcError as e:
+            if e.maybe_applied:
+                raise
+'''])
+    assert len(findings) == 1
+    assert "fire:" in findings[0].message
+    assert "swallowed without consulting" in findings[0].message
+
+
+def test_l9_msg_resolved_through_same_function_assignment():
+    findings = _l9(clients=['''\
+class C:
+    def send(self):
+        msg = ("put", 1, 2)
+        try:
+            self._gcs.call(msg)
+        except RpcError:
+            pass
+'''])
+    assert len(findings) == 1
+    assert "'put'" in findings[0].message
+
+
+# ------------------------------------------------------------------ L10
+
+
+_L10_META = '''\
+RESYNC_COVERAGE = {
+    "put_thing": "durable",
+}
+'''
+
+_L10_GCS = '''\
+import time
+
+_WAL_OPS = frozenset({
+    "put_thing",
+})
+
+
+class Gcs:
+    def _snapshot_state(self):
+        return {"things": dict(self._things)}
+
+    def _restore_state(self, state):
+        self._things = dict(state["things"])
+
+    def _op_put_thing(self, key, value):
+        self._things[key] = value
+
+    def _op_get_thing(self, key):
+        return self._things.get(key)
+
+    def _op_gcs_info(self):
+        return {"death_seq": self._death_seq}
+'''
+
+_L10_HA = '''\
+def resync_node(gcs, node):
+    gcs.call(("loc_add_batch", node.locations()))
+'''
+
+_L10_NS = '''\
+class NodeServer:
+    def register_msg(self):
+        return ("register_node", self.node_id)
+'''
+
+
+def _l10(meta=_L10_META, gcs=_L10_GCS, ha=_L10_HA, ns=_L10_NS):
+    return l10_durability.analyze(
+        _sf(meta, "ray_tpu/core/cluster/protocol_meta.py"),
+        _sf(gcs, "ray_tpu/core/cluster/gcs.py"),
+        _sf(ha, "ray_tpu/core/cluster/ha.py"),
+        _sf(ns, "ray_tpu/core/cluster/node_server.py"))
+
+
+def test_l10_fixture_is_clean():
+    assert _l10() == []
+
+
+def test_l10_wal_table_missing_from_snapshot_flagged():
+    gcs = _L10_GCS.replace("        self._things[key] = value",
+                           "        self._things[key] = value\n"
+                           "        self._index[key] = True")
+    findings = _l10(gcs=gcs)
+    assert len(findings) == 1
+    assert "self._index" in findings[0].message
+    assert "compaction discards" in findings[0].message
+
+
+def test_l10_snapshot_restore_drift_flagged():
+    gcs = _L10_GCS.replace(
+        '        return {"things": dict(self._things)}',
+        '        return {"things": dict(self._things),\n'
+        '                "extra": dict(self._extra)}')
+    findings = _l10(gcs=gcs)
+    assert len(findings) == 1
+    assert "self._extra" in findings[0].message
+    assert "never restores" in findings[0].message
+
+
+def test_l10_non_wal_op_writing_persisted_table_flagged():
+    gcs = _L10_GCS + ('\n    def _op_set_thing(self, key, value):\n'
+                      '        self._things[key] = value\n')
+    findings = _l10(gcs=gcs)
+    assert len(findings) == 1
+    assert "_op_set_thing" in findings[0].message
+    assert "not in _WAL_OPS" in findings[0].message
+
+
+def test_l10_wal_op_without_handler_flagged():
+    gcs = _L10_GCS.replace('    "put_thing",',
+                           '    "put_thing",\n    "ghost_op",')
+    meta = _L10_META.replace('    "put_thing": "durable",',
+                             '    "put_thing": "durable",\n'
+                             '    "ghost_op": "durable",')
+    findings = _l10(meta=meta, gcs=gcs)
+    assert len(findings) == 1
+    assert "no _op_ghost_op handler" in findings[0].message
+
+
+def test_l10_replay_nondeterminism_flagged():
+    gcs = _L10_GCS.replace(
+        "        self._things[key] = value",
+        "        self._things[key] = (value, time.time())")
+    findings = _l10(gcs=gcs)
+    assert len(findings) == 1
+    assert "time.time()" in findings[0].message
+    assert "replay must be deterministic" in findings[0].message
+
+
+def test_l10_nondeterminism_traced_through_helper_and_ctor():
+    gcs = _L10_GCS.replace(
+        "        self._things[key] = value",
+        "        self._stamp(key)\n"
+        "        self._things[key] = Thing(value)") + '''
+
+    def _stamp(self, key):
+        self._things[key] = time.monotonic()
+
+
+class Thing:
+    def __init__(self, value):
+        self.value = value
+        self.created = time.time()
+'''
+    findings = _l10(gcs=gcs)
+    msgs = sorted(f.message for f in findings)
+    assert any("time.monotonic()" in m for m in msgs)
+    assert any("Thing() constructor runs time.time()" in m for m in msgs)
+
+
+def test_l10_exempt_transient_attrs_clean():
+    gcs = _L10_GCS.replace("        self._things[key] = value",
+                           "        self._things[key] = value\n"
+                           "        self._epoch_seq += 1")
+    assert _l10(gcs=gcs) == []
+
+
+def test_l10_missing_resync_coverage_flagged():
+    findings = _l10(meta="RESYNC_COVERAGE = {}\n")
+    assert len(findings) == 1
+    assert "no RESYNC_COVERAGE entry" in findings[0].message
+
+
+def test_l10_stale_entry_and_unknown_scheme_flagged():
+    meta = ('RESYNC_COVERAGE = {\n'
+            '    "put_thing": "magic:wand",\n'
+            '    "ghost": "durable",\n'
+            '}\n')
+    findings = _l10(meta=meta)
+    msgs = sorted(f.message for f in findings)
+    assert len(msgs) == 2
+    assert any("unknown scheme" in m for m in msgs)
+    assert any("'ghost'" in m and "stale" in m for m in msgs)
+
+
+def test_l10_resync_literal_claim_verified():
+    meta = 'RESYNC_COVERAGE = {"put_thing": "resync:put_thing"}\n'
+    findings = _l10(meta=meta)
+    assert len(findings) == 1
+    assert "never sends that op" in findings[0].message
+    ha = _L10_HA.replace('("loc_add_batch", node.locations())',
+                         '("put_thing", node.things())')
+    assert _l10(meta=meta, ha=ha) == []
+
+
+def test_l10_cursor_claim_verified():
+    meta = 'RESYNC_COVERAGE = {"put_thing": "cursor:nope"}\n'
+    findings = _l10(meta=meta)
+    assert len(findings) == 1
+    assert "_op_gcs_info does not" in findings[0].message
+    meta = 'RESYNC_COVERAGE = {"put_thing": "cursor:death_seq"}\n'
+    assert _l10(meta=meta) == []
+
+
+def test_l10_helper_claim_verified():
+    meta = 'RESYNC_COVERAGE = {"put_thing": "helper:register_msg"}\n'
+    # resync_node never calls the helper
+    findings = _l10(meta=meta)
+    assert len(findings) == 1
+    assert "never calls it" in findings[0].message
+    # called, but the helper builds no such message
+    ha = _L10_HA + "    node.register_msg(gcs)\n"
+    findings = _l10(meta=meta, ha=ha)
+    assert len(findings) == 1
+    assert "builds no 'put_thing' message" in findings[0].message
+    # called and the helper really does carry the op
+    ns = _L10_NS.replace('("register_node", self.node_id)',
+                         '("put_thing", self.node_id)')
+    assert _l10(meta=meta, ha=ha, ns=ns) == []

@@ -269,6 +269,50 @@ def test_dup_and_lost_reply_idempotent_gcs_ops(tmp_path):
         netem.clear()
 
 
+def test_wire_contract_whitelist_parity():
+    """The retry whitelist is now DERIVED from WIRE_CONTRACT
+    (protocol_meta.py) instead of a hand-kept frozenset in rpc.py. Pin
+    the derived set to the literal the dup/lost_reply sweeps above were
+    validated against: reclassifying an op in the contract table must
+    consciously update this pin, with a netem sweep re-run to prove the
+    behavior change is intended."""
+    from ray_tpu.core.cluster import protocol_meta
+    from ray_tpu.core.cluster.rpc import (_IDEMPOTENT_KV_SUBOPS,
+                                          _IDEMPOTENT_OPS,
+                                          _retry_safe_after_apply)
+
+    pinned = frozenset({
+        # reads / polls
+        "ping", "status", "state", "stack_dump", "task_events",
+        "list_logs", "get_log", "list_nodes", "wait_nodes",
+        "deaths_since", "freed_check", "get_named_actor", "list_actors",
+        "loc_get", "loc_get_batch", "poll", "get_fn",
+        "get", "fetch", "fetch_size", "fetch_range", "has", "wait",
+        "actor_opts",
+        # set/last-writer-wins writes (apply-twice == apply-once)
+        "register_node", "heartbeat", "unregister_node", "freed_add",
+        "name_actor", "drop_actor_name", "register_actor",
+        "register_actor_spec", "drop_actor_spec", "loc_add",
+        "loc_add_batch", "loc_drop", "register_fn", "cancel",
+        "kill_actor", "prestart_workers", "register_driver",
+        "driver_heartbeat", "unregister_driver", "driver_deaths_since",
+        "owner_cleanup", "gcs_info",
+        # exactly-once via server-side dedup on the caller-chosen nonce
+        "submit", "actor_call", "create_actor",
+    })
+    assert protocol_meta.RETRY_SAFE_OPS == pinned
+    assert _IDEMPOTENT_OPS == pinned  # rpc.py imports, not re-declares
+    assert protocol_meta.RETRY_SAFE_KV_SUBOPS == frozenset(
+        {"put", "get", "del", "exists", "keys"})
+    assert _IDEMPOTENT_KV_SUBOPS == protocol_meta.RETRY_SAFE_KV_SUBOPS
+    # the transport predicate agrees end-to-end
+    assert _retry_safe_after_apply(("loc_add", b"o" * 16, ("h", 1)))
+    assert _retry_safe_after_apply(("kv", "get", "k"))
+    assert not _retry_safe_after_apply(("kv", "merge", "k", {}))
+    assert not _retry_safe_after_apply(("publish", "c", "m"))
+    assert not _retry_safe_after_apply(("free", [b"o" * 16]))
+
+
 # ------------------------------------------------- split-brain fencing
 
 
