@@ -427,6 +427,145 @@ def bench_serve_paged():
     return itl_ms, cold * 1e3, warm * 1e3, cold / warm
 
 
+def bench_serve_affinity(model_config=None, page_size=64,
+                         num_pages=None, sessions=8, turns=4):
+    """serve_prefix_hit_ratio_multireplica: prefix-cache hit ratio of a
+    session-heavy workload over TWO engine replicas, routed blind
+    (seed power-of-two) vs cache-affinity (score_replicas over live
+    residency digests). The pools are sized so ONE replica cannot hold
+    every session's prefix: blind routing spreads each session across
+    both replicas and LRU-thrashes, affinity pins each session to its
+    digest holder. Returns (hit_affinity, hit_blind). Acceptance
+    (ISSUE 18): affinity >= 2x blind at 2+ replicas."""
+    import random as _r
+    import time as _t
+
+    from ray_tpu.serve.affinity import ResidencyDigest, score_replicas
+    from ray_tpu.serve.paged_engine import PagedLLMEngine
+
+    if model_config is None:
+        model_config = {"preset": "llama3_1b_proxy",
+                        "param_dtype": "bfloat16"}
+    prefix_pages = 4
+    # headroom for HALF the sessions' prefixes + one in-flight request
+    if num_pages is None:
+        num_pages = prefix_pages * (sessions // 2 + 2)
+    rng = _r.Random(0)
+    prefixes = [[rng.randrange(1000) for _ in range(
+        prefix_pages * page_size)] for _ in range(sessions)]
+    # session turn order interleaved round-robin: every session revisits
+    # while the others churn the pool, the worst case for blind routing
+    sched = [(s, t) for t in range(turns) for s in range(sessions)]
+
+    def run(affine: bool) -> float:
+        engines = [
+            PagedLLMEngine(model_config=model_config, num_slots=4,
+                           max_len=(prefix_pages + 2) * page_size,
+                           prefill_buckets=[page_size],
+                           max_new_tokens=4, chunk_steps=2,
+                           page_size=page_size, num_pages=num_pages)
+            for _ in range(2)]
+        pick_rng = _r.Random(1)
+        replicas = [("r0", None), ("r1", None)]
+        try:
+            for s, t in sched:
+                prompt = prefixes[s] + [rng.randrange(1000)
+                                        for _ in range(3)]
+                choice = None
+                if affine:
+                    digests = {
+                        f"r{i}": ResidencyDigest.from_report(
+                            e.residency_digest())
+                        for i, e in enumerate(engines)}
+                    choice = score_replicas(
+                        prompt, replicas,
+                        {k: v for k, v in digests.items()
+                         if v is not None},
+                        {}, min_prefix_tokens=page_size,
+                        load_penalty=64.0)
+                if choice is None:  # seed pow-2 (idle: first of the pair)
+                    choice = pick_rng.sample(replicas, 2)[0][0]
+                eng = engines[int(choice[1:])]
+                eng.submit(f"s{s}t{t}", prompt)
+                t_end = _t.monotonic() + 600
+                while not eng.collect() and _t.monotonic() < t_end:
+                    _t.sleep(0.005)
+            hits = sum(e._prefix_hit_tokens for e in engines)
+            computed = sum(e._prefill_tokens_computed for e in engines)
+            return hits / max(1, hits + computed)
+        finally:
+            for e in engines:
+                e.shutdown()
+
+    return run(affine=True), run(affine=False)
+
+
+def bench_serve_disagg(model_config=None, page_size=64,
+                       long_tokens=448, n_short=8, n_long=4):
+    """Disaggregation rows: p99 TTFT and p99 decode ITL of a mixed
+    stream — short decode-heavy requests with long prompts landing
+    mid-decode — on the plain paged engine (disagg off) vs the
+    disaggregated engine (dedicated prefill workers + device-channel KV
+    handoff). Off the decode loop, long-prompt prefill chunks stop
+    stealing decode ticks, so the short requests' ITL tail flattens.
+    Returns {"off": (ttft_p99_ms, itl_p99_ms), "on": ...}. Acceptance
+    (ISSUE 18): disagg-on p99 decode ITL <= disagg-off."""
+    import random as _r
+    import time as _t
+
+    from ray_tpu.serve import qos
+    from ray_tpu.serve.disagg import DisaggPagedEngine
+    from ray_tpu.serve.paged_engine import PagedLLMEngine
+
+    if model_config is None:
+        model_config = {"preset": "llama3_1b_proxy",
+                        "param_dtype": "bfloat16"}
+    rng = _r.Random(2)
+    max_len = long_tokens + 2 * page_size
+    shorts = [[rng.randrange(1000) for _ in range(page_size // 2)]
+              for _ in range(n_short)]
+    longs = [[rng.randrange(1000) for _ in range(long_tokens)]
+             for _ in range(n_long)]
+    kw = dict(model_config=model_config, num_slots=8, max_len=max_len,
+              prefill_buckets=[2 * page_size], max_new_tokens=48,
+              chunk_steps=4, page_size=page_size)
+
+    out = {}
+    for mode in ("off", "on"):
+        eng = (DisaggPagedEngine(prefill_workers=1,
+                                 divert_min_tokens=2 * page_size, **kw)
+               if mode == "on" else PagedLLMEngine(**kw))
+        try:
+            eng.submit("warmup", shorts[0], 2)
+            t_end = _t.monotonic() + 600
+            while not eng.collect() and _t.monotonic() < t_end:
+                _t.sleep(0.01)
+            for i, p in enumerate(shorts):
+                eng.submit(f"short{i}", p)
+            _t.sleep(0.05)  # shorts reach steady decode, then the burst
+            for i, p in enumerate(longs):
+                eng.submit(f"long{i}", p, 8)
+            done = {}
+            t_end = _t.monotonic() + 600
+            while (len(done) < n_short + n_long
+                   and _t.monotonic() < t_end):
+                done.update(eng.collect())
+                _t.sleep(0.005)
+        finally:
+            eng.shutdown()
+        if len(done) < n_short + n_long:
+            raise RuntimeError(f"disagg bench incomplete ({mode}): "
+                               f"{sorted(done)}")
+        ttfts = [done[f"long{i}"]["ttft_s"] * 1e3
+                 for i in range(n_long)]
+        itls = [(r["latency_s"] - r["ttft_s"])
+                / max(1, len(r["tokens"]) - 1) * 1e3
+                for k, r in done.items() if k.startswith("short")]
+        out[mode] = (qos.percentile(ttfts, 99),
+                     qos.percentile(itls, 99))
+    return out
+
+
 # --- ray_perf-style microbenchmarks ------------------------------------------
 
 def _timeit(fn, n: int, warm: int = 1) -> float:
@@ -1676,6 +1815,39 @@ def main():
             rows.append({"metric": "serve_paged_itl_p50_ms", "value": -1,
                          "unit": f"error: {e}"})
 
+    # 3c) disaggregated serving plane (ISSUE 18): cache-affinity routing
+    # hit ratio over 2 replicas, and prefill/decode split tail latency
+    if backend == "tpu":
+        try:
+            hit_aff, hit_blind = bench_serve_affinity()
+            rows.append(_row("serve_prefix_hit_ratio_multireplica",
+                             hit_aff, "fraction"))
+            rows.append(_row("serve_prefix_hit_ratio_blind", hit_blind,
+                             "fraction"))
+            rows.append(_row("serve_affinity_hit_ratio_speedup",
+                             hit_aff / max(hit_blind, 1e-9), "x"))
+        except Exception as e:  # pragma: no cover
+            rows.append({"metric": "serve_prefix_hit_ratio_multireplica",
+                         "value": -1, "unit": f"error: {e}"})
+        try:
+            dis = bench_serve_disagg()
+            rows.append(_row("serve_disagg_off_p99_ttft_ms",
+                             dis["off"][0], "ms"))
+            rows.append(_row("serve_disagg_on_p99_ttft_ms",
+                             dis["on"][0], "ms"))
+            rows.append(_row("serve_disagg_off_p99_itl_ms",
+                             dis["off"][1], "ms"))
+            rows.append(_row("serve_disagg_on_p99_itl_ms",
+                             dis["on"][1], "ms"))
+            # acceptance: moving prefill off the decode loop must not
+            # inflate the decode ITL tail (>= 1.0 means on wins)
+            rows.append(_row("serve_disagg_itl_tail_ratio",
+                             dis["off"][1] / max(dis["on"][1], 1e-9),
+                             "x"))
+        except Exception as e:  # pragma: no cover
+            rows.append({"metric": "serve_disagg_on_p99_itl_ms",
+                         "value": -1, "unit": f"error: {e}"})
+
     # BASELINE.json.published was empty until this repo established it
     # (round 2); once present, report the honest ratio against it.
     published = {}
@@ -1768,6 +1940,16 @@ def main():
             ("node_drain_ms", "node_drain_ms", False),
             ("job_orphan_recovery_ms", "job_orphan_recovery_ms",
              False),
+            ("serve_affinity_hit_ratio_speedup",
+             "serve_affinity_hit_ratio_speedup", True),
+            ("serve_prefix_hit_ratio_multireplica",
+             "serve_prefix_hit_ratio_multireplica", True),
+            ("serve_disagg_on_p99_ttft_ms",
+             "serve_disagg_on_p99_ttft_ms", False),
+            ("serve_disagg_on_p99_itl_ms",
+             "serve_disagg_on_p99_itl_ms", False),
+            ("serve_disagg_itl_tail_ratio",
+             "serve_disagg_itl_tail_ratio", True),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)

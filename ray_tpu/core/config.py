@@ -181,10 +181,42 @@ _FLAGS: List[Flag] = [
          "group before failing with PlacementGroupError; the error "
          "names the first bundle the cluster cannot satisfy."),
     # ---- serve / overload ------------------------------------------------
+    Flag("serve_affinity_load_penalty", float, 64.0,
+         "Cache-affinity load discount: estimated matched-prefix tokens "
+         "a replica's score loses per router-local in-flight request on "
+         "it. Higher values make affinity defer to load balance sooner "
+         "(a replica must hold that many MORE cached prefix tokens to "
+         "beat a one-request-lighter peer); 0 routes to the best cache "
+         "holder regardless of load."),
+    Flag("serve_affinity_min_prefix_tokens", int, 16,
+         "Minimum estimated matched-prefix tokens before cache-affinity "
+         "routing overrides power-of-two choices. Below this, the "
+         "prefill saved is too small to justify skewing load — the "
+         "request routes blind. Must be at least one page to ever "
+         "match (prefix fingerprints cover full pages only)."),
+    Flag("serve_cache_affinity", bool, False,
+         "Prefix-cache-aware routing: engine replicas publish a bounded "
+         "digest of their cached KV prefix fingerprints; the router "
+         "scores candidates by estimated matched-prefix tokens minus a "
+         "load penalty (serve_affinity_load_penalty) and routes to the "
+         "best holder when the match clears "
+         "serve_affinity_min_prefix_tokens. Off (default) keeps the "
+         "seed power-of-two router byte-identical — no digest polling, "
+         "no extra RNG draws."),
     Flag("serve_dag_spin_us", int, -1,
          "Busy-poll budget for serve dag_mode pipelines (the replica->"
          "engine hot path compiled onto DAG channels); -1 inherits "
          "dag_spin_us, 0 forces pure-block channels for serve only."),
+    Flag("serve_disagg", bool, False,
+         "Prefill/decode disaggregation for paged engine replicas: "
+         "prompts longer than the largest prefill bucket divert to "
+         "dedicated prefill workers whose finished KV pages stream to "
+         "the decode engine over a DeviceChannel (device arrays handed "
+         "off by reference; in-process queue fallback without a store) "
+         "and are adopted as cached prefixes — heavy-tail prompts stop "
+         "stealing decode ITL. Off (default) prefills inline, exactly "
+         "the seed engine. serve.disagg.engine_class() resolves the "
+         "flag for deployments."),
     Flag("serve_max_queue_depth", int, 0,
          "Default per-deployment admission cap: router-local requests in "
          "flight (admitted, not yet completed) beyond which new requests "
@@ -193,6 +225,12 @@ _FLAGS: List[Flag] = [
          "cap). 0 = unbounded — admission is a no-op, exactly the "
          "pre-QoS behavior. Per-deployment 'max_queue_depth' config "
          "overrides this default."),
+    Flag("serve_prefill_workers", int, 1,
+         "Dedicated prefill workers per disaggregated engine replica "
+         "(serve_disagg on): each owns a private staging KV pool and "
+         "prefills diverted prompts concurrently with decode, handing "
+         "finished pages off as they complete. More workers overlap "
+         "more heavy prompts at the cost of staging-pool HBM."),
     Flag("serve_replica_wait_s", float, 30.0,
          "How long the router waits for a running replica to appear "
          "before failing the request with ReplicaUnavailableError "

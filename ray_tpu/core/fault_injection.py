@@ -70,6 +70,23 @@ site           key                      actions
                                         Fires in the router (driver or
                                         proxy process), so in-process
                                         ``inject`` works
+``prefill_handoff``  request id         ``drop`` — the finished KV-page
+                                        handoff from a disaggregated
+                                        prefill worker to its decode
+                                        engine is silently lost (pages
+                                        computed, message never
+                                        delivered); the decode side's
+                                        handoff lease expires and the
+                                        request re-prefills locally.
+                                        ``kill_worker`` — the prefill
+                                        worker aborts mid-stream before
+                                        publishing anything (worker
+                                        death); a fresh worker is
+                                        respawned and the request
+                                        recovers the same way. Fires on
+                                        the worker thread inside the
+                                        replica process, so in-process
+                                        ``inject`` works
 ``job_claim``  job id                   ``drop`` — the job agent
                                         abandons a claim right after the
                                         PENDING -> RUNNING cas succeeds,
@@ -112,7 +129,7 @@ from ray_tpu.util.debug_lock import make_lock
 
 SITES = ("get", "spill", "dispatch", "task", "actor_call",
          "actor_worker_kill", "gcs_kill", "gang_resize", "serve_overload",
-         "job_claim")
+         "job_claim", "prefill_handoff")
 
 _lock = make_lock("fault_injection._lock")
 _specs: Dict[str, List[dict]] = {}

@@ -187,6 +187,17 @@ def _is_device_array(value: Any) -> bool:
         return False
 
 
+def _is_device_payload(value: Any) -> bool:
+    """True when a stage payload can stay on device whole: a single jax
+    Array, or a non-empty tuple/list of jax Arrays (multi-buffer handoffs
+    like a KV page pair — pickling any element would defeat the edge)."""
+    if _is_device_array(value):
+        return True
+    if isinstance(value, (tuple, list)) and value:
+        return all(_is_device_array(v) for v in value)
+    return False
+
+
 def donating_jit(fn, donate_argnums=(0,)):
     """jit a stage method so the listed array arguments are DONATED: the
     consumer stage reuses the producer's device buffer in place instead
@@ -200,10 +211,12 @@ def donating_jit(fn, donate_argnums=(0,)):
 
 class DeviceChannel:
     """DAG edge whose payload stays on device: both stages are methods of
-    the same TPU actor process, so the producer's output jax Array is
-    handed off by reference through :data:`_DEVICE_HANDOFF` — donation
-    semantics, the producer must not reuse the value after write — and
-    only a ("d",) doorbell record crosses the inner shm channel.
+    the same TPU actor process, so the producer's output jax Array (or
+    tuple of jax Arrays — e.g. a KV page pair from a disaggregated
+    prefill) is handed off by reference through :data:`_DEVICE_HANDOFF`
+    — donation semantics, the producer must not reuse the value after
+    write — and only a ("d",) doorbell record crosses the inner shm
+    channel.
 
     Non-array payloads (host values, ("e", exc) error records, the close
     sentinel) pass through the inner channel unchanged, so the stage loop
@@ -229,7 +242,7 @@ class DeviceChannel:
 
     def write(self, value: Any, timeout_ms: int = 10_000):
         if (isinstance(value, tuple) and len(value) == 2
-                and value[0] == "v" and _is_device_array(value[1])):
+                and value[0] == "v" and _is_device_payload(value[1])):
             seq = self._inner._seq + 1
             with _DEVICE_HANDOFF_LOCK:
                 _DEVICE_HANDOFF[(self._key, seq)] = value[1]

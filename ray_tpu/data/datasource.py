@@ -1088,7 +1088,12 @@ class DeltaDatasource(Datasource):
                             for c in want_parts})
                         yield tbl
                         continue
-                    tbl = pq.read_table(p, columns=file_cols)
+                    # partitioning=None: the delta log's partitionValues
+                    # are the source of truth — pyarrow would otherwise
+                    # hive-infer day=... path segments as string columns,
+                    # shadowing the schema-typed materialization below
+                    tbl = pq.read_table(p, columns=file_cols,
+                                        partitioning=None)
                     for c in want_parts:
                         # writers MAY also store partition columns in the
                         # data files; don't append a duplicate then

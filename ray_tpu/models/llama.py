@@ -382,7 +382,10 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
             "drop them rather than read tuning signal from a no-op")
     from jax.sharding import PartitionSpec as P
 
-    shard_map = jax.shard_map
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # jax < 0.5: public alias not exported yet
+        from jax.experimental.shard_map import shard_map
 
     # pp x sequence-parallel composition: pp OUTER (this shard_map), sp
     # INNER (ring_attention_local's KV blocks rotate on the sp sub-axis,
@@ -438,7 +441,8 @@ def loss_fn_pp(cfg: LlamaConfig, params, batch: Dict[str, jax.Array],
     def sharded_pipeline(stage_layers, mbs_rep, cos_, sin_):
         from ray_tpu.parallel.pipeline import pipeline_apply
 
-        pp = jax.lax.axis_size("pp")
+        from ray_tpu.parallel.device_collectives import axis_size
+        pp = axis_size("pp")
         outs = pipeline_apply(stage_fn_with_rope(cos_, sin_),
                               stage_layers, mbs_rep, "pp")
         # outputs live on the LAST stage; sum-rotate so every stage holds

@@ -36,7 +36,9 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
 
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    n = jax.lax.axis_size(axis_name)
+    from ray_tpu.parallel.device_collectives import axis_size
+
+    n = axis_size(axis_name)
     my_rank = jax.lax.axis_index(axis_name)
     b, chunk, h, d = q.shape
     n_rep = h // k.shape[2]
@@ -70,11 +72,13 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         return acc_new, m_new, l_new, k_nxt, v_nxt
 
     # pvary marks the fresh accumulators as varying over the ring axis so the
-    # fori_loop carry types match (outputs depend on axis_index).
-    acc0 = jax.lax.pvary(jnp.zeros((b, h, chunk, d), jnp.float32), axis_name)
-    m0 = jax.lax.pvary(
+    # fori_loop carry types match (outputs depend on axis_index); jax < 0.6
+    # has no varying-axes typing, so the identity is the correct no-op there.
+    pvary = getattr(jax.lax, "pvary", lambda x, _: x)
+    acc0 = pvary(jnp.zeros((b, h, chunk, d), jnp.float32), axis_name)
+    m0 = pvary(
         jnp.full((b, h, chunk, 1), _NEG_INF, jnp.float32), axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((b, h, chunk, 1), jnp.float32), axis_name)
+    l0 = pvary(jnp.zeros((b, h, chunk, 1), jnp.float32), axis_name)
     acc, m, l, _, _ = jax.lax.fori_loop(0, n, step, (acc0, m0, l0, k, v))
     out = acc / jnp.maximum(l, 1e-30)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
@@ -85,7 +89,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
                    sm_scale: Optional[float] = None) -> jax.Array:
     """Global-array entry: q/k/v [batch, seq, heads, head_dim] with seq
     sharded over ``axis_name``; returns the same layout."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5: public alias not exported yet
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)

@@ -58,7 +58,9 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     from ray_tpu.ops.layers import repeat_kv
 
-    n = jax.lax.axis_size(axis_name)
+    from ray_tpu.parallel.device_collectives import axis_size
+
+    n = axis_size(axis_name)
     h, kvh = q.shape[2], k.shape[2]
     if h % n:
         raise ValueError(
@@ -88,7 +90,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
                       attn_fn: Optional[Callable] = None) -> jax.Array:
     """Global-array entry: q/k/v [batch, seq, heads, head_dim] with seq
     sharded over ``axis_name``; returns the same layout."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5: public alias not exported yet
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)

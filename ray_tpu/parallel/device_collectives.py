@@ -74,7 +74,10 @@ def axis_index(axis: AxisName):
 def axis_size(axis: str):
     import jax
 
-    return jax.lax.axis_size(axis)
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    # jax < 0.5: psum of a literal folds to the static axis size
+    return jax.lax.psum(1, axis)
 
 
 def ring_permute(x, axis: str, shift: int = 1):
@@ -83,7 +86,7 @@ def ring_permute(x, axis: str, shift: int = 1):
     (ppermute over ICI; the building block of ring attention)."""
     import jax
 
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis, perm)
 

@@ -36,7 +36,9 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     the LAST stage (zeros elsewhere — combine with a masked psum or read
     on the last stage). Differentiable end to end.
     """
-    P = jax.lax.axis_size(axis_name)
+    from ray_tpu.parallel.device_collectives import axis_size
+
+    P = axis_size(axis_name)
     M = microbatches.shape[0]
     p = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % P) for i in range(P)]

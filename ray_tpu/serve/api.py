@@ -64,7 +64,8 @@ class DeploymentHandle:
 
     def options(self, *, multiplexed_model_id: Optional[str] = None,
                 priority: Union[str, int, None] = None,
-                deadline_s: Optional[float] = None) -> "_OptionedHandle":
+                deadline_s: Optional[float] = None,
+                session_id: Optional[str] = None) -> "_OptionedHandle":
         """Per-request routing options (reference: handle.options):
         ``multiplexed_model_id`` routes to a replica that already holds
         that model variant and exposes the id to the deployment via
@@ -73,9 +74,13 @@ class DeploymentHandle:
         defaults for requests issued through the returned handle view —
         under overload, lower classes shed first and requests whose
         deadline the router estimates unmeetable are rejected with
-        BackpressureError."""
+        BackpressureError. ``session_id`` pins the conversation to one
+        replica when ``serve_cache_affinity`` is on, so multi-turn
+        prompts keep hitting the replica whose paged KV cache holds the
+        shared prefix (sticky unless that replica falls behind)."""
         return _OptionedHandle(self, multiplexed_model_id,
-                               priority=priority, deadline_s=deadline_s)
+                               priority=priority, deadline_s=deadline_s,
+                               session_id=session_id)
 
     def stream(self, *args, **kwargs):
         """Streaming responses: for generator deployments (the callable
@@ -110,7 +115,8 @@ class _OptionedHandle:
     def __init__(self, handle: DeploymentHandle,
                  multiplexed_model_id: Optional[str],
                  priority: Union[str, int, None] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 session_id: Optional[str] = None):
         from ray_tpu.serve.qos import normalize_priority
 
         self._handle = handle
@@ -123,15 +129,18 @@ class _OptionedHandle:
             raise ValueError(
                 f"deadline_s must be positive (got {deadline_s})")
         self._deadline_s = deadline_s
+        self._session_id = session_id
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return DeploymentResponse(self._handle._get_router().request(
             args, kwargs, model_id=self._model_id,
-            priority=self._priority, deadline_s=self._deadline_s))
+            priority=self._priority, deadline_s=self._deadline_s,
+            session_id=self._session_id))
 
     def options(self, *, multiplexed_model_id: Optional[str] = None,
                 priority: Union[str, int, None] = None,
-                deadline_s: Optional[float] = None) -> "_OptionedHandle":
+                deadline_s: Optional[float] = None,
+                session_id: Optional[str] = None) -> "_OptionedHandle":
         # unset fields inherit from this view so chained .options()
         # calls compose instead of resetting
         return _OptionedHandle(
@@ -140,14 +149,17 @@ class _OptionedHandle:
              else self._model_id),
             priority=priority if priority is not None else self._priority,
             deadline_s=(deadline_s if deadline_s is not None
-                        else self._deadline_s))
+                        else self._deadline_s),
+            session_id=(session_id if session_id is not None
+                        else self._session_id))
 
     def stream(self, *args, **kwargs):
         # the router rejects model_id only where it genuinely can't be
         # honored (engine mailbox); generator streams route mux-aware
         return self._handle._get_router().stream_request(
             args, kwargs, model_id=self._model_id,
-            priority=self._priority, deadline_s=self._deadline_s)
+            priority=self._priority, deadline_s=self._deadline_s,
+            session_id=self._session_id)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):

@@ -58,6 +58,9 @@ class _DeploymentInfo:
         # samples (ms), aggregated into the serve:demand KV signal
         self.depths: Dict[str, tuple] = {}  # router_id -> (depth, ts)
         self.ttft_ms: deque = deque(maxlen=512)
+        # cache-affinity telemetry: router_id -> (residency summary, ts);
+        # the summary maps replica_id -> cached prefix-chain count
+        self.residency: Dict[str, tuple] = {}
 
     @staticmethod
     def _initial_target(cfg: dict) -> int:
@@ -124,13 +127,18 @@ class ServeController:
 
     def report_load(self, name: str, router_id: str, load: int,
                     queue_depth: Optional[int] = None,
-                    ttft_ms: Optional[List[float]] = None) -> None:
+                    ttft_ms: Optional[List[float]] = None,
+                    residency: Optional[dict] = None) -> None:
         """Routers push their in-flight count per deployment (reference:
         handles push autoscaling metrics to the controller); reports
         expire so a vanished router stops counting. QoS-era routers also
         carry their admission queue depth and the TTFT samples observed
-        since the last report — both default None so old-signature
-        callers keep working."""
+        since the last report; cache-affinity routers additionally carry
+        a residency summary ({"replicas": {rid: cached chain count},
+        "cached_chains": total}) aggregated into status() /
+        demand_snapshot(). Every extension defaults None, so the legacy
+        3-positional, the QoS 5-arg, and the 6-arg shapes all land
+        here unchanged."""
         with self._lock:
             info = self._deployments.get(name)
             if info is not None:
@@ -140,6 +148,8 @@ class ServeController:
                     info.depths[router_id] = (int(queue_depth), now)
                 if ttft_ms:
                     info.ttft_ms.extend(float(x) for x in ttft_ms)
+                if residency is not None:
+                    info.residency[router_id] = (dict(residency), now)
 
     def get_replicas(self, name: str):
         """(version, [(replica_id, actor_name)]) for router refresh."""
@@ -215,6 +225,18 @@ class ServeController:
             info = self._deployments.get(name)
             return dict(info.config) if info else None
 
+    @staticmethod
+    def _cached_chains(info) -> int:
+        """Aggregate the routers' residency summaries into one number:
+        per replica, the max chain count any router reported (reports
+        describe the same replica cache, so max — not sum — dedups),
+        summed across replicas."""
+        per_replica: Dict[str, int] = {}
+        for summary, _ in info.residency.values():
+            for rid, n in (summary.get("replicas") or {}).items():
+                per_replica[rid] = max(per_replica.get(rid, 0), int(n))
+        return sum(per_replica.values())
+
     def status(self) -> Dict[str, Any]:
         from ray_tpu.serve.qos import percentile
 
@@ -231,6 +253,7 @@ class ServeController:
                     "queue_depth": sum(d for d, _ in info.depths.values()),
                     "ttft_p50_ms": percentile(info.ttft_ms, 50),
                     "ttft_p99_ms": percentile(info.ttft_ms, 99),
+                    "cached_prefix_chains": self._cached_chains(info),
                 }
                 for name, info in self._deployments.items()
             }
@@ -249,10 +272,14 @@ class ServeController:
                 for rid, (_, ts) in list(info.depths.items()):
                     if now - ts >= 3.0:  # vanished router: expire like loads
                         del info.depths[rid]
+                for rid, (_, ts) in list(info.residency.items()):
+                    if now - ts >= 3.0:
+                        del info.residency[rid]
                 out[name] = {
                     "queue_depth": sum(d for d, _ in info.depths.values()),
                     "ttft_p50_ms": percentile(info.ttft_ms, 50),
                     "ttft_p99_ms": percentile(info.ttft_ms, 99),
+                    "cached_prefix_chains": self._cached_chains(info),
                 }
         return out
 

@@ -1,0 +1,363 @@
+"""Disaggregated serving: prefill/decode split inside one replica
+process, with KV pages streamed over the compiled-DAG device channel.
+
+Continuous batching interleaves prefill and decode chunks on one device
+loop (serve/paged_engine.py), so a burst of long prompts still steals
+decode ticks and inflates inter-token latency for every running request.
+Disaggregation moves heavy prompt prefill OFF the decode loop: dedicated
+prefill workers run the same compiled prefill-chunk program against a
+private staging page pool, then hand the finished KV pages to the decode
+engine — on device, by reference, through a :class:`DeviceChannel`
+(dag/channel.py) when the process has an object store (donated jax
+buffers, no host round-trip), or directly on the handoff queue when it
+does not. The decode engine adopts the pages as cached prefixes
+(``PagedLLMEngine.import_pages``) and admits the request normally: its
+``match_prefix`` hits the imported chain and prefills only the tail
+(the prompt's last partial page — whose logits seed generation), so
+decode-side prefill work per diverted request is one short chunk.
+
+Public analogue: vLLM/DistServe-style prefill-decode disaggregation;
+here the transfer plane is the runtime's own device-channel handoff
+rather than NCCL/RDMA.
+
+Durability: every diverted request is recorded in a handoff lease
+(``_handoff_pending``) BEFORE it leaves the submit path. A lost handoff
+— worker death, dropped message (fault site ``prefill_handoff``), pool
+overflow — is recovered by the decode tick's expiry sweep, which
+resubmits the original request for plain local prefill. Zero requests
+are ever lost; the cost of a lost handoff is latency, not correctness.
+Worker threads that die are respawned by the decode tick's health check.
+
+Staging-pool note: each worker's staging cache uses the SAME geometry
+(num_pages, page_size) as the engine pool, so every prefill-chunk /
+gather program is shared with the engine's compiled set — no
+per-worker compilation. Size ``num_pages`` with that headroom in mind
+when enabling disaggregation on a real device.
+"""
+
+from __future__ import annotations
+
+import queue as _q
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.core import fault_injection
+from ray_tpu.serve.llm_engine import _bucket
+from ray_tpu.serve.paged_engine import PagedLLMEngine, _PageAllocator
+
+
+class _WorkerKilled(Exception):
+    """Raised inside a prefill worker by the ``prefill_handoff``
+    ``kill_worker`` fault action: terminates the worker loop so the
+    thread dies exactly as an OS-level kill would look to the engine
+    (no cleanup, no handoff), exercising the respawn + lease recovery
+    path."""
+
+
+class DisaggPagedEngine(PagedLLMEngine):
+    """PagedLLMEngine with disaggregated prefill workers.
+
+    Extra knobs:
+
+    prefill_workers: dedicated prefill threads (default: the
+        ``serve_prefill_workers`` flag).
+    handoff_timeout_s: lease on each prefill→decode handoff; past it the
+        decode loop re-prefills the request locally (default 5.0 —
+        tests shrink it to exercise recovery).
+    divert_min_tokens: prompts at least this long are diverted (default:
+        the largest prefill bucket — shorter prompts prefill in one
+        chunk anyway, so diversion would only add a handoff).
+    """
+
+    def __init__(self, *args, prefill_workers: Optional[int] = None,
+                 handoff_timeout_s: float = 5.0,
+                 divert_min_tokens: Optional[int] = None, **kw):
+        if prefill_workers is None:
+            from ray_tpu.core.config import config
+
+            prefill_workers = config.serve_prefill_workers
+        self._n_workers = max(0, int(prefill_workers))
+        self._handoff_timeout_s = float(handoff_timeout_s)
+        self._divert_min_arg = divert_min_tokens
+        self._prefill_q: "_q.Queue" = _q.Queue()
+        self._handoff_q: "_q.Queue" = _q.Queue()
+        self._handoff_lock = threading.Lock()
+        # req_id -> (submit item, lease deadline); the durability record
+        self._handoff_pending: Dict[str, tuple] = {}
+        self._wstates: Dict[int, dict] = {}
+        self._wthreads: List[threading.Thread] = []
+        self._disagg_diverted = 0
+        self._disagg_handoffs = 0
+        self._disagg_recovered = 0
+        self._disagg_imported_pages = 0
+        super().__init__(*args, **kw)
+        self._divert_min_tokens = (self._divert_min_arg
+                                   if self._divert_min_arg is not None
+                                   else self._buckets[-1])
+        for widx in range(self._n_workers):
+            self._spawn_worker(widx)
+
+    # ---- submit: divert heavy prompts to the prefill plane ---------------
+
+    def submit(self, req_id: str, prompt_tokens: List[int],
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0,
+               stop_ids: Optional[List[int]] = None) -> None:
+        item = (req_id, list(prompt_tokens),
+                max_new_tokens or self._max_new, time.monotonic(),
+                float(temperature),
+                frozenset(int(t) for t in (stop_ids or ())))
+        plen = min(len(item[1]), self._max_len - 1)
+        if (self._wthreads and plen >= self._divert_min_tokens
+                and (plen - 1) // self._page_size >= 1):
+            # lease FIRST: from here on, losing the handoff anywhere can
+            # only delay the request, never lose it
+            with self._handoff_lock:
+                self._handoff_pending[req_id] = (
+                    item, time.monotonic() + self._handoff_timeout_s)
+            self._disagg_diverted += 1
+            self._prefill_q.put(item)
+            return
+        self._in.put(item)
+
+    # ---- prefill workers -------------------------------------------------
+
+    def _spawn_worker(self, widx: int):
+        th = threading.Thread(target=self._worker_loop, args=(widx,),
+                              daemon=True, name=f"prefill-worker-{widx}")
+        if widx < len(self._wthreads):
+            self._wthreads[widx] = th
+        else:
+            self._wthreads.append(th)
+        th.start()
+
+    def _make_worker_state(self) -> dict:
+        from ray_tpu.core import runtime_context
+        from ray_tpu.models import llama_paged
+
+        cache = llama_paged.init_paged_cache(
+            self._cfg, self._alloc.num_pages, self._page_size,
+            mesh=self._mesh)
+        chan = chan_r = None
+        core = runtime_context.get_core_or_none()
+        store = getattr(core, "store", None) if core is not None else None
+        if store is not None:
+            try:
+                from ray_tpu.dag.channel import DeviceChannel
+
+                # doorbell-sized slot: the KV payload itself never
+                # touches shm (device handoff registry, by reference).
+                # Channel endpoints track their seqno per OBJECT, so the
+                # decode side reads through its own endpoint opened from
+                # the descriptor, never the worker's writer endpoint.
+                chan = DeviceChannel.create(store, capacity=1 << 12)
+                chan_r = DeviceChannel.open(store, chan.descriptor())
+            except Exception:  # noqa: BLE001 — no store headroom: queue
+                if chan is not None:
+                    chan.release()
+                chan = chan_r = None
+        return {"alloc": _PageAllocator(self._alloc.num_pages,
+                                        self._page_size),
+                "cache": cache, "chan": chan, "chan_r": chan_r}
+
+    def _worker_loop(self, widx: int):
+        import numpy as np
+
+        old = self._wstates.get(widx)
+        if old is not None:
+            # respawn after a mid-stream death: the old channel may hold
+            # a stale rendezvous; never reuse it
+            for end in ("chan", "chan_r"):
+                if old.get(end) is not None:
+                    old[end].release()
+        ws = self._make_worker_state()
+        self._wstates[widx] = ws
+        while not self._stop:
+            try:
+                item = self._prefill_q.get(timeout=0.1)
+            except _q.Empty:
+                continue
+            if item is None:
+                break
+            try:
+                self._worker_prefill(np, self._jnp, ws, widx, item)
+            except _WorkerKilled:
+                return  # thread dies with no cleanup; _heal_workers respawns
+
+    def _worker_prefill(self, np, jnp, ws, widx: int, item: tuple):
+        req_id = item[0]
+        try:
+            toks = [int(t) for t in item[1]][: self._max_len - 1]
+            ps = self._page_size
+            n_full = (len(toks) - 1) // ps
+            if n_full < 1:
+                raise ValueError("prompt too short to divert")
+            head = toks[:n_full * ps]
+            alloc = ws["alloc"]
+            # worker-side prefix cache: repeated prefixes re-export
+            # without recompute (the staging pool keeps its own LRU)
+            shared, hashes, matched = alloc.match_prefix(head, len(head))
+            fresh = alloc.alloc(n_full - len(shared))
+            if fresh is None:
+                for pg in shared:
+                    alloc.release(pg)
+                raise RuntimeError("staging pool exhausted")
+            pages = shared + fresh
+            bt_row = np.zeros((self._maxp,), np.int32)
+            bt_row[:len(pages)] = pages
+            bt_dev = jnp.asarray(bt_row)
+            ctx0 = matched
+            while ctx0 < len(head):
+                n = min(len(head) - ctx0, self._buckets[-1])
+                C = _bucket(n, self._buckets)
+                row = np.zeros((1, C), np.int32)
+                row[0, :n] = head[ctx0:ctx0 + n]
+                ws["cache"], _ = self._prefill_chunk(
+                    ws["cache"], jnp.asarray(row), bt_dev,
+                    jnp.asarray(ctx0, jnp.int32),
+                    jnp.asarray(n, jnp.int32))
+                ctx0 += n
+            # gather COPIES the page contents out of the staging pool, so
+            # releasing the pages below cannot race the handoff payload
+            k, v = self.export_pages(pages, cache=ws["cache"])
+            for i, pg in enumerate(pages):
+                if i >= len(shared):
+                    alloc.register(hashes[i], pg)
+                alloc.release(pg)
+        except Exception:  # noqa: BLE001 — degraded: local prefill
+            self._expire_now(req_id)
+            return
+        if fault_injection.enabled():
+            action = fault_injection.fire("prefill_handoff", req_id)
+            if action == "drop":
+                return  # lease expiry recovers the request
+            if action == "kill_worker":
+                raise _WorkerKilled(req_id)
+        chan = ws.get("chan")
+        if chan is not None:
+            try:
+                chan.write(("v", (k, v)))
+                self._handoff_q.put(("chan", widx, req_id, hashes))
+                return
+            except Exception:  # noqa: BLE001 — channel wedged: fall back
+                pass
+        self._handoff_q.put(("direct", req_id, hashes, k, v))
+
+    def _expire_now(self, req_id: str):
+        """Resubmit a leased request for local prefill immediately (the
+        worker knows its handoff will never arrive)."""
+        with self._handoff_lock:
+            rec = self._handoff_pending.pop(req_id, None)
+        if rec is not None:
+            self._disagg_recovered += 1
+            self._in.put(rec[0])
+
+    # ---- decode side: adopt handoffs, sweep leases, heal workers ---------
+
+    def _drain_handoffs(self):
+        while True:
+            try:
+                rec = self._handoff_q.get_nowait()
+            except _q.Empty:
+                return
+            if rec[0] == "chan":
+                _, widx, req_id, hashes = rec
+                k = v = None
+                ws = self._wstates.get(widx)
+                chan = ws.get("chan_r") if ws is not None else None
+                if chan is not None:
+                    # the doorbell only follows a completed write, so the
+                    # payload is already registered — read, THEN decide:
+                    # an unread message would wedge the worker's next
+                    # rendezvous write even for an expired lease
+                    try:
+                        _, payload = chan.read(timeout_ms=5000)
+                        k, v = payload
+                    except Exception:  # noqa: BLE001 — lease recovers it
+                        k = v = None
+            else:
+                _, req_id, hashes, k, v = rec
+            with self._handoff_lock:
+                lease = self._handoff_pending.pop(req_id, None)
+            if k is not None:
+                try:
+                    self._disagg_imported_pages += self.import_pages(
+                        k, v, hashes)
+                except Exception:  # noqa: BLE001 — admit re-prefills
+                    pass
+            if lease is not None:
+                self._disagg_handoffs += 1
+                # pool-full imports adopted 0 pages: _admit simply finds
+                # no cached prefix and prefills the whole prompt locally
+                self._in.put(lease[0])
+
+    def _sweep_leases(self):
+        now = time.monotonic()
+        expired = []
+        with self._handoff_lock:
+            for rid, (item, deadline) in list(
+                    self._handoff_pending.items()):
+                if now > deadline:
+                    expired.append(item)
+                    del self._handoff_pending[rid]
+        for item in expired:
+            self._disagg_recovered += 1
+            self._in.put(item)
+
+    def _heal_workers(self):
+        if self._stop:
+            return
+        for widx, th in enumerate(self._wthreads):
+            if not th.is_alive():
+                self._spawn_worker(widx)
+
+    def _tick(self, np, jnp):
+        self._heal_workers()
+        self._drain_handoffs()
+        self._sweep_leases()
+        super()._tick(np, jnp)
+
+    # ---- surface ---------------------------------------------------------
+
+    def _has_parked_requests(self) -> bool:
+        with self._handoff_lock:
+            pending = bool(self._handoff_pending)
+        return pending or super()._has_parked_requests()
+
+    def stats(self) -> dict:
+        st = super().stats()
+        with self._handoff_lock:
+            pending = len(self._handoff_pending)
+        st["queued"] += pending
+        st.update(
+            prefill_workers=sum(1 for t in self._wthreads
+                                if t.is_alive()),
+            disagg_diverted=self._disagg_diverted,
+            disagg_handoffs=self._disagg_handoffs,
+            disagg_recovered=self._disagg_recovered,
+            disagg_imported_pages=self._disagg_imported_pages,
+            disagg_pending=pending)
+        return st
+
+    def shutdown(self):
+        super().shutdown()
+        for _ in self._wthreads:
+            self._prefill_q.put(None)
+        for th in self._wthreads:
+            th.join(timeout=2.0)
+        for ws in self._wstates.values():
+            for end in ("chan", "chan_r"):
+                if ws.get(end) is not None:
+                    ws[end].release()
+        self._wstates.clear()
+
+
+def engine_class() -> type:
+    """The serving engine class deployments should bind: the
+    disaggregated engine when the ``serve_disagg`` flag is on, the plain
+    paged engine otherwise — so one deployment definition serves both
+    modes and the flag is the single switch."""
+    from ray_tpu.core.config import config
+
+    return DisaggPagedEngine if config.serve_disagg else PagedLLMEngine

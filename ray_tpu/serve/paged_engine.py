@@ -32,6 +32,7 @@ reference itself ships neither (it serves via torch).
 from __future__ import annotations
 
 import collections
+import hashlib
 import queue as _q
 from typing import Dict, List, Optional, Tuple
 
@@ -61,7 +62,15 @@ class _PageAllocator:
 
     @staticmethod
     def chain_hash(prev: int, page_tokens: Tuple[int, ...]) -> int:
-        return hash((prev, page_tokens))
+        """Stable chained fingerprint of the prefix ending at this page.
+        blake2b over prev-hash ‖ token bytes, NOT builtin hash():
+        hash() is PYTHONHASHSEED-salted per process, so cross-replica
+        digests could never match and cache-aware routing
+        (serve/affinity.py) would see zero affinity everywhere."""
+        h = hashlib.blake2b(prev.to_bytes(8, "little"), digest_size=8)
+        for t in page_tokens:
+            h.update(int(t).to_bytes(8, "little", signed=True))
+        return int.from_bytes(h.digest(), "little")
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """n fresh pages (refcount 1), evicting cold cached prefixes as
@@ -174,6 +183,17 @@ class PagedLLMEngine(LLMEngine):
                 use_kernel=self._use_kernel)
         self._cache = llama_paged.init_paged_cache(
             self._cfg, num_pages, ps, mesh=self._mesh)
+        # page transfer programs (disaggregated serving, serve/disagg.py):
+        # gather pulls a page range out of a pool, scatter adopts one
+        # into this engine's pool in place (donated on TPU — no full-pool
+        # copy per import; CPU jax ignores donation and would only warn)
+        import jax
+
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        self._gather_j = jax.jit(lambda pool, idx: pool[:, idx])
+        self._scatter_j = jax.jit(
+            lambda pool, idx, pages: pool.at[:, idx].set(pages),
+            donate_argnums=donate)
         # chunked prefill replaces the dense engine's max_len-1
         # overflow bucket: long prompts run as a sequence of
         # bucket-sized chunks, so only the explicit buckets compile
@@ -433,6 +453,72 @@ class PagedLLMEngine(LLMEngine):
                       jnp.zeros((1,), bool),
                       jnp.zeros((1,), jnp.int32))
         np.asarray(self._cache["k"][0, 0, 0, 0, 0])
+
+    # ---- disaggregation surface (serve/disagg.py, serve/affinity.py) -----
+
+    def export_pages(self, pages: List[int], cache: Optional[dict] = None
+                     ) -> tuple:
+        """Gather the K/V contents of ``pages`` (pool indices) as a pair
+        of [L, n, KVH, page, hd] device arrays — the payload half of a
+        prefill→decode handoff. ``cache`` defaults to this engine's pool;
+        prefill workers pass their private staging cache. The caller must
+        hold refs on the pages for the duration of the gather."""
+        cache = self._cache if cache is None else cache
+        idx = self._jnp.asarray(pages, self._jnp.int32)
+        return self._gather_j(cache["k"], idx), self._gather_j(
+            cache["v"], idx)
+
+    def import_pages(self, k, v, hashes: List[int]) -> int:
+        """Adopt exported pages into this engine's pool as CACHED
+        prefixes, refcount-correct: allocate destination pages, scatter
+        the contents in (donated pool update), register each page under
+        its chain hash, then release — the pages land in the allocator's
+        LRU exactly like pages published by a finished slot, so the next
+        matching prompt retains them through ``match_prefix`` and the
+        normal refcount lifecycle applies. Hashes already resident are
+        skipped (no duplicate pool pressure). Returns the number of
+        pages adopted; 0 — with nothing allocated, nothing leaked — when
+        the pool cannot cover or everything is already cached.
+
+        Engine-thread only: mutates ``self._cache`` un-locked, like every
+        other cache update in the tick loop."""
+        jnp = self._jnp
+        alloc = self._alloc
+        keep = [i for i, h in enumerate(hashes)
+                if h not in alloc.hash2page]
+        if not keep:
+            return 0
+        dst = alloc.alloc(len(keep))
+        if dst is None:
+            return 0
+        if len(keep) != len(hashes):
+            sel = jnp.asarray(keep, jnp.int32)
+            k, v = self._gather_j(k, sel), self._gather_j(v, sel)
+        idx = jnp.asarray(dst, jnp.int32)
+        self._cache["k"] = self._scatter_j(self._cache["k"], idx, k)
+        self._cache["v"] = self._scatter_j(self._cache["v"], idx, v)
+        for i, pg in zip(keep, dst):
+            alloc.register(hashes[i], pg)
+            alloc.release(pg)
+        return len(keep)
+
+    def residency_digest(self, max_entries: int = 4096) -> dict:
+        """Bounded snapshot of this engine's cached prefix fingerprints —
+        the routing half of cache-aware serving (serve/affinity.py).
+        Chain hashes are process-stable (blake2b), so a router can
+        recompute a prompt's hashes and estimate how many prefix tokens
+        this replica already holds without shipping any tokens. Safe to
+        call from the actor's request thread: one dict snapshot, and a
+        torn read merely stales the digest until the next report."""
+        alloc = self._alloc
+        try:
+            hashes = list(alloc.hash2page)
+        except RuntimeError:  # resized mid-iteration: report next tick
+            hashes = []
+        if len(hashes) > max_entries:
+            hashes = hashes[-max_entries:]
+        return {"page_size": alloc.page_size, "hashes": hashes,
+                "num_pages": alloc.num_pages}
 
     def stats(self) -> dict:
         st = super().stats()

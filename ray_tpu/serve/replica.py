@@ -157,3 +157,15 @@ class ReplicaActor:
         if hasattr(self._instance, "stats"):
             return self._instance.stats()
         return {}
+
+    def residency_digest(self) -> Any:
+        """Prefix-cache residency snapshot for cache-affinity routing
+        (serve/affinity.py); None for deployments without the surface —
+        the router must keep routing those blind, never error."""
+        inst = self._instance
+        if inst is not None and hasattr(inst, "residency_digest"):
+            try:
+                return inst.residency_digest()
+            except Exception:  # noqa: BLE001
+                return None
+        return None

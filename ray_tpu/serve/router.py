@@ -121,6 +121,12 @@ class Router:
         self._inflight: Dict[str, int] = {}
         # multiplexing: model id -> replica id that last loaded it
         self._mux_affinity: Dict[str, str] = {}
+        # cache-aware routing (serve_cache_affinity, serve/affinity.py):
+        # per-replica prefix-residency digests refreshed by the report
+        # loop, and session id -> replica id stickiness (a session's
+        # chain lives where its previous turn ran)
+        self._residency: Dict[str, Any] = {}
+        self._session_affinity: Dict[str, str] = {}
         self._version = -1
         self._snapshot = 0
         self._deployment_gone = False
@@ -153,7 +159,12 @@ class Router:
         # (the controller aggregates depth + TTFT percentiles for the
         # autoscaler's serve:demand KV key)
         self._autoscaling = bool(cfg.get("autoscaling_config"))
-        self._report_enabled = self._autoscaling or qos_active
+        # cache-affinity routing rides the same loop: digests refresh on
+        # the report tick, so an engine deployment under the flag always
+        # reports (the controller then also sees residency aggregates)
+        self._report_enabled = (self._autoscaling or qos_active
+                                or (config.serve_cache_affinity
+                                    and self._engine))
         self._report_thread: Optional[threading.Thread] = None
         if self._report_enabled:
             import os as _os
@@ -348,9 +359,20 @@ class Router:
                     with self._lock:
                         load = sum(self._inflight.values())
                         depth = self._depth
-                    ref = self._controller.report_load.remote(
-                        self._name, self._router_id, load,
-                        max(load, depth), self._ttft.drain_samples())
+                    residency = None
+                    if config.serve_cache_affinity and self._engine:
+                        residency = self._poll_residency()
+                    if residency is not None:
+                        ref = self._controller.report_load.remote(
+                            self._name, self._router_id, load,
+                            max(load, depth), self._ttft.drain_samples(),
+                            residency)
+                    else:
+                        # legacy 5-arg shape when affinity is off: the
+                        # flag-off wire traffic stays byte-identical
+                        ref = self._controller.report_load.remote(
+                            self._name, self._router_id, load,
+                            max(load, depth), self._ttft.drain_samples())
                     if prev_ref is not None:
                         # free the previous report's return entry — a
                         # periodic fire-and-forget would otherwise grow
@@ -381,6 +403,34 @@ class Router:
                     ray_tpu.free(prev_ref)
                 except Exception:  # noqa: BLE001
                     pass
+
+    def _poll_residency(self) -> dict:
+        """Refresh per-replica prefix-residency digests (engine replicas
+        publish bounded chain-hash fingerprint sets; see
+        serve/affinity.py) and return the aggregate the report loop
+        forwards to the controller. Best-effort per replica: one without
+        the surface (non-paged engine, old code) or one that died simply
+        contributes no digest, and _pick falls back to pow-2 for it."""
+        from ray_tpu.serve.affinity import ResidencyDigest
+
+        with self._lock:
+            replicas = list(self._replicas)
+        summary: Dict[str, int] = {}
+        for rid, handle in replicas:
+            try:
+                payload = ray_tpu.get(
+                    handle.residency_digest.remote(), timeout=5)
+            except Exception:  # noqa: BLE001 — dead/old replica
+                payload = None
+            dg = ResidencyDigest.from_report(payload)
+            with self._lock:
+                if dg is not None:
+                    self._residency[rid] = dg
+                    summary[rid] = len(dg.hashes)
+                else:
+                    self._residency.pop(rid, None)
+        return {"replicas": summary,
+                "cached_chains": sum(summary.values())}
 
     def stop(self):
         """Stop background reporting (called by DeploymentHandle teardown
@@ -421,11 +471,22 @@ class Router:
                     self._inflight.setdefault(rid, 0)
         self._ensure_topology_thread()
 
-    def _pick(self, model_id: Optional[str] = None) -> Tuple[str, Any]:
+    def _pick(self, model_id: Optional[str] = None,
+              prompt_tokens: Optional[list] = None,
+              session_id: Optional[str] = None) -> Tuple[str, Any]:
         """Power-of-two-choices on local in-flight counts; with a
         multiplexed ``model_id``, prefer the replica that already loaded
         that variant (reference: multiplex-aware replica scheduler) unless
-        it is clearly overloaded vs the pow-2 alternative."""
+        it is clearly overloaded vs the pow-2 alternative.
+
+        Under ``serve_cache_affinity``, engine requests carrying their
+        ``prompt_tokens`` (and optionally a ``session_id``) first try the
+        cache-affinity pick (serve/affinity.py): the replica holding the
+        longest cached prefix of the prompt wins unless its load penalty
+        eats the match; no candidate clearing the bar falls back to
+        pow-2 unchanged. Flag off, the extra arguments are inert and the
+        seed pow-2 path runs byte-identical (no digest reads, no extra
+        RNG draws)."""
         deadline = time.monotonic() + config.serve_replica_wait_s
         while True:
             self._refresh()
@@ -448,31 +509,77 @@ class Router:
                     if self._inflight.get(rid, 0) <= least + 4:
                         return hot
         choice = None
-        if len(replicas) == 1:
-            choice = replicas[0]
-        else:
-            a, b = random.sample(replicas, 2)
-            with self._lock:
-                choice = a if (self._inflight.get(a[0], 0)
-                               <= self._inflight.get(b[0], 0)) else b
+        if config.serve_cache_affinity and (prompt_tokens is not None
+                                            or session_id is not None):
+            choice = self._pick_affinity(replicas, prompt_tokens,
+                                         session_id)
+        if choice is None:
+            if len(replicas) == 1:
+                choice = replicas[0]
+            else:
+                a, b = random.sample(replicas, 2)
+                with self._lock:
+                    choice = a if (self._inflight.get(a[0], 0)
+                                   <= self._inflight.get(b[0], 0)) else b
         if model_id is not None:
             with self._lock:
                 self._mux_affinity[model_id] = choice[0]
                 if len(self._mux_affinity) > 10_000:
                     self._mux_affinity.clear()  # bounded, rebuilt on use
+        if session_id is not None and config.serve_cache_affinity:
+            with self._lock:
+                self._session_affinity[session_id] = choice[0]
+                if len(self._session_affinity) > 10_000:
+                    self._session_affinity.clear()
         return choice
+
+    def _pick_affinity(self, replicas: List[Tuple[str, Any]],
+                       prompt_tokens: Optional[list],
+                       session_id: Optional[str]
+                       ) -> Optional[Tuple[str, Any]]:
+        """Cache-affinity choice: session stickiness first (the session's
+        previous replica holds its whole chain, beyond what full-page
+        digests can attest), then residency-digest scoring. None = no
+        candidate cleared the bar; caller falls back to pow-2."""
+        from ray_tpu.serve.affinity import score_replicas
+
+        by_id = {r[0]: r for r in replicas}
+        with self._lock:
+            if session_id is not None:
+                rid = self._session_affinity.get(session_id)
+                if rid in by_id:
+                    # same hot-replica tolerance as mux affinity
+                    least = min(self._inflight.get(r[0], 0)
+                                for r in replicas)
+                    if self._inflight.get(rid, 0) <= least + 4:
+                        return by_id[rid]
+            digests = dict(self._residency)
+            inflight = dict(self._inflight)
+        rid = score_replicas(
+            prompt_tokens, replicas, digests, inflight,
+            min_prefix_tokens=config.serve_affinity_min_prefix_tokens,
+            load_penalty=config.serve_affinity_load_penalty)
+        return by_id.get(rid)
 
     def _drop_replica(self, rid: str):
         with self._lock:
             self._replicas = [r for r in self._replicas if r[0] != rid]
             self._inflight.pop(rid, None)
+            # affinity state for a corpse must go too: its digest can no
+            # longer win a pick, and sticky sessions re-score fresh on
+            # their next request instead of chasing the dead replica
+            self._residency.pop(rid, None)
+            for sid in [s for s, r in self._session_affinity.items()
+                        if r == rid]:
+                del self._session_affinity[sid]
         self._ttft.drop_replica(rid)
 
     # --------------------------------------------------------------- routing
 
     def request(self, args: tuple, kwargs: dict,
                 model_id: Optional[str] = None,
-                priority=None, deadline_s: Optional[float] = None) -> Future:
+                priority=None, deadline_s: Optional[float] = None,
+                session_id: Optional[str] = None) -> Future:
         self._ensure_report_thread()
         if model_id is not None and (self._engine or self._max_batch > 1):
             # engine mailboxes and dynamic batches mix requests across
@@ -491,7 +598,8 @@ class Router:
         deadline_wall = None if dl is None else time.time() + dl
         if self._engine:
             threading.Thread(target=self._engine_request,
-                             args=(args, kwargs, fut), daemon=True).start()
+                             args=(args, kwargs, fut, session_id),
+                             daemon=True).start()
         elif self._max_batch > 1:
             with self._lock:
                 self._pending.append((args, kwargs, fut))
@@ -502,7 +610,7 @@ class Router:
         else:
             threading.Thread(target=self._unary_request,
                              args=(args, kwargs, fut, model_id,
-                                   deadline_wall),
+                                   deadline_wall, session_id),
                              daemon=True).start()
         return fut
 
@@ -544,7 +652,8 @@ class Router:
         return fut
 
     def _unary_request(self, args, kwargs, fut: Future, model_id=None,
-                       deadline_wall: Optional[float] = None):
+                       deadline_wall: Optional[float] = None,
+                       session_id: Optional[str] = None):
         from ray_tpu.serve.multiplex import _MUX_KWARG
 
         if model_id is not None:
@@ -554,7 +663,7 @@ class Router:
         err: Optional[BaseException] = None
         for _ in range(3):  # retry across replicas on replica death
             try:
-                rid, handle = self._pick(model_id)
+                rid, handle = self._pick(model_id, session_id=session_id)
             except ReplicaUnavailableError as e:
                 fut.set_exception(e)
                 return
@@ -645,7 +754,8 @@ class Router:
 
     def stream_request(self, args, kwargs, timeout_s: float = 600.0,
                        model_id: Optional[str] = None,
-                       priority=None, deadline_s: Optional[float] = None):
+                       priority=None, deadline_s: Optional[float] = None,
+                       session_id: Optional[str] = None):
         """Streaming entry point. Generator deployments (the callable
         uses ``yield``) ride ``num_returns="streaming"`` actor calls:
         each yielded item seals into the object store as produced and is
@@ -665,7 +775,8 @@ class Router:
             token = self._admit(pr, dl)
             return _TokenStream(
                 self._generator_stream(args, kwargs, timeout_s,
-                                       model_id, token, dl), token)
+                                       model_id, token, dl, session_id),
+                token)
         if not self._engine:
             raise TypeError(
                 f"deployment {self._name!r} is neither a generator nor "
@@ -679,13 +790,15 @@ class Router:
                 "streaming deployments")
         token = self._admit(pr, dl)
         return _TokenStream(
-            self._engine_stream(args, kwargs, timeout_s, token, dl),
+            self._engine_stream(args, kwargs, timeout_s, token, dl,
+                                session_id),
             token)
 
     def _generator_stream(self, args, kwargs, timeout_s: float,
                           model_id: Optional[str],
                           token: Optional[_DepthToken] = None,
-                          deadline_s: Optional[float] = None):
+                          deadline_s: Optional[float] = None,
+                          session_id: Optional[str] = None):
         """Consume a generator replica: one streaming actor call, yield
         each item as its ref arrives (backpressure rides the stream's
         credit window, so a slow consumer stalls the replica's yields)."""
@@ -694,7 +807,7 @@ class Router:
 
         if model_id is not None:
             kwargs = dict(kwargs, **{_MUX_KWARG: model_id})
-        rid, handle = self._pick(model_id)
+        rid, handle = self._pick(model_id, session_id=session_id)
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
         t0 = time.monotonic()
@@ -750,7 +863,8 @@ class Router:
 
     def _engine_stream(self, args, kwargs, timeout_s: float,
                        token: Optional[_DepthToken] = None,
-                       deadline_s: Optional[float] = None):
+                       deadline_s: Optional[float] = None,
+                       session_id: Optional[str] = None):
         """Generator over an engine request's progress: yields lists of
         NEW tokens as they are generated, ending after the final chunk
         (reference: serve streaming responses / vLLM token streaming).
@@ -761,7 +875,9 @@ class Router:
         with self._lock:
             self._req_seq += 1
             req_id = f"s{id(self)}-{self._req_seq}"
-        rid, handle = self._pick()
+        rid, handle = self._pick(
+            prompt_tokens=self._prompt_of(args, kwargs),
+            session_id=session_id)
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
         t0 = time.monotonic()
@@ -829,13 +945,24 @@ class Router:
             if token is not None:
                 token.release()
 
-    def _engine_request(self, args, kwargs, fut: Future):
+    @staticmethod
+    def _prompt_of(args: tuple, kwargs: dict) -> Optional[list]:
+        """The prompt token list of an engine submit call (positional
+        ``prompt_tokens`` or the kwarg) — what cache-affinity scores.
+        None for shapes the engine surface doesn't use anyway."""
+        toks = args[0] if args else kwargs.get("prompt_tokens")
+        return toks if isinstance(toks, (list, tuple)) else None
+
+    def _engine_request(self, args, kwargs, fut: Future,
+                        session_id: Optional[str] = None):
         """Submit to an engine replica's mailbox and poll its collect()."""
         with self._lock:
             self._req_seq += 1
             req_id = f"r{id(self)}-{self._req_seq}"
         try:
-            rid, handle = self._pick()
+            rid, handle = self._pick(
+                prompt_tokens=self._prompt_of(args, kwargs),
+                session_id=session_id)
         except ReplicaUnavailableError as e:
             fut.set_exception(e)
             return

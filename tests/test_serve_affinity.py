@@ -1,0 +1,269 @@
+"""Cache-affinity routing: residency digests, Router._pick scoring, the
+report_load wire compatibility (3-, 5- and 6-arg shapes), and the
+controller's residency aggregation.
+
+Router._pick is tested against directly-constructed router state
+(precedent: test_locality.py drives core._pick_node the same way) so the
+scoring contract is pinned without a live control plane; the flag-off
+parity test proves serve_cache_affinity=off leaves the seed pow-2 path
+byte-identical (same RNG draw sequence, same choices).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from ray_tpu.core.config import config
+from ray_tpu.serve.affinity import (ResidencyDigest, chain_hashes,
+                                    matched_prefix_tokens, score_replicas)
+from ray_tpu.serve.paged_engine import _PageAllocator
+
+PS = 8  # page size used throughout
+
+
+def _digest(tokens, page_size=PS, ts=None):
+    return ResidencyDigest(page_size, chain_hashes(tokens, page_size),
+                           ts=ts)
+
+
+# ------------------------------------------------------------ pure scoring
+
+
+def test_chain_hashes_match_allocator_chain():
+    """Router-side chain_hashes and allocator-side match_prefix compute
+    the SAME fingerprints — the whole affinity estimate rests on this."""
+    toks = list(range(100, 100 + 4 * PS))
+    alloc = _PageAllocator(num_pages=16, page_size=PS)
+    _, alloc_hashes, _ = alloc.match_prefix(toks, len(toks))
+    assert chain_hashes(toks, PS) == alloc_hashes
+    # chained: a different FIRST page changes every later fingerprint
+    toks2 = [1] + toks[1:]
+    assert chain_hashes(toks2, PS)[-1] != alloc_hashes[-1]
+
+
+def test_matched_prefix_tokens_longest_leading_run():
+    toks = list(range(5 * PS + 3))
+    full = _digest(toks)
+    assert matched_prefix_tokens(toks, full) == 5 * PS
+    # a digest holding only the first two pages matches 2 pages
+    two = _digest(toks[: 2 * PS])
+    assert matched_prefix_tokens(toks, two) == 2 * PS
+    # a gap in the chain stops the run even if later hashes are present
+    hashes = chain_hashes(toks, PS)
+    gappy = ResidencyDigest(PS, [hashes[0]] + hashes[2:])
+    assert matched_prefix_tokens(toks, gappy) == PS
+    assert matched_prefix_tokens(toks, ResidencyDigest(PS, [])) == 0
+
+
+def test_score_replicas_prefers_holder_and_breaks_ties_on_load():
+    toks = list(range(4 * PS))
+    replicas = [("r1", None), ("r2", None), ("r3", None)]
+    dg = _digest(toks)
+    # only r2 holds the prefix
+    assert score_replicas(toks, replicas, {"r2": dg}, {},
+                          min_prefix_tokens=16, load_penalty=64.0) == "r2"
+    # both r1 and r2 hold it; the lighter replica wins
+    assert score_replicas(toks, replicas, {"r1": dg, "r2": dg},
+                          {"r1": 3, "r2": 0},
+                          min_prefix_tokens=16, load_penalty=64.0) == "r2"
+    # equal everything: deterministic lexicographic tiebreak
+    assert score_replicas(toks, replicas, {"r1": dg, "r2": dg}, {},
+                          min_prefix_tokens=16, load_penalty=64.0) == "r1"
+
+
+def test_score_replicas_bars_and_fallbacks():
+    toks = list(range(4 * PS))
+    replicas = [("r1", None), ("r2", None)]
+    dg = _digest(toks)
+    # match below min_prefix_tokens: no candidate
+    short = toks[:PS]
+    assert score_replicas(short, replicas, {"r2": _digest(short)}, {},
+                          min_prefix_tokens=16,
+                          load_penalty=64.0) is None
+    # stale digest: skipped
+    now = time.monotonic()
+    stale = _digest(toks, ts=now - 10.0)
+    assert score_replicas(toks, replicas, {"r2": stale}, {},
+                          min_prefix_tokens=16, load_penalty=64.0,
+                          now=now) is None
+    # the load penalty eats the match: an overloaded holder must NOT
+    # attract more traffic than blind balancing would give it
+    assert score_replicas(toks, replicas, {"r2": dg}, {"r2": 50},
+                          min_prefix_tokens=16,
+                          load_penalty=64.0) is None
+    assert score_replicas(None, replicas, {"r2": dg}, {},
+                          min_prefix_tokens=16, load_penalty=64.0) is None
+
+
+def test_residency_digest_from_report_tolerates_garbage():
+    ok = ResidencyDigest.from_report({"page_size": PS, "hashes": [1, 2]})
+    assert ok is not None and ok.hashes == frozenset((1, 2))
+    for bad in (None, 42, [], {"hashes": [1]}, {"page_size": "x"}):
+        assert ResidencyDigest.from_report(bad) is None
+
+
+# ------------------------------------------------------- Router._pick
+
+
+def _bare_router(replica_ids, inflight=None):
+    """Router with hand-built state (no control plane), enough for
+    _pick/_pick_affinity/_drop_replica."""
+    from ray_tpu.serve.qos import TtftEstimator
+
+    from ray_tpu.serve.router import Router
+
+    r = Router.__new__(Router)
+    r._lock = threading.Lock()
+    r._name = "aff-test"
+    r._replicas = [(rid, f"handle-{rid}") for rid in replica_ids]
+    r._inflight = dict(inflight or {rid: 0 for rid in replica_ids})
+    r._mux_affinity = {}
+    r._residency = {}
+    r._session_affinity = {}
+    r._ttft = TtftEstimator(0.5)
+    r._refresh = lambda force=False: None  # shadow: no controller
+    return r
+
+
+@pytest.fixture
+def affinity_on():
+    os.environ["RTPU_SERVE_CACHE_AFFINITY"] = "1"
+    config.reload()
+    yield
+    del os.environ["RTPU_SERVE_CACHE_AFFINITY"]
+    config.reload()
+
+
+def test_pick_prefers_digest_holder(affinity_on):
+    toks = list(range(4 * PS))
+    r = _bare_router(["r1", "r2", "r3"])
+    r._residency["r2"] = _digest(toks)
+    for seed in range(10):  # affinity wins independent of the RNG
+        random.seed(seed)
+        assert r._pick(prompt_tokens=toks)[0] == "r2"
+
+
+def test_pick_overloaded_holder_falls_back_to_pow2(affinity_on):
+    toks = list(range(4 * PS))
+    r = _bare_router(["r1", "r2"], inflight={"r1": 0, "r2": 50})
+    r._residency["r2"] = _digest(toks)
+    random.seed(0)
+    # penalty (64 * 50) dwarfs the 32-token match: blind pow-2 runs,
+    # and with these loads it always lands on the idle replica
+    assert r._pick(prompt_tokens=toks)[0] == "r1"
+
+
+def test_pick_stale_digest_falls_back(affinity_on):
+    toks = list(range(4 * PS))
+    r = _bare_router(["r1", "r2"], inflight={"r1": 0, "r2": 1})
+    r._residency["r2"] = _digest(toks, ts=time.monotonic() - 10.0)
+    random.seed(1)
+    picks = {r._pick(prompt_tokens=toks)[0] for _ in range(20)}
+    # stale digest never forces r2: pow-2 keeps preferring the lighter
+    assert picks == {"r1"}
+
+
+def test_pick_session_sticky_until_replica_dies(affinity_on):
+    toks = list(range(4 * PS))
+    r = _bare_router(["r1", "r2", "r3"])
+    r._residency["r2"] = _digest(toks)
+    assert r._pick(prompt_tokens=toks, session_id="s1")[0] == "r2"
+    # sticky: later turns follow the session even with no prompt tokens
+    assert r._pick(session_id="s1")[0] == "r2"
+    # replica death clears residency AND session pins; the session
+    # re-scores onto a live replica and re-pins there
+    r._drop_replica("r2")
+    assert "r2" not in r._residency
+    assert "s1" not in r._session_affinity
+    nxt = r._pick(prompt_tokens=toks, session_id="s1")[0]
+    assert nxt in ("r1", "r3")
+    assert r._session_affinity["s1"] == nxt
+
+
+def test_pick_session_unpins_from_backed_up_replica(affinity_on):
+    r = _bare_router(["r1", "r2"], inflight={"r1": 0, "r2": 10})
+    r._session_affinity["s1"] = "r2"
+    random.seed(2)
+    # 10 > least + 4 tolerance: stickiness yields to load balancing
+    assert r._pick(session_id="s1")[0] == "r1"
+
+
+def test_pick_flag_off_matches_seed_pow2_exactly():
+    """serve_cache_affinity=off: _pick with affinity arguments present
+    (and digests populated!) draws the SAME RNG sequence and makes the
+    SAME choices as the seed power-of-two loop — byte-identical
+    behavior, not just similar distribution."""
+    assert not config.serve_cache_affinity  # default off
+    toks = list(range(4 * PS))
+    inflight = {"r1": 5, "r2": 0, "r3": 2}
+    r = _bare_router(["r1", "r2", "r3"], inflight=inflight)
+    r._residency["r1"] = _digest(toks)  # must be inert with the flag off
+
+    random.seed(1234)
+    got = [r._pick(prompt_tokens=toks, session_id="s")[0]
+           for _ in range(50)]
+    assert not r._session_affinity  # no affinity state written flag-off
+
+    random.seed(1234)
+    replicas = [(rid, f"handle-{rid}") for rid in ("r1", "r2", "r3")]
+    want = []
+    for _ in range(50):
+        a, b = random.sample(replicas, 2)
+        want.append(a[0] if inflight[a[0]] <= inflight[b[0]] else b[0])
+    assert got == want
+
+
+# ------------------------------------------- controller wire + aggregation
+
+
+def test_report_load_accepts_all_three_signatures():
+    """Legacy 3-positional, QoS 5-arg, and residency 6-arg reports all
+    land on the same controller method; each extension only adds."""
+    from ray_tpu.serve.controller import ServeController
+
+    c = ServeController()
+    try:
+        c.deploy("d", b"", {"num_replicas": 0})
+        c.report_load("d", "legacy", 2)
+        c.report_load("d", "qos", 1, 7, [12.0, 40.0])
+        c.report_load("d", "aff", 1, 0, None,
+                      {"replicas": {"rep-0": 3}, "cached_chains": 3})
+        st = c.status()["d"]
+        assert st["queue_depth"] == 7
+        assert st["ttft_p99_ms"] > 0
+        assert st["cached_prefix_chains"] == 3
+        snap = c.demand_snapshot()["d"]
+        assert snap["cached_prefix_chains"] == 3
+    finally:
+        c.shutdown()
+
+
+def test_controller_residency_aggregation_and_expiry():
+    """Per-replica counts dedup across routers (max, not sum — both
+    routers describe the same replica cache), sum across replicas; a
+    vanished router's report expires from demand_snapshot like depths."""
+    from ray_tpu.serve.controller import ServeController
+
+    c = ServeController()
+    try:
+        c.deploy("d", b"", {"num_replicas": 0})
+        c.report_load("d", "router-a", 0, None, None,
+                      {"replicas": {"rep-0": 5, "rep-1": 2}})
+        c.report_load("d", "router-b", 0, None, None,
+                      {"replicas": {"rep-0": 4, "rep-2": 7}})
+        assert c.status()["d"]["cached_prefix_chains"] == 5 + 2 + 7
+        # age router-b's report past the 3s expiry window
+        with c._lock:
+            info = c._deployments["d"]
+            summary, _ = info.residency["router-b"]
+            info.residency["router-b"] = (summary,
+                                          time.monotonic() - 4.0)
+        assert c.demand_snapshot()["d"]["cached_prefix_chains"] == 5 + 2
+        assert "router-b" not in c._deployments["d"].residency
+    finally:
+        c.shutdown()

@@ -1,0 +1,281 @@
+"""Disaggregated prefill/decode engine: token parity with the plain
+paged engine, handoff chaos (drop + worker kill) losing zero requests
+and zero pages, tuple-of-arrays DeviceChannel payloads, the store-backed
+channel transport, and a netem-style seed sweep over the prefill→decode
+edge.
+
+Parity anchor: PagedLLMEngine is pinned token-exact to the dense engine
+(test_serve_paged.py), so disagg == paged ⇒ disagg == reference.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import fault_injection, runtime_context
+from ray_tpu.core.config import config
+
+TINY = dict(model_config={"preset": "tiny"}, num_slots=4, max_len=96,
+            prefill_buckets=[16], max_new_tokens=8, chunk_steps=4)
+
+
+def _drain(engine, reqs, timeout_s=120):
+    for rid, prompt, kw in reqs:
+        engine.submit(rid, prompt, **kw)
+    out = {}
+    deadline = time.time() + timeout_s
+    while len(out) < len(reqs) and time.time() < deadline:
+        out.update(engine.collect())
+        time.sleep(0.01)
+    return out
+
+
+def _assert_no_leaked_pages(eng):
+    alloc = eng._alloc
+    assert len(alloc.free) + len(alloc.lru) == alloc.num_pages
+
+
+def _prompts(seed=7, lens=(3, 23, 9, 40, 70)):
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(1, 250, n)] for n in lens]
+
+
+def test_disagg_matches_plain_paged():
+    """Greedy generations are token-identical to the plain paged engine
+    for a mixed batch; long prompts actually take the diverted path
+    (prefill worker → handoff → decode-side adoption)."""
+    from ray_tpu.serve.disagg import DisaggPagedEngine
+    from ray_tpu.serve.paged_engine import PagedLLMEngine
+
+    prompts = _prompts()
+    reqs = [(f"r{i}", p, {}) for i, p in enumerate(prompts)]
+
+    plain = PagedLLMEngine(page_size=8, **TINY)
+    try:
+        want = _drain(plain, reqs)
+    finally:
+        plain.shutdown()
+
+    dis = DisaggPagedEngine(page_size=8, prefill_workers=1, **TINY)
+    try:
+        got = _drain(dis, reqs)
+        st = dis.stats()
+    finally:
+        dis.shutdown()
+
+    assert set(got) == set(want)
+    for rid in want:
+        assert got[rid]["tokens"] == want[rid]["tokens"], rid
+    # prompts >= the 16-token divert floor with >= 1 full head page
+    # (23, 40, 70) went through the prefill plane, pages were adopted
+    assert st["disagg_diverted"] == 3
+    assert st["disagg_handoffs"] == 3
+    assert st["disagg_imported_pages"] > 0
+    assert st["disagg_recovered"] == 0
+    _assert_no_leaked_pages(dis)
+
+
+def test_disagg_dropped_handoff_recovers():
+    """prefill_handoff 'drop' loses the KV handoff mid-stream; the lease
+    sweep resubmits the victim for local prefill. Zero lost requests,
+    token output unchanged, zero leaked pages."""
+    from ray_tpu.serve.disagg import DisaggPagedEngine
+
+    prompts = _prompts(seed=11, lens=(40, 40))
+    reqs = [("victim", prompts[0], {}), ("bystander", prompts[1], {})]
+
+    clean = DisaggPagedEngine(page_size=8, prefill_workers=1, **TINY)
+    try:
+        want = _drain(clean, reqs)
+    finally:
+        clean.shutdown()
+
+    eng = DisaggPagedEngine(page_size=8, prefill_workers=1,
+                            handoff_timeout_s=0.5, **TINY)
+    try:
+        fault_injection.inject("prefill_handoff", "drop", "victim",
+                               times=1)
+        got = _drain(eng, reqs)
+        st = eng.stats()
+    finally:
+        fault_injection.clear()
+        eng.shutdown()
+
+    assert got["victim"]["tokens"] == want["victim"]["tokens"]
+    assert got["bystander"]["tokens"] == want["bystander"]["tokens"]
+    assert st["disagg_recovered"] >= 1
+    assert st["disagg_pending"] == 0
+    _assert_no_leaked_pages(eng)
+
+
+def test_disagg_worker_kill_respawns_and_recovers():
+    """prefill_handoff 'kill_worker' kills the worker thread mid-request
+    (no cleanup, no handoff): the victim recovers through its lease and
+    the health check respawns the worker, which serves later requests."""
+    from ray_tpu.serve.disagg import DisaggPagedEngine
+
+    prompts = _prompts(seed=13, lens=(40, 40))
+    first = [("victim", prompts[0], {})]
+    second = [("after", prompts[1], {})]
+
+    eng = DisaggPagedEngine(page_size=8, prefill_workers=1,
+                            handoff_timeout_s=0.5, **TINY)
+    try:
+        fault_injection.inject("prefill_handoff", "kill_worker",
+                               "victim", times=1)
+        got = _drain(eng, first)
+        assert "victim" in got and got["victim"]["tokens"]
+        assert eng.stats()["disagg_recovered"] >= 1
+        # the respawned worker handles subsequent diversions normally
+        got2 = _drain(eng, second)
+        assert "after" in got2 and got2["after"]["tokens"]
+        st = eng.stats()
+    finally:
+        fault_injection.clear()
+        eng.shutdown()
+
+    assert st["prefill_workers"] == 1  # dead thread was replaced
+    assert st["disagg_handoffs"] >= 1
+    _assert_no_leaked_pages(eng)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_disagg_handoff_chaos_seed_sweep(seed):
+    """netem-style sweep over the prefill→decode edge: per seed, a
+    random subset of diverted requests loses its handoff. Every request
+    still completes and the page pool balances — chaos on this edge
+    costs latency only."""
+    from ray_tpu.serve.disagg import DisaggPagedEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = [[int(t) for t in rng.integers(1, 250, 40)]
+               for _ in range(4)]
+    reqs = [(f"s{seed}-r{i}", p, {}) for i, p in enumerate(prompts)]
+    victims = [reqs[i][0] for i in rng.choice(4, size=2, replace=False)]
+
+    eng = DisaggPagedEngine(page_size=8, prefill_workers=1,
+                            handoff_timeout_s=0.3, **TINY)
+    try:
+        for rid in victims:
+            fault_injection.inject("prefill_handoff", "drop", rid,
+                                   times=1)
+        got = _drain(eng, reqs)
+        st = eng.stats()
+    finally:
+        fault_injection.clear()
+        eng.shutdown()
+
+    assert set(got) == {rid for rid, _, _ in reqs}  # zero lost requests
+    assert all(got[rid]["tokens"] for rid, _, _ in reqs)
+    assert st["disagg_recovered"] >= len(victims)
+    assert st["disagg_pending"] == 0
+    _assert_no_leaked_pages(eng)
+
+
+def test_engine_class_resolves_serve_disagg_flag():
+    import os
+
+    from ray_tpu.serve.disagg import DisaggPagedEngine, engine_class
+    from ray_tpu.serve.paged_engine import PagedLLMEngine
+
+    assert engine_class() is PagedLLMEngine  # default off
+    os.environ["RTPU_SERVE_DISAGG"] = "1"
+    try:
+        config.reload()
+        assert engine_class() is DisaggPagedEngine
+    finally:
+        del os.environ["RTPU_SERVE_DISAGG"]
+        config.reload()
+
+
+# ---------------------------------------------- device-channel transport
+
+
+@pytest.fixture(scope="module")
+def dag_ray():
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    ray_tpu.init(num_workers=2, object_store_memory=256 << 20)
+    yield
+    core = runtime_context.get_core_or_none()
+    if core is not None:
+        core.shutdown()
+    runtime_context.set_core(prev)
+
+
+def test_device_channel_tuple_payload_roundtrip(dag_ray):
+    """A tuple of jax Arrays (the KV page pair shape of a disagg
+    handoff) crosses a DeviceChannel by reference — every element is the
+    same object, no pickle round-trip — and release() still clears the
+    handoff registry."""
+    import jax.numpy as jnp
+
+    from ray_tpu.dag.channel import _DEVICE_HANDOFF, DeviceChannel
+
+    store = runtime_context.get_core().store
+    ch = DeviceChannel.create(store, capacity=1 << 12)
+    reader = DeviceChannel.open(store, ch.descriptor())
+    try:
+        k, v = jnp.arange(8.0), jnp.ones((2, 4))
+        ch.write(("v", (k, v)))
+        tag, out = reader.read()
+        assert tag == "v"
+        assert out[0] is k and out[1] is v  # by reference, per element
+        # a mixed tuple (one non-array member) must take the pickled
+        # path, not half-register in the handoff registry
+        ch.write(("v", (k, "meta")))
+        tag, out = reader.read()
+        assert tag == "v" and out[1] == "meta"
+        assert not any(kk[0] == ch._key for kk in _DEVICE_HANDOFF)
+        # empty tuple: pickled path (device payloads are never empty)
+        ch.write(("v", ()))
+        assert reader.read() == ("v", ())
+    finally:
+        ch.release()
+        reader.release()
+    assert not any(kk[0] == ch._key for kk in _DEVICE_HANDOFF)
+
+
+def test_disagg_uses_device_channel_when_store_present(dag_ray):
+    """Constructed in a process with an object store, the engine's
+    prefill workers hand KV pages over DeviceChannels (on-device, by
+    reference) — and the output is still token-identical to the plain
+    engine."""
+    from ray_tpu.serve.disagg import DisaggPagedEngine
+    from ray_tpu.serve.paged_engine import PagedLLMEngine
+
+    prompts = _prompts(seed=17, lens=(40, 70))
+    reqs = [(f"r{i}", p, {}) for i, p in enumerate(prompts)]
+
+    plain = PagedLLMEngine(page_size=8, **TINY)
+    try:
+        want = _drain(plain, reqs)
+    finally:
+        plain.shutdown()
+
+    eng = DisaggPagedEngine(page_size=8, prefill_workers=1, **TINY)
+    try:
+        # the worker state really bound a channel (store present) —
+        # state is built inside the worker thread, so poll briefly
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+                ws.get("chan") is not None
+                for ws in eng._wstates.values()):
+            time.sleep(0.01)
+        assert any(ws.get("chan") is not None
+                   for ws in eng._wstates.values())
+        got = _drain(eng, reqs)
+        st = eng.stats()
+    finally:
+        eng.shutdown()
+
+    for rid in want:
+        assert got[rid]["tokens"] == want[rid]["tokens"], rid
+    assert st["disagg_handoffs"] == 2
+    assert st["disagg_imported_pages"] > 0  # KV really crossed the edge
+    assert st["disagg_recovered"] == 0      # no silent fallback
+    _assert_no_leaked_pages(eng)

@@ -279,3 +279,26 @@ def test_pool_exhausted_retry_is_head_of_line():
         assert order.index("big") < order.index("s1")
     finally:
         eng.shutdown()
+
+
+def test_chain_hash_stable_across_processes():
+    """Chain hashes must be process-invariant: they cross process
+    boundaries in residency digests (serve/affinity.py) and disagg
+    handoffs, so a PYTHONHASHSEED-salted builtin hash() would silently
+    zero the router-side match rate. Two interpreters with different
+    hash seeds must agree."""
+    import os
+    import subprocess
+    import sys
+
+    prog = ("from ray_tpu.serve.paged_engine import _PageAllocator as A;"
+            "print(A.chain_hash(0, tuple(range(8))),"
+            " A.chain_hash(12345, (7, 8, 9)))")
+    outs = []
+    for seed in ("0", "424242"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        outs.append(subprocess.run(
+            [sys.executable, "-c", prog], env=env, capture_output=True,
+            text=True, check=True, timeout=120).stdout.strip())
+    assert outs[0] == outs[1]
+    assert outs[0].split()[0] != "0"  # hashes are real values
