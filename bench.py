@@ -1438,6 +1438,109 @@ def bench_serve_overload(rows: list):
         runtime_context.set_core(prev)
 
 
+def bench_serve_replay(rows: list):
+    """Request fault tolerance rows (ISSUE 20).
+
+    serve_replica_kill_recovery_ms: worst request latency in a
+    sequential unary stream over 2 replicas when one replica is
+    SIGKILLed mid-flight with ``serve_request_replay`` on — the killed
+    request's latency covers death detection, the re-pick (which skips
+    the corpse), and the replay. Healthy requests price the floor.
+
+    serve_stream_resume_added_ttft_ms: extra inter-chunk gap at the
+    resume boundary of a token stream whose replica "dies" after the
+    first delivered chunk (injected ``stream_resume``), vs the steady
+    median gap of an uninterrupted stream on the same engine — the
+    price of the resubmit + prompt-and-watermark re-prefill. No
+    reference numbers — the conservative bars live in
+    BASELINE.json.published."""
+    import os as _os
+    import signal as _signal
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core import fault_injection, runtime_context
+    from ray_tpu.core.config import config
+
+    prev = runtime_context.get_core_or_none()
+    runtime_context.set_core(None)
+    _os.environ["RTPU_SERVE_REQUEST_REPLAY"] = "1"
+    config.reload()
+    ray_tpu.init(num_workers=4, object_store_memory=128 << 20)
+    try:
+        @serve.deployment(name="replay_bench", num_replicas=2)
+        class Work:
+            def __call__(self, x):
+                time.sleep(0.02)
+                return x
+
+            def pid(self):
+                return _os.getpid()
+
+        handle = serve.run(Work.bind())
+        pids = set()
+        deadline = time.monotonic() + 60
+        while len(pids) < 2 and time.monotonic() < deadline:
+            pids.add(handle.pid.remote().result(timeout=30))
+        if len(pids) < 2:
+            raise RuntimeError("replay bench never saw 2 replicas")
+        victim = sorted(pids)[0]
+        lats = []
+        for i in range(30):
+            if i == 5:
+                # land the kill inside the request's service window
+                threading.Timer(0.01, _os.kill,
+                                (victim, _signal.SIGKILL)).start()
+            t0 = time.perf_counter()
+            handle.remote(i).result(timeout=120)
+            lats.append((time.perf_counter() - t0) * 1e3)
+        rows.append(_row("serve_replica_kill_recovery_ms", max(lats),
+                         "ms"))
+
+        import jax
+
+        from ray_tpu.serve.llm_engine import LLMEngine
+
+        on_tpu = jax.default_backend() == "tpu"
+        mc = ({"preset": "llama3_1b_proxy", "param_dtype": "bfloat16"}
+              if on_tpu else {"preset": "tiny"})
+        dep = serve.deployment(
+            name="replay_stream_bench", engine=True, num_cpus=0.1,
+        )(LLMEngine).bind(
+            model_config=mc, num_slots=4,
+            max_len=128 if on_tpu else 64, prefill_buckets=[16],
+            max_new_tokens=24, chunk_steps=1)
+        sh = serve.run(dep, timeout=600)
+        prompt = [5, 11, 2]
+
+        def chunk_gaps_ms(inject: bool):
+            if inject:
+                fault_injection.inject("stream_resume", "drop",
+                                       "replay_stream_bench", times=1)
+            try:
+                ts = [time.perf_counter()]
+                for _ in sh.stream(prompt, 24):
+                    ts.append(time.perf_counter())
+            finally:
+                fault_injection.clear()
+            # drop the TTFT gap: the rows price steady-state + resume
+            return [(b - a) * 1e3 for a, b in zip(ts[1:], ts[2:])]
+
+        chunk_gaps_ms(False)  # warm the stream path
+        steady = sorted(chunk_gaps_ms(False))
+        median_gap = steady[len(steady) // 2]
+        resume_gap = max(chunk_gaps_ms(True))
+        rows.append(_row("serve_stream_resume_added_ttft_ms",
+                         max(0.1, resume_gap - median_gap), "ms"))
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+        runtime_context.set_core(prev)
+        del _os.environ["RTPU_SERVE_REQUEST_REPLAY"]
+        config.reload()
+
+
 def bench_node_drain(rows: list):
     """node_drain_ms: cordon-to-DRAINED wall time for a 2-node cluster
     whose draining node hosts a restartable actor — the window covers
@@ -1690,6 +1793,14 @@ def main():
     except Exception as e:  # pragma: no cover
         rows.append({"metric": "serve_p99_ttft_overload_ms", "value": -1,
                      "unit": f"error: {e}"})
+
+    # serving-plane request fault tolerance: mid-flight replica kill
+    # recovery + mid-stream resume cost (ISSUE 20)
+    try:
+        bench_serve_replay(rows)
+    except Exception as e:  # pragma: no cover
+        rows.append({"metric": "serve_replica_kill_recovery_ms",
+                     "value": -1, "unit": f"error: {e}"})
 
     # planned-removal lifecycle: cordon -> actor migration -> DRAINED
     # (ISSUE 16: drain must move work, not kill it)
@@ -1950,6 +2061,10 @@ def main():
              "serve_disagg_on_p99_itl_ms", False),
             ("serve_disagg_itl_tail_ratio",
              "serve_disagg_itl_tail_ratio", True),
+            ("serve_replica_kill_recovery_ms",
+             "serve_replica_kill_recovery_ms", False),
+            ("serve_stream_resume_added_ttft_ms",
+             "serve_stream_resume_added_ttft_ms", False),
         ]
         for pub_key, row_key, hib in checks:
             pub, got = published.get(pub_key), by_name.get(row_key)

@@ -217,6 +217,12 @@ _FLAGS: List[Flag] = [
          "stealing decode ITL. Off (default) prefills inline, exactly "
          "the seed engine. serve.disagg.engine_class() resolves the "
          "flag for deployments."),
+    Flag("serve_eject_ttft_ratio", float, 3.0,
+         "Gray-replica detection bar (serve_replica_ejection on): a "
+         "replica whose TTFT EWMA exceeds this multiple of the median "
+         "of its peers' EWMAs (after a minimum observation count) is "
+         "ejected from the router's pick set until the hysteresis "
+         "cooldown expires or the controller replaces it."),
     Flag("serve_max_queue_depth", int, 0,
          "Default per-deployment admission cap: router-local requests in "
          "flight (admitted, not yet completed) beyond which new requests "
@@ -231,10 +237,37 @@ _FLAGS: List[Flag] = [
          "prefills diverted prompts concurrently with decode, handing "
          "finished pages off as they complete. More workers overlap "
          "more heavy prompts at the cost of staging-pool HBM."),
+    Flag("serve_replay_max_attempts", int, 3,
+         "Total dispatch attempts per request under serve_request_replay "
+         "(first try + replays). Every replay re-picks a replica via the "
+         "affinity scorer; an exhausted budget surfaces "
+         "ReplicaUnavailableError carrying the attempt count and the "
+         "last cause."),
+    Flag("serve_replica_ejection", bool, False,
+         "Gray-replica ejection: the router scores per-replica health "
+         "(TTFT EWMA outlier vs the deployment median, consecutive "
+         "dispatch-failure streak, engine-poll staleness) and stops "
+         "picking ejected replicas; routers report ejections with their "
+         "load reports and the controller probes and replaces "
+         "persistently gray replicas (reports that stop refreshing "
+         "restore the replica instead). Off (default) keeps the pick "
+         "path byte-identical to the seed pow-2 router."),
     Flag("serve_replica_wait_s", float, 30.0,
          "How long the router waits for a running replica to appear "
          "before failing the request with ReplicaUnavailableError "
          "(deployment deleted, never deployed, or all replicas down)."),
+    Flag("serve_request_replay", bool, False,
+         "Durable request replay: every unary/batch/call_method request "
+         "carries a dedup nonce recorded in the router's request "
+         "ledger; on replica death or call timeout the router re-picks "
+         "(affinity-aware) and replays up to serve_replay_max_attempts, "
+         "with replica-side nonce dedup collapsing at-least-once "
+         "execution to exactly-once results. Also enables mid-stream "
+         "resume: an engine token stream that loses its replica "
+         "resubmits prompt + delivered tokens to the best affinity "
+         "candidate and splices at the delivered-token watermark. Off "
+         "(default) keeps the seed 3-attempt retry loops and the wire "
+         "payloads byte-identical."),
     Flag("serve_shutdown_grace_s", float, 15.0,
          "How long serve controller shutdown waits for backgrounded "
          "replica stops (graceful_shutdown + kill) to finish before "

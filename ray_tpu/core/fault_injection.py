@@ -96,6 +96,31 @@ site           key                      actions
                                         must recover the job. Fires in
                                         the agent's process, so
                                         in-process ``inject`` works
+``serve_replica_kill``  "<deployment>:<replica id>"  ``die`` — the serve
+                                        router observes a synthetic
+                                        ActorDiedError for the replica
+                                        it just picked BEFORE the call
+                                        dispatches (a lost request: the
+                                        replay must re-pick and re-
+                                        execute); ``die_after`` — the
+                                        call executes on the replica,
+                                        then the router discards the
+                                        result and observes the death
+                                        (a lost reply: the replay must
+                                        be absorbed by replica-side
+                                        nonce dedup for exactly-once).
+                                        Fires in the router's process,
+                                        so in-process ``inject`` works
+``stream_resume``  deployment name      ``drop`` — an engine token
+                                        stream observes replica death
+                                        right after delivering its next
+                                        chunk, forcing the mid-stream
+                                        resume path (serve_request_
+                                        replay): re-pick, resubmit
+                                        prompt + delivered tokens,
+                                        splice at the watermark. Fires
+                                        in the router's process, so
+                                        in-process ``inject`` works
 =============  =======================  ==================================
 
 Env/config surface: ``RTPU_FAULT_<SITE>=<action>[:<times>[:<match>]]``
@@ -129,7 +154,8 @@ from ray_tpu.util.debug_lock import make_lock
 
 SITES = ("get", "spill", "dispatch", "task", "actor_call",
          "actor_worker_kill", "gcs_kill", "gang_resize", "serve_overload",
-         "job_claim", "prefill_handoff")
+         "job_claim", "prefill_handoff", "serve_replica_kill",
+         "stream_resume")
 
 _lock = make_lock("fault_injection._lock")
 _specs: Dict[str, List[dict]] = {}

@@ -154,23 +154,45 @@ class BackpressureError(RayTpuError):
 
 
 class ReplicaUnavailableError(RayTpuError):
-    """No running replica could be found for a deployment within the
-    router's wait window (``serve_replica_wait_s``): the deployment was
-    deleted, never deployed, or every replica is down/restarting. Unlike
+    """No running replica could serve a deployment's request: none
+    appeared within the router's wait window (``serve_replica_wait_s``
+    — deleted, never deployed, or every replica down/restarting), or
+    the request's replay budget ran out across replica deaths. Unlike
     ``BackpressureError`` this is not load-dependent — retrying sooner
     will not help until the control plane brings replicas back. The HTTP
-    proxy maps it to 503."""
+    proxy maps it to 503.
 
-    def __init__(self, message: str = "", deployment: str = ""):
+    ``attempts`` counts the dispatch attempts the router spent before
+    giving up (0 when no replica was ever picked) and ``last_cause``
+    carries the final attempt's error (usually ActorDiedError), so
+    callers can distinguish "never had a replica" from "replicas kept
+    dying under the request"."""
+
+    def __init__(self, message: str = "", deployment: str = "",
+                 attempts: int = 0, last_cause=None):
         self.deployment = deployment
+        self.attempts = int(attempts)
+        self.last_cause = last_cause
         if not message:
-            message = (f"no running replicas for deployment {deployment!r}"
-                       if deployment else "no running replicas")
+            if self.attempts:
+                message = (
+                    f"request to deployment {deployment!r} failed after "
+                    f"{self.attempts} attempt(s)")
+                if last_cause is not None:
+                    message += f"; last cause: {last_cause!r}"
+            else:
+                message = (
+                    f"no running replicas for deployment {deployment!r}"
+                    if deployment else "no running replicas")
         self._message = message
         super().__init__(message)
 
     def __reduce__(self):
-        return (type(self), (self._message, self.deployment))
+        # rebuild from the original fields (not the composed message) so
+        # a pickle round-trip neither doubles the suffix nor drops the
+        # structured attempt count / cause
+        return (type(self), (self._message, self.deployment,
+                             self.attempts, self.last_cause))
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):

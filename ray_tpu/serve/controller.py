@@ -19,6 +19,16 @@ import ray_tpu
 
 HEALTH_CHECK_PERIOD_S = 1.0
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+#: gray-replica handling (serve_replica_ejection): routers report the
+#: replicas they have locally ejected; a report not renewed within the
+#: expiry restores the replica, one gray continuously for the replace
+#: window gets probed (ping with a short timeout) and replaced — a
+#: slow-but-alive replica passes the liveness ping yet still serves 10x
+#: TTFT, so persistence of the routers' ejection IS the replace signal
+GRAY_REPORT_EXPIRY_S = 3.0
+GRAY_REPLACE_AFTER_S = 5.0
+GRAY_PROBE_TIMEOUT_S = 2.0
+GRAY_REPLACE_COOLDOWN_S = 10.0
 #: KV rendezvous key the controller publishes serve demand under; the
 #: cluster autoscaler (autoscaler_v2) reads it so serve queue depth and
 #: TTFT percentiles count as demand alongside task queues + pending PGs.
@@ -61,6 +71,12 @@ class _DeploymentInfo:
         # cache-affinity telemetry: router_id -> (residency summary, ts);
         # the summary maps replica_id -> cached prefix-chain count
         self.residency: Dict[str, tuple] = {}
+        # gray-replica reports: replica_id -> (first_reported_ts,
+        # last_reported_ts); entries renew while any router still
+        # ejects the replica and expire GRAY_REPORT_EXPIRY_S after the
+        # last report (the replica recovered: restore, don't replace)
+        self.gray: Dict[str, tuple] = {}
+        self.last_gray_replace = 0.0
 
     @staticmethod
     def _initial_target(cfg: dict) -> int:
@@ -128,7 +144,8 @@ class ServeController:
     def report_load(self, name: str, router_id: str, load: int,
                     queue_depth: Optional[int] = None,
                     ttft_ms: Optional[List[float]] = None,
-                    residency: Optional[dict] = None) -> None:
+                    residency: Optional[dict] = None,
+                    gray: Optional[List[str]] = None) -> None:
         """Routers push their in-flight count per deployment (reference:
         handles push autoscaling metrics to the controller); reports
         expire so a vanished router stops counting. QoS-era routers also
@@ -136,9 +153,11 @@ class ServeController:
         since the last report; cache-affinity routers additionally carry
         a residency summary ({"replicas": {rid: cached chain count},
         "cached_chains": total}) aggregated into status() /
-        demand_snapshot(). Every extension defaults None, so the legacy
-        3-positional, the QoS 5-arg, and the 6-arg shapes all land
-        here unchanged."""
+        demand_snapshot(); ejection-era routers (serve_replica_ejection)
+        carry the replica ids they currently hold gray — the control
+        loop probes and replaces the persistently gray. Every extension
+        defaults None, so the legacy 3-positional, the QoS 5-arg, the
+        6-arg, and the 7-arg shapes all land here unchanged."""
         with self._lock:
             info = self._deployments.get(name)
             if info is not None:
@@ -150,6 +169,9 @@ class ServeController:
                     info.ttft_ms.extend(float(x) for x in ttft_ms)
                 if residency is not None:
                     info.residency[router_id] = (dict(residency), now)
+                for rid in (gray or ()):
+                    first, _ = info.gray.get(rid, (now, now))
+                    info.gray[rid] = (first, now)
 
     def get_replicas(self, name: str):
         """(version, [(replica_id, actor_name)]) for router refresh."""
@@ -321,6 +343,7 @@ class ServeController:
             try:
                 self._reconcile()
                 self._health_check()
+                self._probe_gray()
                 self._notify_topology_changes()
                 now = time.monotonic()
                 if now - last_publish >= _DEMAND_PUBLISH_PERIOD_S:
@@ -487,6 +510,56 @@ class ServeController:
         self._stop_threads = [x for x in self._stop_threads
                               if x.is_alive()] + [t]
         t.start()
+
+    def _probe_gray(self):
+        """Act on the routers' gray-replica reports: expire entries no
+        router has renewed (the replica recovered — routers restore it
+        locally after their own cooldown, the controller just forgets),
+        drop entries for replicas that already left the deployment, and
+        probe-then-replace one that has stayed gray past the replace
+        window. The probe is a short-timeout ping: whether it passes
+        (slow-but-alive, the gray signature) or fails (wedged), the
+        replica is replaced — persistence of the ejection is the
+        signal, the probe only distinguishes the two for the kill path
+        having a live target. Replacement is rate-limited to one per
+        cooldown per deployment so a fleet-wide slowdown (overload, not
+        grayness) cannot cascade into mass replacement."""
+        now = time.monotonic()
+        victims = []
+        with self._lock:
+            for info in self._deployments.values():
+                for rid, (first, last_ts) in list(info.gray.items()):
+                    if now - last_ts >= GRAY_REPORT_EXPIRY_S:
+                        del info.gray[rid]
+                        continue
+                    r = info.replicas.get(rid)
+                    if r is None or r.state != "RUNNING":
+                        del info.gray[rid]
+                        continue
+                    if (now - first >= GRAY_REPLACE_AFTER_S
+                            and now - info.last_gray_replace
+                            >= GRAY_REPLACE_COOLDOWN_S
+                            and sum(1 for x in info.replicas.values()
+                                    if x.state == "RUNNING") > 1):
+                        info.last_gray_replace = now
+                        info.gray.pop(rid, None)
+                        victims.append((info, r))
+                        break  # at most one per deployment per sweep
+
+        def probe_and_replace(info, r):
+            try:
+                ray_tpu.get(r.handle.ping.remote(),
+                            timeout=GRAY_PROBE_TIMEOUT_S)
+            except Exception:  # noqa: BLE001 — wedged, not just slow
+                pass
+            with self._lock:
+                if r.replica_id in info.replicas:
+                    self._stop_replica(info, r)
+            # _reconcile starts the replacement on its next tick
+
+        for info, r in victims:
+            threading.Thread(target=probe_and_replace, args=(info, r),
+                             daemon=True).start()
 
     def _health_check(self):
         now = time.monotonic()

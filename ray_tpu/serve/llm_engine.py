@@ -201,6 +201,7 @@ class LLMEngine:
         self._in: "queue.Queue[tuple]" = queue.Queue()
         self._cancelled: Dict[str, float] = {}  # req_id -> cancel time
         self._done: Dict[str, Any] = {}
+        self._seen_ids: Dict[str, float] = {}  # req_id -> submit time
         self._done_lock = threading.Lock()
         self._steps = 0
         self._key_ctr = 0
@@ -239,9 +240,23 @@ class LLMEngine:
         slot applies its own temperature on-device. ``stop_ids``: extra
         per-request stop tokens besides the engine's eos_id (generation
         ends when any is produced; the stop token is kept in the
-        output, reference: vLLM SamplingParams.stop_token_ids)."""
+        output, reference: vLLM SamplingParams.stop_token_ids).
+
+        ``req_id`` is the request's identity: a duplicate submit (router
+        replay racing a lost-but-delivered first submit) is dropped so
+        at-least-once delivery still runs the generation exactly once —
+        the original's result lands in the mailbox under the same id."""
+        now = time.monotonic()
+        with self._done_lock:
+            if len(self._seen_ids) > 2048:
+                cutoff = now - 600.0
+                self._seen_ids = {r: t for r, t in self._seen_ids.items()
+                                  if t > cutoff}
+            if req_id in self._seen_ids:
+                return
+            self._seen_ids[req_id] = now
         self._in.put((req_id, list(prompt_tokens),
-                      max_new_tokens or self._max_new, time.monotonic(),
+                      max_new_tokens or self._max_new, now,
                       float(temperature),
                       frozenset(int(t) for t in (stop_ids or ()))))
 

@@ -70,6 +70,7 @@ class TtftEstimator:
     def __init__(self, alpha: float = 0.3):
         self.alpha = min(1.0, max(0.01, float(alpha)))
         self._ewma: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}  # observations per replica
         self._samples: list = []  # recent TTFTs in ms, drained by reports
         self._lock = threading.Lock()
 
@@ -80,6 +81,7 @@ class TtftEstimator:
             self._ewma[replica_id] = (
                 ttft_s if prev is None
                 else prev + self.alpha * (ttft_s - prev))
+            self._count[replica_id] = self._count.get(replica_id, 0) + 1
             self._samples.append(ttft_s * 1e3)
             if len(self._samples) > self.MAX_SAMPLES:
                 del self._samples[:len(self._samples) - self.MAX_SAMPLES]
@@ -87,6 +89,14 @@ class TtftEstimator:
     def drop_replica(self, replica_id: str) -> None:
         with self._lock:
             self._ewma.pop(replica_id, None)
+            self._count.pop(replica_id, None)
+
+    def snapshot(self) -> Dict[str, tuple]:
+        """{replica_id: (ewma_s, observation count)} — the input to
+        gray-replica outlier scoring (serve/retry.py ReplicaHealth)."""
+        with self._lock:
+            return {rid: (ewma, self._count.get(rid, 0))
+                    for rid, ewma in self._ewma.items()}
 
     def drain_samples(self) -> list:
         with self._lock:

@@ -9,9 +9,14 @@ replica's queue never blocks behind a long generation.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Dict, List
 
 import ray_tpu
+
+#: bound on the applied-results memo: old entries age out FIFO once the
+#: router has long since resolved (or abandoned) the request
+_APPLIED_LIMIT = 1024
 
 
 @ray_tpu.remote
@@ -45,6 +50,16 @@ class ReplicaActor:
                 self._collect_takes_ids = len(sig.parameters) >= 1
             except (TypeError, ValueError):
                 pass
+        # exactly-once dedup memo: requests dispatched under
+        # serve_request_replay carry a nonce; a replayed nonce whose
+        # first attempt already executed here (reply lost, not request)
+        # returns the recorded result instead of re-running side effects
+        self._applied: OrderedDict = OrderedDict()
+
+    def _applied_put(self, nonce: str, result: Any) -> None:
+        self._applied[nonce] = result
+        while len(self._applied) > _APPLIED_LIMIT:
+            self._applied.popitem(last=False)
 
     def ping(self) -> str:
         return "ok"
@@ -65,7 +80,13 @@ class ReplicaActor:
 
     def handle(self, args: tuple, kwargs: dict) -> Any:
         from ray_tpu.serve.multiplex import _MUX_KWARG, _current_model_id
+        from ray_tpu.serve.retry import _NONCE_KWARG
 
+        nonce = kwargs.pop(_NONCE_KWARG, None)
+        if nonce is not None and nonce in self._applied:
+            # replay of a request that already executed here (the reply
+            # was lost, not the request): exactly-once, skip re-execution
+            return self._applied[nonce]
         deadline = self._check_deadline(kwargs)
         if deadline is not None and self._is_pipeline:
             kwargs["_deadline"] = deadline
@@ -73,10 +94,14 @@ class ReplicaActor:
         if mid is not None:
             token = _current_model_id.set(mid)
             try:
-                return self._call(*args, **kwargs)
+                out = self._call(*args, **kwargs)
             finally:
                 _current_model_id.reset(token)
-        return self._call(*args, **kwargs)
+        else:
+            out = self._call(*args, **kwargs)
+        if nonce is not None:
+            self._applied_put(nonce, out)
+        return out
 
     @staticmethod
     def _check_deadline(kwargs: dict):
@@ -116,17 +141,43 @@ class ReplicaActor:
     def handle_batch(self, requests: List[tuple]) -> List[Any]:
         """Dynamic batching: the router flushes a list of (args, kwargs);
         the deployment's batch callable receives the list of first args
-        (reference @serve.batch semantics: fn(list) -> list)."""
+        (reference @serve.batch semantics: fn(list) -> list). Under
+        replay each member carries its own nonce: a replayed batch runs
+        the callable only on members this replica has not executed yet
+        (a prior attempt may have partially/fully executed before the
+        reply was lost) and splices memoized results back in order."""
+        from ray_tpu.serve.retry import _NONCE_KWARG
+
+        nonces = [kw.pop(_NONCE_KWARG, None) for _, kw in requests]
         items = [a[0] if a else None for a, _ in requests]
-        out = self._call(items)
-        if not isinstance(out, (list, tuple)) or len(out) != len(items):
-            raise ValueError(
-                "@serve.batch callable must return a list of the same "
-                f"length as its input (got {type(out).__name__})")
-        return list(out)
+        fresh = [i for i, n in enumerate(nonces)
+                 if n is None or n not in self._applied]
+        results: List[Any] = [None] * len(items)
+        if fresh:
+            out = self._call([items[i] for i in fresh])
+            if not isinstance(out, (list, tuple)) or len(out) != len(fresh):
+                raise ValueError(
+                    "@serve.batch callable must return a list of the same "
+                    f"length as its input (got {type(out).__name__})")
+            for i, r in zip(fresh, out):
+                results[i] = r
+                if nonces[i] is not None:
+                    self._applied_put(nonces[i], r)
+        for i, n in enumerate(nonces):
+            if i not in fresh and n is not None:
+                results[i] = self._applied[n]
+        return results
 
     def call_method(self, method: str, args: tuple, kwargs: dict) -> Any:
-        return getattr(self._instance, method)(*args, **kwargs)
+        from ray_tpu.serve.retry import _NONCE_KWARG
+
+        nonce = kwargs.pop(_NONCE_KWARG, None)
+        if nonce is not None and nonce in self._applied:
+            return self._applied[nonce]
+        out = getattr(self._instance, method)(*args, **kwargs)
+        if nonce is not None:
+            self._applied_put(nonce, out)
+        return out
 
     # ---- engine mailbox ----------------------------------------------------
 

@@ -31,10 +31,14 @@ import ray_tpu
 from ray_tpu.core import fault_injection
 from ray_tpu.core.config import config
 from ray_tpu.exceptions import (ActorDiedError, BackpressureError,
+                                GetTimeoutError, ObjectTimeoutError,
                                 ReplicaUnavailableError, TaskError)
 from ray_tpu.serve.qos import (TtftEstimator, depth_limit,
                                normalize_priority, qos_from_config,
                                retry_after_hint)
+from ray_tpu.serve.retry import (_NONCE_KWARG, ReplicaHealth,
+                                 RequestLedger, exhausted_error,
+                                 replay_attempts, run_with_replay)
 
 #: internal kwarg carrying a request's wall-clock deadline to the
 #: replica (popped in ReplicaActor.handle, same pattern as _MUX_KWARG)
@@ -150,6 +154,11 @@ class Router:
         self._qos = qos_from_config(cfg)
         self._depth = 0  # admitted, not yet completed (all paths)
         self._ttft = TtftEstimator(config.serve_ttft_ewma_alpha)
+        # request fault tolerance (serve/retry.py): the replay ledger
+        # mints dedup nonces under serve_request_replay; per-replica
+        # gray scoring ejects outliers under serve_replica_ejection
+        self._ledger = RequestLedger()
+        self._health = ReplicaHealth()
         qos_active = (self._qos["max_queue_depth"] > 0
                       or self._qos["deadline_s"] is not None
                       or "priority" in cfg)
@@ -164,7 +173,8 @@ class Router:
         # reports (the controller then also sees residency aggregates)
         self._report_enabled = (self._autoscaling or qos_active
                                 or (config.serve_cache_affinity
-                                    and self._engine))
+                                    and self._engine)
+                                or config.serve_replica_ejection)
         self._report_thread: Optional[threading.Thread] = None
         if self._report_enabled:
             import os as _os
@@ -362,7 +372,16 @@ class Router:
                     residency = None
                     if config.serve_cache_affinity and self._engine:
                         residency = self._poll_residency()
-                    if residency is not None:
+                    gray = (self._health.ejected_ids()
+                            if config.serve_replica_ejection else [])
+                    if gray:
+                        # 7-arg shape: the controller probes gray
+                        # replicas and replaces the persistently slow
+                        ref = self._controller.report_load.remote(
+                            self._name, self._router_id, load,
+                            max(load, depth), self._ttft.drain_samples(),
+                            residency, gray)
+                    elif residency is not None:
                         ref = self._controller.report_load.remote(
                             self._name, self._router_id, load,
                             max(load, depth), self._ttft.drain_samples(),
@@ -439,8 +458,24 @@ class Router:
 
     def _observe_ttft(self, rid: str, dt_s: float):
         """Feed an observed TTFT (streams: submit to first chunk; unary
-        paths: full call latency as the proxy) into the estimator."""
+        paths: full call latency as the proxy) into the estimator; under
+        ejection the same observation feeds gray scoring — a replica
+        whose EWMA is an outlier vs its peers' median stops being picked
+        until it recovers or the controller replaces it."""
         self._ttft.observe(rid, dt_s)
+        if config.serve_replica_ejection:
+            self._health.note_ttft(rid, self._ttft.snapshot(),
+                                   config.serve_eject_ttft_ratio)
+
+    def _note_replica_failure(self, rid: str):
+        """A dispatch to ``rid`` failed with replica loss (real or
+        injected): drop it from the routing set, force-refresh so the
+        next pick sees the controller's view, and — under ejection —
+        count the failure toward the gray streak."""
+        if config.serve_replica_ejection:
+            self._health.note_failure(rid)
+        self._drop_replica(rid)
+        self._refresh(force=True)
 
     # ------------------------------------------------------------- replicas
 
@@ -473,7 +508,8 @@ class Router:
 
     def _pick(self, model_id: Optional[str] = None,
               prompt_tokens: Optional[list] = None,
-              session_id: Optional[str] = None) -> Tuple[str, Any]:
+              session_id: Optional[str] = None,
+              avoid: Optional[set] = None) -> Tuple[str, Any]:
         """Power-of-two-choices on local in-flight counts; with a
         multiplexed ``model_id``, prefer the replica that already loaded
         that variant (reference: multiplex-aware replica scheduler) unless
@@ -497,6 +533,20 @@ class Router:
             if time.monotonic() > deadline:
                 raise ReplicaUnavailableError(deployment=self._name)
             time.sleep(0.05)
+        if config.serve_replica_ejection:
+            # ejected (gray) replicas stop receiving picks; the filter
+            # never empties the candidate set (all-gray → full list).
+            # Flag off this branch never runs: pow-2 stays byte-identical
+            replicas = self._health.filter(replicas)
+        if avoid:
+            # replay re-pick: skip replicas this request already watched
+            # die — the controller's health check may not have noticed
+            # yet, so a forced refresh can re-add the corpse and burn
+            # the whole replay budget on it. Empty on first attempts,
+            # so the pow-2 path is untouched; never empties the
+            # candidate set (a sole survivor is retried regardless)
+            alive = [r for r in replicas if r[0] not in avoid]
+            replicas = alive or replicas
         if model_id is not None:
             with self._lock:
                 rid = self._mux_affinity.get(model_id)
@@ -573,6 +623,10 @@ class Router:
                         if r == rid]:
                 del self._session_affinity[sid]
         self._ttft.drop_replica(rid)
+        # gray-health state deliberately survives the drop: a force
+        # refresh re-adds a slow-but-alive replica immediately, and its
+        # failure streak must keep accruing across that cycle (entries
+        # for genuinely replaced replicas age out via the cooldown)
 
     # --------------------------------------------------------------- routing
 
@@ -622,32 +676,18 @@ class Router:
         fut: Future = Future()
 
         def run():
-            err: Optional[BaseException] = None
-            for _ in range(3):
-                try:
-                    rid, handle = self._pick()
-                except ReplicaUnavailableError as e:
-                    fut.set_exception(e)
-                    return
-                with self._lock:
-                    self._inflight[rid] = self._inflight.get(rid, 0) + 1
-                try:
-                    out = ray_tpu.get(
-                        handle.call_method.remote(method, args, kwargs))
-                    fut.set_result(out)
-                    return
-                except ActorDiedError as e:
-                    self._drop_replica(rid)
-                    self._refresh(force=True)
-                    err = e
-                except BaseException as e:  # noqa: BLE001 — app error: no retry
-                    fut.set_exception(e)
-                    return
-                finally:
-                    with self._lock:
-                        if rid in self._inflight:
-                            self._inflight[rid] -= 1
-            fut.set_exception(err or RuntimeError("request failed"))
+            def attempt(rid, handle, nonce):
+                kw = (kwargs if nonce is None
+                      else dict(kwargs, **{_NONCE_KWARG: nonce}))
+                return ray_tpu.get(
+                    handle.call_method.remote(method, args, kw))
+
+            status, out = run_with_replay(
+                self, lambda failed: self._pick(avoid=failed), attempt)
+            if status == "ok":
+                fut.set_result(out)
+            else:
+                fut.set_exception(out)
         threading.Thread(target=run, daemon=True).start()
         return fut
 
@@ -660,40 +700,29 @@ class Router:
             kwargs = dict(kwargs, **{_MUX_KWARG: model_id})
         if deadline_wall is not None:
             kwargs = dict(kwargs, **{_DEADLINE_KWARG: deadline_wall})
-        err: Optional[BaseException] = None
-        for _ in range(3):  # retry across replicas on replica death
-            try:
-                rid, handle = self._pick(model_id, session_id=session_id)
-            except ReplicaUnavailableError as e:
-                fut.set_exception(e)
-                return
-            with self._lock:
-                self._inflight[rid] = self._inflight.get(rid, 0) + 1
+
+        def attempt(rid, handle, nonce):
+            kw = (kwargs if nonce is None
+                  else dict(kwargs, **{_NONCE_KWARG: nonce}))
             t0 = time.monotonic()
-            try:
-                out = ray_tpu.get(handle.handle.remote(args, kwargs))
-                self._observe_ttft(rid, time.monotonic() - t0)
-                fut.set_result(out)
-                return
-            except ActorDiedError as e:
-                self._drop_replica(rid)
-                self._refresh(force=True)
-                err = e
-            except TaskError as e:
-                # surface the replica's typed shed (deadline expired
-                # before execution) unwrapped, like a router-side shed
-                cause = e.cause
-                fut.set_exception(
-                    cause if isinstance(cause, BackpressureError) else e)
-                return
-            except BaseException as e:  # noqa: BLE001 — application error
-                fut.set_exception(e)
-                return
-            finally:
-                with self._lock:
-                    if rid in self._inflight:
-                        self._inflight[rid] -= 1
-        fut.set_exception(err or RuntimeError("request failed"))
+            out = ray_tpu.get(handle.handle.remote(args, kw))
+            self._observe_ttft(rid, time.monotonic() - t0)
+            return out
+
+        status, out = run_with_replay(
+            self, lambda failed: self._pick(model_id,
+                                            session_id=session_id,
+                                            avoid=failed),
+            attempt)
+        if status == "ok":
+            fut.set_result(out)
+            return
+        if isinstance(out, TaskError) and isinstance(out.cause,
+                                                     BackpressureError):
+            # surface the replica's typed shed (deadline expired before
+            # execution) unwrapped, like a router-side shed
+            out = out.cause
+        fut.set_exception(out)
 
     # -------------------------------------------------------------- batching
 
@@ -717,38 +746,31 @@ class Router:
                 self._flush_batch(batch)
 
     def _flush_batch(self, batch):
-        reqs = [(a, k) for a, k, _ in batch]
         futs = [f for _, _, f in batch]
-        err: Optional[BaseException] = None
-        for _ in range(3):
-            try:
-                rid, handle = self._pick()
-            except ReplicaUnavailableError as e:
-                for f in futs:
-                    f.set_exception(e)
-                return
-            with self._lock:
-                self._inflight[rid] = self._inflight.get(rid, 0) + len(batch)
+
+        def attempt(rid, handle, nonce):
+            if nonce is None:
+                reqs = [(a, k) for a, k, _ in batch]
+            else:
+                # per-member nonces: handle_batch may have PARTIALLY
+                # executed before the reply was lost, so a replayed
+                # batch deduplicates member-by-member on the replica
+                reqs = [(a, dict(k, **{_NONCE_KWARG: f"{nonce}.{i}"}))
+                        for i, (a, k, _) in enumerate(batch)]
             t0 = time.monotonic()
-            try:
-                outs = ray_tpu.get(handle.handle_batch.remote(reqs))
-                self._observe_ttft(rid, time.monotonic() - t0)
-                for f, o in zip(futs, outs):
-                    f.set_result(o)
-                return
-            except ActorDiedError as e:
-                self._drop_replica(rid)
-                self._refresh(force=True)
-                err = e
-            except BaseException as e:  # noqa: BLE001
-                err = e
-                break
-            finally:
-                with self._lock:
-                    if rid in self._inflight:
-                        self._inflight[rid] -= len(batch)
-        for f in futs:
-            f.set_exception(err or RuntimeError("batch failed"))
+            outs = ray_tpu.get(handle.handle_batch.remote(reqs))
+            self._observe_ttft(rid, time.monotonic() - t0)
+            return outs
+
+        status, out = run_with_replay(
+            self, lambda failed: self._pick(avoid=failed), attempt,
+            weight=len(batch))
+        if status == "ok":
+            for f, o in zip(futs, out):
+                f.set_result(o)
+        else:
+            for f in futs:
+                f.set_exception(out)
 
     # ---------------------------------------------------------------- engine
 
@@ -871,19 +893,78 @@ class Router:
         Requires an engine with ``peek`` (the LLM engine); bounded by
         ``timeout_s`` overall and, when the request carries a deadline,
         shed typed (BackpressureError, generation cancelled) the moment
-        the deadline expires mid-flight."""
-        with self._lock:
-            self._req_seq += 1
-            req_id = f"s{id(self)}-{self._req_seq}"
-        rid, handle = self._pick(
-            prompt_tokens=self._prompt_of(args, kwargs),
-            session_id=session_id)
-        with self._lock:
-            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        the deadline expires mid-flight.
+
+        Under ``serve_request_replay`` the stream survives replica loss:
+        the router checkpoints a delivered-token watermark (tokens the
+        consumer has actually received), and on ActorDiedError — or the
+        injected ``stream_resume`` fault site — resubmits
+        ``prompt + tokens_so_far`` to the next pick (cache affinity makes
+        the replayed prefix cheap on a warm replica) with the new-token
+        budget shrunk by the watermark. The client stream splices at the
+        watermark: greedy decoding regenerates the identical
+        continuation, with no duplicated or missing tokens. Flag off,
+        replica loss kills the stream exactly as before."""
         t0 = time.monotonic()
         deadline = t0 + timeout_s
         req_deadline = None if deadline_s is None else t0 + deadline_s
-        first = True
+        delivered: list = []  # resume watermark: tokens the consumer got
+        max_attempts = replay_attempts()
+        attempts = 0
+        last: Optional[BaseException] = None
+        failed: set = set()
+        try:
+            while attempts < max_attempts:
+                attempts += 1
+                a, k = self._resume_call(args, kwargs, delivered)
+                if a is None:
+                    return  # watermark exhausted the budget: complete
+                with self._lock:
+                    self._req_seq += 1
+                    req_id = f"s{id(self)}-{self._req_seq}"
+                rid, handle = self._pick(
+                    prompt_tokens=self._prompt_of(a, k),
+                    session_id=session_id, avoid=failed)
+                with self._lock:
+                    self._inflight[rid] = self._inflight.get(rid, 0) + 1
+                try:
+                    yield from self._stream_attempt(
+                        rid, handle, req_id, a, k, delivered, t0,
+                        deadline, req_deadline, deadline_s, timeout_s)
+                    return
+                except ActorDiedError as e:
+                    if not config.serve_request_replay:
+                        # seed behavior: replica loss kills the stream
+                        self._drop_replica(rid)
+                        raise
+                    last = e
+                    failed.add(rid)
+                    self._note_replica_failure(rid)
+                except (GetTimeoutError, ObjectTimeoutError) as e:
+                    if not config.serve_request_replay:
+                        raise  # seed behavior: a poll timeout is terminal
+                    last = e
+                    failed.add(rid)
+                    self._note_replica_failure(rid)
+                finally:
+                    with self._lock:
+                        if rid in self._inflight:
+                            self._inflight[rid] = max(
+                                0, self._inflight[rid] - 1)
+            raise exhausted_error(self._name, attempts, last)
+        finally:
+            if token is not None:
+                token.release()
+
+    def _stream_attempt(self, rid: str, handle, req_id: str, args, kwargs,
+                        delivered: list, t0: float, deadline: float,
+                        req_deadline: Optional[float],
+                        deadline_s: Optional[float], timeout_s: float):
+        """One dispatch of an engine stream: submit + peek-poll, yielding
+        chunks of new tokens. Each chunk is appended to ``delivered``
+        (the resume watermark) only AFTER the consumer's ``next()``
+        returned — a chunk lost between peek and delivery replays."""
+        first = not delivered  # TTFT belongs to the original first token
         collected = False
         try:
             ray_tpu.get(handle.submit.remote(req_id, *args, **kwargs))
@@ -911,7 +992,15 @@ class Router:
                             self._observe_ttft(rid,
                                                time.monotonic() - t0)
                         yield new
+                        delivered.extend(new)
                         sent = snap["offset"] + len(new)
+                        if fault_injection.enabled():
+                            action = fault_injection.fire(
+                                "stream_resume", self._name)
+                            if action == "drop":
+                                raise ActorDiedError(
+                                    "injected stream_resume: engine "
+                                    f"replica {rid} died mid-stream")
                     if snap["done"]:
                         collected = True
                         ray_tpu.get(handle.collect.remote([req_id]),
@@ -928,9 +1017,6 @@ class Router:
                     raise TimeoutError(
                         f"stream {req_id} exceeded {timeout_s}s")
                 time.sleep(0.005)
-        except ActorDiedError:
-            self._drop_replica(rid)
-            raise
         finally:
             if not collected:
                 # abandoned/errored mid-stream: abort generation and
@@ -939,11 +1025,42 @@ class Router:
                     handle.cancel.remote(req_id)
                 except Exception:  # noqa: BLE001
                     pass
-            with self._lock:
-                if rid in self._inflight:  # dropped replicas stay dropped
-                    self._inflight[rid] = max(0, self._inflight[rid] - 1)
-            if token is not None:
-                token.release()
+
+    @staticmethod
+    def _resume_call(args, kwargs, delivered: list):
+        """Rebuild an engine submit call for mid-stream resume: the new
+        prompt is ``original prompt + delivered tokens`` (the prefix
+        cache makes the replay cheap) and the explicit new-token budget
+        shrinks by the watermark so the resumed generation stops exactly
+        where the uninterrupted one would. Returns (args, kwargs) —
+        unchanged when nothing was delivered yet — or (None, None) when
+        the watermark already exhausted the budget (stream complete).
+        Engines running on their default budget regenerate the remainder
+        under their own cap."""
+        if not delivered:
+            return args, kwargs
+        args = list(args)
+        kwargs = dict(kwargs)
+        prompt = args[0] if args else kwargs.get("prompt_tokens")
+        prompt = list(prompt) + [int(t) for t in delivered]
+        if args:
+            args[0] = prompt
+        else:
+            kwargs["prompt_tokens"] = prompt
+        max_new = None
+        if len(args) >= 2 and args[1] is not None:
+            max_new = int(args[1])
+        elif kwargs.get("max_new_tokens") is not None:
+            max_new = int(kwargs["max_new_tokens"])
+        if max_new is not None:
+            remaining = max_new - len(delivered)
+            if remaining <= 0:
+                return None, None
+            if len(args) >= 2 and args[1] is not None:
+                args[1] = remaining
+            else:
+                kwargs["max_new_tokens"] = remaining
+        return tuple(args), kwargs
 
     @staticmethod
     def _prompt_of(args: tuple, kwargs: dict) -> Optional[list]:
@@ -956,40 +1073,87 @@ class Router:
     def _engine_request(self, args, kwargs, fut: Future,
                         session_id: Optional[str] = None):
         """Submit to an engine replica's mailbox and poll its collect()."""
-        with self._lock:
-            self._req_seq += 1
-            req_id = f"r{id(self)}-{self._req_seq}"
-        try:
-            rid, handle = self._pick(
-                prompt_tokens=self._prompt_of(args, kwargs),
-                session_id=session_id)
-        except ReplicaUnavailableError as e:
-            fut.set_exception(e)
-            return
-        t0 = time.monotonic()
-        fut.add_done_callback(
-            lambda f: (f.exception() is None
-                       and self._observe_ttft(rid,
-                                              time.monotonic() - t0)))
-        with self._lock:
-            self._inflight[rid] = self._inflight.get(rid, 0) + 1
-            st = self._engine_state.setdefault(rid, {
-                "futures": {}, "poller": None, "handle": handle,
-            })
-            st["futures"][req_id] = fut
-        try:
-            ray_tpu.get(handle.submit.remote(req_id, *args, **kwargs))
-        except BaseException as e:  # noqa: BLE001
+        self._engine_dispatch(args, kwargs, fut, session_id, 0, None)
+
+    def _engine_dispatch(self, args, kwargs, fut: Future,
+                         session_id: Optional[str],
+                         attempts: int, last: Optional[BaseException],
+                         avoid: Optional[set] = None):
+        """Dispatch (or re-dispatch after replica loss) one engine
+        request: pick, submit to the replica's mailbox, and ensure its
+        collect poller. The req_id is fresh per attempt — the engine
+        deduplicates repeated submits of the SAME id (a replay racing a
+        delivered-but-unacked first submit runs the generation once),
+        while a fresh id on a NEW replica regenerates a request whose
+        result died with its replica. ``attempts``/``last``/``avoid``
+        carry the budget and the dead-replica set across _poll_engine
+        re-dispatches."""
+        max_attempts = replay_attempts()
+        avoid = set(avoid or ())
+        while attempts < max_attempts:
+            attempts += 1
             with self._lock:
-                st["futures"].pop(req_id, None)
-                self._inflight[rid] -= 1
-            fut.set_exception(e)
+                self._req_seq += 1
+                req_id = f"r{id(self)}-{self._req_seq}"
+            try:
+                rid, handle = self._pick(
+                    prompt_tokens=self._prompt_of(args, kwargs),
+                    session_id=session_id, avoid=avoid)
+            except ReplicaUnavailableError as e:
+                if last is not None:
+                    e = exhausted_error(self._name, attempts - 1, last)
+                fut.set_exception(e)
+                return
+            if fault_injection.enabled():
+                action = fault_injection.fire(
+                    "serve_replica_kill", f"{self._name}:{rid}")
+                if action in ("die", "die_after"):
+                    # both variants collapse on the mailbox path: the
+                    # submit (or the replica holding its result) is lost
+                    # before collect, and the fresh req_id on the next
+                    # attempt regenerates safely
+                    last = ActorDiedError(
+                        "injected serve_replica_kill: engine replica "
+                        f"{rid} died")
+                    avoid.add(rid)
+                    self._note_replica_failure(rid)
+                    continue
+            with self._lock:
+                self._inflight[rid] = self._inflight.get(rid, 0) + 1
+                st = self._engine_state.setdefault(rid, {
+                    "futures": {}, "poller": None, "handle": handle,
+                })
+                st["futures"][req_id] = {
+                    "fut": fut, "args": args, "kwargs": kwargs,
+                    "session_id": session_id, "attempts": attempts,
+                    "t0": time.monotonic(),
+                }
+            try:
+                ray_tpu.get(handle.submit.remote(req_id, *args, **kwargs))
+            except ActorDiedError as e:
+                with self._lock:
+                    st["futures"].pop(req_id, None)
+                    if rid in self._inflight:
+                        self._inflight[rid] -= 1
+                last = e
+                avoid.add(rid)
+                self._note_replica_failure(rid)
+                continue
+            except BaseException as e:  # noqa: BLE001 — app error: terminal
+                with self._lock:
+                    st["futures"].pop(req_id, None)
+                    if rid in self._inflight:
+                        self._inflight[rid] -= 1
+                fut.set_exception(e)
+                return
+            with self._lock:
+                if st["poller"] is None or not st["poller"].is_alive():
+                    st["poller"] = threading.Thread(
+                        target=self._poll_engine, args=(rid, st),
+                        daemon=True)
+                    st["poller"].start()
             return
-        with self._lock:
-            if st["poller"] is None or not st["poller"].is_alive():
-                st["poller"] = threading.Thread(
-                    target=self._poll_engine, args=(rid, st), daemon=True)
-                st["poller"].start()
+        fut.set_exception(exhausted_error(self._name, attempts, last))
 
     def _poll_engine(self, rid: str, st: dict):
         handle = st["handle"]
@@ -1002,26 +1166,43 @@ class Router:
                 # only this router's ids: collect() is destructive and
                 # other handles/processes poll the same engine
                 done = ray_tpu.get(handle.collect.remote(mine), timeout=60)
-            except BaseException as e:  # noqa: BLE001 — replica died
+            except BaseException as e:  # noqa: BLE001 — replica died/hung
                 with self._lock:
-                    futs = list(st["futures"].values())
+                    entries = list(st["futures"].values())
                     st["futures"].clear()
-                self._drop_replica(rid)
-                for f in futs:
-                    f.set_exception(e)
+                    self._inflight[rid] = max(
+                        0, self._inflight.get(rid, 0) - len(entries))
+                    self._engine_state.pop(rid, None)
+                self._note_replica_failure(rid)
+                # replica loss must not fail the in-flight requests:
+                # each re-dispatches with a fresh req_id against the
+                # next pick, up to its remaining replay budget
+                for ent in entries:
+                    threading.Thread(
+                        target=self._engine_dispatch,
+                        args=(ent["args"], ent["kwargs"], ent["fut"],
+                              ent["session_id"], ent["attempts"], e,
+                              {rid}),
+                        daemon=True).start()
                 return
+            resolved = []
             if done:
                 with self._lock:
-                    n = 0
                     for req_id, result in done.items():
-                        f = st["futures"].pop(req_id, None)
-                        if f is not None:
-                            n += 1
-                            if isinstance(result, Exception):
-                                f.set_exception(result)
-                            else:
-                                f.set_result(result)
+                        ent = st["futures"].pop(req_id, None)
+                        if ent is not None:
+                            resolved.append((ent, result))
                     self._inflight[rid] = max(
-                        0, self._inflight.get(rid, 0) - n)
+                        0, self._inflight.get(rid, 0) - len(resolved))
+            if resolved:
+                if config.serve_replica_ejection:
+                    self._health.note_ok(rid)
+                for ent, result in resolved:
+                    if isinstance(result, Exception):
+                        ent["fut"].set_exception(result)
+                    else:
+                        self._observe_ttft(rid,
+                                           time.monotonic() - ent["t0"])
+                        ent["fut"].set_result(result)
             else:
                 time.sleep(0.003)

@@ -115,6 +115,7 @@ def _bare_router(replica_ids, inflight=None):
     _pick/_pick_affinity/_drop_replica."""
     from ray_tpu.serve.qos import TtftEstimator
 
+    from ray_tpu.serve.retry import ReplicaHealth, RequestLedger
     from ray_tpu.serve.router import Router
 
     r = Router.__new__(Router)
@@ -126,6 +127,8 @@ def _bare_router(replica_ids, inflight=None):
     r._residency = {}
     r._session_affinity = {}
     r._ttft = TtftEstimator(0.5)
+    r._ledger = RequestLedger()
+    r._health = ReplicaHealth()
     r._refresh = lambda force=False: None  # shadow: no controller
     return r
 
